@@ -1,0 +1,161 @@
+"""Checkpoint save/load with a reference-parity meta layout.
+
+Counterpart of the reference's distributed dump/load (`client/Model.cpp:89-134`,
+`server/EmbeddingDumpOperator.cpp`, `EmbeddingLoadOperator.cpp`): a `model_meta` JSON at
+the root (sign, variables, version) plus per-variable payload directories; optimizer
+state optional (`include_optimizer`); load verifies meta and supports a different shard
+count than dump (the reference remaps keys `index*shard_num + shard_id` on load,
+`EmbeddingShardFile.h:23-25` — we store tables in **global id order**, so resharding is
+a pure relayout at load).
+
+This module is the single-host path (np arrays). The mesh-sharded variant
+(per-shard streams + async "persist" pmem-equivalent) lives in `parallel/checkpoint.py`
+and reuses the same meta format.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import uuid as uuid_mod
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .meta import (META_FORMAT_VERSION, ModelMeta, ModelVariableMeta)
+
+MODEL_META_FILE = "model_meta"  # same file name as the reference (`Model.cpp:88-108`)
+
+
+def _flatten_params(params, prefix="") -> Dict[str, np.ndarray]:
+    out = {}
+    if isinstance(params, dict):
+        for k, v in params.items():
+            out.update(_flatten_params(v, f"{prefix}{k}/"))
+    else:
+        out[prefix[:-1]] = np.asarray(params)
+    return out
+
+
+def _unflatten_params(flat: Dict[str, np.ndarray]) -> Any:
+    tree: Dict[str, Any] = {}
+    for key, value in flat.items():
+        parts = key.split("/")
+        node = tree
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = jnp.asarray(value)
+    return tree
+
+
+def save_server_model(state, model, path: str, *, include_optimizer: bool = True,
+                      model_sign: str = "") -> ModelMeta:
+    """Dump the full train state (reference: `exb.save_server_model` /
+    `Model::dump_model`). `state` is a `TrainState`; tables are written in global id
+    order so any future mesh size can load them."""
+    os.makedirs(path, exist_ok=True)
+    model_sign = model_sign or f"{uuid_mod.uuid4().hex}-{int(state.model_version)}"
+    meta = ModelMeta(model_sign=model_sign, uri=path, num_shards=1)
+
+    for name, spec in model.specs.items():
+        vdir = os.path.join(path, f"variable_{spec.variable_id}")
+        os.makedirs(vdir, exist_ok=True)
+        mv = ModelVariableMeta(
+            variable_id=spec.variable_id,
+            storage_name=name,
+            meta=spec.meta,
+            optimizer=spec.optimizer.to_config() if spec.optimizer else {},
+            initializer=spec.initializer.to_config(),
+            table={"category": "hash" if spec.use_hash_table else "array",
+                   "capacity": spec.capacity},
+        )
+        meta.variables.append(mv)
+        if spec.sparse_as_dense:
+            # sad tables live (and are restored from) dense_params.npz; writing a
+            # second copy here would just be dead weight on disk
+            continue
+        ts = state.tables[name]
+        np.save(os.path.join(vdir, "weights.npy"), np.asarray(ts.weights))
+        if ts.keys is not None:
+            np.save(os.path.join(vdir, "keys.npy"), np.asarray(ts.keys))
+        if include_optimizer:
+            for slot_name, arr in ts.slots.items():
+                np.save(os.path.join(vdir, f"slot_{slot_name}.npy"), np.asarray(arr))
+
+    dense = _flatten_params(state.dense_params)
+    np.savez(os.path.join(path, "dense_params.npz"), **dense)
+    if include_optimizer:
+        np.savez(os.path.join(path, "dense_slots.npz"),
+                 **_flatten_params(state.dense_slots))
+    meta.dense_manifest = {k: {"shape": list(v.shape), "dtype": str(v.dtype)}
+                           for k, v in dense.items()}
+    extra = {"step": int(state.step), "model_version": int(state.model_version),
+             "include_optimizer": include_optimizer}
+    with open(os.path.join(path, MODEL_META_FILE), "w") as f:
+        d = json.loads(meta.to_json())
+        d["extra"] = extra
+        json.dump(d, f, indent=2, sort_keys=True)
+    return meta
+
+
+def read_model_meta(path: str) -> ModelMeta:
+    with open(os.path.join(path, MODEL_META_FILE)) as f:
+        return ModelMeta.from_json(f.read())
+
+
+def load_server_model(state, model, path: str):
+    """Restore into an existing TrainState (reference: `exb.load_server_model` /
+    `Model::load_model` — meta check, clear all weights, stream per-variable files).
+    Returns the new TrainState."""
+    with open(os.path.join(path, MODEL_META_FILE)) as f:
+        raw = f.read()
+    meta = ModelMeta.from_json(raw)
+    extra = json.loads(raw).get("extra", {})
+    by_name = {v.storage_name: v for v in meta.variables}
+    for name, spec in model.specs.items():
+        if name not in by_name:
+            raise ValueError(f"checkpoint is missing variable {name!r} "
+                             f"(reference load_model rejects meta mismatch too)")
+        ckpt_meta = by_name[name].meta
+        if (ckpt_meta.embedding_dim != spec.meta.embedding_dim
+                or ckpt_meta.datatype != spec.meta.datatype):
+            raise ValueError(f"variable {name!r} meta mismatch: "
+                             f"{ckpt_meta} vs {spec.meta}")
+
+    dense_npz = np.load(os.path.join(path, "dense_params.npz"))
+    dense_params = _unflatten_params({k: dense_npz[k] for k in dense_npz.files})
+    slots_path = os.path.join(path, "dense_slots.npz")
+    dense_slots = state.dense_slots
+    if os.path.exists(slots_path):
+        z = np.load(slots_path)
+        dense_slots = _unflatten_params({k: z[k] for k in z.files})
+
+    new_tables = dict(state.tables)
+    for name, spec in model.specs.items():
+        if spec.sparse_as_dense:
+            continue
+        vdir = os.path.join(path, f"variable_{spec.variable_id}")
+        ts = state.tables[name]
+        weights = jnp.asarray(np.load(os.path.join(vdir, "weights.npy")))
+        slots = dict(ts.slots)
+        for slot_name in list(slots):
+            p = os.path.join(vdir, f"slot_{slot_name}.npy")
+            if os.path.exists(p):
+                slots[slot_name] = jnp.asarray(np.load(p))
+            # else: optimizer state was dumped without slots; keep fresh init
+            # (reference load with include_optimizer=False resets states too)
+        keys = ts.keys
+        kp = os.path.join(vdir, "keys.npy")
+        if keys is not None and os.path.exists(kp):
+            keys = jnp.asarray(np.load(kp))
+        new_tables[name] = ts.replace(weights=weights, slots=slots, keys=keys)
+
+    return state.replace(
+        step=jnp.asarray(extra.get("step", 0), jnp.int32),
+        model_version=jnp.asarray(extra.get("model_version", 0), jnp.int32),
+        dense_params=dense_params,
+        dense_slots=dense_slots,
+        tables=new_tables,
+    )

@@ -1,0 +1,223 @@
+"""Embedding table state + the user-facing `Embedding` layer spec.
+
+Counterpart of the reference's user API surface (`tensorflow/exb.py`):
+- `EmbeddingSpec` ~ the layer config (`Embedding.__init__`, `exb.py:388-419`):
+  input_dim (-1 = 2^63 hashed), output_dim, dtype, initializer, per-variable optimizer,
+  num_shards, sparse_as_dense.
+- `EmbeddingTableState` ~ the server-side storage for one variable
+  (`variable/EmbeddingTable.h` array table + optimizer slots from
+  `EmbeddingOptimizerVariable.h`) — here a pytree of jax.Arrays so it shards,
+  checkpoints and donates like any other train state.
+
+Row-sharding layout (matches the reference so checkpoints stay resharding-friendly,
+`EmbeddingPullOperator.cpp:74-84`): global id `i` lives on shard `i % S`, local row
+`i // S`. A single-device table is the S=1 special case.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import warnings
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from .initializers import Initializer, Uniform, make_initializer
+from .meta import EmbeddingVariableMeta, HASH_VOCABULARY_THRESHOLD
+from .optimizers import SparseOptimizer, make_optimizer
+from .ops.sparse import lookup_rows, sparse_apply_dense_table
+
+
+class EmbeddingTableState(struct.PyTreeNode):
+    """One variable's shard-local storage: weights + optimizer slots.
+
+    For `kind == "hash"` tables, `keys` maps slot -> global id (EMPTY sentinel = -1) and
+    lookups go through the open-addressing probe (`tables/hash_table.py`).
+    """
+
+    weights: jax.Array                    # (rows, dim)
+    slots: Dict[str, jax.Array]           # name -> (rows, k)
+    keys: Optional[jax.Array] = None      # (rows,) int64, hash tables only
+    # cumulative count of ids that failed to insert (hash tables only; the static-
+    # capacity divergence from the reference's unbounded table must be observable)
+    overflow: Optional[jax.Array] = None  # () int32
+
+
+@dataclasses.dataclass(frozen=True)
+class EmbeddingSpec:
+    """Static description of one embedding variable (hashable; safe as a jit static).
+
+    reference parity: `exb.py:388-443` (layer args) + `variable/Meta.h` (variable meta).
+    """
+
+    name: str
+    input_dim: int                       # -1 -> hashed 63-bit id space (hash table)
+    output_dim: int
+    datatype: str = "float32"
+    initializer: Initializer = dataclasses.field(default_factory=Uniform)
+    optimizer: Optional[SparseOptimizer] = None   # None -> use model default
+    num_shards: int = -1                 # -1 -> all mesh devices
+    sparse_as_dense: bool = False        # small tables: dense mirrored param instead
+    capacity: int = 0                    # hash tables: slots per build; 0 = auto
+    variable_id: int = -1
+
+    def __post_init__(self):
+        if self.input_dim == 0 or self.input_dim < -1:
+            raise ValueError(f"invalid input_dim {self.input_dim}")
+        if self.output_dim <= 0:
+            raise ValueError(f"invalid output_dim {self.output_dim}")
+
+    @property
+    def use_hash_table(self) -> bool:
+        return self.input_dim == -1 or self.input_dim >= HASH_VOCABULARY_THRESHOLD
+
+    @property
+    def vocabulary_size(self) -> int:
+        return HASH_VOCABULARY_THRESHOLD if self.use_hash_table else self.input_dim
+
+    @property
+    def meta(self) -> EmbeddingVariableMeta:
+        return EmbeddingVariableMeta(
+            datatype=self.datatype,
+            embedding_dim=self.output_dim,
+            vocabulary_size=-1 if self.use_hash_table else self.input_dim,
+        )
+
+    @property
+    def dtype(self):
+        return jnp.dtype(self.datatype) if self.datatype != "bfloat16" else jnp.bfloat16
+
+    def rows_per_shard(self, num_shards: int) -> int:
+        """ceil(vocab / S), the reference's `reserve_items`
+        (`EmbeddingInitOperator.cpp:146-168`)."""
+        if self.use_hash_table:
+            if self.capacity <= 0:
+                raise ValueError(
+                    f"hash-table variable {self.name!r} needs an explicit capacity")
+            return -(-self.capacity // num_shards)
+        return -(-self.input_dim // num_shards)
+
+    def to_config(self) -> dict:
+        return {
+            "name": self.name,
+            "input_dim": self.input_dim,
+            "output_dim": self.output_dim,
+            "datatype": self.datatype,
+            "initializer": self.initializer.to_config(),
+            "optimizer": self.optimizer.to_config() if self.optimizer else None,
+            "num_shards": self.num_shards,
+            "sparse_as_dense": self.sparse_as_dense,
+            "capacity": self.capacity,
+            "variable_id": self.variable_id,
+        }
+
+    @classmethod
+    def from_config(cls, d: dict) -> "EmbeddingSpec":
+        d = dict(d)
+        d["initializer"] = make_initializer(d["initializer"])
+        d["optimizer"] = make_optimizer(d["optimizer"]) if d.get("optimizer") else None
+        return cls(**d)
+
+
+# ---------------------------------------------------------------------------
+# Functional table ops (single shard / single device).  The sharded versions in
+# `parallel/sharded.py` run these on each device's shard under shard_map.
+# ---------------------------------------------------------------------------
+
+
+def init_table_state(spec: EmbeddingSpec, optimizer: SparseOptimizer,
+                     seed: int = 0, num_shards: int = 1,
+                     shard_id: int = 0) -> EmbeddingTableState:
+    """Materialize one shard's table (reference: lazy `_new_weights` init on first pull,
+    `EmbeddingOptimizerVariable.h:242-266`; we init rows eagerly — deterministic per
+    (seed, shard), documented divergence: RNG stream differs from lazy order)."""
+    rows = spec.rows_per_shard(num_shards)
+    key = jax.random.fold_in(jax.random.PRNGKey(seed), spec.variable_id * 131071 + shard_id)
+    weights = spec.initializer(key, (rows, spec.output_dim), spec.dtype)
+    slots = optimizer.init_slots(rows, spec.output_dim, spec.dtype)
+    keys = None
+    overflow = None
+    if spec.use_hash_table:
+        if not jax.config.jax_enable_x64:
+            warnings.warn(
+                f"hash-table variable {spec.name!r}: jax_enable_x64 is off, so keys "
+                "are int32 and the id space is 32-bit (ids congruent mod 2^32 "
+                "collide). Enable x64 for the full 63-bit hashed id space.")
+        keys = jnp.full((rows,), -1, dtype=jnp.int64)
+        overflow = jnp.zeros((), jnp.int32)
+    return EmbeddingTableState(weights=weights, slots=slots, keys=keys,
+                               overflow=overflow)
+
+
+def lookup(spec: EmbeddingSpec, state: EmbeddingTableState,
+           ids: jax.Array) -> jax.Array:
+    """Single-shard pull: ids (any shape) -> rows (ids.shape + (dim,)).
+    reference: `Variable.sparse_read`/`pull_weights` (`exb.py:308-327`)."""
+    flat = ids.reshape(-1)
+    if spec.use_hash_table:
+        from .tables.hash_table import hash_lookup
+        rows = hash_lookup(state, flat)
+    else:
+        rows = lookup_rows(state.weights, flat)
+    return rows.reshape(ids.shape + (spec.output_dim,))
+
+
+def lookup_train(spec: EmbeddingSpec, state: EmbeddingTableState,
+                 ids: jax.Array):
+    """Training pull: like `lookup` but hash tables insert unseen ids (lazy init).
+    Returns (new_state, rows). Array tables never mutate on pull."""
+    flat = ids.reshape(-1)
+    if spec.use_hash_table:
+        from .tables.hash_table import hash_lookup_train
+        state, rows = hash_lookup_train(state, flat)
+    else:
+        rows = lookup_rows(state.weights, flat)
+    return state, rows.reshape(ids.shape + (spec.output_dim,))
+
+
+def apply_gradients(spec: EmbeddingSpec, state: EmbeddingTableState,
+                    optimizer: SparseOptimizer, ids: jax.Array,
+                    grads: jax.Array) -> EmbeddingTableState:
+    """Single-shard push+update fused: duplicate grads summed, optimizer applied once
+    per unique id (reference: push `EmbeddingPushOperator.cpp` + store
+    `EmbeddingStoreOperator.cpp` collapsed into one step — SPMD needs no batch gate)."""
+    flat_ids = ids.reshape(-1)
+    flat_grads = grads.reshape(-1, spec.output_dim)
+    if spec.use_hash_table:
+        from .tables.hash_table import hash_apply_gradients
+        return hash_apply_gradients(state, optimizer, flat_ids, flat_grads)
+    weights, slots = sparse_apply_dense_table(
+        optimizer, state.weights, state.slots, flat_ids, flat_grads)
+    return state.replace(weights=weights, slots=slots)
+
+
+class Embedding:
+    """Drop-in layer handle, mirroring `exb.Embedding` (`exb.py:388-443`).
+
+    Collects itself into the enclosing `EmbeddingModel`'s variable list; the actual
+    compute is functional (lookup / apply_gradients) driven by the Trainer.
+    """
+
+    def __init__(self, input_dim: int, output_dim: int, *, name: str,
+                 datatype: str = "float32",
+                 embeddings_initializer: Optional[Initializer] = None,
+                 optimizer: Optional[SparseOptimizer] = None,
+                 num_shards: int = -1,
+                 sparse_as_dense: bool = False,
+                 capacity: int = 0):
+        self.spec = EmbeddingSpec(
+            name=name,
+            input_dim=input_dim,
+            output_dim=output_dim,
+            datatype=datatype,
+            initializer=embeddings_initializer or Uniform(),
+            optimizer=optimizer,
+            num_shards=num_shards,
+            sparse_as_dense=sparse_as_dense,
+            capacity=capacity,
+        )
+
+    def __repr__(self):
+        return f"Embedding({self.spec.name}: {self.spec.input_dim}x{self.spec.output_dim})"

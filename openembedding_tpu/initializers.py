@@ -1,0 +1,145 @@
+"""Embedding-row initializers.
+
+Counterpart of the reference's `variable/EmbeddingInitializer.h` (constant, uniform,
+normal with truncated rejection sampling) and the Keras-initializer translation table in
+`tensorflow/exb.py:25-63` (RandomNormal, RandomUniform, Constant, Zeros, Ones).
+
+The reference initializes rows lazily at first pull on the owning server thread; on TPU
+rows are materialized up front (dense table) or at insert (hash table) with
+`jax.random` — deterministic per (seed, row) so a resharded restore reproduces identical
+untrained rows. Each initializer is a pure function (key, shape, dtype) -> array,
+registered by category name for config round-trip.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Type
+
+import jax
+import jax.numpy as jnp
+
+
+_REGISTRY: Dict[str, Type["Initializer"]] = {}
+
+
+def _register(cls):
+    _REGISTRY[cls.category] = cls
+    return cls
+
+
+class Initializer:
+    category = ""
+
+    def __call__(self, key: jax.Array, shape, dtype=jnp.float32) -> jax.Array:
+        raise NotImplementedError
+
+    def to_config(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["category"] = self.category
+        return d
+
+
+@_register
+@dataclasses.dataclass
+class Constant(Initializer):
+    """(reference: EmbeddingConstantInitializer, `EmbeddingInitializer.h:19-34`)"""
+
+    category = "constant"
+    value: float = 0.0
+
+    def __call__(self, key, shape, dtype=jnp.float32):
+        return jnp.full(shape, self.value, dtype=dtype)
+
+
+def Zeros() -> Constant:
+    return Constant(0.0)
+
+
+def Ones() -> Constant:
+    return Constant(1.0)
+
+
+@_register
+@dataclasses.dataclass
+class Uniform(Initializer):
+    """(reference: EmbeddingUniformInitializer, `EmbeddingInitializer.h:36-55`)"""
+
+    category = "uniform"
+    minval: float = -0.05
+    maxval: float = 0.05
+
+    def __call__(self, key, shape, dtype=jnp.float32):
+        return jax.random.uniform(key, shape, dtype=dtype,
+                                  minval=self.minval, maxval=self.maxval)
+
+
+@_register
+@dataclasses.dataclass
+class Normal(Initializer):
+    """(reference: EmbeddingNormalInitializer non-truncated path,
+    `EmbeddingInitializer.h:57-91`)"""
+
+    category = "normal"
+    mean: float = 0.0
+    stddev: float = 0.05
+
+    def __call__(self, key, shape, dtype=jnp.float32):
+        return self.mean + self.stddev * jax.random.normal(key, shape, dtype=dtype)
+
+
+@_register
+@dataclasses.dataclass
+class TruncatedNormal(Initializer):
+    """Truncated at 2 sigma (reference: EmbeddingNormalInitializer truncated rejection
+    loop, `EmbeddingInitializer.h:57-91`; here via `jax.random.truncated_normal`)."""
+
+    category = "truncated_normal"
+    mean: float = 0.0
+    stddev: float = 0.05
+
+    def __call__(self, key, shape, dtype=jnp.float32):
+        return self.mean + self.stddev * jax.random.truncated_normal(
+            key, -2.0, 2.0, shape, dtype=dtype)
+
+
+def make_initializer(config: dict) -> Initializer:
+    """Build from a {category, **params} config dict (reference: Factory +
+    `_tensorflow_initializer_config`, `exb.py:25-63`)."""
+    config = dict(config)
+    category = config.pop("category")
+    # accept Keras initializer class names too, like the exb.py translation table
+    aliases = {
+        "RandomNormal": "normal", "random_normal": "normal",
+        "RandomUniform": "uniform", "random_uniform": "uniform",
+        "Constant": "constant", "Zeros": "constant", "zeros": "constant",
+        "Ones": "constant", "ones": "constant",
+        "TruncatedNormal": "truncated_normal", "truncated_normal": "truncated_normal",
+    }
+    category = aliases.get(category, category)
+    if category == "constant" and config.pop("__ones__", False):
+        config.setdefault("value", 1.0)
+    cls = _REGISTRY.get(category)
+    if cls is None:
+        raise ValueError(f"unknown initializer category {category!r}")
+    return cls(**config)
+
+
+def from_keras(initializer) -> Initializer:
+    """Translate a Keras initializer object (reference: `exb.py:25-63`; seed/dtype are
+    dropped there too — our seed comes from the variable id)."""
+    name = type(initializer).__name__
+    cfg = initializer.get_config() if hasattr(initializer, "get_config") else {}
+    if name in ("RandomNormal",):
+        return Normal(mean=cfg.get("mean", 0.0), stddev=cfg.get("stddev", 0.05))
+    if name in ("TruncatedNormal",):
+        return TruncatedNormal(mean=cfg.get("mean", 0.0), stddev=cfg.get("stddev", 0.05))
+    if name in ("RandomUniform",):
+        return Uniform(minval=cfg.get("minval", -0.05), maxval=cfg.get("maxval", 0.05))
+    if name in ("Constant",):
+        return Constant(value=cfg.get("value", 0.0))
+    if name in ("Zeros",):
+        return Constant(0.0)
+    if name in ("Ones",):
+        return Constant(1.0)
+    raise ValueError(f"unsupported initializer {name!r} (reference rejects these too)")

@@ -1,0 +1,163 @@
+"""Datatype and model metadata, JSON round-trip.
+
+TPU-native counterpart of the reference's `openembedding/variable/DataType.h` and
+`variable/Meta.h` (EmbeddingVariableMeta / ModelVariableMeta / ModelOfflineMeta /
+ModelMeta).  The reference packs element size into a C enum and serializes metas as JSON
+with a format version ("0.2", `Meta.h`); here dtypes map onto jnp dtypes and metas are
+dataclasses with `to_json`/`from_json`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+# Format version of the offline (checkpoint) metadata layout. The reference uses "0.2"
+# (`variable/Meta.h`); we start our own lineage at "tpu-1".
+META_FORMAT_VERSION = "tpu-1"
+
+# Vocabulary sizes at or above this threshold (or input_dim == -1 in the layer API) mean
+# "ids are 63-bit hashes; use a hash-table variable" (reference: `Meta.h:44-46`,
+# `tensorflow/exb.py` Embedding input_dim=-1 -> 2**63 hash range).
+HASH_VOCABULARY_THRESHOLD = 1 << 63
+
+
+class DataType:
+    """String-keyed dtype registry (reference: `variable/DataType.h`)."""
+
+    _TABLE = {
+        "int8": jnp.int8,
+        "int16": jnp.int16,
+        "int32": jnp.int32,
+        "int64": jnp.int64,
+        "float32": jnp.float32,
+        "float64": jnp.float64,
+        "bfloat16": jnp.bfloat16,  # TPU-native addition; not in the reference
+    }
+
+    def __init__(self, name: str):
+        name = str(np.dtype(name)) if name not in self._TABLE else name
+        if name not in self._TABLE:
+            raise ValueError(f"unsupported datatype: {name!r}")
+        self.name = name
+
+    @property
+    def jnp_dtype(self):
+        return self._TABLE[self.name]
+
+    @property
+    def size(self) -> int:
+        return np.dtype(self.name if self.name != "bfloat16" else "uint16").itemsize
+
+    def __eq__(self, other):
+        return isinstance(other, DataType) and other.name == self.name
+
+    def __hash__(self):
+        return hash(self.name)
+
+    def __repr__(self):
+        return f"DataType({self.name})"
+
+
+@dataclasses.dataclass
+class EmbeddingVariableMeta:
+    """Shape/dtype meta of one embedding variable (reference: `Meta.h` struct
+    EmbeddingVariableMeta: datatype, embedding_dim, vocabulary_size)."""
+
+    datatype: str = "float32"
+    embedding_dim: int = 0
+    vocabulary_size: int = 0  # -1 or >= 2**63 means hashed 63-bit id space
+
+    @property
+    def use_hash_table(self) -> bool:
+        return self.vocabulary_size < 0 or self.vocabulary_size >= HASH_VOCABULARY_THRESHOLD
+
+    def line_size(self) -> int:
+        return self.embedding_dim * DataType(self.datatype).size
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "EmbeddingVariableMeta":
+        return cls(**{k: d[k] for k in ("datatype", "embedding_dim", "vocabulary_size") if k in d})
+
+
+@dataclasses.dataclass
+class ModelVariableMeta:
+    """Per-variable entry of a model checkpoint meta (reference: `Meta.h`
+    ModelVariableMeta: meta + variable_id + storage_name)."""
+
+    variable_id: int = 0
+    storage_name: str = ""
+    meta: EmbeddingVariableMeta = dataclasses.field(default_factory=EmbeddingVariableMeta)
+    # config dumps so a restore can rebuild table/optimizer/initializer:
+    optimizer: dict = dataclasses.field(default_factory=dict)
+    initializer: dict = dataclasses.field(default_factory=dict)
+    table: dict = dataclasses.field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ModelVariableMeta":
+        d = dict(d)
+        d["meta"] = EmbeddingVariableMeta.from_dict(d.get("meta", {}))
+        return cls(**{k: d[k] for k in
+                      ("variable_id", "storage_name", "meta", "optimizer", "initializer", "table")
+                      if k in d})
+
+
+# Model lifecycle states used by the serving registry (reference: `Meta.h` ModelMeta
+# status CREATING/NORMAL/DELETING and `client/ModelController.cpp`).
+MODEL_STATUS = ("CREATING", "NORMAL", "LOADING", "DELETING", "ERROR")
+
+
+@dataclasses.dataclass
+class ModelMeta:
+    """Offline model meta written at the root of a checkpoint (reference: `Meta.h`
+    ModelOfflineMeta/ModelMeta; JSON with model_sign, variables, version)."""
+
+    model_sign: str = ""
+    version: str = META_FORMAT_VERSION
+    status: str = "NORMAL"
+    uri: str = ""
+    error: str = ""
+    num_shards: int = 1  # mesh size at dump time; load remaps if different
+    variables: List[ModelVariableMeta] = dataclasses.field(default_factory=list)
+    # Extra dense (non-embedding) param manifest: name -> {shape, dtype}
+    dense_manifest: Dict[str, dict] = dataclasses.field(default_factory=dict)
+
+    def to_json(self) -> str:
+        d = dataclasses.asdict(self)
+        return json.dumps(d, indent=2, sort_keys=True)
+
+    @classmethod
+    def from_json(cls, s: str) -> "ModelMeta":
+        d = json.loads(s)
+        variables = [ModelVariableMeta.from_dict(v) for v in d.get("variables", [])]
+        out = cls(
+            model_sign=d.get("model_sign", ""),
+            version=d.get("version", META_FORMAT_VERSION),
+            status=d.get("status", "NORMAL"),
+            uri=d.get("uri", ""),
+            error=d.get("error", ""),
+            num_shards=d.get("num_shards", 1),
+            variables=variables,
+            dense_manifest=d.get("dense_manifest", {}),
+        )
+        if out.version != META_FORMAT_VERSION:
+            raise ValueError(
+                f"checkpoint meta version {out.version!r} != supported {META_FORMAT_VERSION!r}")
+        return out
+
+    def find_variable(self, variable_id: int) -> Optional[ModelVariableMeta]:
+        for v in self.variables:
+            if v.variable_id == variable_id:
+                return v
+        return None

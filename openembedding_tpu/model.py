@@ -1,0 +1,268 @@
+"""EmbeddingModel + Trainer: the train-step builder.
+
+Counterpart of the reference's `distributed_optimizer` / `distributed_model`
+(`tensorflow/exb.py:446-642`). The reference splits one Keras optimizer into (a) the
+dense path (Horovod-allreduced Keras apply) and (b) the PS sparse path (translated
+config, server-side apply). Here ONE `SparseOptimizer` drives both paths with identical
+math: dense params are updated as single-row "tables" (every leaf touched every step, so
+per-row beta^t == Keras's global iteration count), and embedding tables via the fused
+sparse apply. No fake-grad trick is needed (`exb.py:89-97`): dense grads psum under
+pjit/shard_map, sparse grads ride the all-to-all push path.
+
+Batch convention: {"sparse": {var_name: int ids (B,) or (B, F)},
+                   "dense":  optional float (B, D),
+                   "label":  (B,) or (B, 1)}.
+
+The flax dense module is called as `module.apply({'params': p}, embedded, dense)` where
+`embedded` maps var_name -> (B, ..., dim) pulled rows.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from .embedding import (Embedding, EmbeddingSpec, EmbeddingTableState,
+                        apply_gradients, init_table_state, lookup, lookup_train)
+from .optimizers import Adagrad, SparseOptimizer
+
+
+def binary_logloss(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Mean sigmoid binary cross-entropy (the reference benchmarks train CTR models
+    with keras BinaryCrossentropy, `test/benchmark/criteo_deepctr.py`)."""
+    logits = logits.reshape(-1)
+    labels = labels.reshape(-1).astype(logits.dtype)
+    return jnp.mean(jnp.clip(logits, 0) - logits * labels +
+                    jnp.log1p(jnp.exp(-jnp.abs(logits))))
+
+
+# ---------------------------------------------------------------------------
+# Dense-path optimizer reuse: every dense leaf is a 1-row table.
+# ---------------------------------------------------------------------------
+
+def init_dense_slots(optimizer: SparseOptimizer, params) -> Any:
+    return jax.tree_util.tree_map(
+        lambda p: optimizer.init_slots(1, p.size, p.dtype), params)
+
+
+def dense_apply(optimizer: SparseOptimizer, params, slots, grads) -> Tuple[Any, Any]:
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    slot_leaves = treedef.flatten_up_to(slots)
+    grad_leaves = treedef.flatten_up_to(grads)
+    ones = jnp.ones((1,), jnp.int32)
+    new_params, new_slots = [], []
+    for p, s, g in zip(leaves, slot_leaves, grad_leaves):
+        # optimizer math in f32 (see SparseOptimizer.init_slots) even for bf16 params
+        nw, ns = optimizer.apply(p.reshape(1, -1).astype(jnp.float32), s,
+                                 g.reshape(1, -1).astype(jnp.float32), ones)
+        new_params.append(nw.reshape(p.shape).astype(p.dtype))
+        new_slots.append(ns)
+    return (jax.tree_util.tree_unflatten(treedef, new_params),
+            jax.tree_util.tree_unflatten(treedef, new_slots))
+
+
+class TrainState(struct.PyTreeNode):
+    """All mutable training state as one pytree (shards/donates/checkpoints whole)."""
+
+    step: jax.Array
+    dense_params: Any
+    dense_slots: Any
+    tables: Dict[str, EmbeddingTableState]
+    # model_version mirrors the reference's float64 CPU counter used to build serving
+    # signs `uuid-floor(version)` (`exb.py:131-138`); incremented 0.1 per step there,
+    # +1 per step here with signs taken at save time.
+    model_version: jax.Array
+
+
+class EmbeddingModel:
+    """A flax dense module + its embedding variables.
+
+    reference: `distributed_model()` clone-replacing Keras Embedding layers
+    (`exb.py:593-642`); here the user declares the embeddings explicitly (idiomatic
+    functional style) or uses the models in `openembedding_tpu.models` which do it.
+    """
+
+    def __init__(self, module, embeddings: List[Embedding],
+                 loss_fn: Callable = binary_logloss):
+        self.module = module
+        self.specs: Dict[str, EmbeddingSpec] = {}
+        for i, e in enumerate(embeddings):
+            spec = dataclasses.replace(e.spec, variable_id=i)
+            if spec.name in self.specs:
+                raise ValueError(f"duplicate embedding name {spec.name!r}")
+            if spec.sparse_as_dense and spec.optimizer is not None:
+                # sad tables train on the dense path with the Trainer's optimizer
+                # (reference parity: 'Cache' vars are plain mirrored tf.Variables,
+                # `exb.py:241-248`); honoring a per-variable optimizer there would
+                # silently lie, so reject the combination.
+                raise ValueError(
+                    f"embedding {spec.name!r}: sparse_as_dense tables cannot have a "
+                    "per-variable optimizer (they train with the dense optimizer)")
+            self.specs[spec.name] = spec
+        self.loss_fn = loss_fn
+
+    def sad_specs(self) -> Dict[str, EmbeddingSpec]:
+        """sparse_as_dense variables (the reference's 'Cache' mode, `exb.py:241-248`):
+        small tables kept as dense mirrored params, trained by the dense path."""
+        return {n: s for n, s in self.specs.items() if s.sparse_as_dense}
+
+    def ps_specs(self) -> Dict[str, EmbeddingSpec]:
+        return {n: s for n, s in self.specs.items() if not s.sparse_as_dense}
+
+
+class Trainer:
+    """Builds jitted train/eval steps for an EmbeddingModel on one device.
+
+    The multi-device version (mesh / shard_map, DP dense + row-sharded tables) is
+    `parallel.MeshTrainer`, which reuses these per-device step functions.
+    """
+
+    def __init__(self, model: EmbeddingModel,
+                 optimizer: Optional[SparseOptimizer] = None, seed: int = 0):
+        self.model = model
+        self.optimizer = optimizer or Adagrad()
+        self.seed = seed
+
+    def opt_for(self, spec: EmbeddingSpec) -> SparseOptimizer:
+        return spec.optimizer or self.optimizer
+
+    # -- init ---------------------------------------------------------------
+
+    def init(self, sample_batch: Dict[str, Any]) -> TrainState:
+        key = jax.random.PRNGKey(self.seed)
+        embedded = self._fake_embedded(sample_batch)
+        dense_inputs = sample_batch.get("dense")
+        variables = self.module_init(key, embedded, dense_inputs)
+        params = variables["params"]
+        # sparse_as_dense tables live inside dense params under a reserved scope
+        sad = {}
+        for name, spec in self.model.sad_specs().items():
+            k = jax.random.fold_in(key, 7919 + spec.variable_id)
+            sad[name] = spec.initializer(k, (spec.input_dim, spec.output_dim),
+                                         spec.dtype)
+        if sad:
+            params = dict(params)
+            params["__embeddings__"] = sad
+        tables = {
+            name: init_table_state(spec, self.opt_for(spec), seed=self.seed)
+            for name, spec in self.model.ps_specs().items()
+        }
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            dense_params=params,
+            dense_slots=init_dense_slots(self.optimizer, params),
+            tables=tables,
+            model_version=jnp.zeros((), jnp.int32),
+        )
+
+    def module_init(self, key, embedded, dense_inputs):
+        return self.model.module.init(key, embedded, dense_inputs)
+
+    def _fake_embedded(self, batch):
+        out = {}
+        for name, spec in self.model.specs.items():
+            ids = jnp.asarray(batch["sparse"][name])
+            out[name] = jnp.zeros(ids.shape + (spec.output_dim,), spec.dtype)
+        return out
+
+    # -- the per-device step (pure; shard_map-able) -------------------------
+
+    def train_step(self, state: TrainState, batch) -> Tuple[TrainState, Dict]:
+        """One synchronous step: pull -> fwd/bwd -> dense apply + sparse apply.
+
+        The reference needs a 4-RPC protocol with batch-version gating for this
+        (`EmbeddingPullOperator`/`Push`/`Store` + `exb_barrier`); under SPMD the whole
+        step is one XLA program and is synchronous by construction.
+        """
+        model = self.model
+        ps_specs = model.ps_specs()
+        sad_specs = model.sad_specs()
+
+        # PULL: gather rows for this batch (non-differentiated w.r.t. the table — the
+        # rows themselves are the leaf, exactly the reference's pull/push contract).
+        # Hash tables insert unseen ids here, so pull threads the table state.
+        pulled = {}
+        pulled_tables = {}
+        for name, spec in ps_specs.items():
+            pulled_tables[name], pulled[name] = self.table_pull(
+                spec, state.tables[name], jnp.asarray(batch["sparse"][name]))
+
+        def loss_fn(dense_params, pulled_rows):
+            embedded = dict(pulled_rows)
+            for name, spec in sad_specs.items():
+                table = dense_params["__embeddings__"][name]
+                ids = jnp.asarray(batch["sparse"][name])
+                embedded[name] = jnp.take(table, ids, axis=0)
+            logits = model.module.apply({"params": dense_params}, embedded,
+                                        batch.get("dense"))
+            return model.loss_fn(logits, batch["label"]), logits
+
+        (loss, logits), (dense_grads, row_grads) = jax.value_and_grad(
+            loss_fn, argnums=(0, 1), has_aux=True)(state.dense_params, pulled)
+
+        dense_grads = self.reduce_dense_grads(dense_grads)
+
+        # DENSE apply (reference: Keras optimizer after Horovod allreduce)
+        new_params, new_slots = dense_apply(
+            self.optimizer, state.dense_params, state.dense_slots, dense_grads)
+
+        # SPARSE push+update (reference: PushGradients + UpdateWeights store op)
+        new_tables = dict(state.tables)
+        for name, spec in ps_specs.items():
+            new_tables[name] = self.table_apply(
+                spec, pulled_tables[name], jnp.asarray(batch["sparse"][name]),
+                row_grads[name])
+
+        new_state = TrainState(
+            step=state.step + 1,
+            dense_params=new_params,
+            dense_slots=new_slots,
+            tables=new_tables,
+            model_version=state.model_version + 1,
+        )
+        metrics = {"loss": loss, "logits": logits}
+        return new_state, metrics
+
+    # hooks overridden by MeshTrainer:
+    def reduce_dense_grads(self, grads):
+        return grads
+
+    def table_pull(self, spec, table, ids):
+        return lookup_train(spec, table, ids)
+
+    def table_apply(self, spec, table, ids, grads):
+        return apply_gradients(spec, table, self.opt_for(spec), ids, grads)
+
+    def table_lookup(self, spec, table, ids):
+        return lookup(spec, table, ids)
+
+    def eval_step(self, state: TrainState, batch) -> Dict:
+        model = self.model
+        embedded = {
+            name: self.table_lookup(spec, state.tables[name],
+                                    jnp.asarray(batch["sparse"][name]))
+            for name, spec in model.ps_specs().items()
+        }
+        for name, spec in model.sad_specs().items():
+            table = state.dense_params["__embeddings__"][name]
+            embedded[name] = jnp.take(table, jnp.asarray(batch["sparse"][name]), axis=0)
+        logits = model.module.apply({"params": state.dense_params}, embedded,
+                                    batch.get("dense"))
+        return {"logits": logits,
+                "loss": model.loss_fn(logits, batch["label"])}
+
+    # -- jitted drivers ------------------------------------------------------
+
+    def jit_train_step(self):
+        """NOTE: the input TrainState is DONATED (huge tables must update in place,
+        not 2x HBM) — always rebind: `state, metrics = step(state, batch)`; a stale
+        `state` reference is dead after the call."""
+        return jax.jit(self.train_step, donate_argnums=(0,))
+
+    def jit_eval_step(self):
+        return jax.jit(self.eval_step)

@@ -1,0 +1,104 @@
+"""Static-shape dedup and owner-bucketing primitives.
+
+These are the XLA-friendly counterparts of the reference's client-side hot loops:
+`exb_unique_indices` (`entry/c_api.cc:220-231`) and the dedup + shard-scatter in
+`EmbeddingPullOperator::generate_request` (`server/EmbeddingPullOperator.cpp:60-112`) /
+`EmbeddingPushOperator::generate_request` (`server/EmbeddingPushOperator.cpp:29-62`).
+
+The reference uses CPU `EasyHashMap`s with dynamic sizes; under XLA everything is
+sort-based with **static capacities**: a buffer of n ids dedups into a buffer of n slots
+with `counts == 0` marking padding. All functions are jit-safe (no data-dependent
+shapes).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class UniqueResult(NamedTuple):
+    unique_ids: jax.Array   # (n,) — first num_unique slots are the sorted unique ids
+    inverse: jax.Array      # (n,) int32 — ids[i] == unique_ids[inverse[i]]
+    counts: jax.Array       # (n,) int32 — duplicate multiplicity; 0 = padding slot
+    num_unique: jax.Array   # () int32
+
+
+def unique_with_counts(ids: jax.Array) -> UniqueResult:
+    """Sort-based unique with inverse mapping and counts, static output size n.
+
+    Reference semantics: gradients of duplicate ids are summed and the count recorded
+    (`MpscGradientReducer.h:26-53`); here `inverse` lets the caller `segment_sum`
+    per-duplicate gradients into the unique slots.
+    """
+    n = ids.shape[0]
+    order = jnp.argsort(ids)
+    sorted_ids = ids[order]
+    is_new = jnp.concatenate(
+        [jnp.ones((1,), dtype=bool), sorted_ids[1:] != sorted_ids[:-1]])
+    seg = jnp.cumsum(is_new) - 1  # segment index of each sorted element
+    num_unique = seg[-1] + 1
+    # duplicate writes to one segment all carry the same value, so .set is deterministic
+    unique_ids = jnp.zeros((n,), ids.dtype).at[seg].set(sorted_ids, mode="drop")
+    counts = jax.ops.segment_sum(jnp.ones((n,), jnp.int32), seg, num_segments=n)
+    inverse = jnp.zeros((n,), jnp.int32).at[order].set(seg.astype(jnp.int32))
+    return UniqueResult(unique_ids, inverse, counts.astype(jnp.int32),
+                        num_unique.astype(jnp.int32))
+
+
+class BucketResult(NamedTuple):
+    bucket_ids: jax.Array    # (num_shards, capacity) — ids grouped by owner shard
+    bucket_valid: jax.Array  # (num_shards, capacity) bool
+    # position of input element i inside its bucket: (owner[i], slot[i])
+    owner: jax.Array         # (n,) int32
+    slot: jax.Array          # (n,) int32
+    overflow: jax.Array      # () int32 — elements dropped because a bucket was full
+
+
+def bucket_by_owner(ids: jax.Array, valid: jax.Array, num_shards: int,
+                    capacity: int) -> BucketResult:
+    """Group ids into per-owner-shard buckets of static capacity.
+
+    Owner layout matches the reference: `owner = id % num_shards`, row-within-shard
+    `id // num_shards` (`EmbeddingPullOperator.cpp:74-84`). Elements beyond a bucket's
+    capacity are counted in `overflow` and dropped (the reference's dynamic buffers
+    can't overflow; static XLA shapes can — callers size capacity via config and tests
+    use capacity == n for exactness).
+    """
+    n = ids.shape[0]
+    owner = jnp.where(valid, (ids % num_shards).astype(jnp.int32), num_shards)
+    # stable sort by owner so each bucket preserves input order
+    order = jnp.argsort(owner, stable=True)
+    sorted_owner = owner[order]
+    # index within the owner group = position - start of that owner's run
+    group_start = jnp.searchsorted(sorted_owner, sorted_owner, side="left")
+    idx_in_group = jnp.arange(n, dtype=jnp.int32) - group_start.astype(jnp.int32)
+    slot_sorted = idx_in_group
+    in_cap = (slot_sorted < capacity) & (sorted_owner < num_shards)
+    overflow = jnp.sum((~in_cap) & (sorted_owner < num_shards)).astype(jnp.int32)
+    # scatter (owner, slot) -> id; out-of-capacity and invalid entries drop
+    flat_pos = jnp.where(in_cap, sorted_owner * capacity + slot_sorted,
+                         num_shards * capacity)
+    bucket_ids = jnp.zeros((num_shards * capacity,), ids.dtype).at[flat_pos].set(
+        ids[order], mode="drop").reshape(num_shards, capacity)
+    bucket_valid = jnp.zeros((num_shards * capacity,), bool).at[flat_pos].set(
+        True, mode="drop").reshape(num_shards, capacity)
+    # per-input-element position (for unbucketing responses)
+    owner_out = jnp.zeros((n,), jnp.int32).at[order].set(sorted_owner)
+    slot_out = jnp.zeros((n,), jnp.int32).at[order].set(
+        jnp.where(in_cap, slot_sorted, capacity))
+    return BucketResult(bucket_ids, bucket_valid, owner_out, slot_out, overflow)
+
+
+def unbucket(bucket_rows: jax.Array, owner: jax.Array, slot: jax.Array) -> jax.Array:
+    """Inverse of bucket_by_owner for per-id payloads: read back each input element's
+    row from its (owner, slot) position. bucket_rows: (num_shards, capacity, ...)."""
+    num_shards, capacity = bucket_rows.shape[:2]
+    flat = bucket_rows.reshape((num_shards * capacity,) + bucket_rows.shape[2:])
+    pos = jnp.clip(owner * capacity + slot, 0, num_shards * capacity - 1)
+    oob = (owner >= num_shards) | (slot >= capacity)
+    out = flat[pos]
+    return jnp.where(oob.reshape((-1,) + (1,) * (out.ndim - 1)),
+                     jnp.zeros_like(out), out)

@@ -1,0 +1,87 @@
+"""Single-shard sparse gather / scatter / fused-optimizer-apply.
+
+Counterpart of the reference's server-side hot path on one shard:
+`EmbeddingOptimizerVariable::pull_weights` (table read, `EmbeddingOptimizerVariable.h:
+242-266`) and `update_weights` (commit + reduce + per-unique-row optimizer update,
+`:273-297`). Here a "shard" is just the rows of the table a device owns; the ops are
+plain XLA (Pallas variants live in `ops/pallas_*.py`).
+
+Scatter correctness under static shapes: padding slots of the unique-id buffer are
+scattered with out-of-bounds indices and `mode='drop'`, so they can never corrupt row 0.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .dedup import unique_with_counts
+
+
+def lookup_rows(weights: jax.Array, rows: jax.Array,
+                valid: jax.Array = None) -> jax.Array:
+    """Gather rows (table read; reference `pull_weights` fast path). Out-of-range or
+    invalid row indices return zeros — consistent with the gradient path, which drops
+    them, so a buggy id pipeline can't create train/serve skew."""
+    n_rows = weights.shape[0]
+    in_range = (rows >= 0) & (rows < n_rows)
+    if valid is not None:
+        in_range = in_range & valid
+    safe = jnp.clip(rows, 0, n_rows - 1)
+    out = jnp.take(weights, safe, axis=0)
+    return jnp.where(in_range.reshape(in_range.shape + (1,) * (out.ndim - in_range.ndim)),
+                     out, jnp.zeros_like(out))
+
+
+def scatter_rows(weights: jax.Array, rows: jax.Array, values: jax.Array,
+                 valid: jax.Array) -> jax.Array:
+    """Overwrite rows; invalid slots are dropped via out-of-bounds scatter."""
+    n_rows = weights.shape[0]
+    target = jnp.where(valid, rows, n_rows)  # n_rows is out of bounds -> dropped
+    return weights.at[target].set(values, mode="drop")
+
+
+def sparse_apply_dense_table(
+    optimizer,
+    weights: jax.Array,
+    slots: Dict[str, jax.Array],
+    row_ids: jax.Array,
+    grads: jax.Array,
+    pre_counts: jax.Array = None,
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Fused sparse update of a dense (array) table shard.
+
+    row_ids: (n,) local row indices (may contain duplicates and padding);
+    grads: (n, dim) per-occurrence gradients; pre_counts: (n,) multiplicity already
+    accumulated upstream (e.g. summed over workers), default 1 per occurrence, 0 = pad.
+
+    Pipeline (reference `update_weights`, `EmbeddingOptimizerVariable.h:273-297`):
+    dedup -> sum gradients/counts over duplicates -> gather rows+slots -> fused
+    optimizer apply -> scatter back. Rows not touched stay bit-identical.
+    """
+    n = row_ids.shape[0]
+    if pre_counts is None:
+        pre_counts = jnp.ones((n,), jnp.int32)
+    # Route padding (count==0) to an out-of-range sort key so dedup's padding slots
+    # coincide with count-0 slots after the segment sums.
+    uniq = unique_with_counts(jnp.where(pre_counts > 0, row_ids, weights.shape[0]))
+    g = jax.ops.segment_sum(grads, uniq.inverse, num_segments=n)
+    counts = jax.ops.segment_sum(pre_counts, uniq.inverse, num_segments=n)
+    # padding slots (id == n_rows sentinel) get counts 0:
+    counts = jnp.where(uniq.unique_ids < weights.shape[0], counts, 0)
+
+    # Optimizer math always runs in float32, whatever the table dtype: in bf16,
+    # beta_2^t rounds to 1.0 (killing Adam's lr_t) and g^2 accumulators lose most of
+    # their mantissa. Slots are stored f32 (`SparseOptimizer.init_slots`); weights are
+    # upcast for the update and cast back on scatter (TPU-idiomatic mixed precision).
+    w_rows = lookup_rows(weights, uniq.unique_ids).astype(jnp.float32)
+    s_rows = {k: lookup_rows(v, uniq.unique_ids) for k, v in slots.items()}
+    new_w, new_s = optimizer.apply(w_rows, s_rows, g.astype(jnp.float32), counts)
+    valid = counts > 0
+    weights = scatter_rows(weights, uniq.unique_ids, new_w.astype(weights.dtype), valid)
+    slots = {k: scatter_rows(slots[k], uniq.unique_ids,
+                             new_s[k].astype(slots[k].dtype), valid)
+             for k in slots}
+    return weights, slots

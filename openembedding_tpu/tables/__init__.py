@@ -1,0 +1,9 @@
+"""Embedding table implementations.
+
+- array: dense row-sharded table (reference: `EmbeddingArrayTable`,
+  `variable/EmbeddingTable.h:121-197`) — just the weights array; logic in `ops/sparse.py`.
+- hash: static-capacity open-addressing device table for 2^63 hashed id spaces
+  (reference: `EmbeddingHashTable`, `variable/EmbeddingTable.h:24-119`).
+"""
+
+from .hash_table import hash_lookup, hash_apply_gradients, hash_find_or_insert

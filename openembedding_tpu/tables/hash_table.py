@@ -1,0 +1,151 @@
+"""Static-capacity open-addressing device hash table.
+
+Counterpart of the reference's `EmbeddingHashTable` (`variable/EmbeddingTable.h:24-119`:
+`EasyHashMap<key, T*>` + pooled value arenas) used when `input_dim == -1` (63-bit hashed
+id space, `tensorflow/exb.py:388-419`, `Meta.h:44-46`).
+
+The reference grows unboundedly in host RAM; XLA needs static shapes, so this table has
+a **fixed slot capacity** with linear probing and an overflow counter (documented
+divergence; size capacity ~2x expected unique ids). All ops are jit-safe and run as a
+handful of fused gathers/scatters:
+
+- `hash_find_or_insert`: one probe round per loop iteration for the whole id batch at
+  once; empty-slot claims race through a scatter-then-reread, so the winner is whoever
+  XLA's scatter kept — the loser keeps probing. This replaces the reference's per-key
+  mutex-free `EasyHashMap::try_emplace` on the owning server thread.
+- newly claimed slots already hold initializer values: rows are materialized at table
+  creation (`embedding.init_table_state`), replacing the reference's lazy `_new_weights`
+  init-on-first-pull (`EmbeddingOptimizerVariable.h:242-266`).
+
+Ids must be non-negative (63-bit hash space); -1 is the EMPTY sentinel.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+EMPTY = -1
+DEFAULT_NUM_PROBES = 64
+
+
+def _mix(ids: jax.Array) -> jax.Array:
+    """Avalanche mixer so clustered ids spread over slots (fibonacci hashing)."""
+    if ids.dtype.itemsize >= 8:
+        u = ids.astype(jnp.uint64)
+        u = (u ^ (u >> 33)) * jnp.uint64(0xFF51AFD7ED558CCD)
+        u = u ^ (u >> 33)
+        return u
+    u = ids.astype(jnp.uint32)
+    u = (u ^ (u >> 16)) * jnp.uint32(0x45D9F3B)
+    u = u ^ (u >> 16)
+    return u
+
+
+def hash_find_or_insert(keys: jax.Array, ids: jax.Array,
+                        num_probes: int = DEFAULT_NUM_PROBES
+                        ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Find each id's slot, inserting missing ids into empty slots.
+
+    keys: (capacity,) int table; ids: (n,) unique non-negative ids (dedup first —
+    duplicate ids in one call may claim two slots). Returns (new_keys, slot (n,) int32
+    with `capacity` marking overflow, overflow_count).
+    """
+    capacity = keys.shape[0]
+    valid = ids >= 0  # negative ids (padding like -1) must never match EMPTY slots
+    base = (_mix(ids) % jnp.asarray(capacity).astype(_mix(ids).dtype)).astype(jnp.int32)
+    slot0 = jnp.full(ids.shape, capacity, jnp.int32)
+    placed0 = ~valid  # invalid ids are "done" from the start, slot == capacity
+
+    def probe(d, carry):
+        keys, slot, placed = carry
+        pos = (base + d) % capacity
+        cur = keys[pos]
+        found = (~placed) & (cur == ids)
+        slot = jnp.where(found, pos, slot)
+        placed = placed | found
+        want = (~placed) & (cur == EMPTY)
+        target = jnp.where(want, pos, capacity)
+        keys = keys.at[target].set(ids, mode="drop")
+        got = want & (keys[pos] == ids)
+        slot = jnp.where(got, pos, slot)
+        placed = placed | got
+        return keys, slot, placed
+
+    keys, slot, placed = jax.lax.fori_loop(
+        0, num_probes, probe, (keys, slot0, placed0))
+    overflow = jnp.sum(~placed).astype(jnp.int32)
+    return keys, slot, overflow
+
+
+def hash_find(keys: jax.Array, ids: jax.Array,
+              num_probes: int = DEFAULT_NUM_PROBES) -> jax.Array:
+    """Read-only probe: slot index per id, `capacity` if absent (reference read-only
+    serving pull `get_weights`, `EmbeddingPullOperator.cpp:149-205`)."""
+    capacity = keys.shape[0]
+    base = (_mix(ids) % jnp.asarray(capacity).astype(_mix(ids).dtype)).astype(jnp.int32)
+    slot0 = jnp.full(ids.shape, capacity, jnp.int32)
+    done0 = ids < 0  # negative ids never match (EMPTY sentinel is -1)
+
+    def probe(d, carry):
+        slot, done = carry
+        pos = (base + d) % capacity
+        cur = keys[pos]
+        found = (~done) & (cur == ids)
+        slot = jnp.where(found, pos, slot)
+        # an EMPTY slot on the probe path terminates the search (id absent)
+        done = done | found | ((~done) & (cur == EMPTY))
+        return slot, done
+
+    slot, _ = jax.lax.fori_loop(0, num_probes, probe, (slot0, done0))
+    return slot
+
+
+def hash_lookup(state, ids: jax.Array) -> jax.Array:
+    """Read-only pull: absent ids return zero rows."""
+    ids = ids.astype(state.keys.dtype)
+    slot = hash_find(state.keys, ids)
+    capacity, dim = state.weights.shape
+    hit = slot < capacity
+    rows = jnp.take(state.weights, jnp.clip(slot, 0, capacity - 1), axis=0)
+    return jnp.where(hit[:, None], rows, jnp.zeros_like(rows))
+
+
+def hash_lookup_train(state, ids: jax.Array):
+    """Training pull: inserts unseen ids (their slots already carry initializer values)
+    and returns (new_state, rows). Mirrors the reference's lazy-init pull
+    (`EmbeddingOptimizerVariable.h:242-266`)."""
+    from ..ops.dedup import unique_with_counts
+
+    ids = ids.astype(state.keys.dtype)
+    uniq = unique_with_counts(ids)
+    # only insert real (count>0) unique ids; padding probes for EMPTY and is dropped
+    probe_ids = jnp.where(uniq.counts > 0, uniq.unique_ids, EMPTY)
+    new_keys, uslot, overflow = hash_find_or_insert(state.keys, probe_ids)
+    slot = uslot[uniq.inverse]
+    capacity = state.keys.shape[0]
+    hit = slot < capacity
+    rows = jnp.take(state.weights, jnp.clip(slot, 0, capacity - 1), axis=0)
+    rows = jnp.where(hit[:, None], rows, jnp.zeros_like(rows))
+    new_overflow = (state.overflow + overflow if state.overflow is not None
+                    else overflow)
+    return state.replace(keys=new_keys, overflow=new_overflow), rows
+
+
+def hash_apply_gradients(state, optimizer, ids: jax.Array, grads: jax.Array):
+    """Push+update: translate ids -> slots (no insert; forward pull inserted them),
+    then run the shared fused sparse apply over slot indices."""
+    from ..ops.sparse import sparse_apply_dense_table
+
+    ids = ids.astype(state.keys.dtype)
+    slot = hash_find(state.keys, ids)
+    capacity = state.keys.shape[0]
+    # absent ids (overflowed at pull time) drop their gradients, like the reference
+    # dropping pushes for ids a dead shard lost; mark them as padding via count 0
+    pre_counts = jnp.where(slot < capacity, 1, 0).astype(jnp.int32)
+    weights, slots = sparse_apply_dense_table(
+        optimizer, state.weights, state.slots,
+        jnp.clip(slot, 0, capacity), grads, pre_counts=pre_counts)
+    return state.replace(weights=weights, slots=slots)

@@ -1,0 +1,78 @@
+"""`EmbeddingVariable` — stateful convenience handle over the functional core.
+
+Counterpart of the reference's Python `Variable` (`tensorflow/exb.py:222-360`:
+`sparse_read`, `pull_weights`, `push_gradients`, `update_weights`,
+`set_server_optimizer`) for users who want the PS-style imperative API directly rather
+than the `Trainer` train-step builder. State lives in `.state` as a pytree; every method
+is a thin wrapper over jitted pure functions.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .embedding import (EmbeddingSpec, EmbeddingTableState, apply_gradients,
+                        init_table_state, lookup, lookup_train)
+from .optimizers import Default, SparseOptimizer
+
+
+class EmbeddingVariable:
+    def __init__(self, spec: EmbeddingSpec, optimizer: Optional[SparseOptimizer] = None,
+                 seed: int = 0):
+        self.spec = spec
+        self.optimizer = optimizer or spec.optimizer or Default()
+        self.state: EmbeddingTableState = init_table_state(
+            spec, self.optimizer, seed=seed)
+        self._pending_ids = None
+        self._pending_grads = None
+
+    # -- reference `Variable.sparse_read` (`exb.py:308-327`): the *training* pull,
+    #    which lazily initializes unseen ids — for hash tables that inserts keys, so
+    #    the table state is threaded through. Use `read_only_pull` for serving.
+    def sparse_read(self, ids) -> jax.Array:
+        self.state, rows = lookup_train(self.spec, self.state, jnp.asarray(ids))
+        return rows
+
+    pull_weights = sparse_read
+
+    # -- reference serving path (`read_only_pull` handler): never inserts
+    def read_only_pull(self, ids) -> jax.Array:
+        return lookup(self.spec, self.state, jnp.asarray(ids))
+
+    # -- reference `Variable.push_gradients`: queue grads; applied at update_weights
+    def push_gradients(self, ids, grads) -> None:
+        ids = jnp.asarray(ids).reshape(-1)
+        grads = jnp.asarray(grads).reshape(-1, self.spec.output_dim)
+        if self._pending_ids is None:
+            self._pending_ids, self._pending_grads = ids, grads
+        else:
+            self._pending_ids = jnp.concatenate([self._pending_ids, ids])
+            self._pending_grads = jnp.concatenate([self._pending_grads, grads])
+
+    # -- reference `Variable.update_weights` (store op): apply queued grads once
+    def update_weights(self) -> None:
+        if self._pending_ids is None:
+            return
+        self.state = apply_gradients(
+            self.spec, self.state, self.optimizer, self._pending_ids,
+            self._pending_grads)
+        self._pending_ids = self._pending_grads = None
+
+    # -- reference `Variable.set_server_optimizer` (`exb.py`): swap optimizer,
+    #    migrating slot state layout (reference hot-swaps table impls via Factory +
+    #    copy_from, `EmbeddingVariable.cpp:29-60`; slots that exist in both layouts are
+    #    carried over, new ones take their init value).
+    def set_optimizer(self, optimizer: SparseOptimizer) -> None:
+        old_slots = self.state.slots
+        rows = self.state.weights.shape[0]
+        new_slots = optimizer.init_slots(rows, self.spec.output_dim,
+                                         self.state.weights.dtype)
+        for name in new_slots:
+            if name in old_slots and old_slots[name].shape == new_slots[name].shape:
+                new_slots[name] = old_slots[name]
+        self.state = self.state.replace(slots=new_slots)
+        self.optimizer = optimizer

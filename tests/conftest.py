@@ -1,0 +1,19 @@
+"""Test harness: simulate an 8-device TPU mesh on CPU.
+
+Mirrors the reference's test strategy of simulating a multi-process cluster inside one
+test binary (`core::MultiProcess` fork harness, `entry/c_api_test.h:195,285`): here one
+process hosts 8 virtual XLA CPU devices and shard_map/pjit run real collectives over
+them (SURVEY.md §4 implication (a)).
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = _flags + " --xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+# 63-bit hashed id spaces need int64 ids (`meta.HASH_VOCABULARY_THRESHOLD`)
+jax.config.update("jax_enable_x64", True)

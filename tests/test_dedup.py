@@ -1,0 +1,66 @@
+"""Unit tests for the static-shape dedup / bucketing primitives (the counterparts of
+the reference's client-side hot loops, `EmbeddingPullOperator.cpp:60-112`)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from openembedding_tpu.ops.dedup import bucket_by_owner, unbucket, unique_with_counts
+
+
+@pytest.mark.parametrize("n,vocab", [(16, 5), (128, 1000), (64, 2)])
+def test_unique_with_counts_matches_numpy(n, vocab):
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, vocab, size=n)
+    res = jax.jit(unique_with_counts)(jnp.asarray(ids))
+    expect_u, expect_c = np.unique(ids, return_counts=True)
+    k = int(res.num_unique)
+    assert k == len(expect_u)
+    np.testing.assert_array_equal(np.asarray(res.unique_ids)[:k], expect_u)
+    np.testing.assert_array_equal(np.asarray(res.counts)[:k], expect_c)
+    # padding slots have count 0
+    assert np.all(np.asarray(res.counts)[k:] == 0)
+    # inverse maps each id back to its unique slot
+    np.testing.assert_array_equal(np.asarray(res.unique_ids)[np.asarray(res.inverse)], ids)
+
+
+def test_unique_single_value():
+    ids = jnp.full((32,), 7, jnp.int32)
+    res = unique_with_counts(ids)
+    assert int(res.num_unique) == 1
+    assert int(res.counts[0]) == 32
+    assert int(res.unique_ids[0]) == 7
+
+
+def test_bucket_unbucket_roundtrip():
+    rng = np.random.default_rng(1)
+    n, shards = 64, 4
+    ids = jnp.asarray(rng.integers(0, 1000, size=n))
+    valid = jnp.asarray(rng.random(n) > 0.2)
+    res = bucket_by_owner(ids, valid, shards, capacity=n)
+    assert int(res.overflow) == 0
+    # every valid id landed in its owner bucket
+    b_ids = np.asarray(res.bucket_ids)
+    b_valid = np.asarray(res.bucket_valid)
+    for s in range(shards):
+        got = sorted(b_ids[s][b_valid[s]].tolist())
+        expect = sorted(int(i) for i, v in zip(np.asarray(ids), np.asarray(valid))
+                        if v and i % shards == s)
+        assert got == expect
+    # unbucket returns each element's own payload
+    payload = b_ids[..., None].astype(np.float32)  # payload = the id itself
+    back = unbucket(jnp.asarray(payload), res.owner, res.slot)
+    back = np.asarray(back)[:, 0]
+    np.testing.assert_array_equal(
+        back[np.asarray(valid)], np.asarray(ids)[np.asarray(valid)].astype(np.float32))
+    # invalid elements read back zeros
+    assert np.all(back[~np.asarray(valid)] == 0)
+
+
+def test_bucket_overflow_counted():
+    ids = jnp.zeros((16,), jnp.int32)  # all owner 0
+    valid = jnp.ones((16,), bool)
+    res = bucket_by_owner(ids, valid, num_shards=4, capacity=4)
+    assert int(res.overflow) == 12
+    assert int(res.bucket_valid.sum()) == 4

@@ -1,0 +1,103 @@
+"""Regression tests for review findings on the round-1 core (bf16 slots, negative-id
+hash corruption, facade lazy-insert, overflow accounting, OOB lookup skew)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import openembedding_tpu as embed
+from openembedding_tpu.embedding import (EmbeddingSpec, apply_gradients,
+                                         init_table_state, lookup, lookup_train)
+
+
+def test_bf16_table_adam_still_updates():
+    """Adam's per-row beta_2^t must not round to 1.0 for bf16 tables (slots stay f32)."""
+    opt = embed.Adam(learning_rate=0.1)
+    spec = EmbeddingSpec(name="b", input_dim=32, output_dim=8, datatype="bfloat16",
+                         initializer=embed.Constant(1.0), variable_id=0)
+    state = init_table_state(spec, opt)
+    assert state.slots["beta_2_t"].dtype == jnp.float32
+    ids = jnp.asarray([1, 2, 3])
+    grads = jnp.ones((3, 8), jnp.bfloat16)
+    state = apply_gradients(spec, state, opt, ids, grads)
+    w = np.asarray(state.weights.astype(jnp.float32))
+    assert not np.allclose(w[1], 1.0), "bf16 row did not move"
+    b2t = float(np.asarray(state.slots["beta_2_t"]).min())
+    assert b2t < 1.0  # touched rows advanced to 0.999 exactly
+
+
+def test_negative_ids_do_not_corrupt_hash_table():
+    """-1 padding ids must neither claim nor update EMPTY slots."""
+    opt = embed.SGD(learning_rate=1.0)
+    spec = EmbeddingSpec(name="h", input_dim=-1, output_dim=4, capacity=64,
+                         initializer=embed.Constant(0.0), variable_id=0)
+    state = init_table_state(spec, opt)
+    ids = jnp.asarray([-1, 7, -1], jnp.int64)
+    state, rows = lookup_train(spec, state, ids)
+    assert int((np.asarray(state.keys) >= 0).sum()) == 1  # only id 7 inserted
+    np.testing.assert_array_equal(np.asarray(rows[0]), 0)
+    grads = jnp.ones((3, 4), jnp.float32)
+    state = apply_gradients(spec, state, opt, ids, grads)
+    keys = np.asarray(state.keys)
+    w = np.asarray(state.weights)
+    # every slot whose key is still EMPTY must be untouched (weights stayed 0)
+    np.testing.assert_array_equal(w[keys == -1], 0.0)
+    # id 7's row got exactly its own gradient applied once
+    np.testing.assert_allclose(w[keys == 7], -1.0, rtol=1e-6)
+
+
+def test_embedding_variable_hash_table_trains():
+    """The facade's training pull must insert ids (was: read-only lookup dropped
+    every gradient)."""
+    var = embed.EmbeddingVariable(
+        EmbeddingSpec(name="h", input_dim=-1, output_dim=4, capacity=128,
+                      initializer=embed.Constant(1.0), variable_id=0),
+        optimizer=embed.SGD(learning_rate=1.0))
+    rows = var.sparse_read(jnp.asarray([3, 5], jnp.int64))
+    np.testing.assert_allclose(np.asarray(rows), 1.0)  # initializer value, not zeros
+    var.push_gradients(jnp.asarray([3, 5], jnp.int64), jnp.ones((2, 4), jnp.float32))
+    var.update_weights()
+    after = np.asarray(var.read_only_pull(jnp.asarray([3, 5, 9], jnp.int64)))
+    np.testing.assert_allclose(after[:2], 0.0, atol=1e-6)  # 1 - 1.0*1
+    np.testing.assert_allclose(after[2], 0.0)  # 9 never inserted -> zeros
+
+
+def test_hash_overflow_is_surfaced():
+    opt = embed.SGD(learning_rate=0.1)
+    spec = EmbeddingSpec(name="h", input_dim=-1, output_dim=2, capacity=4,
+                         variable_id=0)
+    state = init_table_state(spec, opt)
+    ids = jnp.asarray(np.arange(10), jnp.int64)
+    state, _ = lookup_train(spec, state, ids)
+    assert int(state.overflow) == 6  # 4 fit, 6 overflowed
+    state, _ = lookup_train(spec, state, ids)
+    assert int(state.overflow) == 12  # cumulative
+
+
+def test_out_of_range_lookup_returns_zeros():
+    """Array-table lookup of id >= input_dim returns zeros (not the last row), matching
+    the gradient path which drops those ids."""
+    opt = embed.SGD(learning_rate=0.1)
+    spec = EmbeddingSpec(name="a", input_dim=8, output_dim=4,
+                         initializer=embed.Constant(2.0), variable_id=0)
+    state = init_table_state(spec, opt)
+    rows = np.asarray(lookup(spec, state, jnp.asarray([7, 8, 100, -3])))
+    np.testing.assert_allclose(rows[0], 2.0)
+    np.testing.assert_allclose(rows[1:], 0.0)
+
+
+def test_sad_with_per_variable_optimizer_rejected():
+    import flax.linen as nn
+
+    class M(nn.Module):
+        @nn.compact
+        def __call__(self, embedded, dense):
+            return jnp.zeros((1,))
+
+    with pytest.raises(ValueError, match="sparse_as_dense"):
+        embed.EmbeddingModel(M(), [
+            embed.Embedding(10, 4, name="x", sparse_as_dense=True,
+                            optimizer=embed.SGD(learning_rate=0.0))])
