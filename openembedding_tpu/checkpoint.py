@@ -51,13 +51,23 @@ def _unflatten_params(flat: Dict[str, np.ndarray]) -> Any:
 
 
 def save_server_model(state, model, path: str, *, include_optimizer: bool = True,
-                      model_sign: str = "") -> ModelMeta:
+                      model_sign: str = "", num_shards: int = 1) -> ModelMeta:
     """Dump the full train state (reference: `exb.save_server_model` /
-    `Model::dump_model`). `state` is a `TrainState`; tables are written in global id
-    order so any future mesh size can load them."""
+    `Model::dump_model`).
+
+    `num_shards` is the mesh size the state was trained on (1 for the single-device
+    Trainer; `MeshTrainer.save` passes its own). Array tables are de-interleaved to
+    **global id order** on disk and hash tables are compacted to (id, row, slots)
+    triples sorted by id, so a load at ANY future mesh size is a pure relayout
+    (reference: key remap `index*shard_num + shard_id` on load,
+    `EmbeddingShardFile.h:23-25`). NOTE: this single-host path gathers each table to
+    host RAM; the streaming per-shard writer is future work (`parallel` checkpoint).
+    """
+    from .parallel.sharded import deinterleave_rows
+
     os.makedirs(path, exist_ok=True)
     model_sign = model_sign or f"{uuid_mod.uuid4().hex}-{int(state.model_version)}"
-    meta = ModelMeta(model_sign=model_sign, uri=path, num_shards=1)
+    meta = ModelMeta(model_sign=model_sign, uri=path, num_shards=num_shards)
 
     for name, spec in model.specs.items():
         vdir = os.path.join(path, f"variable_{spec.variable_id}")
@@ -77,12 +87,26 @@ def save_server_model(state, model, path: str, *, include_optimizer: bool = True
             # second copy here would just be dead weight on disk
             continue
         ts = state.tables[name]
-        np.save(os.path.join(vdir, "weights.npy"), np.asarray(ts.weights))
-        if ts.keys is not None:
-            np.save(os.path.join(vdir, "keys.npy"), np.asarray(ts.keys))
-        if include_optimizer:
-            for slot_name, arr in ts.slots.items():
-                np.save(os.path.join(vdir, f"slot_{slot_name}.npy"), np.asarray(arr))
+        if spec.use_hash_table:
+            # compact to id-sorted (ids, rows, slots): layout-independent on disk
+            keys = np.asarray(ts.keys)
+            sel = keys >= 0
+            order = np.argsort(keys[sel], kind="stable")
+            np.save(os.path.join(vdir, "ids.npy"), keys[sel][order])
+            np.save(os.path.join(vdir, "weights.npy"),
+                    np.asarray(ts.weights)[sel][order])
+            if include_optimizer:
+                for slot_name, arr in ts.slots.items():
+                    np.save(os.path.join(vdir, f"slot_{slot_name}.npy"),
+                            np.asarray(arr)[sel][order])
+        else:
+            vocab = spec.input_dim
+            np.save(os.path.join(vdir, "weights.npy"),
+                    deinterleave_rows(np.asarray(ts.weights), num_shards, vocab))
+            if include_optimizer:
+                for slot_name, arr in ts.slots.items():
+                    np.save(os.path.join(vdir, f"slot_{slot_name}.npy"),
+                            deinterleave_rows(np.asarray(arr), num_shards, vocab))
 
     dense = _flatten_params(state.dense_params)
     np.savez(os.path.join(path, "dense_params.npz"), **dense)
@@ -105,10 +129,53 @@ def read_model_meta(path: str) -> ModelMeta:
         return ModelMeta.from_json(f.read())
 
 
-def load_server_model(state, model, path: str):
+def _np_interleave(id_major: np.ndarray, num_shards: int) -> np.ndarray:
+    """id-major (vocab, k) -> shard-major (rps*S, k), zero-padded (host-side twin of
+    `parallel.sharded.interleave_rows`)."""
+    vocab, k = id_major.shape
+    rps = -(-vocab // num_shards)
+    out = np.zeros((rps * num_shards, k), id_major.dtype)
+    out[:vocab] = id_major
+    return np.ascontiguousarray(
+        out.reshape(rps, num_shards, k).transpose(1, 0, 2).reshape(-1, k))
+
+
+def _np_hash_insert(keys: np.ndarray, ids: np.ndarray, num_shards: int,
+                    num_probes: int = 1024) -> np.ndarray:
+    """Host-side re-insertion of checkpointed hash keys into a (possibly different)
+    shard layout, using the SAME probe sequence as the device kernel
+    (`tables/hash_table.py`: base = mix(id) % capacity, linear probing inside the
+    owning shard's slot range). Mutates `keys`; returns global slot per id (-1 =
+    dropped: capacity exhausted on that shard)."""
+    from .tables.hash_table import np_mix
+
+    rows_total = keys.shape[0]
+    cps = rows_total // num_shards
+    owner = (ids % num_shards).astype(np.int64)
+    base = (np_mix(ids) % np.uint64(cps)).astype(np.int64) \
+        if ids.dtype.itemsize >= 8 else (np_mix(ids) % np.uint32(cps)).astype(np.int64)
+    pos_out = np.full(len(ids), -1, np.int64)
+    for i in range(len(ids)):
+        start = owner[i] * cps
+        b = base[i]
+        for d in range(min(num_probes, cps)):
+            p = start + (b + d) % cps
+            if keys[p] == -1:
+                keys[p] = ids[i]
+                pos_out[i] = p
+                break
+    return pos_out
+
+
+def load_server_model(state, model, path: str, *, num_shards: int = 1):
     """Restore into an existing TrainState (reference: `exb.load_server_model` /
     `Model::load_model` — meta check, clear all weights, stream per-variable files).
-    Returns the new TrainState."""
+
+    `num_shards` is the TARGET mesh size (the layout of `state`) — it may differ from
+    the dump-time `meta.num_shards`: array tables re-interleave, hash tables re-insert
+    key by key (reference: checkpoint at np=2 restored at np=8 is covered by its e2e
+    sweep, `build.sh:91-150`). Returns the new TrainState with the input state's
+    shardings preserved."""
     with open(os.path.join(path, MODEL_META_FILE)) as f:
         raw = f.read()
     meta = ModelMeta.from_json(raw)
@@ -120,7 +187,8 @@ def load_server_model(state, model, path: str):
                              f"(reference load_model rejects meta mismatch too)")
         ckpt_meta = by_name[name].meta
         if (ckpt_meta.embedding_dim != spec.meta.embedding_dim
-                or ckpt_meta.datatype != spec.meta.datatype):
+                or ckpt_meta.datatype != spec.meta.datatype
+                or ckpt_meta.vocabulary_size != spec.meta.vocabulary_size):
             raise ValueError(f"variable {name!r} meta mismatch: "
                              f"{ckpt_meta} vs {spec.meta}")
 
@@ -138,19 +206,45 @@ def load_server_model(state, model, path: str):
             continue
         vdir = os.path.join(path, f"variable_{spec.variable_id}")
         ts = state.tables[name]
-        weights = jnp.asarray(np.load(os.path.join(vdir, "weights.npy")))
-        slots = dict(ts.slots)
-        for slot_name in list(slots):
-            p = os.path.join(vdir, f"slot_{slot_name}.npy")
-            if os.path.exists(p):
-                slots[slot_name] = jnp.asarray(np.load(p))
-            # else: optimizer state was dumped without slots; keep fresh init
-            # (reference load with include_optimizer=False resets states too)
-        keys = ts.keys
-        kp = os.path.join(vdir, "keys.npy")
-        if keys is not None and os.path.exists(kp):
-            keys = jnp.asarray(np.load(kp))
-        new_tables[name] = ts.replace(weights=weights, slots=slots, keys=keys)
+
+        def _put(np_arr, like):
+            arr = jnp.asarray(np_arr.astype(like.dtype))
+            sharding = getattr(like, "sharding", None)
+            return jax.device_put(arr, sharding) if sharding is not None else arr
+
+        if spec.use_hash_table:
+            ids = np.load(os.path.join(vdir, "ids.npy"))
+            w_rows = np.load(os.path.join(vdir, "weights.npy"))
+            keys_np = np.full(ts.keys.shape, -1, np.asarray(ts.keys).dtype)
+            pos = _np_hash_insert(keys_np, ids.astype(keys_np.dtype), num_shards)
+            placed = pos >= 0
+            weights_np = np.asarray(ts.weights).copy()
+            weights_np[pos[placed]] = w_rows[placed]
+            slots = dict(ts.slots)
+            for slot_name in list(slots):
+                p = os.path.join(vdir, f"slot_{slot_name}.npy")
+                if os.path.exists(p):
+                    s_np = np.asarray(ts.slots[slot_name]).copy()
+                    s_np[pos[placed]] = np.load(p)[placed]
+                    slots[slot_name] = _put(s_np, ts.slots[slot_name])
+            new_tables[name] = ts.replace(
+                weights=_put(weights_np, ts.weights),
+                slots=slots,
+                keys=_put(keys_np, ts.keys),
+                overflow=jnp.asarray(int((~placed).sum()), jnp.int32))
+        else:
+            w_id = np.load(os.path.join(vdir, "weights.npy"))
+            weights = _put(_np_interleave(w_id, num_shards), ts.weights)
+            slots = dict(ts.slots)
+            for slot_name in list(slots):
+                p = os.path.join(vdir, f"slot_{slot_name}.npy")
+                if os.path.exists(p):
+                    slots[slot_name] = _put(
+                        _np_interleave(np.load(p), num_shards),
+                        ts.slots[slot_name])
+                # else: optimizer state was dumped without slots; keep fresh init
+                # (reference load with include_optimizer=False resets states too)
+            new_tables[name] = ts.replace(weights=weights, slots=slots)
 
     return state.replace(
         step=jnp.asarray(extra.get("step", 0), jnp.int32),

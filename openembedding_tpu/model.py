@@ -122,11 +122,25 @@ class Trainer:
     `parallel.MeshTrainer`, which reuses these per-device step functions.
     """
 
+    num_shards = 1  # MeshTrainer overrides with the mesh size
+
     def __init__(self, model: EmbeddingModel,
                  optimizer: Optional[SparseOptimizer] = None, seed: int = 0):
         self.model = model
         self.optimizer = optimizer or Adagrad()
         self.seed = seed
+
+    # -- checkpointing (reference: model.save/save_weights/load_weights wiring,
+    #    `exb.py:550-583`) -------------------------------------------------------
+    def save(self, state: "TrainState", path: str, **kw):
+        from .checkpoint import save_server_model
+        return save_server_model(state, self.model, path,
+                                 num_shards=self.num_shards, **kw)
+
+    def load(self, state: "TrainState", path: str):
+        from .checkpoint import load_server_model
+        return load_server_model(state, self.model, path,
+                                 num_shards=self.num_shards)
 
     def opt_for(self, spec: EmbeddingSpec) -> SparseOptimizer:
         return spec.optimizer or self.optimizer
@@ -148,10 +162,7 @@ class Trainer:
         if sad:
             params = dict(params)
             params["__embeddings__"] = sad
-        tables = {
-            name: init_table_state(spec, self.opt_for(spec), seed=self.seed)
-            for name, spec in self.model.ps_specs().items()
-        }
+        tables = self.init_tables()
         return TrainState(
             step=jnp.zeros((), jnp.int32),
             dense_params=params,
@@ -159,6 +170,14 @@ class Trainer:
             tables=tables,
             model_version=jnp.zeros((), jnp.int32),
         )
+
+    def init_tables(self) -> Dict[str, EmbeddingTableState]:
+        """Hook: single-device tables. MeshTrainer overrides to create the tables
+        directly sharded (a huge table must never materialize on one device)."""
+        return {
+            name: init_table_state(spec, self.opt_for(spec), seed=self.seed)
+            for name, spec in self.model.ps_specs().items()
+        }
 
     def module_init(self, key, embedded, dense_inputs):
         return self.model.module.init(key, embedded, dense_inputs)
@@ -188,9 +207,14 @@ class Trainer:
         # Hash tables insert unseen ids here, so pull threads the table state.
         pulled = {}
         pulled_tables = {}
+        pull_plans = {}
+        stats = {}
         for name, spec in ps_specs.items():
-            pulled_tables[name], pulled[name] = self.table_pull(
-                spec, state.tables[name], jnp.asarray(batch["sparse"][name]))
+            pulled_tables[name], pulled[name], pull_stats, pull_plans[name] = \
+                self.table_pull(spec, state.tables[name],
+                                jnp.asarray(batch["sparse"][name]))
+            for k, v in pull_stats.items():
+                stats[f"{name}/{k}"] = v
 
         def loss_fn(dense_params, pulled_rows):
             embedded = dict(pulled_rows)
@@ -214,9 +238,11 @@ class Trainer:
         # SPARSE push+update (reference: PushGradients + UpdateWeights store op)
         new_tables = dict(state.tables)
         for name, spec in ps_specs.items():
-            new_tables[name] = self.table_apply(
+            new_tables[name], push_stats = self.table_apply(
                 spec, pulled_tables[name], jnp.asarray(batch["sparse"][name]),
-                row_grads[name])
+                row_grads[name], pull_plans[name])
+            for k, v in push_stats.items():
+                stats[f"{name}/{k}"] = v
 
         new_state = TrainState(
             step=state.step + 1,
@@ -225,18 +251,26 @@ class Trainer:
             tables=new_tables,
             model_version=state.model_version + 1,
         )
-        metrics = {"loss": loss, "logits": logits}
+        metrics = self.reduce_metrics({"loss": loss, "logits": logits,
+                                       "stats": stats})
         return new_state, metrics
 
     # hooks overridden by MeshTrainer:
     def reduce_dense_grads(self, grads):
         return grads
 
-    def table_pull(self, spec, table, ids):
-        return lookup_train(spec, table, ids)
+    def reduce_metrics(self, metrics):
+        return metrics
 
-    def table_apply(self, spec, table, ids, grads):
-        return apply_gradients(spec, table, self.opt_for(spec), ids, grads)
+    def table_pull(self, spec, table, ids):
+        """-> (new_table, rows, stats, plan). The plan (routing/dedup state) is handed
+        back to table_apply so push reuses pull's work; None on single device."""
+        table, rows = lookup_train(spec, table, ids)
+        return table, rows, {}, None
+
+    def table_apply(self, spec, table, ids, grads, plan=None):
+        """-> (new_table, stats)."""
+        return apply_gradients(spec, table, self.opt_for(spec), ids, grads), {}
 
     def table_lookup(self, spec, table, ids):
         return lookup(spec, table, ids)

@@ -1,0 +1,4 @@
+from .mesh import make_mesh, table_sharding, replicated, batch_sharding
+from .sharded import (sharded_lookup_train, sharded_lookup, sharded_apply_gradients,
+                      deinterleave_rows, interleave_rows)
+from .trainer import MeshTrainer

@@ -1,0 +1,51 @@
+"""Device-mesh layout helpers.
+
+The reference's process topology (master + N PS shards + M Horovod workers,
+`client/EnvConfig.h`, `WorkerContext.cpp`) collapses on TPU into one SPMD program over a
+`jax.sharding.Mesh`. One 1-D axis ("data") plays both roles:
+
+- every device is a *worker*: the batch is sharded over 'data' and dense grads psum
+  over it (the reference's Horovod allreduce);
+- every device is a *server*: embedding rows are sharded over the same axis (the
+  reference's embedded one-server-per-worker mode, `wait_num_servers == -1`,
+  `openembedding/__init__.py:27-31`, `client/WorkerContext.cpp:12-16`).
+
+Multi-host: build the mesh over `jax.devices()` (all hosts) and let ICI/DCN carry the
+collectives — the reference's TCP/RDMA RPC + master rendezvous are obviated by the JAX
+runtime's own coordination service.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+
+
+def make_mesh(devices: Optional[Sequence] = None, axis: str = DATA_AXIS) -> Mesh:
+    devices = list(devices) if devices is not None else jax.devices()
+    return Mesh(np.array(devices), (axis,))
+
+
+def table_sharding(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:
+    """Embedding tables: rows sharded over the mesh (reference: PS shard placement,
+    `Model.cpp:153-186`)."""
+    return NamedSharding(mesh, P(axis, None))
+
+
+def keys_sharding(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    """Dense params/opt state: replicated (the reference broadcasts + allreduces)."""
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:
+    """Batches: leading dim sharded = each device is one data-parallel worker."""
+    return NamedSharding(mesh, P(axis))

@@ -1,0 +1,237 @@
+"""Sharded pull/push: the reference's PS wire protocol re-expressed as ICI collectives.
+
+These functions run **inside shard_map** on a 1-D mesh of S devices. Each device holds
+one table shard (rows where `id % S == shard_index`, the reference's layout,
+`EmbeddingPullOperator.cpp:74-84`) and one slice of the batch.
+
+PULL (reference `EmbeddingPullOperator`, client dedup -> per-node RPC -> server gather
+-> client reassemble):
+  1. dedup local ids (client-side dedup, `c_api.cc:220-231`)
+  2. bucket unique ids by owner shard (the per-node request vectors)
+  3. `all_to_all` id buckets            [the RPC fan-out, now one ICI collective]
+  4. gather rows from the local shard (server hot loop; hash tables lazily insert —
+     the reference's `_new_weights` init-on-pull)
+  5. `all_to_all` rows back, un-bucket, expand duplicates (client `apply_response`)
+
+PUSH+UPDATE (reference `EmbeddingPushOperator` + `EmbeddingStoreOperator`, collapsed:
+SPMD needs no batch-version gate):
+  1. reuse the pull's dedup/bucketing/exchange plan (the reference likewise keeps the
+     pull request around; recomputing would double the hot-path sort + id all_to_all)
+  2. segment-sum local grads + counts into the unique slots (client pre-sum, `:29-62`)
+  3. bucket + `all_to_all` grads/counts along the same routes
+  4. owner re-dedups across sources (the MPSC reducer, `MpscGradientReducer.h`) and
+     applies the fused optimizer once per unique row
+
+Static capacity: each (src, dst) bucket holds `capacity` ids. `capacity == n` is exact
+but moves S*n ids; real workloads set a capacity_factor so capacity ~ factor * n / S
+and watch the overflow counters (dropped ids pull zeros / drop grads — divergence from
+the reference's unbounded buffers, surfaced in metrics).
+
+Out-of-vocab ids (array tables) are masked invalid end to end: they pull zeros and
+their gradients are dropped, identical to the single-device path (`ops/sparse.py`).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..embedding import EmbeddingSpec, EmbeddingTableState
+from ..ops.dedup import BucketResult, UniqueResult, bucket_by_owner, unbucket, \
+    unique_with_counts
+from ..ops.sparse import lookup_rows, sparse_apply_dense_table
+from .mesh import DATA_AXIS
+
+
+class ExchangePlan(NamedTuple):
+    """The routing state shared between a pull and its matching push (reference: the
+    cached request/offset maps inside the pull handler reused at apply_response and
+    by the push for the same batch)."""
+
+    uniq: UniqueResult
+    buckets: BucketResult
+    recv_ids: jax.Array    # (S, cap) ids this shard must serve
+    recv_valid: jax.Array  # (S, cap)
+    cap: int
+
+
+def _bucket_capacity(n: int, num_shards: int, capacity_factor: float) -> int:
+    if capacity_factor <= 0:  # exact mode
+        return n
+    return max(1, min(n, int(-(-capacity_factor * n // num_shards))))
+
+
+def _id_valid(spec: EmbeddingSpec, ids: jax.Array) -> jax.Array:
+    """In-vocab mask. Hash tables accept any non-negative id; array tables reject
+    ids outside [0, input_dim) so padded shard rows are never read or trained."""
+    if spec.use_hash_table:
+        return ids >= 0
+    return (ids >= 0) & (ids < spec.input_dim)
+
+
+def make_plan(spec: EmbeddingSpec, ids: jax.Array, *, axis: str = DATA_AXIS,
+              capacity_factor: float = 0.0) -> ExchangePlan:
+    """Dedup local ids, bucket by owner, exchange the id buckets (one all_to_all)."""
+    S = jax.lax.axis_size(axis)
+    flat = ids.reshape(-1)
+    n = flat.shape[0]
+    uniq = unique_with_counts(flat)
+    valid = (uniq.counts > 0) & _id_valid(spec, uniq.unique_ids)
+    cap = _bucket_capacity(n, S, capacity_factor)
+    buckets = bucket_by_owner(uniq.unique_ids, valid, S, cap)
+    # [BOUNDARY: was one RPC per owning server; now one ICI all_to_all]
+    recv_ids = jax.lax.all_to_all(buckets.bucket_ids, axis, 0, 0)
+    recv_valid = jax.lax.all_to_all(buckets.bucket_valid, axis, 0, 0)
+    return ExchangePlan(uniq, buckets, recv_ids, recv_valid, cap)
+
+
+def _serve_rows(spec: EmbeddingSpec, state: EmbeddingTableState,
+                plan: ExchangePlan, *, train: bool, axis: str
+                ) -> Tuple[EmbeddingTableState, jax.Array]:
+    """Server side of a pull: gather this shard's rows for the received ids."""
+    S = jax.lax.axis_size(axis)
+    flat_recv = plan.recv_ids.reshape(-1)
+    flat_valid = plan.recv_valid.reshape(-1)
+    if spec.use_hash_table:
+        probe = jnp.where(flat_valid, flat_recv, -1)
+        if train:
+            from ..tables.hash_table import hash_lookup_train
+            old_overflow = state.overflow
+            state, rows = hash_lookup_train(state, probe)
+            # overflow is replicated table-level state: psum the per-shard increment
+            delta = jax.lax.psum(state.overflow - old_overflow, axis)
+            state = state.replace(overflow=old_overflow + delta)
+        else:
+            from ..tables.hash_table import hash_lookup
+            rows = hash_lookup(state, probe)
+    else:
+        local_rows = jnp.where(flat_valid, flat_recv // S, -1)
+        rows = lookup_rows(state.weights, local_rows)
+    return state, rows.reshape(S, plan.cap, spec.output_dim)
+
+
+def _reassemble(plan: ExchangePlan, rows: jax.Array, out_shape,
+                dim: int, axis: str) -> jax.Array:
+    """Client side: rows back over the a2a, un-bucket, expand duplicates."""
+    back = jax.lax.all_to_all(rows, axis, 0, 0)
+    uniq_rows = unbucket(back, plan.buckets.owner, plan.buckets.slot)
+    out = jnp.take(uniq_rows, plan.uniq.inverse, axis=0)
+    return out.reshape(out_shape + (dim,))
+
+
+def sharded_lookup_train(
+    spec: EmbeddingSpec,
+    state: EmbeddingTableState,
+    ids: jax.Array,
+    *,
+    axis: str = DATA_AXIS,
+    capacity_factor: float = 0.0,
+) -> Tuple[EmbeddingTableState, jax.Array, Dict[str, jax.Array], ExchangePlan]:
+    """Training pull inside shard_map. Returns (new_shard_state, rows, stats, plan);
+    feed the plan to `sharded_apply_gradients` for the same batch."""
+    plan = make_plan(spec, ids, axis=axis, capacity_factor=capacity_factor)
+    state, rows = _serve_rows(spec, state, plan, train=True, axis=axis)
+    out = _reassemble(plan, rows, ids.shape, spec.output_dim, axis)
+    stats = {
+        "pull_indices": jnp.asarray(ids.size, jnp.int32),   # reference accumulator
+        "pull_unique": plan.uniq.num_unique,                # `pull_unique` counter
+        "pull_overflow": plan.buckets.overflow,
+    }
+    return state, out, stats, plan
+
+
+def sharded_lookup(
+    spec: EmbeddingSpec,
+    state: EmbeddingTableState,
+    ids: jax.Array,
+    *,
+    axis: str = DATA_AXIS,
+    capacity_factor: float = 0.0,
+) -> jax.Array:
+    """Read-only pull (serving/eval; reference `read_only_pull` handler — never
+    inserts, absent hash ids return zeros)."""
+    plan = make_plan(spec, ids, axis=axis, capacity_factor=capacity_factor)
+    _, rows = _serve_rows(spec, state, plan, train=False, axis=axis)
+    return _reassemble(plan, rows, ids.shape, spec.output_dim, axis)
+
+
+def sharded_apply_gradients(
+    spec: EmbeddingSpec,
+    state: EmbeddingTableState,
+    optimizer,
+    ids: jax.Array,
+    grads: jax.Array,
+    *,
+    axis: str = DATA_AXIS,
+    capacity_factor: float = 0.0,
+    plan: Optional[ExchangePlan] = None,
+) -> Tuple[EmbeddingTableState, Dict[str, jax.Array]]:
+    """Push + fused update inside shard_map. Pass the pull's `plan` to skip the
+    duplicate dedup/bucketing and id exchange."""
+    S = jax.lax.axis_size(axis)
+    if plan is None:
+        plan = make_plan(spec, ids, axis=axis, capacity_factor=capacity_factor)
+    gflat = grads.reshape(-1, spec.output_dim)
+    n = gflat.shape[0]
+    uniq, buckets, cap = plan.uniq, plan.buckets, plan.cap
+    # client-side pre-sum over local duplicates (`EmbeddingPushOperator.cpp:29-62`)
+    g = jax.ops.segment_sum(gflat, uniq.inverse, num_segments=n)
+    valid = (uniq.counts > 0) & _id_valid(spec, uniq.unique_ids)
+    # scatter grads/counts into the plan's bucket positions (payload follows its id)
+    flat_pos = jnp.where((buckets.owner < S) & (buckets.slot < cap),
+                         buckets.owner * cap + buckets.slot, S * cap)
+    g_buckets = jnp.zeros((S * cap, spec.output_dim), g.dtype).at[flat_pos].set(
+        g, mode="drop").reshape(S, cap, spec.output_dim)
+    c_buckets = jnp.zeros((S * cap,), jnp.int32).at[flat_pos].set(
+        jnp.where(valid, uniq.counts, 0), mode="drop").reshape(S, cap)
+
+    recv_g = jax.lax.all_to_all(g_buckets, axis, 0, 0)
+    recv_c = jax.lax.all_to_all(c_buckets, axis, 0, 0)
+
+    # server side: cross-source re-dedup + fused optimizer (MPSC reduce + update)
+    rids = plan.recv_ids.reshape(-1)
+    rg = recv_g.reshape(-1, spec.output_dim)
+    rc = recv_c.reshape(-1)
+    if spec.use_hash_table:
+        from ..tables.hash_table import hash_find
+        slot = hash_find(state.keys,
+                         jnp.where(rc > 0, rids, -1).astype(state.keys.dtype))
+        capacity = state.keys.shape[0]
+        pre_counts = jnp.where((slot < capacity) & (rc > 0), rc, 0)
+        weights, slots = sparse_apply_dense_table(
+            optimizer, state.weights, state.slots,
+            jnp.clip(slot, 0, capacity), rg, pre_counts=pre_counts)
+    else:
+        local_rows = jnp.where(rc > 0, rids // S, state.weights.shape[0])
+        weights, slots = sparse_apply_dense_table(
+            optimizer, state.weights, state.slots, local_rows, rg, pre_counts=rc)
+    stats = {"push_overflow": buckets.overflow}
+    return state.replace(weights=weights, slots=slots), stats
+
+
+# ---------------------------------------------------------------------------
+# Layout converters for checkpointing / export.
+# Shard-major storage: global array row (shard * rows_per_shard + local) holds id
+# (local * S + shard). Checkpoints are written in plain id order (reference: load
+# remaps keys `index*shard_num + shard_id`, `EmbeddingShardFile.h:23-25`), so any
+# future mesh size can reshard by pure relayout.
+# ---------------------------------------------------------------------------
+
+
+def deinterleave_rows(global_rows, num_shards: int, vocab: int):
+    """(S*rps, dim) shard-major -> (vocab, dim) id-major. Works on np or jnp."""
+    rps = global_rows.shape[0] // num_shards
+    per_shard = global_rows.reshape(num_shards, rps, -1)
+    id_major = per_shard.transpose(1, 0, 2).reshape(num_shards * rps, -1)
+    return id_major[:vocab]
+
+
+def interleave_rows(id_major: jax.Array, num_shards: int) -> jax.Array:
+    """(vocab, dim) id-major -> (S*rps, dim) shard-major, zero-padded."""
+    vocab, dim = id_major.shape
+    rps = -(-vocab // num_shards)
+    padded = jnp.zeros((rps * num_shards, dim), id_major.dtype).at[:vocab].set(id_major)
+    return padded.reshape(rps, num_shards, dim).transpose(1, 0, 2).reshape(
+        num_shards * rps, dim)

@@ -44,6 +44,19 @@ def _mix(ids: jax.Array) -> jax.Array:
     return u
 
 
+def np_mix(ids):
+    """Numpy mirror of `_mix` — MUST stay in sync: checkpoint load re-inserts keys
+    host-side using the same probe sequence so the device `hash_find` locates them."""
+    import numpy as np
+    if ids.dtype.itemsize >= 8:
+        u = ids.astype(np.uint64)
+        u = (u ^ (u >> np.uint64(33))) * np.uint64(0xFF51AFD7ED558CCD)
+        return u ^ (u >> np.uint64(33))
+    u = ids.astype(np.uint32)
+    u = (u ^ (u >> np.uint32(16))) * np.uint32(0x45D9F3B)
+    return u ^ (u >> np.uint32(16))
+
+
 def hash_find_or_insert(keys: jax.Array, ids: jax.Array,
                         num_probes: int = DEFAULT_NUM_PROBES
                         ) -> Tuple[jax.Array, jax.Array, jax.Array]:

@@ -1,0 +1,144 @@
+"""Checkpoint round-trip tests incl. topology change (the reference's e2e sweep covers
+checkpoint at np=2 -> restore at np=8, `build.sh:91-150`; SURVEY.md §4 implication (c))."""
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import openembedding_tpu as embed
+from openembedding_tpu.parallel import MeshTrainer, deinterleave_rows, make_mesh
+
+S = 8
+
+
+class TinyDense(nn.Module):
+    @nn.compact
+    def __call__(self, embedded, dense_inputs):
+        parts = [embedded[k].reshape(embedded[k].shape[0], -1)
+                 for k in sorted(embedded)]
+        x = jnp.concatenate(parts, axis=-1)
+        return nn.Dense(1)(x)[:, 0]
+
+
+def make_batch(rng, vocab, B, hash_ids=False):
+    if hash_ids:
+        ids = rng.integers(0, 2**61, size=(B, 3), dtype=np.int64)
+    else:
+        ids = rng.integers(0, vocab, size=(B, 3))
+    y = (ids.sum(axis=1) % 2).astype(np.float32)
+    return {"sparse": {"emb": jnp.asarray(ids)}, "label": jnp.asarray(y)}
+
+
+def build(vocab, trainer_cls, capacity=0, **kw):
+    layer = embed.Embedding(vocab, 8, name="emb", capacity=capacity)
+    model = embed.EmbeddingModel(TinyDense(), [layer])
+    return embed.Trainer(model, optimizer=embed.Adagrad(learning_rate=0.05)) \
+        if trainer_cls is embed.Trainer else \
+        trainer_cls(model, optimizer=embed.Adagrad(learning_rate=0.05), **kw)
+
+
+def train_some(trainer, batch, steps=10, mesh=False):
+    state = trainer.init(batch)
+    step = (trainer.jit_train_step(batch, state) if mesh
+            else trainer.jit_train_step())
+    for _ in range(steps):
+        state, m = step(state, batch)
+    return state, m
+
+
+def test_mesh_to_single_roundtrip(tmp_path):
+    """Train on 8-way mesh, save, restore into a single-device trainer: every id's row
+    and optimizer slot must match exactly."""
+    rng = np.random.default_rng(0)
+    vocab = 201  # deliberately not divisible by 8 (padding rows in play)
+    mesh = make_mesh()
+    tr_mesh = build(vocab, MeshTrainer, mesh=mesh)
+    batch = make_batch(rng, vocab, 16 * S)
+    state, _ = train_some(tr_mesh, batch, mesh=True)
+    tr_mesh.save(state, str(tmp_path / "ckpt"))
+
+    tr_one = build(vocab, embed.Trainer)
+    st1 = tr_one.init(batch)
+    st1 = tr_one.load(st1, str(tmp_path / "ckpt"))
+
+    expect_w = deinterleave_rows(np.asarray(state.tables["emb"].weights), S, vocab)
+    np.testing.assert_array_equal(np.asarray(st1.tables["emb"].weights), expect_w)
+    expect_a = deinterleave_rows(np.asarray(state.tables["emb"].slots["accum"]),
+                                 S, vocab)
+    np.testing.assert_array_equal(np.asarray(st1.tables["emb"].slots["accum"]),
+                                  expect_a)
+    # dense params too
+    for a, b in zip(jax.tree_util.tree_leaves(state.dense_params),
+                    jax.tree_util.tree_leaves(st1.dense_params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert int(st1.step) == 10
+
+
+def test_single_to_mesh_roundtrip(tmp_path):
+    """Reverse direction: single-device training restored onto the mesh; sharded
+    lookups must return the same rows."""
+    rng = np.random.default_rng(1)
+    vocab = 100
+    tr_one = build(vocab, embed.Trainer)
+    batch = make_batch(rng, vocab, 16 * S)
+    state1, _ = train_some(tr_one, batch)
+    tr_one.save(state1, str(tmp_path / "ckpt"))
+
+    mesh = make_mesh()
+    tr_mesh = build(vocab, MeshTrainer, mesh=mesh)
+    st = tr_mesh.init(batch)
+    st = tr_mesh.load(st, str(tmp_path / "ckpt"))
+    got = deinterleave_rows(np.asarray(st.tables["emb"].weights), S, vocab)
+    np.testing.assert_array_equal(got, np.asarray(state1.tables["emb"].weights))
+    # and the restored mesh state keeps training
+    step = tr_mesh.jit_train_step(batch, st)
+    st, m = step(st, batch)
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_hash_table_topology_change(tmp_path):
+    """Hash-table variables: mesh-trained keys re-inserted into a single-device table;
+    every trained id must read back its exact row."""
+    rng = np.random.default_rng(2)
+    mesh = make_mesh()
+    tr_mesh = build(-1, MeshTrainer, capacity=4096, mesh=mesh)
+    batch = make_batch(rng, -1, 16 * S, hash_ids=True)
+    state, _ = train_some(tr_mesh, batch, mesh=True)
+    tr_mesh.save(state, str(tmp_path / "ckpt"))
+
+    tr_one = build(-1, embed.Trainer, capacity=4096)
+    st1 = tr_one.init(batch)
+    st1 = tr_one.load(st1, str(tmp_path / "ckpt"))
+    assert int(st1.tables["emb"].overflow) == 0
+
+    ids = np.unique(np.asarray(batch["sparse"]["emb"]).reshape(-1))
+    from openembedding_tpu.embedding import lookup
+    got = np.asarray(lookup(tr_one.model.specs["emb"], st1.tables["emb"],
+                            jnp.asarray(ids)))
+    want = np.asarray(tr_mesh.jit_eval_step(batch, state)(
+        state, batch))  # not comparable directly; instead compare via mesh lookup
+    # simpler oracle: compacted dump itself
+    dumped_ids = np.load(tmp_path / "ckpt" / "variable_0" / "ids.npy")
+    dumped_w = np.load(tmp_path / "ckpt" / "variable_0" / "weights.npy")
+    lut = {int(i): dumped_w[k] for k, i in enumerate(dumped_ids)}
+    for k, i in enumerate(ids):
+        np.testing.assert_array_equal(got[k], lut[int(i)], err_msg=f"id {i}")
+
+
+def test_include_optimizer_false_resets_slots(tmp_path):
+    rng = np.random.default_rng(3)
+    vocab = 50
+    tr = build(vocab, embed.Trainer)
+    batch = make_batch(rng, vocab, 32)
+    state, _ = train_some(tr, batch)
+    tr.save(state, str(tmp_path / "ckpt"), include_optimizer=False)
+    st2 = tr.init(batch)
+    st2 = tr.load(st2, str(tmp_path / "ckpt"))
+    np.testing.assert_array_equal(np.asarray(st2.tables["emb"].weights),
+                                  np.asarray(state.tables["emb"].weights))
+    # slots kept their fresh init (reference resets optimizer state too)
+    np.testing.assert_allclose(np.asarray(st2.tables["emb"].slots["accum"]), 0.1)
