@@ -1,0 +1,71 @@
+"""Headline benchmark: DeepFM on synthetic Criteo, examples/sec/chip.
+
+Mirrors the reference's headline number (`documents/en/benchmark.md:41-56`): DeepFM,
+embedding dim 9, Adagrad, batch 4096/chip, Criteo-like Zipfian ids over a 2^24-row
+table. The reference reports 692k examples/s on 8x Tesla T4 + 1 remote PS =
+86.5k examples/s/chip, which is the `vs_baseline` denominator.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Run on the real TPU chip (default env) or CPU (JAX_PLATFORMS=cpu) — the metric is
+per-chip either way. The train step is measured steady-state: input batches are
+pre-staged on device so the host pipeline (measured separately by
+`examples/criteo_deepfm.py --profile-input`) is off the clock, matching how the
+reference reports its number (tf.data prefetch hides the input pipeline).
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+BATCH = 4096
+VOCAB = 1 << 24
+DIM = 9
+WARMUP = 3
+STEPS = 50
+BASELINE_PER_CHIP = 692_000 / 8  # reference Criteo-1TB DeepFM, per chip
+
+
+def main():
+    import jax
+    import openembedding_tpu as embed
+    from openembedding_tpu.model import Trainer
+    from openembedding_tpu.models import make_deepfm
+    from openembedding_tpu.data import synthetic_criteo
+
+    model = make_deepfm(vocabulary=VOCAB, dim=DIM)
+    trainer = Trainer(model, embed.Adagrad(learning_rate=0.05))
+
+    # int32 ids: keep x64 off on TPU (VOCAB < 2^31)
+    batches = [jax.device_put(b) for b in synthetic_criteo(
+        BATCH, id_space=VOCAB, steps=WARMUP + 5, seed=7, ids_dtype=np.int32)]
+
+    state = trainer.init(batches[0])
+    step = trainer.jit_train_step()
+
+    for i in range(WARMUP):
+        state, metrics = step(state, batches[i % len(batches)])
+    # block_until_ready is not a reliable fence through the remote-TPU tunnel;
+    # fetching a scalar that depends on the last step is (it must round-trip).
+    float(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for i in range(STEPS):
+        state, metrics = step(state, batches[i % len(batches)])
+    loss = float(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    examples_per_sec = BATCH * STEPS / dt
+    assert np.isfinite(loss), f"non-finite loss {loss}"
+    print(json.dumps({
+        "metric": "deepfm_dim9_examples_per_sec_per_chip",
+        "value": round(examples_per_sec, 1),
+        "unit": "examples/s/chip",
+        "vs_baseline": round(examples_per_sec / BASELINE_PER_CHIP, 3),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,21 +1,26 @@
 """Repo-level pytest bootstrap.
 
-Every Python interpreter in this image claims the single axon TPU at startup
-(`/root/.axon_site/sitecustomize.py`); concurrent claims block each other. Tests run on
-a virtual 8-device CPU mesh (SURVEY.md §4) and must neither hold nor contend for that
-claim, so pytest re-execs itself once in a cleaned environment before any JAX backend
-initializes. Benchmarks (`bench.py`) are the only thing that should touch the real TPU.
+Every Python interpreter in this image registers the axon TPU backend at startup
+(`/root/.axon_site/sitecustomize.py`) and claims the single real TPU chip the first
+time a JAX backend initializes; concurrent claims block each other. Tests run on a
+virtual 8-device CPU mesh (SURVEY.md §4) and must neither hold nor contend for that
+claim, so before any backend initializes we (a) point XLA at 8 virtual host devices
+and (b) flip jax's platform selection to cpu — the registered axon plugin is then
+never instantiated and the chip is never claimed. Benchmarks (`bench.py`) are the
+only thing that should touch the real TPU.
+
+(An earlier version re-exec'd the interpreter with a cleaned env; that silently
+swallowed all pytest output because pytest's capture already owned fd 1 when the
+execve ran.)
 """
 
 import os
-import sys
 
-if os.environ.get("PALLAS_AXON_POOL_IPS") and os.environ.get("_OE_TPU_TEST_REEXEC") != "1":
-    env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env["_OE_TPU_TEST_REEXEC"] = "1"
-    env["JAX_PLATFORMS"] = "cpu"
-    flags = env.get("XLA_FLAGS", "")
-    if "--xla_force_host_platform_device_count" not in flags:
-        env["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
-    os.execve(sys.executable, [sys.executable, "-m", "pytest"] + sys.argv[1:], env)
+flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

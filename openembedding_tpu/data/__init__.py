@@ -1,0 +1,12 @@
+"""Input pipelines: Criteo readers, synthetic generators, device prefetch.
+
+reference: the benchmark readers in `test/benchmark/criteo_deepctr.py:168-240`
+(CSV / TFRecord / Criteo-1TB TSV interleaved readers) and the preprocessors
+(`examples/criteo_preprocess.py`, `test/criteo_preprocess.cpp`).
+"""
+
+from .criteo import (CriteoBatcher, criteo_fold_offsets, hash_category,
+                     read_criteo_tsv, synthetic_criteo, prefetch_to_device)
+
+__all__ = ["CriteoBatcher", "criteo_fold_offsets", "hash_category",
+           "read_criteo_tsv", "synthetic_criteo", "prefetch_to_device"]

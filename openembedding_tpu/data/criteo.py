@@ -1,0 +1,237 @@
+"""Criteo input pipeline: streaming TSV reader, hashing, folding, synthetic data.
+
+reference: `test/benchmark/criteo_deepctr.py:168-240` (CSV/TFRecord/Criteo-1TB TSV
+readers with tf.data interleave + prefetch) and the relabel-by-frequency
+preprocessors (`test/criteo_preprocess.cpp`, `examples/criteo_preprocess.py`).
+
+TPU-first notes:
+- All categorical fields fold into ONE id space (`criteo_fold_offsets` /
+  `hash_category` with per-field salts) so the train step pulls (B, 26) ids in a
+  single all_to_all (see `models/__init__.py`).
+- The host pipeline must stay off the critical path (SURVEY.md §7 hard parts): the
+  reader yields fixed-shape numpy batches; `prefetch_to_device` double-buffers
+  `jax.device_put` so step N+1's transfer overlaps step N's compute. A native C++
+  parser (`native/`) replaces the Python row parser when built.
+- Multi-host: pass (host_id, num_hosts) and each host reads its interleaved slice of
+  rows — the reference's per-worker file sharding, without a coordinator.
+
+Criteo row format (label \\t I1..I13 \\t C1..C26): integer features log-transformed
+(log(x+4)^2 per the reference preprocessor, `examples/criteo_preprocess.py`),
+categorical hex tokens hashed.
+"""
+
+from __future__ import annotations
+
+import gzip
+import itertools
+import queue as queue_mod
+import threading
+from typing import Dict, Iterator, Optional, Sequence
+
+import numpy as np
+
+NUM_DENSE = 13
+NUM_SPARSE = 26
+
+_FNV_OFFSET = np.uint64(0xCBF29CE484222325)
+_FNV_PRIME = np.uint64(0x100000001B3)
+
+
+def hash_category(token_hash: np.ndarray, field: np.ndarray,
+                  id_space: int) -> np.ndarray:
+    """Map (token hash, field index) -> folded id in [0, id_space).
+
+    Salting by field keeps distinct fields' tokens apart in the shared table —
+    the moral equivalent of the reference's per-variable hash spaces (input_dim=-1
+    tables hash into 2^63 per variable, `exb.py:396-401`)."""
+    h = (token_hash.astype(np.uint64) ^ _FNV_OFFSET) * _FNV_PRIME
+    h ^= (field.astype(np.uint64) + np.uint64(0x9E3779B97F4A7C15))
+    h *= _FNV_PRIME
+    h &= np.uint64(0x7FFFFFFFFFFFFFFF)
+    return (h % np.uint64(id_space)).astype(np.int64)
+
+
+def criteo_fold_offsets(vocab_sizes: Sequence[int]) -> np.ndarray:
+    """Per-field offsets for folding per-field id spaces into one table
+    (relabel-by-frequency data uses contiguous per-field vocabs; reference keeps
+    them as separate variables, we concatenate: field f's id i -> offsets[f]+i)."""
+    return np.concatenate([[0], np.cumsum(vocab_sizes)[:-1]]).astype(np.int64)
+
+
+def _parse_rows(rows, id_space: int):
+    """rows: list of tab-split string fields."""
+    n = len(rows)
+    labels = np.zeros((n,), np.float32)
+    dense = np.zeros((n, NUM_DENSE), np.float32)
+    sparse = np.zeros((n, NUM_SPARSE), np.int64)
+    fields = np.arange(NUM_SPARSE, dtype=np.uint64)
+    for r, cols in enumerate(rows):
+        labels[r] = float(cols[0]) if cols[0] else 0.0
+        for i in range(NUM_DENSE):
+            v = cols[1 + i]
+            x = float(v) if v else 0.0
+            dense[r, i] = np.square(np.log(max(x, 0.0) + 4.0))
+        toks = np.array(
+            [int(cols[1 + NUM_DENSE + i], 16) if cols[1 + NUM_DENSE + i] else i
+             for i in range(NUM_SPARSE)], dtype=np.uint64)
+        sparse[r] = hash_category(toks, fields, id_space)
+    return labels, dense, sparse
+
+
+def read_criteo_tsv(paths, batch_size: int, *, id_space: int = 1 << 25,
+                    host_id: int = 0, num_hosts: int = 1,
+                    drop_remainder: bool = True,
+                    repeat: bool = False) -> Iterator[Dict]:
+    """Stream Criteo TSV (optionally .gz) files into fixed-shape batches.
+
+    Rows are interleaved across hosts (row i goes to host i % num_hosts) — the
+    per-worker sharding the reference gets from tf.data `shard()`."""
+    if isinstance(paths, str):
+        paths = [paths]
+    while True:
+        pending = []
+        for path in paths:
+            opener = gzip.open if str(path).endswith(".gz") else open
+            with opener(path, "rt") as f:
+                for i, line in enumerate(f):
+                    if i % num_hosts != host_id:
+                        continue
+                    cols = line.rstrip("\n").split("\t")
+                    if len(cols) < 1 + NUM_DENSE + NUM_SPARSE:
+                        cols = cols + [""] * (1 + NUM_DENSE + NUM_SPARSE - len(cols))
+                    pending.append(cols)
+                    if len(pending) == batch_size:
+                        labels, dense, sparse = _parse_rows(pending, id_space)
+                        yield {"sparse": {"categorical": sparse},
+                               "dense": dense, "label": labels}
+                        pending = []
+        if pending and not drop_remainder:
+            labels, dense, sparse = _parse_rows(pending, id_space)
+            yield {"sparse": {"categorical": sparse}, "dense": dense,
+                   "label": labels}
+        if not repeat:
+            return
+
+
+def synthetic_criteo(batch_size: int, *, id_space: int = 1 << 25,
+                     num_fields: int = NUM_SPARSE, dense_dim: int = NUM_DENSE,
+                     seed: int = 0, alpha: float = 1.05,
+                     steps: Optional[int] = None,
+                     ids_dtype=np.int64) -> Iterator[Dict]:
+    """Synthetic Criteo-like stream with Zipfian ids (hot-key skew like real CTR
+    logs — exercises the dedup path the way Criteo does; uniform ids would make
+    dedup look uselessly cheap). Labels come from a fixed random linear model so
+    loss actually decreases in smoke tests."""
+    rng = np.random.default_rng(seed)
+    w_dense = rng.normal(size=(dense_dim,)).astype(np.float32) * 0.3
+    it = itertools.count() if steps is None else range(steps)
+    for _ in it:
+        # Zipf via inverse-CDF on uniform: id = floor(u^(-1/(alpha-1))) clipped
+        u = rng.random((batch_size, num_fields))
+        raw = np.floor(np.clip(u ** (-1.0 / (alpha - 1.0)), 1.0, 2.0 ** 62)
+                       ).astype(np.int64)
+        fields = np.broadcast_to(np.arange(num_fields, dtype=np.uint64),
+                                 (batch_size, num_fields))
+        ids = hash_category(raw.astype(np.uint64), fields, id_space
+                            ).astype(ids_dtype)
+        dense = rng.normal(size=(batch_size, dense_dim)).astype(np.float32)
+        logit = dense @ w_dense + 0.01 * (ids % 97 - 48).sum(axis=1) / num_fields
+        labels = (rng.random(batch_size) < 1.0 / (1.0 + np.exp(-logit))
+                  ).astype(np.float32)
+        yield {"sparse": {"categorical": ids}, "dense": dense, "label": labels}
+
+
+def _rows_concat(a: Dict, b: Dict) -> Dict:
+    out = {"sparse": {k: np.concatenate([a["sparse"][k], b["sparse"][k]])
+                      for k in a["sparse"]},
+           "label": np.concatenate([a["label"], b["label"]])}
+    if a.get("dense") is not None:
+        out["dense"] = np.concatenate([a["dense"], b["dense"]])
+    if "weight" in a or "weight" in b:
+        wa = a.get("weight", np.ones_like(a["label"]))
+        wb = b.get("weight", np.ones_like(b["label"]))
+        out["weight"] = np.concatenate([wa, wb])
+    return out
+
+
+def _rows_slice(batch: Dict, lo: int, hi: int) -> Dict:
+    return {k: ({k2: v2[lo:hi] for k2, v2 in v.items()} if k == "sparse"
+                else v[lo:hi])
+            for k, v in batch.items() if v is not None}
+
+
+class CriteoBatcher:
+    """Rebatches any row iterator to a fixed batch size: splits oversized incoming
+    batches, carries remainders across batches, and pads the final partial batch.
+    Padded rows get id -1 (pulls zeros, grads dropped) and a `weight` of 0 — the
+    loss fns weight samples so pad rows contribute nothing (unlike the reference,
+    whose tf.data `drop_remainder` just discards the tail)."""
+
+    def __init__(self, it: Iterator[Dict], batch_size: int):
+        self.it = it
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        B = self.batch_size
+        buf: Optional[Dict] = None
+        for batch in self.it:
+            buf = batch if buf is None else _rows_concat(buf, batch)
+            n = buf["label"].shape[0]
+            lo = 0
+            while n - lo >= B:
+                yield _rows_slice(buf, lo, lo + B)
+                lo += B
+            buf = _rows_slice(buf, lo, n) if lo else buf
+            if buf["label"].shape[0] == 0:
+                buf = None
+        if buf is not None and buf["label"].shape[0] > 0:
+            n = buf["label"].shape[0]
+            pad = B - n
+            out = {
+                "sparse": {k: np.concatenate(
+                    [v, np.full((pad,) + v.shape[1:], -1, v.dtype)])
+                    for k, v in buf["sparse"].items()},
+                "label": np.concatenate(
+                    [buf["label"], np.zeros((pad,), np.float32)]),
+                "weight": np.concatenate(
+                    [buf.get("weight", np.ones((n,), np.float32)),
+                     np.zeros((pad,), np.float32)]),
+            }
+            if buf.get("dense") is not None:
+                out["dense"] = np.concatenate(
+                    [buf["dense"], np.zeros((pad,) + buf["dense"].shape[1:],
+                                            buf["dense"].dtype)])
+            yield out
+
+
+def prefetch_to_device(it: Iterator, size: int = 2,
+                       sharding=None) -> Iterator:
+    """Background-thread device prefetch: overlaps host parsing + H2D transfer with
+    device compute (the reference's `pulling()` dataset prefetch + tf.data
+    AUTOTUNE, `exb.py:645-691`). With a NamedSharding, batches land pre-sharded."""
+    import jax
+
+    q: queue_mod.Queue = queue_mod.Queue(maxsize=size)
+    _END = object()
+
+    def producer():
+        try:
+            for item in it:
+                if sharding is not None:
+                    item = jax.device_put(item, sharding)
+                else:
+                    item = jax.tree_util.tree_map(jax.numpy.asarray, item)
+                q.put(item)
+            q.put(_END)
+        except BaseException as e:  # propagate to the consumer, don't fake EOF
+            q.put(e)
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    while True:
+        item = q.get()
+        if item is _END:
+            return
+        if isinstance(item, BaseException):
+            raise item
+        yield item

@@ -103,6 +103,22 @@ class TruncatedNormal(Initializer):
             key, -2.0, 2.0, shape, dtype=dtype)
 
 
+@_register
+@dataclasses.dataclass
+class CombinedFirstOrder(Initializer):
+    """For the model zoo's combined tables (col 0 = first-order/linear weight,
+    cols 1..dim = latent vector, `models/__init__.py`): column 0 starts at zero like
+    a freshly-initialized linear layer, latent columns ~ N(mean, stddev)."""
+
+    category = "combined_first_order"
+    mean: float = 0.0
+    stddev: float = 1e-4
+
+    def __call__(self, key, shape, dtype=jnp.float32):
+        out = self.mean + self.stddev * jax.random.normal(key, shape, dtype=dtype)
+        return out.at[..., 0].set(0.0)
+
+
 def make_initializer(config: dict) -> Initializer:
     """Build from a {category, **params} config dict (reference: Factory +
     `_tensorflow_initializer_config`, `exb.py:25-63`)."""

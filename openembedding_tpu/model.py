@@ -32,13 +32,20 @@ from .embedding import (Embedding, EmbeddingSpec, EmbeddingTableState,
 from .optimizers import Adagrad, SparseOptimizer
 
 
-def binary_logloss(logits: jax.Array, labels: jax.Array) -> jax.Array:
+def binary_logloss(logits: jax.Array, labels: jax.Array,
+                   weight: Optional[jax.Array] = None) -> jax.Array:
     """Mean sigmoid binary cross-entropy (the reference benchmarks train CTR models
-    with keras BinaryCrossentropy, `test/benchmark/criteo_deepctr.py`)."""
+    with keras BinaryCrossentropy, `test/benchmark/criteo_deepctr.py`). `weight`
+    (per-sample, e.g. 0 for the padded tail rows of a partial batch from
+    `data.CriteoBatcher`) turns the mean into a weighted mean."""
     logits = logits.reshape(-1)
     labels = labels.reshape(-1).astype(logits.dtype)
-    return jnp.mean(jnp.clip(logits, 0) - logits * labels +
-                    jnp.log1p(jnp.exp(-jnp.abs(logits))))
+    per = (jnp.clip(logits, 0) - logits * labels +
+           jnp.log1p(jnp.exp(-jnp.abs(logits))))
+    if weight is None:
+        return jnp.mean(per)
+    w = weight.reshape(-1).astype(per.dtype)
+    return jnp.sum(per * w) / jnp.maximum(jnp.sum(w), 1.0)
 
 
 # ---------------------------------------------------------------------------
@@ -145,6 +152,15 @@ class Trainer:
     def opt_for(self, spec: EmbeddingSpec) -> SparseOptimizer:
         return spec.optimizer or self.optimizer
 
+    def _loss(self, logits, batch):
+        """Pass the per-sample weight through when the batch carries one (padded
+        tail batches from `data.CriteoBatcher`); loss fns without a weight arg
+        keep working for weightless batches."""
+        w = batch.get("weight")
+        if w is None:
+            return self.model.loss_fn(logits, batch["label"])
+        return self.model.loss_fn(logits, batch["label"], jnp.asarray(w))
+
     # -- init ---------------------------------------------------------------
 
     def init(self, sample_batch: Dict[str, Any]) -> TrainState:
@@ -224,7 +240,7 @@ class Trainer:
                 embedded[name] = jnp.take(table, ids, axis=0)
             logits = model.module.apply({"params": dense_params}, embedded,
                                         batch.get("dense"))
-            return model.loss_fn(logits, batch["label"]), logits
+            return self._loss(logits, batch), logits
 
         (loss, logits), (dense_grads, row_grads) = jax.value_and_grad(
             loss_fn, argnums=(0, 1), has_aux=True)(state.dense_params, pulled)
@@ -287,8 +303,7 @@ class Trainer:
             embedded[name] = jnp.take(table, jnp.asarray(batch["sparse"][name]), axis=0)
         logits = model.module.apply({"params": state.dense_params}, embedded,
                                     batch.get("dense"))
-        return {"logits": logits,
-                "loss": model.loss_fn(logits, batch["label"])}
+        return {"logits": logits, "loss": self._loss(logits, batch)}
 
     # -- jitted drivers ------------------------------------------------------
 

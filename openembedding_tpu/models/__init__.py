@@ -1,0 +1,27 @@
+"""Model zoo: the CTR model families the reference benchmarks, rebuilt TPU-first.
+
+Reference coverage (`documents/en/benchmark.md:6-16`, `examples/`,
+`test/benchmark/criteo_deepctr.py`): WDL (Wide&Deep), DeepFM, xDeepFM at dims 9/64,
+the LR subclass example (`examples/criteo_lr_subclass.py`), plus DLRM (the reference's
+PMem paper workload) and a two-tower retrieval model.
+
+TPU-first layout decision (differs deliberately from the reference's per-feature
+DeepCTR `Embedding` layers): all categorical fields share ONE row-sharded table, with
+per-field id offsets applied by the data pipeline (`data/criteo.py`). A batch pulls
+(B, F) ids in a single all_to_all exchange instead of F small ones — F=26 tiny
+collectives would be ICI-latency-bound. The first-order (wide/linear) weight rides the
+same table as column 0 (tables store dim+1 columns), so WDL/DeepFM need no second
+exchange for their linear term.
+"""
+
+from .ctr import (MLP, LogisticRegression, WideDeep, DeepFM, XDeepFM, DLRM,
+                  make_lr, make_wdl, make_deepfm, make_xdeepfm, make_dlrm,
+                  CRITEO_NUM_SPARSE, CRITEO_NUM_DENSE)
+from .two_tower import TwoTower, make_two_tower, in_batch_softmax_loss
+
+__all__ = [
+    "MLP", "LogisticRegression", "WideDeep", "DeepFM", "XDeepFM", "DLRM",
+    "make_lr", "make_wdl", "make_deepfm", "make_xdeepfm", "make_dlrm",
+    "TwoTower", "make_two_tower", "in_batch_softmax_loss",
+    "CRITEO_NUM_SPARSE", "CRITEO_NUM_DENSE",
+]

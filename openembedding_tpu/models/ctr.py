@@ -1,0 +1,273 @@
+"""CTR model families (LR, Wide&Deep, DeepFM, xDeepFM, DLRM) as flax modules.
+
+Each module's `__call__(embedded, dense)` matches the Trainer contract
+(`model.py`): `embedded` maps variable name -> pulled rows, `dense` is the
+(B, num_dense) float features (or None). Modules return logits (B,).
+
+The sparse side is one shared table named ``"categorical"`` holding dim+1 columns:
+column 0 is the first-order/linear weight, columns 1..dim the latent vector (see
+`models/__init__.py` for why). Dense compute runs in a configurable `compute_dtype`
+(bfloat16 by default on TPU — MXU-native) with float32 params and a float32 logit.
+
+Reference models: WDL/DeepFM/xDeepFM are what `test/benchmark/criteo_deepctr.py`
+builds via DeepCTR; LR mirrors `examples/criteo_lr_subclass.py`; DLRM is the
+reference's PMem-paper workload (`documents/en/pmem.md`).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..embedding import Embedding
+from ..initializers import CombinedFirstOrder
+from ..model import EmbeddingModel, binary_logloss
+
+CRITEO_NUM_SPARSE = 26   # C1..C26
+CRITEO_NUM_DENSE = 13    # I1..I13
+
+CATEGORICAL = "categorical"
+
+
+class MLP(nn.Module):
+    """Dense tower. Hidden layers ReLU; last layer linear unless `activate_last`."""
+
+    features: Sequence[int]
+    activate_last: bool = False
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.compute_dtype)
+        for i, f in enumerate(self.features):
+            x = nn.Dense(f, dtype=self.compute_dtype,
+                         param_dtype=jnp.float32)(x)
+            if i < len(self.features) - 1 or self.activate_last:
+                x = nn.relu(x)
+        return x
+
+
+def _split_first_order(e):
+    """A combined table row is [w, v_1..v_d]: first-order weight + latent vector."""
+    return e[..., 0], e[..., 1:]
+
+
+class LogisticRegression(nn.Module):
+    """Wide-only model: sum of per-field first-order weights + linear over dense.
+    reference: `examples/criteo_lr_subclass.py` (Embedding(output_dim=1) + Dense)."""
+
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, embedded, dense):
+        w, _ = _split_first_order(embedded[CATEGORICAL])
+        logit = jnp.sum(w.astype(jnp.float32), axis=-1)
+        if dense is not None:
+            logit += nn.Dense(1, dtype=self.compute_dtype,
+                              param_dtype=jnp.float32)(
+                dense.astype(self.compute_dtype))[..., 0].astype(jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros, (1,), jnp.float32)
+        return logit + bias[0]
+
+
+class WideDeep(nn.Module):
+    """Wide & Deep (WDL). Wide = first-order column + dense linear; Deep = MLP over
+    [dense, flattened latent vectors]. reference benchmark model #1."""
+
+    hidden: Sequence[int] = (256, 128)
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, embedded, dense):
+        w, v = _split_first_order(embedded[CATEGORICAL])   # (B,F), (B,F,d)
+        wide = jnp.sum(w.astype(jnp.float32), axis=-1)
+        feats = v.reshape(v.shape[0], -1)
+        if dense is not None:
+            feats = jnp.concatenate([dense.astype(v.dtype), feats], axis=-1)
+            wide += nn.Dense(1, dtype=self.compute_dtype,
+                             param_dtype=jnp.float32)(
+                dense.astype(self.compute_dtype))[..., 0].astype(jnp.float32)
+        deep = MLP(tuple(self.hidden) + (1,),
+                   compute_dtype=self.compute_dtype)(feats)
+        return wide + deep[..., 0].astype(jnp.float32)
+
+
+class DeepFM(nn.Module):
+    """DeepFM: first-order + FM pairwise interactions + DNN, shared embeddings.
+    reference benchmark model #2 (the flagship: Criteo-1TB 692k ex/s run)."""
+
+    hidden: Sequence[int] = (400, 400, 400)
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, embedded, dense):
+        w, v = _split_first_order(embedded[CATEGORICAL])   # (B,F), (B,F,d)
+        first = jnp.sum(w.astype(jnp.float32), axis=-1)
+        vb = v.astype(self.compute_dtype)
+        # FM second order: 0.5 * sum_d [(sum_f v)^2 - sum_f v^2]
+        sum_sq = jnp.square(jnp.sum(vb, axis=1))
+        sq_sum = jnp.sum(jnp.square(vb), axis=1)
+        fm = 0.5 * jnp.sum(sum_sq - sq_sum, axis=-1).astype(jnp.float32)
+        feats = vb.reshape(vb.shape[0], -1)
+        if dense is not None:
+            feats = jnp.concatenate([dense.astype(self.compute_dtype), feats],
+                                    axis=-1)
+            first += nn.Dense(1, dtype=self.compute_dtype,
+                              param_dtype=jnp.float32)(
+                dense.astype(self.compute_dtype))[..., 0].astype(jnp.float32)
+        deep = MLP(tuple(self.hidden) + (1,),
+                   compute_dtype=self.compute_dtype)(feats)
+        return first + fm + deep[..., 0].astype(jnp.float32)
+
+
+class XDeepFM(nn.Module):
+    """xDeepFM: linear + CIN (compressed interaction network) + DNN.
+    reference benchmark model #3.
+
+    CIN layer k:  z = x^{k-1} (outer, field dim) x^0  -> feature-map contraction.
+    Implemented as two einsums — both land on the MXU as batched matmuls."""
+
+    hidden: Sequence[int] = (400, 400)
+    cin_layers: Sequence[int] = (128, 128)
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, embedded, dense):
+        w, v = _split_first_order(embedded[CATEGORICAL])
+        linear = jnp.sum(w.astype(jnp.float32), axis=-1)
+        x0 = v.astype(self.compute_dtype)               # (B, F, d)
+        xk = x0
+        cin_outs = []
+        for li, h in enumerate(self.cin_layers):
+            # (B, Hk, d) x (B, F, d) -> (B, Hk, F, d), contracted by W: (h, Hk, F)
+            wmat = self.param(f"cin_{li}", nn.initializers.glorot_uniform(),
+                              (h, xk.shape[1], x0.shape[1]), jnp.float32)
+            z = jnp.einsum("bhd,bfd->bhfd", xk, x0)
+            xk = jnp.einsum("bhfd,nhf->bnd", z,
+                            wmat.astype(self.compute_dtype))
+            cin_outs.append(jnp.sum(xk, axis=-1))       # (B, h)
+        cin = jnp.concatenate(cin_outs, axis=-1)
+        cin_logit = nn.Dense(1, dtype=self.compute_dtype,
+                             param_dtype=jnp.float32)(cin)[..., 0]
+        feats = x0.reshape(x0.shape[0], -1)
+        if dense is not None:
+            feats = jnp.concatenate([dense.astype(self.compute_dtype), feats],
+                                    axis=-1)
+            linear += nn.Dense(1, dtype=self.compute_dtype,
+                               param_dtype=jnp.float32)(
+                dense.astype(self.compute_dtype))[..., 0].astype(jnp.float32)
+        deep = MLP(tuple(self.hidden) + (1,),
+                   compute_dtype=self.compute_dtype)(feats)
+        return (linear + cin_logit.astype(jnp.float32)
+                + deep[..., 0].astype(jnp.float32))
+
+
+class DLRM(nn.Module):
+    """DLRM: bottom MLP on dense -> pairwise dot interactions with the field
+    embeddings -> top MLP. The reference's 500 GB PMem workload
+    (`documents/en/pmem.md`, ICDE 2023 paper)."""
+
+    bottom: Sequence[int] = (512, 256)
+    top: Sequence[int] = (512, 256)
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, embedded, dense):
+        _, v = _split_first_order(embedded[CATEGORICAL])   # (B, F, d)
+        d = v.shape[-1]
+        vb = v.astype(self.compute_dtype)
+        if dense is not None:
+            bot = MLP(tuple(self.bottom) + (d,), activate_last=True,
+                      compute_dtype=self.compute_dtype)(dense)
+            feats = jnp.concatenate([bot[:, None, :], vb], axis=1)  # (B, F+1, d)
+        else:
+            bot = None
+            feats = vb
+        # pairwise dots, upper triangle (batched matmul -> MXU)
+        inter = jnp.einsum("bfd,bgd->bfg", feats, feats)
+        f = feats.shape[1]
+        iu, ju = jnp.triu_indices(f, k=1)
+        flat_inter = inter[:, iu, ju]                      # (B, f*(f-1)/2)
+        top_in = (jnp.concatenate([bot, flat_inter], axis=-1)
+                  if bot is not None else flat_inter)
+        out = MLP(tuple(self.top) + (1,), compute_dtype=self.compute_dtype)(top_in)
+        return out[..., 0].astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# Builders: module + embedding variables -> EmbeddingModel.
+# ---------------------------------------------------------------------------
+
+
+def _categorical_embedding(vocabulary: int, dim: int, *, hashed: bool,
+                           capacity: int, num_shards: int,
+                           optimizer=None) -> Embedding:
+    """The shared combined table: dim+1 columns (col 0 = first-order weight).
+
+    Initialization matches the reference's defaults: latent vectors ~ N(0, 1e-4)
+    (DeepCTR's embeddings_initializer=RandomNormal(stddev=1e-4)); a uniform init
+    would swamp the FM term. First-order column starts at 0 like a Zeros linear."""
+    return Embedding(
+        input_dim=-1 if hashed else vocabulary,
+        output_dim=dim + 1,
+        name=CATEGORICAL,
+        embeddings_initializer=CombinedFirstOrder(stddev=1e-4),
+        optimizer=optimizer,
+        num_shards=num_shards,
+        capacity=capacity,
+    )
+
+
+def _make(module, *, vocabulary: int, dim: int, hashed: bool = False,
+          capacity: int = 0, num_shards: int = -1, optimizer=None,
+          loss_fn=binary_logloss) -> EmbeddingModel:
+    emb = _categorical_embedding(vocabulary, dim, hashed=hashed,
+                                 capacity=capacity, num_shards=num_shards,
+                                 optimizer=optimizer)
+    return EmbeddingModel(module, [emb], loss_fn=loss_fn)
+
+
+def make_lr(vocabulary: int, *, hashed: bool = False, capacity: int = 0,
+            num_shards: int = -1, optimizer=None,
+            compute_dtype=jnp.bfloat16) -> EmbeddingModel:
+    # dim=0: the combined table is just the 1-column first-order weight
+    return _make(LogisticRegression(compute_dtype=compute_dtype),
+                 vocabulary=vocabulary, dim=0, hashed=hashed,
+                 capacity=capacity, num_shards=num_shards, optimizer=optimizer)
+
+
+def make_wdl(vocabulary: int, dim: int = 9, *, hidden=(256, 128),
+             hashed: bool = False, capacity: int = 0, num_shards: int = -1,
+             optimizer=None, compute_dtype=jnp.bfloat16) -> EmbeddingModel:
+    return _make(WideDeep(hidden=hidden, compute_dtype=compute_dtype),
+                 vocabulary=vocabulary, dim=dim, hashed=hashed,
+                 capacity=capacity, num_shards=num_shards, optimizer=optimizer)
+
+
+def make_deepfm(vocabulary: int, dim: int = 9, *, hidden=(400, 400, 400),
+                hashed: bool = False, capacity: int = 0, num_shards: int = -1,
+                optimizer=None, compute_dtype=jnp.bfloat16) -> EmbeddingModel:
+    return _make(DeepFM(hidden=hidden, compute_dtype=compute_dtype),
+                 vocabulary=vocabulary, dim=dim, hashed=hashed,
+                 capacity=capacity, num_shards=num_shards, optimizer=optimizer)
+
+
+def make_xdeepfm(vocabulary: int, dim: int = 9, *, hidden=(400, 400),
+                 cin_layers=(128, 128), hashed: bool = False, capacity: int = 0,
+                 num_shards: int = -1, optimizer=None,
+                 compute_dtype=jnp.bfloat16) -> EmbeddingModel:
+    return _make(XDeepFM(hidden=hidden, cin_layers=cin_layers,
+                         compute_dtype=compute_dtype),
+                 vocabulary=vocabulary, dim=dim, hashed=hashed,
+                 capacity=capacity, num_shards=num_shards, optimizer=optimizer)
+
+
+def make_dlrm(vocabulary: int, dim: int = 16, *, bottom=(512, 256),
+              top=(512, 256), hashed: bool = False, capacity: int = 0,
+              num_shards: int = -1, optimizer=None,
+              compute_dtype=jnp.bfloat16) -> EmbeddingModel:
+    return _make(DLRM(bottom=bottom, top=top, compute_dtype=compute_dtype),
+                 vocabulary=vocabulary, dim=dim, hashed=hashed,
+                 capacity=capacity, num_shards=num_shards, optimizer=optimizer)

@@ -1,0 +1,82 @@
+"""Two-tower retrieval model (user tower x item tower, in-batch softmax).
+
+The reference's scope is CTR ranking, but its README positions OpenEmbedding for
+recommender systems generally; `BASELINE.json` lists "Two-tower retrieval (Movielens)"
+as a target config. Sparse side follows the zoo convention: one table per tower
+(user features / item features), each pulled in a single exchange.
+
+Batch convention: {"sparse": {"user": (B, Fu) ids, "item": (B, Fi) ids},
+                   "label": unused (in-batch negatives), "dense": optional user dense}.
+The module returns the (B, B) score matrix: row i = user i against every in-batch
+item; `in_batch_softmax_loss` takes the diagonal as the positive.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..embedding import Embedding
+from ..initializers import Normal
+from ..model import EmbeddingModel
+from .ctr import MLP
+
+USER = "user"
+ITEM = "item"
+
+
+def in_batch_softmax_loss(scores: jax.Array, labels=None,
+                          weight=None) -> jax.Array:
+    """Sampled-softmax with in-batch negatives: positives on the diagonal.
+    `weight` masks padded rows (0-weight) out of the mean."""
+    del labels
+    logp = -jnp.diagonal(jax.nn.log_softmax(scores, axis=-1))
+    if weight is None:
+        return jnp.mean(logp)
+    w = weight.reshape(-1).astype(logp.dtype)
+    return jnp.sum(logp * w) / jnp.maximum(jnp.sum(w), 1.0)
+
+
+class TwoTower(nn.Module):
+    tower: Sequence[int] = (256, 128)
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, embedded, dense):
+        u = embedded[USER]                       # (B, Fu, d)
+        i = embedded[ITEM]                       # (B, Fi, d)
+        u_in = u.reshape(u.shape[0], -1)
+        if dense is not None:
+            u_in = jnp.concatenate([dense.astype(u.dtype), u_in], axis=-1)
+        uemb = MLP(self.tower, compute_dtype=self.compute_dtype,
+                   name="user_tower")(u_in)
+        iemb = MLP(self.tower, compute_dtype=self.compute_dtype,
+                   name="item_tower")(i.reshape(i.shape[0], -1))
+        uemb = uemb / (jnp.linalg.norm(uemb, axis=-1, keepdims=True) + 1e-6)
+        iemb = iemb / (jnp.linalg.norm(iemb, axis=-1, keepdims=True) + 1e-6)
+        temp = self.param("log_inv_temperature", nn.initializers.zeros,
+                          (1,), jnp.float32)
+        # (B, B) score matrix — one batched matmul on the MXU
+        return (uemb @ iemb.T).astype(jnp.float32) * jnp.exp(temp[0]) * 20.0
+
+
+def make_two_tower(user_vocabulary: int, item_vocabulary: int, dim: int = 16, *,
+                   tower=(256, 128), hashed: bool = False,
+                   user_capacity: int = 0, item_capacity: int = 0,
+                   num_shards: int = -1, optimizer=None,
+                   compute_dtype=jnp.bfloat16) -> EmbeddingModel:
+    embs = [
+        Embedding(input_dim=-1 if hashed else user_vocabulary, output_dim=dim,
+                  name=USER, embeddings_initializer=Normal(stddev=1e-2),
+                  optimizer=optimizer, num_shards=num_shards,
+                  capacity=user_capacity),
+        Embedding(input_dim=-1 if hashed else item_vocabulary, output_dim=dim,
+                  name=ITEM, embeddings_initializer=Normal(stddev=1e-2),
+                  optimizer=optimizer, num_shards=num_shards,
+                  capacity=item_capacity),
+    ]
+    return EmbeddingModel(TwoTower(tower=tower, compute_dtype=compute_dtype),
+                          embs, loss_fn=in_batch_softmax_loss)
