@@ -1,0 +1,337 @@
+"""Pallas TPU kernels for the embedding hot path (SURVEY.md §7 step 4).
+
+Counterpart of the reference's server-side hot loops — the table read of
+`EmbeddingOptimizerVariable::pull_weights` (`variable/EmbeddingOptimizerVariable.h:
+242-266`) and the commit+reduce+update of `update_weights` (`:273-297`,
+`variable/EmbeddingOptimizer.h`) — done the TPU way: the table stays in HBM and rows
+stream through VMEM via explicit async DMAs instead of XLA's generic gather/scatter.
+
+Two kernels:
+
+- `gather_rows`: B row-DMAs in flight per grid step (memory-level parallelism against
+  HBM latency), then one vectorized copy to the output block.
+- `fused_sparse_apply`: ONE pass over HBM per unique row — loads the weight row and
+  every optimizer slot row, runs the fused optimizer update on the whole block in VMEM,
+  and DMAs the results back in place (`input_output_aliases`). The XLA fallback
+  (`ops/sparse.py`) instead issues a separate gather + scatter per slot array, i.e.
+  2*(1+num_slots) HBM sweeps of the touched rows plus intermediate buffers.
+
+Safety contract (both kernels): row indices may contain padding/invalid entries.
+Loads are always issued with the index clamped into range (harmless read); stores are
+predicated per-row on `counts > 0`, and callers guarantee `counts > 0` implies a valid,
+globally-unique row (the dedup in `ops/sparse.py::sparse_apply_dense_table` provides
+uniqueness), so no write ever races another row's write.
+
+MEASURED (v5e-1, `tools/pallas_microbench.py`, 2026-07): XLA's native gather/scatter
+runs this workload at HBM bandwidth already — gather 1.9G rows/s @ dim 64 / 5.1G @ dim
+128, fused XLA apply 1.0G grads/s @ dim 64 (~1 TB/s effective) — while per-row-DMA
+Pallas is HBM-latency-bound (~16M rows/s): random single-row access has no locality
+for DMA to exploit, so **the XLA path IS the TPU-native fast path** and these kernels
+are DEFAULT OFF. They remain available (`OETPU_PALLAS=on`) for lane-aligned tables
+(dim % 128 == 0) and as the scaffold for a future batched-rows variant.
+
+Mode control: `set_mode("off"|"on"|"interpret")`, env `OETPU_PALLAS`.
+"interpret" runs the Pallas interpreter (CPU tests, `tests/test_pallas.py`).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_MODE = os.environ.get("OETPU_PALLAS", "off")
+
+DEFAULT_BLOCK = 256
+# DMA semaphores are a scarce scoped resource (a (2, 256) sem array blew the 2 KB
+# sflag budget on v5e); in-flight row DMAs are bounded by a small ring instead.
+SEM_RING = 8
+
+
+def set_mode(mode: str) -> None:
+    """"off" (default — XLA path, measured faster), "on", or "interpret"."""
+    global _MODE
+    if mode not in ("auto", "on", "off", "interpret"):
+        raise ValueError(f"bad pallas mode {mode!r}")
+    _MODE = mode
+
+
+def get_mode() -> str:
+    return _MODE
+
+
+def _resolve() -> Tuple[bool, bool]:
+    """-> (use_pallas, interpret)."""
+    if _MODE in ("off", "auto"):  # auto == off: XLA measured faster (module doc)
+        return False, False
+    if _MODE == "interpret":
+        return True, True
+    return True, False
+
+
+# ---------------------------------------------------------------------------
+# gather_rows
+# ---------------------------------------------------------------------------
+
+
+def _gather_kernel(rows_smem, w_hbm, out_ref, scratch, sems, *, block, n_rows):
+    """SEM_RING row-DMAs in flight; slot i reuses semaphore i % SEM_RING after
+    waiting out its previous occupant."""
+    g = pl.program_id(0)
+
+    def copy(i):
+        row = rows_smem[g * block + i]
+        safe = jnp.clip(row, 0, n_rows - 1)
+        return pltpu.make_async_copy(
+            w_hbm.at[pl.ds(safe, 1), :], scratch.at[pl.ds(i, 1), :],
+            sems.at[jax.lax.rem(i, SEM_RING)])
+
+    def start(i, _):
+        @pl.when(i >= SEM_RING)
+        def _():
+            copy(i - SEM_RING).wait()
+        copy(i).start()
+        return 0
+
+    jax.lax.fori_loop(0, block, start, 0)
+
+    def drain(i, _):
+        copy(i).wait()
+        return 0
+
+    jax.lax.fori_loop(max(0, block - SEM_RING), block, drain, 0)
+    out_ref[:] = scratch[:]
+
+
+@functools.partial(jax.jit, static_argnames=("block", "interpret"))
+def _gather_call(weights, padded_rows, *, block, interpret):
+    n_rows, dim = weights.shape
+    nb = padded_rows.shape[0] // block
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nb,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec((block, dim), lambda g, rows: (g, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((block, dim), weights.dtype),
+            pltpu.SemaphoreType.DMA((SEM_RING,)),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_gather_kernel, block=block, n_rows=n_rows),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((padded_rows.shape[0], dim), weights.dtype),
+        interpret=interpret,
+    )(padded_rows, weights)
+
+
+def gather_rows(weights: jax.Array, rows: jax.Array,
+                valid: Optional[jax.Array] = None, *,
+                block: int = DEFAULT_BLOCK,
+                interpret: bool = False) -> jax.Array:
+    """Pallas `lookup_rows`: out-of-range/invalid rows return zeros."""
+    n_rows, _ = weights.shape
+    flat = rows.reshape(-1).astype(jnp.int32)
+    n = flat.shape[0]
+    block = min(block, max(8, n))
+    npad = -(-n // block) * block
+    padded = jnp.full((npad,), -1, jnp.int32).at[:n].set(flat)
+    out = _gather_call(weights, padded, block=block, interpret=interpret)[:n]
+    in_range = (flat >= 0) & (flat < n_rows)
+    if valid is not None:
+        in_range = in_range & valid.reshape(-1)
+    return jnp.where(in_range[:, None], out, jnp.zeros_like(out))
+
+
+def _lane_aligned(*widths: int) -> bool:
+    """Mosaic constraint: per-row HBM DMA slices must cover whole 128-lane tiles, so
+    the kernels only run on hardware when every row width is a multiple of 128.
+    (Unaligned dims — the reference's 9/64 benchmarks — stay on the XLA path, whose
+    native gather already runs at HBM bandwidth; measured in
+    `tools/pallas_microbench.py`.)"""
+    return all(w % 128 == 0 for w in widths)
+
+
+def maybe_gather_rows(weights, rows, valid=None):
+    """Dispatch hook for `ops.sparse.lookup_rows`; None = use the XLA path."""
+    use, interpret = _resolve()
+    if not use or weights.ndim != 2:
+        return None
+    if not interpret and not _lane_aligned(weights.shape[1]):
+        return None
+    return gather_rows(weights, rows, valid, interpret=interpret)
+
+
+# ---------------------------------------------------------------------------
+# fused_sparse_apply
+# ---------------------------------------------------------------------------
+
+
+def _apply_kernel(optimizer, slot_names, table_dtype, block, n_rows, *refs):
+    """refs = (rows_smem, grads, counts, w_in, *s_in, w_out, *s_out,
+               scr_w, *scr_s, sems)."""
+    k = len(slot_names)
+    rows_smem, grads_ref, counts_ref = refs[0], refs[1], refs[2]
+    # refs[3 : 4+k] are the aliased inputs (unused — we read via the out refs,
+    # which share their buffers)
+    outs = list(refs[4 + k: 5 + 2 * k])      # w_out, *s_out
+    scrs = list(refs[5 + 2 * k: 6 + 3 * k])  # scr_w, *scr_s
+    sems = refs[6 + 3 * k]                   # DMA sems, shape (1+k, SEM_RING)
+    g = pl.program_id(0)
+
+    def copies(i, inward):
+        row = rows_smem[g * block + i]
+        safe = jnp.clip(row, 0, n_rows - 1)
+        dmas = []
+        for j, (buf, scr) in enumerate(zip(outs, scrs)):
+            hbm = buf.at[pl.ds(safe, 1), :]
+            vmem = scr.at[pl.ds(i, 1), :]
+            src, dst = (hbm, vmem) if inward else (vmem, hbm)
+            dmas.append(pltpu.make_async_copy(
+                src, dst, sems.at[j, jax.lax.rem(i, SEM_RING)]))
+        return dmas
+
+    # phase 1: load weight row + every slot row, SEM_RING rows in flight
+    def start_load(i, _):
+        @pl.when(i >= SEM_RING)
+        def _():
+            for dma in copies(i - SEM_RING, True):
+                dma.wait()
+        for dma in copies(i, True):
+            dma.start()
+        return 0
+
+    def drain_load(i, _):
+        for dma in copies(i, True):
+            dma.wait()
+        return 0
+
+    jax.lax.fori_loop(0, block, start_load, 0)
+    jax.lax.fori_loop(max(0, block - SEM_RING), block, drain_load, 0)
+
+    # phase 2: fused optimizer update on the whole block (VPU, f32 math)
+    counts = counts_ref[:, 0]
+    slots = {name: scrs[1 + j][:] for j, name in enumerate(slot_names)}
+    new_w, new_slots = optimizer.apply(
+        scrs[0][:].astype(jnp.float32), slots,
+        grads_ref[:].astype(jnp.float32), counts)
+    scrs[0][:] = new_w.astype(table_dtype)
+    for j, name in enumerate(slot_names):
+        scrs[1 + j][:] = new_slots[name]
+
+    # phase 3: store back — predicated on counts > 0 (padding rows never write);
+    # ring waits are predicated on the SAME row's count so we never wait a DMA
+    # that was never started
+    def start_store(i, _):
+        @pl.when((i >= SEM_RING) & (counts_ref[i - SEM_RING, 0] > 0))
+        def _():
+            for dma in copies(i - SEM_RING, False):
+                dma.wait()
+
+        @pl.when(counts_ref[i, 0] > 0)
+        def _():
+            for dma in copies(i, False):
+                dma.start()
+        return 0
+
+    def drain_store(i, _):
+        @pl.when(counts_ref[i, 0] > 0)
+        def _():
+            for dma in copies(i, False):
+                dma.wait()
+        return 0
+
+    jax.lax.fori_loop(0, block, start_store, 0)
+    jax.lax.fori_loop(max(0, block - SEM_RING), block, drain_store, 0)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("optimizer", "slot_names", "block", "interpret"))
+def _apply_call(optimizer, slot_names, weights, slot_list, rows, grads, counts,
+                *, block, interpret):
+    n_rows, dim = weights.shape
+    npad = rows.shape[0]
+    nb = npad // block
+    k = len(slot_names)
+    any_spec = pl.BlockSpec(memory_space=pl.ANY)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nb,),
+        in_specs=[
+            pl.BlockSpec((block, dim), lambda g, rows: (g, 0),
+                         memory_space=pltpu.VMEM),          # grads
+            pl.BlockSpec((block, 1), lambda g, rows: (g, 0),
+                         memory_space=pltpu.VMEM),          # counts
+            any_spec,                                       # weights (aliased)
+        ] + [any_spec] * k,                                 # slots (aliased)
+        out_specs=[any_spec] * (1 + k),
+        scratch_shapes=[
+            pltpu.VMEM((block, dim), weights.dtype),
+        ] + [
+            pltpu.VMEM((block, s.shape[1]), s.dtype) for s in slot_list
+        ] + [
+            pltpu.SemaphoreType.DMA((1 + k, SEM_RING)),
+        ],
+    )
+    out_shape = [jax.ShapeDtypeStruct(weights.shape, weights.dtype)] + [
+        jax.ShapeDtypeStruct(s.shape, s.dtype) for s in slot_list]
+    # inputs flatten as (rows, grads, counts, weights, *slots): alias the tables
+    # onto the outputs so the update happens in place in HBM
+    aliases = {3 + j: j for j in range(1 + k)}
+    outs = pl.pallas_call(
+        functools.partial(_apply_kernel, optimizer, slot_names, weights.dtype,
+                          block, n_rows),
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        input_output_aliases=aliases,
+        interpret=interpret,
+    )(rows, grads, counts, weights, *slot_list)
+    return outs[0], list(outs[1:])
+
+
+def fused_sparse_apply(optimizer, weights: jax.Array, slots: Dict[str, jax.Array],
+                       rows: jax.Array, grads: jax.Array, counts: jax.Array, *,
+                       block: int = DEFAULT_BLOCK, interpret: bool = False
+                       ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Fused dedup-free sparse update: `rows` must be unique where counts > 0
+    (callers dedup first); counts == 0 marks padding. One HBM read + write per
+    touched (row, array) pair."""
+    n_rows, dim = weights.shape
+    flat = rows.reshape(-1).astype(jnp.int32)
+    n = flat.shape[0]
+    counts = counts.reshape(-1).astype(jnp.int32)
+    counts = jnp.where((flat >= 0) & (flat < n_rows), counts, 0)
+    grads = grads.reshape(n, dim)
+
+    block = min(block, max(8, n))
+    npad = -(-n // block) * block
+    p_rows = jnp.full((npad,), -1, jnp.int32).at[:n].set(flat)
+    p_counts = jnp.zeros((npad, 1), jnp.int32).at[:n, 0].set(counts)
+    p_grads = jnp.zeros((npad, dim), jnp.float32).at[:n].set(
+        grads.astype(jnp.float32))
+
+    slot_names = tuple(sorted(slots.keys()))
+    slot_list = [slots[name] for name in slot_names]
+    new_w, new_slots = _apply_call(
+        optimizer, slot_names, weights, slot_list, p_rows, p_grads, p_counts,
+        block=block, interpret=interpret)
+    return new_w, {name: s for name, s in zip(slot_names, new_slots)}
+
+
+def maybe_fused_apply(optimizer, weights, slots, rows, grads, counts):
+    """Dispatch hook for `ops.sparse.sparse_apply_dense_table`; None = XLA path."""
+    use, interpret = _resolve()
+    if not use:
+        return None
+    if not interpret and not _lane_aligned(
+            weights.shape[1], *(s.shape[1] for s in slots.values())):
+        # e.g. Adam's per-row beta^t slots are width 1 -> XLA path on hardware
+        return None
+    return fused_sparse_apply(optimizer, weights, slots, rows, grads, counts,
+                              interpret=interpret)

@@ -25,6 +25,11 @@ def lookup_rows(weights: jax.Array, rows: jax.Array,
     """Gather rows (table read; reference `pull_weights` fast path). Out-of-range or
     invalid row indices return zeros — consistent with the gradient path, which drops
     them, so a buggy id pipeline can't create train/serve skew."""
+    if weights.ndim == 2 and rows.ndim == 1:
+        from .pallas_sparse import maybe_gather_rows
+        out = maybe_gather_rows(weights, rows, valid)
+        if out is not None:
+            return out
     n_rows = weights.shape[0]
     in_range = (rows >= 0) & (rows < n_rows)
     if valid is not None:
@@ -71,6 +76,11 @@ def sparse_apply_dense_table(
     counts = jax.ops.segment_sum(pre_counts, uniq.inverse, num_segments=n)
     # padding slots (id == n_rows sentinel) get counts 0:
     counts = jnp.where(uniq.unique_ids < weights.shape[0], counts, 0)
+
+    from .pallas_sparse import maybe_fused_apply
+    fused = maybe_fused_apply(optimizer, weights, slots, uniq.unique_ids, g, counts)
+    if fused is not None:
+        return fused
 
     # Optimizer math always runs in float32, whatever the table dtype: in bf16,
     # beta_2^t rounds to 1.0 (killing Adam's lr_t) and g^2 accumulators lose most of
