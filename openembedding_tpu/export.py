@@ -1,0 +1,183 @@
+"""Standalone model export for serving — no framework machinery needed to predict.
+
+Counterpart of the reference's `save_as_original_model` (`tensorflow/exb.py:506-547`):
+there, all rows are batch-pulled from the PS (2^20/dim rows per pull) into a vanilla
+`tf.keras.layers.Embedding` inside a standard SavedModel that TF-Serving can run with
+no custom ops. Here the export directory holds:
+
+- `model_meta` — the usual ModelMeta JSON (+ `model_config` recipe when the model came
+  from the zoo factories, replacing the SavedModel's graph);
+- per-variable dense payloads in **global id order** (array and sparse_as_dense tables)
+  or compacted id-sorted pairs (hash tables — the reference cannot standalone-export an
+  unbounded-vocab table at all; we export exactly the resident rows);
+- `dense_params.npz` — the flax dense tower params.
+
+`StandaloneModel.load()` turns the directory back into a pure-JAX jittable predict
+function; `serving.py` wraps it with the registry/REST layer.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .checkpoint import (MODEL_META_FILE, _flatten_params, _unflatten_params)
+from .meta import ModelMeta, ModelVariableMeta
+from .model import EmbeddingModel
+
+MODEL_CONFIG_FILE = "model_config.json"
+# reference batches its export pulls at 2^20/dim rows (`exb.py:506-547`); same chunking
+# bounds host RAM while we stream a sharded table out
+EXPORT_CHUNK_ELEMS = 1 << 20
+
+
+def export_standalone(state, model: EmbeddingModel, path: str, *,
+                      num_shards: int = 1, model_sign: str = "") -> ModelMeta:
+    """Materialize every embedding variable into a self-contained directory.
+
+    Weights only — never optimizer slots (parity: `save_as_original_model` exports a
+    pure inference model). Hash tables export their resident (id, row) pairs.
+    """
+    from .parallel.sharded import deinterleave_rows
+
+    os.makedirs(path, exist_ok=True)
+    import uuid as uuid_mod
+    model_sign = model_sign or f"{uuid_mod.uuid4().hex}-{int(state.model_version)}"
+    meta = ModelMeta(model_sign=model_sign, uri=path, num_shards=1)
+
+    for name, spec in model.specs.items():
+        vdir = os.path.join(path, f"variable_{spec.variable_id}")
+        os.makedirs(vdir, exist_ok=True)
+        meta.variables.append(ModelVariableMeta(
+            variable_id=spec.variable_id,
+            storage_name=name,
+            meta=spec.meta,
+            initializer=spec.initializer.to_config(),
+            table={"category": "hash" if spec.use_hash_table else "array",
+                   "capacity": spec.capacity},
+        ))
+        if spec.sparse_as_dense:
+            arr = np.asarray(state.dense_params["__embeddings__"][name])
+            np.save(os.path.join(vdir, "weights.npy"), arr)
+        elif spec.use_hash_table:
+            ts = state.tables[name]
+            keys = np.asarray(ts.keys)
+            sel = keys >= 0
+            order = np.argsort(keys[sel], kind="stable")
+            np.save(os.path.join(vdir, "ids.npy"), keys[sel][order])
+            np.save(os.path.join(vdir, "weights.npy"),
+                    np.asarray(ts.weights)[sel][order])
+        else:
+            ts = state.tables[name]
+            np.save(os.path.join(vdir, "weights.npy"),
+                    deinterleave_rows(np.asarray(ts.weights), num_shards,
+                                      spec.input_dim))
+
+    dense = {k: v for k, v in _flatten_params(state.dense_params).items()
+             if not k.startswith("__embeddings__/")}
+    np.savez(os.path.join(path, "dense_params.npz"), **dense)
+    meta.dense_manifest = {k: {"shape": list(v.shape), "dtype": str(v.dtype)}
+                           for k, v in dense.items()}
+
+    with open(os.path.join(path, MODEL_META_FILE), "w") as f:
+        d = json.loads(meta.to_json())
+        d["extra"] = {"standalone": True, "step": int(state.step),
+                      "model_version": int(state.model_version)}
+        json.dump(d, f, indent=2, sort_keys=True)
+    if model.config is not None:
+        with open(os.path.join(path, MODEL_CONFIG_FILE), "w") as f:
+            json.dump(model.config, f, indent=2, sort_keys=True)
+    return meta
+
+
+class StandaloneModel:
+    """A loaded standalone export: read-only lookups + a jittable predict().
+
+    The serving counterpart of the reference's read_only_pull handler + TF-Serving
+    SavedModel execution (`EmbeddingPullOperator.cpp:149-205`, `exb_ops.cpp:261-276`).
+    """
+
+    def __init__(self, meta: ModelMeta, tables: Dict[str, dict],
+                 dense_params: Any, model: Optional[EmbeddingModel]):
+        self.meta = meta
+        self._tables = tables      # name -> {kind, weights, [ids]}
+        self.dense_params = dense_params
+        self.model = model         # None if no config recipe and none passed in
+        self._predict_fn = None
+
+    @classmethod
+    def load(cls, path: str, model: Optional[EmbeddingModel] = None
+             ) -> "StandaloneModel":
+        with open(os.path.join(path, MODEL_META_FILE)) as f:
+            meta = ModelMeta.from_json(f.read())
+        if model is None:
+            cfg_path = os.path.join(path, MODEL_CONFIG_FILE)
+            if os.path.exists(cfg_path):
+                from . import models as zoo
+                with open(cfg_path) as f:
+                    model = zoo.from_config(json.load(f))
+        tables = {}
+        for v in meta.variables:
+            vdir = os.path.join(path, f"variable_{v.variable_id}")
+            weights = jnp.asarray(np.load(os.path.join(vdir, "weights.npy")))
+            entry = {"weights": weights, "dim": weights.shape[-1]}
+            ids_path = os.path.join(vdir, "ids.npy")
+            if os.path.exists(ids_path):
+                entry["kind"] = "hash"
+                entry["ids"] = jnp.asarray(np.load(ids_path))
+            else:
+                entry["kind"] = "array"
+            tables[v.storage_name] = entry
+        z = np.load(os.path.join(path, "dense_params.npz"))
+        dense_params = _unflatten_params({k: z[k] for k in z.files})
+        return cls(meta, tables, dense_params, model)
+
+    @property
+    def variable_names(self):
+        return list(self._tables)
+
+    def lookup(self, name: str, ids) -> jax.Array:
+        """Read-only pull: absent/out-of-range ids -> zero rows (reference
+        `get_weights` serving semantics)."""
+        t = self._tables[name]
+        ids = jnp.asarray(ids)
+        flat = ids.reshape(-1)
+        w = t["weights"]
+        if t["kind"] == "hash":
+            # ids.npy is sorted: binary search instead of the device probe table
+            pos = jnp.searchsorted(t["ids"], flat)
+            pos_c = jnp.clip(pos, 0, t["ids"].shape[0] - 1)
+            hit = t["ids"][pos_c] == flat
+            rows = jnp.where(hit[:, None], w[pos_c], jnp.zeros_like(w[:1]))
+        else:
+            in_range = (flat >= 0) & (flat < w.shape[0])
+            rows = jnp.where(in_range[:, None],
+                             w[jnp.clip(flat, 0, w.shape[0] - 1)],
+                             jnp.zeros((1, w.shape[1]), w.dtype))
+        return rows.reshape(ids.shape + (t["dim"],))
+
+    def predict(self, batch: Dict[str, Any]) -> jax.Array:
+        """Full forward pass -> logits. Needs the dense module (from the export's
+        model_config recipe or passed to load())."""
+        if self.model is None:
+            raise ValueError(
+                "standalone export has no model_config recipe; pass the "
+                "EmbeddingModel to StandaloneModel.load(path, model=...)")
+        if self._predict_fn is None:
+            module = self.model.module
+
+            def fwd(dense_params, embedded, dense):
+                params = dict(dense_params)
+                return module.apply({"params": params}, embedded, dense)
+
+            self._predict_fn = jax.jit(fwd)
+        # sparse_as_dense variables were exported as plain array tables, so every
+        # spec (PS or sad) resolves through the same lookup here
+        embedded = {name: self.lookup(name, batch["sparse"][name])
+                    for name in self._tables}
+        return self._predict_fn(self.dense_params, embedded, batch.get("dense"))

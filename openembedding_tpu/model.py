@@ -95,8 +95,15 @@ class EmbeddingModel:
     """
 
     def __init__(self, module, embeddings: List[Embedding],
-                 loss_fn: Callable = binary_logloss):
+                 loss_fn: Callable = binary_logloss,
+                 config: Optional[dict] = None):
+        # `config` (family + kwargs, set by the `models.make_*` factories) lets a
+        # standalone export rebuild the dense module for serving (`export.py`) the way
+        # the reference's SavedModel carries its graph (`exb.py:506-547`). None for
+        # hand-built modules: export still works, predict() just needs the module
+        # passed back in explicitly.
         self.module = module
+        self.config = config
         self.specs: Dict[str, EmbeddingSpec] = {}
         for i, e in enumerate(embeddings):
             spec = dataclasses.replace(e.spec, variable_id=i)

@@ -19,9 +19,35 @@ from .ctr import (MLP, LogisticRegression, WideDeep, DeepFM, XDeepFM, DLRM,
                   CRITEO_NUM_SPARSE, CRITEO_NUM_DENSE)
 from .two_tower import TwoTower, make_two_tower, in_batch_softmax_loss
 
+_FAMILIES = {
+    "lr": make_lr, "wdl": make_wdl, "deepfm": make_deepfm,
+    "xdeepfm": make_xdeepfm, "dlrm": make_dlrm, "two_tower": make_two_tower,
+}
+
+
+def from_config(config: dict, **overrides):
+    """Rebuild a zoo model from its `EmbeddingModel.config` recipe (written into
+    standalone serving exports by `export.py`; the reference ships the whole graph in
+    a SavedModel instead, `tensorflow/exb.py:506-547`). The recipe stores exactly its
+    factory's keyword arguments, so dispatch is uniform."""
+    import jax.numpy as jnp
+
+    cfg = dict(config)
+    cfg.update(overrides)
+    family = cfg.pop("family")
+    if family not in _FAMILIES:
+        raise ValueError(f"unknown model family {family!r}")
+    cfg["compute_dtype"] = jnp.dtype(cfg.get("compute_dtype", "bfloat16"))
+    for k in ("hidden", "cin_layers", "bottom", "top", "tower"):
+        if k in cfg:
+            cfg[k] = tuple(cfg[k])
+    return _FAMILIES[family](**cfg)
+
+
 __all__ = [
     "MLP", "LogisticRegression", "WideDeep", "DeepFM", "XDeepFM", "DLRM",
     "make_lr", "make_wdl", "make_deepfm", "make_xdeepfm", "make_dlrm",
+    "from_config",
     "TwoTower", "make_two_tower", "in_batch_softmax_loss",
     "CRITEO_NUM_SPARSE", "CRITEO_NUM_DENSE",
 ]

@@ -222,11 +222,19 @@ def _categorical_embedding(vocabulary: int, dim: int, *, hashed: bool,
 
 def _make(module, *, vocabulary: int, dim: int, hashed: bool = False,
           capacity: int = 0, num_shards: int = -1, optimizer=None,
-          loss_fn=binary_logloss) -> EmbeddingModel:
+          loss_fn=binary_logloss, config: dict = None) -> EmbeddingModel:
     emb = _categorical_embedding(vocabulary, dim, hashed=hashed,
                                  capacity=capacity, num_shards=num_shards,
                                  optimizer=optimizer)
-    return EmbeddingModel(module, [emb], loss_fn=loss_fn)
+    return EmbeddingModel(module, [emb], loss_fn=loss_fn, config=config)
+
+
+def _config(family: str, compute_dtype, **kwargs) -> dict:
+    """Serializable module-rebuild recipe for standalone serving export: records
+    exactly the keyword arguments its factory accepts, so `models.from_config` is a
+    uniform `factory(**cfg)` with no per-family branches."""
+    return {"family": family,
+            "compute_dtype": jnp.dtype(compute_dtype).name, **kwargs}
 
 
 def make_lr(vocabulary: int, *, hashed: bool = False, capacity: int = 0,
@@ -235,7 +243,10 @@ def make_lr(vocabulary: int, *, hashed: bool = False, capacity: int = 0,
     # dim=0: the combined table is just the 1-column first-order weight
     return _make(LogisticRegression(compute_dtype=compute_dtype),
                  vocabulary=vocabulary, dim=0, hashed=hashed,
-                 capacity=capacity, num_shards=num_shards, optimizer=optimizer)
+                 capacity=capacity, num_shards=num_shards, optimizer=optimizer,
+                 config=_config("lr", compute_dtype, vocabulary=vocabulary,
+                                hashed=hashed, capacity=capacity,
+                                num_shards=num_shards))
 
 
 def make_wdl(vocabulary: int, dim: int = 9, *, hidden=(256, 128),
@@ -243,7 +254,10 @@ def make_wdl(vocabulary: int, dim: int = 9, *, hidden=(256, 128),
              optimizer=None, compute_dtype=jnp.bfloat16) -> EmbeddingModel:
     return _make(WideDeep(hidden=hidden, compute_dtype=compute_dtype),
                  vocabulary=vocabulary, dim=dim, hashed=hashed,
-                 capacity=capacity, num_shards=num_shards, optimizer=optimizer)
+                 capacity=capacity, num_shards=num_shards, optimizer=optimizer,
+                 config=_config("wdl", compute_dtype, vocabulary=vocabulary,
+                                dim=dim, hidden=list(hidden), hashed=hashed,
+                                capacity=capacity, num_shards=num_shards))
 
 
 def make_deepfm(vocabulary: int, dim: int = 9, *, hidden=(400, 400, 400),
@@ -251,7 +265,10 @@ def make_deepfm(vocabulary: int, dim: int = 9, *, hidden=(400, 400, 400),
                 optimizer=None, compute_dtype=jnp.bfloat16) -> EmbeddingModel:
     return _make(DeepFM(hidden=hidden, compute_dtype=compute_dtype),
                  vocabulary=vocabulary, dim=dim, hashed=hashed,
-                 capacity=capacity, num_shards=num_shards, optimizer=optimizer)
+                 capacity=capacity, num_shards=num_shards, optimizer=optimizer,
+                 config=_config("deepfm", compute_dtype, vocabulary=vocabulary,
+                                dim=dim, hidden=list(hidden), hashed=hashed,
+                                capacity=capacity, num_shards=num_shards))
 
 
 def make_xdeepfm(vocabulary: int, dim: int = 9, *, hidden=(400, 400),
@@ -261,7 +278,11 @@ def make_xdeepfm(vocabulary: int, dim: int = 9, *, hidden=(400, 400),
     return _make(XDeepFM(hidden=hidden, cin_layers=cin_layers,
                          compute_dtype=compute_dtype),
                  vocabulary=vocabulary, dim=dim, hashed=hashed,
-                 capacity=capacity, num_shards=num_shards, optimizer=optimizer)
+                 capacity=capacity, num_shards=num_shards, optimizer=optimizer,
+                 config=_config("xdeepfm", compute_dtype, vocabulary=vocabulary,
+                                dim=dim, hidden=list(hidden),
+                                cin_layers=list(cin_layers), hashed=hashed,
+                                capacity=capacity, num_shards=num_shards))
 
 
 def make_dlrm(vocabulary: int, dim: int = 16, *, bottom=(512, 256),
@@ -270,4 +291,8 @@ def make_dlrm(vocabulary: int, dim: int = 16, *, bottom=(512, 256),
               compute_dtype=jnp.bfloat16) -> EmbeddingModel:
     return _make(DLRM(bottom=bottom, top=top, compute_dtype=compute_dtype),
                  vocabulary=vocabulary, dim=dim, hashed=hashed,
-                 capacity=capacity, num_shards=num_shards, optimizer=optimizer)
+                 capacity=capacity, num_shards=num_shards, optimizer=optimizer,
+                 config=_config("dlrm", compute_dtype, vocabulary=vocabulary,
+                                dim=dim, bottom=list(bottom), top=list(top),
+                                hashed=hashed, capacity=capacity,
+                                num_shards=num_shards))

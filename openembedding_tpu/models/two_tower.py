@@ -78,5 +78,13 @@ def make_two_tower(user_vocabulary: int, item_vocabulary: int, dim: int = 16, *,
                   optimizer=optimizer, num_shards=num_shards,
                   capacity=item_capacity),
     ]
-    return EmbeddingModel(TwoTower(tower=tower, compute_dtype=compute_dtype),
-                          embs, loss_fn=in_batch_softmax_loss)
+    from .ctr import _config
+    return EmbeddingModel(
+        TwoTower(tower=tower, compute_dtype=compute_dtype),
+        embs, loss_fn=in_batch_softmax_loss,
+        config=_config("two_tower", compute_dtype,
+                       user_vocabulary=user_vocabulary,
+                       item_vocabulary=item_vocabulary, dim=dim,
+                       tower=list(tower), hashed=hashed,
+                       user_capacity=user_capacity,
+                       item_capacity=item_capacity, num_shards=num_shards))

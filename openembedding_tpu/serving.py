@@ -1,0 +1,340 @@
+"""Serving: model registry, model manager, REST admin + inference HTTP server.
+
+Reference parity map (SURVEY.md §2.3/§2.4, §3.5):
+- master KV tree `_hyper-embedding-model_` + ModelMeta status protocol
+  (`client/Connection.cpp:214-277`, `variable/Meta.h`) -> file-based `ModelRegistry`
+  (atomic JSON writes; one registry dir replaces the master process).
+- `ModelManager::find_model_variable` (`client/ModelController.cpp:24-44`: cache by
+  model_sign, refuse CREATING, read-only handles) -> `ModelManager`.
+- controller binary REST API (`entry/controller.cc:100-205`: POST/GET/DELETE /models,
+  GET/DELETE /nodes) -> `ServingHandler` routes, same resources.
+- TF-Serving `PullWeights` serving path with `model_sign = uuid + "-" +
+  floor(model_version)` (`tensorflow/exb_ops.cpp:261-276`, `entry/py_api.cc:130-138`)
+  -> `resolve_sign` + POST /models/<sign>/pull.
+
+Training-side HA (replica shards, dead-node restore) is obviated by SPMD training;
+serving HA maps to running N of these servers behind a load balancer, each loading the
+same export — the registry is just files, so replicas share it read-only.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import re
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+
+import numpy as np
+
+from .export import StandaloneModel
+
+MODEL_STATUS = ("CREATING", "NORMAL", "DELETING", "ERROR")
+
+
+def resolve_sign(uuid: str, model_version: float) -> str:
+    """uuid + "-" + floor(version) (reference `py_api.cc:130-138`)."""
+    return f"{uuid}-{int(math.floor(model_version))}"
+
+
+class ModelRegistry:
+    """File-backed model registry: one JSON per model_sign under <root>/models/.
+
+    Writes are atomic (tmp + rename), so concurrent serving replicas reading the same
+    directory never see torn state — the moral equivalent of the reference's master
+    tree KV + lock (`Connection.cpp:214-277`)."""
+
+    def __init__(self, root: str):
+        self.root = root
+        self._dir = os.path.join(root, "models")
+        os.makedirs(self._dir, exist_ok=True)
+        self._lock = threading.Lock()
+
+    def _path(self, sign: str) -> str:
+        if not re.fullmatch(r"[A-Za-z0-9._-]+", sign):
+            raise ValueError(f"bad model sign {sign!r}")
+        return os.path.join(self._dir, f"{sign}.json")
+
+    def _write(self, sign: str, entry: dict) -> None:
+        path = self._path(sign)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(entry, f, indent=2, sort_keys=True)
+        os.replace(tmp, path)
+
+    def create_model(self, model_sign: str, uri: str, *, replica_num: int = 1,
+                     shard_num: int = 1) -> dict:
+        """Register CREATING -> caller loads/validates -> mark NORMAL.
+        An existing CREATING entry is overwritten (the reference handles interrupted
+        CREATING the same way, `ModelController.cpp:47-85`); NORMAL entries refuse."""
+        with self._lock:
+            cur = self.get(model_sign)
+            if cur is not None and cur.get("status") == "NORMAL":
+                raise FileExistsError(f"model {model_sign!r} already exists")
+            entry = {"model_sign": model_sign, "uri": uri,
+                     "replica_num": replica_num, "shard_num": shard_num,
+                     "status": "CREATING", "error": "",
+                     "create_time": time.time()}
+            self._write(model_sign, entry)
+            return entry
+
+    def set_status(self, model_sign: str, status: str, error: str = "") -> dict:
+        if status not in MODEL_STATUS:
+            raise ValueError(f"bad status {status!r}")
+        with self._lock:
+            entry = self.get(model_sign)
+            if entry is None:
+                raise KeyError(model_sign)
+            entry["status"] = status
+            entry["error"] = error
+            self._write(model_sign, entry)
+            return entry
+
+    def delete_model(self, model_sign: str) -> None:
+        with self._lock:
+            path = self._path(model_sign)
+            if not os.path.exists(path):
+                raise KeyError(model_sign)
+            os.unlink(path)
+
+    def get(self, model_sign: str) -> Optional[dict]:
+        try:
+            with open(self._path(model_sign)) as f:
+                return json.load(f)
+        except FileNotFoundError:
+            return None
+
+    def show_models(self) -> Dict[str, dict]:
+        out = {}
+        for fn in sorted(os.listdir(self._dir)):
+            if fn.endswith(".json"):
+                with open(os.path.join(self._dir, fn)) as f:
+                    entry = json.load(f)
+                out[entry["model_sign"]] = entry
+        return out
+
+
+class ModelManager:
+    """model_sign -> cached StandaloneModel; refuses models not in NORMAL state
+    (reference `ModelManager::find_model_variable`, `ModelController.cpp:24-44`)."""
+
+    def __init__(self, registry: ModelRegistry):
+        self.registry = registry
+        self._cache: Dict[str, StandaloneModel] = {}
+        self._lock = threading.Lock()
+
+    def find_model(self, model_sign: str) -> StandaloneModel:
+        with self._lock:
+            if model_sign in self._cache:
+                return self._cache[model_sign]
+        entry = self.registry.get(model_sign)
+        if entry is None:
+            raise KeyError(f"unknown model {model_sign!r}")
+        if entry["status"] != "NORMAL":
+            raise RuntimeError(
+                f"model {model_sign!r} is {entry['status']}, not servable")
+        loaded = StandaloneModel.load(entry["uri"])
+        with self._lock:
+            self._cache[model_sign] = loaded
+        return loaded
+
+    def find_model_variable(self, model_sign: str, variable: str):
+        m = self.find_model(model_sign)
+        if variable not in m.variable_names:
+            raise KeyError(f"model {model_sign!r} has no variable {variable!r}")
+        return m, variable
+
+    def evict(self, model_sign: str) -> None:
+        with self._lock:
+            self._cache.pop(model_sign, None)
+
+    def load_model(self, model_sign: str, uri: str, *, replica_num: int = 1,
+                   shard_num: int = 1) -> dict:
+        """create_model + validate-load + NORMAL/ERROR transition (the controller's
+        create flow, `ModelController.cpp:47-85`, done synchronously)."""
+        entry = self.registry.create_model(model_sign, uri,
+                                           replica_num=replica_num,
+                                           shard_num=shard_num)
+        try:
+            loaded = StandaloneModel.load(uri)
+            with self._lock:
+                self._cache[model_sign] = loaded
+            return self.registry.set_status(model_sign, "NORMAL")
+        except Exception as e:  # noqa: BLE001 - status must record any failure
+            self.registry.set_status(model_sign, "ERROR", error=str(e))
+            raise
+
+
+# ---------------------------------------------------------------------------
+# REST server (controller + inference parity in one process)
+# ---------------------------------------------------------------------------
+
+
+class ServingHandler(BaseHTTPRequestHandler):
+    manager: ModelManager = None  # set by make_server
+    node_info: dict = {}
+    quiet = True
+
+    # -- plumbing -----------------------------------------------------------
+
+    def log_message(self, fmt, *args):
+        if not self.quiet:
+            super().log_message(fmt, *args)
+
+    def _json(self, code: int, payload) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _body(self) -> dict:
+        length = int(self.headers.get("Content-Length", 0))
+        if length == 0:
+            return {}
+        return json.loads(self.rfile.read(length))
+
+    def _route(self):
+        path = self.path.rstrip("/")
+        m = re.fullmatch(r"/models/([A-Za-z0-9._-]+)(?::(\w+)|/(pull|predict))?",
+                         path)
+        if m:
+            return "model", m.group(1), m.group(2) or m.group(3)
+        if path == "/models":
+            return "models", None, None
+        m = re.fullmatch(r"/nodes/([A-Za-z0-9._-]+)", path)
+        if m:
+            return "node", m.group(1), None
+        if path == "/nodes":
+            return "nodes", None, None
+        if path == "/healthz":
+            return "healthz", None, None
+        if path == "/metrics":
+            return "metrics", None, None
+        return None, None, None
+
+    # -- verbs --------------------------------------------------------------
+
+    def do_GET(self):  # noqa: N802 (http.server API)
+        kind, sign, _ = self._route()
+        try:
+            if kind == "models":
+                return self._json(200, self.manager.registry.show_models())
+            if kind == "model":
+                entry = self.manager.registry.get(sign)
+                if entry is None:
+                    return self._json(404, {"error": f"unknown model {sign}"})
+                return self._json(200, entry)
+            if kind == "nodes":
+                return self._json(200, {"nodes": [self.node_info]})
+            if kind == "healthz":
+                return self._json(200, {"status": "ok"})
+            if kind == "metrics":
+                from .utils.metrics import prometheus_text
+                body = prometheus_text().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return None
+            return self._json(404, {"error": "not found"})
+        except Exception as e:  # noqa: BLE001 - every handler error becomes a 500
+            return self._json(500, {"error": str(e)})
+
+    def do_POST(self):  # noqa: N802
+        kind, sign, action = self._route()
+        try:
+            body = self._body()
+            if kind == "models" or (kind == "model" and action is None):
+                # POST /models {model_sign, model_uri, replica_num, shard_num}
+                # (controller.proto CreateModelRequest fields)
+                sign = sign or body["model_sign"]
+                entry = self.manager.load_model(
+                    sign, body.get("model_uri") or body["uri"],
+                    replica_num=int(body.get("replica_num", 1)),
+                    shard_num=int(body.get("shard_num", 1)))
+                return self._json(200, entry)
+            if kind == "model" and action == "pull":
+                model, variable = self.manager.find_model_variable(
+                    sign, body["variable"])
+                ids = np.asarray(body["ids"], dtype=np.int64)
+                rows = model.lookup(variable, ids)
+                return self._json(200, {"weights": np.asarray(rows).tolist()})
+            if kind == "model" and action == "predict":
+                model = self.manager.find_model(sign)
+                batch = {
+                    "sparse": {k: np.asarray(v, dtype=np.int64)
+                               for k, v in body.get("sparse", {}).items()},
+                }
+                if body.get("dense") is not None:
+                    batch["dense"] = np.asarray(body["dense"], dtype=np.float32)
+                logits = model.predict(batch)
+                return self._json(200, {"logits": np.asarray(logits).tolist()})
+            return self._json(404, {"error": "not found"})
+        except KeyError as e:
+            return self._json(404, {"error": str(e)})
+        except Exception as e:  # noqa: BLE001
+            return self._json(500, {"error": str(e)})
+
+    def do_DELETE(self):  # noqa: N802
+        kind, sign, _ = self._route()
+        try:
+            if kind == "model":
+                self.manager.registry.set_status(sign, "DELETING")
+                self.manager.evict(sign)
+                self.manager.registry.delete_model(sign)
+                return self._json(200, {"deleted": sign})
+            if kind == "node":
+                # reference: controller can shut nodes down
+                # (`ModelController.cpp:158-164`); here the node is this process
+                threading.Thread(target=self.server.shutdown, daemon=True).start()
+                return self._json(200, {"shutdown": sign})
+            return self._json(404, {"error": "not found"})
+        except KeyError as e:
+            return self._json(404, {"error": str(e)})
+        except Exception as e:  # noqa: BLE001
+            return self._json(500, {"error": str(e)})
+
+
+def make_server(registry_root: str, host: str = "127.0.0.1", port: int = 0
+                ) -> ThreadingHTTPServer:
+    """Build (not start) the serving HTTP server; port 0 picks a free port."""
+    registry = ModelRegistry(registry_root)
+    manager = ModelManager(registry)
+
+    class Handler(ServingHandler):
+        pass
+
+    Handler.manager = manager
+    Handler.node_info = {"node_id": f"{os.uname().nodename}:{os.getpid()}",
+                         "registry": registry_root}
+    httpd = ThreadingHTTPServer((host, port), Handler)
+    httpd.manager = manager
+    return httpd
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="openembedding_tpu serving node (REST admin + inference)")
+    ap.add_argument("--registry", required=True, help="registry root directory")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8501)
+    args = ap.parse_args(argv)
+    httpd = make_server(args.registry, args.host, args.port)
+    print(f"serving on http://{args.host}:{httpd.server_address[1]} "
+          f"(registry: {args.registry})")
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,8 @@
+"""Utility subsystems: metrics/accumulators/timers (see `utils/metrics.py`)."""
+
+from . import metrics
+from .metrics import (Accumulator, vtimer, report, report_table,
+                      prometheus_text, PeriodicReporter)
+
+__all__ = ["metrics", "Accumulator", "vtimer", "report", "report_table",
+           "prometheus_text", "PeriodicReporter"]

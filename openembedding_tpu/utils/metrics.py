@@ -1,0 +1,187 @@
+"""Metrics: accumulators, scope timers, periodic reports, Prometheus exposition.
+
+Reference parity (SURVEY.md §5 tracing/profiling):
+- `Accumulator<SumAggregator>` counters like `pull_indices`/`pull_unique` gated by
+  evaluate-performance mode (`EmbeddingPullOperator.cpp:207-252`) -> `Accumulator`
+  registry (sum/avg/max aggregations, thread-safe, always on — negligible cost in
+  Python; the per-step device counters ride the jitted step's stats dict instead).
+- `VTIMER(1, group, name, ms)` scope timers at hot stages
+  (`EmbeddingVariableHandle.cpp:107,140`) -> `vtimer(group, name)` context manager.
+- periodic cluster-wide accumulator table when `server.report_interval > 0`
+  (`client/WorkerContext.cpp:24-41,140-163`) -> `PeriodicReporter` thread.
+- standalone server's Prometheus exposer flags (`entry/server.cc:7-12,35-36`) ->
+  `prometheus_text()` (text exposition format, served at /metrics by `serving.py`).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from typing import Callable, Dict, Optional
+
+_LOCK = threading.Lock()
+_REGISTRY: Dict[str, "Accumulator"] = {}
+
+
+class Accumulator:
+    """A named metric. kind: "sum" (counter), "avg" (mean of observations),
+    "max" (high-water mark), "gauge" (last value)."""
+
+    def __init__(self, name: str, kind: str = "sum", help: str = ""):
+        if kind not in ("sum", "avg", "max", "gauge"):
+            raise ValueError(f"bad accumulator kind {kind!r}")
+        self.name = name
+        self.kind = kind
+        self.help = help
+        self._lock = threading.Lock()
+        self._total = 0.0
+        self._count = 0
+        self._max = float("-inf")
+
+    @classmethod
+    def get(cls, name: str, kind: str = "sum", help: str = "") -> "Accumulator":
+        with _LOCK:
+            acc = _REGISTRY.get(name)
+            if acc is None:
+                acc = _REGISTRY[name] = cls(name, kind, help)
+            return acc
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        with self._lock:
+            if self.kind == "gauge":
+                self._total = value
+                self._count = 1
+            else:
+                self._total += value
+                self._count += 1
+            if value > self._max:
+                self._max = value
+
+    def value(self) -> float:
+        with self._lock:
+            if self.kind == "avg":
+                return self._total / self._count if self._count else 0.0
+            if self.kind == "max":
+                return self._max if self._count else 0.0
+            return self._total
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    def reset(self) -> None:
+        with self._lock:
+            self._total = 0.0
+            self._count = 0
+            self._max = float("-inf")
+
+
+def observe(name: str, value: float, kind: str = "sum") -> None:
+    Accumulator.get(name, kind).observe(value)
+
+
+@contextmanager
+def vtimer(group: str, name: str):
+    """Scope timer -> avg+max ms accumulators (reference VTIMER semantics:
+    `VTIMER(1, group, name, ms)` wraps the hot operator stages)."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        ms = (time.perf_counter() - t0) * 1e3
+        Accumulator.get(f"{group}.{name}.ms", "avg").observe(ms)
+        Accumulator.get(f"{group}.{name}.max_ms", "max").observe(ms)
+
+
+def record_step_stats(stats: Dict[str, "object"]) -> None:
+    """Fold a train step's device-side stats dict (`{var}/pull_indices`, `.../
+    pull_unique`, `.../pull_overflow`, ...) into host accumulators."""
+    for key, value in stats.items():
+        try:
+            observe(key.replace("/", "."), float(value))
+        except (TypeError, ValueError):
+            continue
+
+
+def report(reset: bool = False) -> Dict[str, float]:
+    with _LOCK:
+        accs = list(_REGISTRY.values())
+    out = {a.name: a.value() for a in accs}
+    if reset:
+        for a in accs:
+            a.reset()
+    return out
+
+
+def report_table(reset: bool = False) -> str:
+    """The reference's periodic accumulator table (`WorkerContext.cpp:140-163`)."""
+    vals = report(reset=reset)
+    if not vals:
+        return "(no metrics)"
+    width = max(len(k) for k in vals)
+    lines = [f"{k.ljust(width)}  {v:,.3f}" for k, v in sorted(vals.items())]
+    return "\n".join(lines)
+
+
+def reset_all() -> None:
+    with _LOCK:
+        accs = list(_REGISTRY.values())
+    for a in accs:
+        a.reset()
+
+
+_SANE = str.maketrans({c: "_" for c in ".-/ "})
+
+
+def prometheus_text() -> str:
+    """Prometheus text exposition (0.0.4) of every accumulator."""
+    lines = []
+    with _LOCK:
+        accs = sorted(_REGISTRY.values(), key=lambda a: a.name)
+    for a in accs:
+        metric = "oetpu_" + a.name.translate(_SANE)
+        ptype = {"sum": "counter", "avg": "gauge", "max": "gauge",
+                 "gauge": "gauge"}[a.kind]
+        if a.help:
+            lines.append(f"# HELP {metric} {a.help}")
+        lines.append(f"# TYPE {metric} {ptype}")
+        lines.append(f"{metric} {a.value()}")
+    return "\n".join(lines) + "\n"
+
+
+class PeriodicReporter:
+    """Background thread printing the accumulator table every `interval` seconds
+    (enabled when interval > 0, like the reference's `server.report_interval`)."""
+
+    def __init__(self, interval: float, sink: Optional[Callable[[str], None]] = None,
+                 reset: bool = True):
+        self.interval = interval
+        self.sink = sink or (lambda s: print(s, flush=True))
+        self.reset = reset
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "PeriodicReporter":
+        if self.interval <= 0:
+            return self
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            self.sink("== accumulator report ==\n" + report_table(reset=self.reset))
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def __enter__(self) -> "PeriodicReporter":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

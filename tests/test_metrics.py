@@ -1,0 +1,93 @@
+"""Metrics subsystem tests (reference §5: accumulators, VTIMER, periodic report,
+Prometheus exposition)."""
+
+import time
+
+import pytest
+
+from openembedding_tpu.utils import metrics
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    metrics._REGISTRY.clear()
+    yield
+    metrics._REGISTRY.clear()
+
+
+def test_accumulator_kinds():
+    metrics.observe("a.sum", 2)
+    metrics.observe("a.sum", 3)
+    metrics.Accumulator.get("a.avg", "avg").observe(2)
+    metrics.Accumulator.get("a.avg", "avg").observe(4)
+    metrics.Accumulator.get("a.max", "max").observe(5)
+    metrics.Accumulator.get("a.max", "max").observe(1)
+    metrics.Accumulator.get("a.g", "gauge").observe(7)
+    metrics.Accumulator.get("a.g", "gauge").observe(9)
+    rep = metrics.report()
+    assert rep["a.sum"] == 5
+    assert rep["a.avg"] == 3
+    assert rep["a.max"] == 5
+    assert rep["a.g"] == 9
+
+
+def test_vtimer_records():
+    with metrics.vtimer("pull", "exchange"):
+        time.sleep(0.01)
+    rep = metrics.report()
+    assert rep["pull.exchange.ms"] >= 10
+    assert rep["pull.exchange.max_ms"] >= rep["pull.exchange.ms"]
+
+
+def test_record_step_stats_from_device_dict():
+    import jax.numpy as jnp
+    metrics.record_step_stats({"categorical/pull_indices": jnp.asarray(128),
+                               "categorical/pull_unique": jnp.asarray(50),
+                               "categorical/pull_overflow": jnp.asarray(0)})
+    rep = metrics.report()
+    assert rep["categorical.pull_indices"] == 128
+    assert rep["categorical.pull_unique"] == 50
+
+
+def test_report_reset():
+    metrics.observe("x", 1)
+    assert metrics.report(reset=True)["x"] == 1
+    assert metrics.report()["x"] == 0
+
+
+def test_prometheus_text():
+    metrics.observe("pull.indices", 10)
+    metrics.Accumulator.get("step.ms", "avg", help="step time").observe(5.0)
+    text = metrics.prometheus_text()
+    assert "# TYPE oetpu_pull_indices counter" in text
+    assert "oetpu_pull_indices 10.0" in text
+    assert "# HELP oetpu_step_ms step time" in text
+    assert "# TYPE oetpu_step_ms gauge" in text
+
+
+def test_periodic_reporter():
+    metrics.observe("tick", 1)
+    seen = []
+    rep = metrics.PeriodicReporter(0.05, sink=seen.append)
+    with rep:
+        time.sleep(0.2)
+    assert seen and "tick" in seen[0]
+
+
+def test_serving_metrics_endpoint(tmp_path):
+    import json
+    import threading
+    import urllib.request
+    from openembedding_tpu.serving import make_server
+
+    metrics.observe("serving.requests", 3)
+    httpd = make_server(str(tmp_path / "reg"), port=0)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        url = f"http://127.0.0.1:{httpd.server_address[1]}/metrics"
+        with urllib.request.urlopen(url) as resp:
+            body = resp.read().decode()
+        assert "oetpu_serving_requests 3.0" in body
+    finally:
+        httpd.shutdown()
