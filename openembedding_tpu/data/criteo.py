@@ -81,13 +81,40 @@ def _parse_rows(rows, id_space: int):
 def read_criteo_tsv(paths, batch_size: int, *, id_space: int = 1 << 25,
                     host_id: int = 0, num_hosts: int = 1,
                     drop_remainder: bool = True,
-                    repeat: bool = False) -> Iterator[Dict]:
+                    repeat: bool = False,
+                    native: str = "auto",
+                    native_threads: int = 4) -> Iterator[Dict]:
     """Stream Criteo TSV (optionally .gz) files into fixed-shape batches.
 
     Rows are interleaved across hosts (row i goes to host i % num_hosts) — the
-    per-worker sharding the reference gets from tf.data `shard()`."""
+    per-worker sharding the reference gets from tf.data `shard()`.
+
+    `native`: "auto" uses the C++ parse pipeline (`native/oetpu_data.cpp`) when it
+    builds and the files are plain TSV, falling back to this Python parser;
+    "on" requires it; "off" forces Python."""
     if isinstance(paths, str):
         paths = [paths]
+    if native not in ("auto", "on", "off"):
+        raise ValueError(f"bad native mode {native!r}")
+    if native != "off" and not any(str(p).endswith(".gz") for p in paths):
+        reader = None
+        try:
+            # only CONSTRUCTION falls back (no compiler / bad build); a failure
+            # mid-stream must propagate — silently restarting from row 0 on the
+            # Python path would feed duplicate rows into training
+            from .. import native as native_mod
+            reader = native_mod.NativeCriteoReader(
+                paths, batch_size, id_space=id_space, host_id=host_id,
+                num_hosts=num_hosts, num_threads=native_threads,
+                drop_remainder=drop_remainder, repeat=repeat)
+        except (RuntimeError, OSError):
+            if native == "on":
+                raise
+        if reader is not None:
+            yield from reader
+            return
+    elif native == "on":
+        raise ValueError("native reader cannot read .gz files")
     while True:
         pending = []
         for path in paths:

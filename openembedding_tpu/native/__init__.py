@@ -1,0 +1,164 @@
+"""Native (C++) data-pipeline bindings via ctypes.
+
+The reference ships native code for its data path (`test/criteo_preprocess.cpp`) and
+runtime (pico-core); here the TSV parse/hash/batch producer is C++
+(`oetpu_data.cpp`) bound with ctypes (no pybind11 in this image). The library is
+built on demand with g++ (cached next to the source, keyed by source mtime);
+everything degrades gracefully to the pure-Python reader when no compiler is
+available (`data/criteo.py` falls back automatically).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Dict, Iterator, Optional, Sequence
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "oetpu_data.cpp")
+_LIB = os.path.join(_DIR, "liboetpu_data.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_error: Optional[str] = None
+
+NUM_DENSE = 13
+NUM_SPARSE = 26
+
+
+def build(force: bool = False) -> str:
+    """Compile the shared library if missing/stale; returns its path."""
+    with _lock:
+        if (not force and os.path.exists(_LIB)
+                and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC)):
+            return _LIB
+        cmd = ["g++", "-O3", "-march=native", "-std=c++17", "-shared", "-fPIC",
+               "-pthread", _SRC, "-o", _LIB + ".tmp"]
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(f"native build failed:\n{proc.stderr}")
+        os.replace(_LIB + ".tmp", _LIB)
+        return _LIB
+
+
+def load() -> ctypes.CDLL:
+    """Build (if needed) and load the library; raises on failure."""
+    global _lib, _build_error
+    if _lib is not None:
+        return _lib
+    if _build_error is not None:
+        raise RuntimeError(_build_error)
+    try:
+        path = build()
+        lib = ctypes.CDLL(path)
+    except (RuntimeError, OSError) as e:
+        _build_error = str(e)
+        raise
+    lib.oetpu_reader_create.restype = ctypes.c_void_p
+    lib.oetpu_reader_create.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p), ctypes.c_int, ctypes.c_int,
+        ctypes.c_uint64, ctypes.c_int, ctypes.c_int, ctypes.c_int]
+    lib.oetpu_reader_next.restype = ctypes.c_int
+    lib.oetpu_reader_next.argtypes = [
+        ctypes.c_void_p,
+        np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")]
+    lib.oetpu_reader_destroy.restype = None
+    lib.oetpu_reader_destroy.argtypes = [ctypes.c_void_p]
+    lib.oetpu_hash_category.restype = ctypes.c_int64
+    lib.oetpu_hash_category.argtypes = [ctypes.c_uint64, ctypes.c_uint64,
+                                        ctypes.c_uint64]
+    lib.oetpu_preprocess.restype = ctypes.c_int64
+    lib.oetpu_preprocess.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")]
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    try:
+        load()
+        return True
+    except (RuntimeError, OSError):
+        return False
+
+
+class NativeCriteoReader:
+    """Streaming batches from Criteo TSV files via the C++ pipeline.
+
+    Yields the same dict batches as `data.criteo.read_criteo_tsv` (bit-identical ids
+    and labels; dense within float rounding of the numpy transform)."""
+
+    def __init__(self, paths: Sequence[str], batch_size: int, *,
+                 id_space: int = 1 << 25, host_id: int = 0, num_hosts: int = 1,
+                 num_threads: int = 4, drop_remainder: bool = True,
+                 repeat: bool = False):
+        if isinstance(paths, str):
+            paths = [paths]
+        for p in paths:
+            if not os.path.exists(p):
+                raise FileNotFoundError(p)
+            if str(p).endswith(".gz"):
+                raise ValueError("native reader reads plain TSV; "
+                                 "gzip falls back to the Python reader")
+        self.paths = [os.fspath(p) for p in paths]
+        self.batch_size = batch_size
+        self.id_space = id_space
+        self.host_id = host_id
+        self.num_hosts = num_hosts
+        self.num_threads = num_threads
+        self.drop_remainder = drop_remainder
+        self.repeat = repeat
+        self._lib = load()
+
+    def _one_pass(self) -> Iterator[Dict]:
+        lib = self._lib
+        arr = (ctypes.c_char_p * len(self.paths))(
+            *[p.encode() for p in self.paths])
+        handle = lib.oetpu_reader_create(
+            arr, len(self.paths), self.batch_size, self.id_space,
+            self.host_id, self.num_hosts, self.num_threads)
+        try:
+            while True:
+                labels = np.empty((self.batch_size,), np.float32)
+                dense = np.empty((self.batch_size, NUM_DENSE), np.float32)
+                sparse = np.empty((self.batch_size, NUM_SPARSE), np.int64)
+                n = lib.oetpu_reader_next(handle, labels, dense, sparse)
+                if n == 0:
+                    return
+                if n < self.batch_size:
+                    if self.drop_remainder:
+                        return
+                    labels, dense, sparse = labels[:n], dense[:n], sparse[:n]
+                yield {"sparse": {"categorical": sparse}, "dense": dense,
+                       "label": labels}
+                if n < self.batch_size:
+                    return
+        finally:
+            lib.oetpu_reader_destroy(handle)
+
+    def __iter__(self) -> Iterator[Dict]:
+        while True:
+            yield from self._one_pass()
+            if not self.repeat:
+                return
+
+
+def preprocess(in_path: str, out_path: str, min_count: int = 10) -> np.ndarray:
+    """Frequency relabel (reference `test/criteo_preprocess.cpp`): rewrites the TSV
+    with each categorical column renumbered by descending frequency (0 = rare).
+    Returns the per-column vocab sizes (26,)."""
+    lib = load()
+    vocab = np.zeros((NUM_SPARSE,), np.int64)
+    rows = lib.oetpu_preprocess(in_path.encode(), out_path.encode(),
+                                min_count, vocab)
+    if rows < 0:
+        raise IOError(f"preprocess failed with code {rows} "
+                      f"({in_path!r} -> {out_path!r})")
+    return vocab

@@ -1,0 +1,140 @@
+"""Native (C++) Criteo pipeline: build, parity vs the Python reader, preprocess.
+
+The contract is bit-identical sparse ids/labels and float-rounding-identical dense
+features vs `data.criteo.read_criteo_tsv(native="off")` (the checked oracle), plus
+the frequency-relabel tool (reference `test/criteo_preprocess.cpp`)."""
+
+import shutil
+
+import numpy as np
+import pytest
+
+from openembedding_tpu.data.criteo import (NUM_DENSE, NUM_SPARSE,
+                                           read_criteo_tsv)
+
+pytestmark = pytest.mark.skipif(shutil.which("g++") is None,
+                                reason="no C++ compiler")
+
+
+def _write_tsv(path, rows, seed=0, short_rows=False):
+    rng = np.random.default_rng(seed)
+    with open(path, "w") as f:
+        for r in range(rows):
+            cols = [str(rng.integers(0, 2))]
+            for i in range(NUM_DENSE):
+                if rng.random() < 0.1:
+                    cols.append("")
+                else:
+                    cols.append(str(int(rng.integers(-5, 1000))))
+            n_cat = NUM_SPARSE if not short_rows or rng.random() < 0.7 else \
+                int(rng.integers(0, NUM_SPARSE))
+            for i in range(n_cat):
+                if rng.random() < 0.1:
+                    cols.append("")
+                else:
+                    cols.append(f"{int(rng.integers(0, 1 << 32)):08x}")
+            f.write("\t".join(cols) + "\n")
+    return path
+
+
+@pytest.fixture(scope="module")
+def native():
+    from openembedding_tpu import native as native_mod
+    native_mod.build()
+    return native_mod
+
+
+def _collect(it):
+    batches = list(it)
+    if not batches:
+        return None
+    return {
+        "label": np.concatenate([b["label"] for b in batches]),
+        "dense": np.concatenate([b["dense"] for b in batches]),
+        "sparse": np.concatenate([b["sparse"]["categorical"] for b in batches]),
+    }
+
+
+def test_hash_parity(native):
+    from openembedding_tpu.data.criteo import hash_category
+    rng = np.random.default_rng(1)
+    toks = rng.integers(0, 1 << 62, size=100, dtype=np.uint64)
+    fields = rng.integers(0, NUM_SPARSE, size=100, dtype=np.uint64)
+    want = hash_category(toks, fields, 1 << 25)
+    lib = native.load()
+    got = np.asarray([lib.oetpu_hash_category(int(t), int(f), 1 << 25)
+                      for t, f in zip(toks, fields)])
+    np.testing.assert_array_equal(want, got)
+
+
+@pytest.mark.parametrize("short_rows", [False, True])
+def test_reader_parity(native, tmp_path, short_rows):
+    path = _write_tsv(str(tmp_path / "a.tsv"), 257, short_rows=short_rows)
+    kw = dict(id_space=1 << 20, drop_remainder=False)
+    want = _collect(read_criteo_tsv(path, 64, native="off", **kw))
+    got = _collect(read_criteo_tsv(path, 64, native="on", **kw))
+    np.testing.assert_array_equal(want["label"], got["label"])
+    np.testing.assert_array_equal(want["sparse"], got["sparse"])
+    np.testing.assert_allclose(want["dense"], got["dense"], rtol=1e-6)
+
+
+def test_reader_multi_file_and_hosts(native, tmp_path):
+    p1 = _write_tsv(str(tmp_path / "a.tsv"), 100, seed=1)
+    p2 = _write_tsv(str(tmp_path / "b.tsv"), 117, seed=2)
+    for host_id in (0, 2):
+        kw = dict(id_space=1 << 20, drop_remainder=False,
+                  host_id=host_id, num_hosts=3)
+        want = _collect(read_criteo_tsv([p1, p2], 32, native="off", **kw))
+        got = _collect(read_criteo_tsv([p1, p2], 32, native="on", **kw))
+        np.testing.assert_array_equal(want["label"], got["label"])
+        np.testing.assert_array_equal(want["sparse"], got["sparse"])
+
+
+def test_reader_drop_remainder_and_repeat(native, tmp_path):
+    path = _write_tsv(str(tmp_path / "c.tsv"), 70)
+    batches = list(read_criteo_tsv(path, 32, native="on", drop_remainder=True))
+    assert len(batches) == 2  # 70 rows -> 2 full batches, 6 dropped
+    it = read_criteo_tsv(path, 32, native="on", drop_remainder=True, repeat=True)
+    seen = [next(it) for _ in range(5)]  # crosses the epoch boundary
+    np.testing.assert_array_equal(seen[0]["sparse"]["categorical"],
+                                  seen[2]["sparse"]["categorical"])
+
+
+def test_missing_trailing_fields_match(native, tmp_path):
+    # a row with ONLY the label: every dense -> 0-transform, cat i -> hash(i)
+    path = str(tmp_path / "d.tsv")
+    with open(path, "w") as f:
+        f.write("1\n")
+        f.write("0\t" + "\t".join(["3"] * NUM_DENSE) + "\n")
+    kw = dict(id_space=1 << 20, drop_remainder=False)
+    want = _collect(read_criteo_tsv(path, 4, native="off", **kw))
+    got = _collect(read_criteo_tsv(path, 4, native="on", **kw))
+    np.testing.assert_array_equal(want["sparse"], got["sparse"])
+    np.testing.assert_allclose(want["dense"], got["dense"], rtol=1e-6)
+
+
+def test_preprocess_relabel(native, tmp_path):
+    src = str(tmp_path / "raw.tsv")
+    with open(src, "w") as f:
+        # c0 token "aa" x3, "bb" x2, "cc" x1 -> ranks aa=1, bb=2, cc=rare(0)
+        for tok in ["aa", "aa", "aa", "bb", "bb", "cc"]:
+            cols = ["1"] + ["2"] * NUM_DENSE + [tok] + ["ff"] * (NUM_SPARSE - 1)
+            f.write("\t".join(cols) + "\n")
+    dst = str(tmp_path / "relabel.tsv")
+    vocab = native.preprocess(src, dst, min_count=2)
+    assert vocab[0] == 3   # {0 rare, 1 aa, 2 bb}
+    assert vocab[1] == 2   # {0 rare, 1 ff}
+    col0 = [line.split("\t")[1 + NUM_DENSE] for line in open(dst)]
+    assert col0 == ["1", "1", "1", "2", "2", "0"]
+    # non-categorical columns pass through
+    first = open(dst).readline().split("\t")
+    assert first[0] == "1" and first[1] == "2"
+
+
+def test_native_reader_throughput_smoke(native, tmp_path):
+    """Not a benchmark, just proof the multi-threaded path moves real volume."""
+    path = _write_tsv(str(tmp_path / "big.tsv"), 5000, seed=3)
+    total = sum(b["label"].shape[0]
+                for b in read_criteo_tsv(path, 512, native="on",
+                                         drop_remainder=False))
+    assert total == 5000
