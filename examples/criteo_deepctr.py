@@ -1,0 +1,176 @@
+"""Criteo CTR training — the reference benchmark workload, TPU-native.
+
+Counterpart of `test/benchmark/criteo_deepctr.py` + `examples/criteo_deepctr_network*`:
+pick a model family (WDL/DeepFM/xDeepFM/DLRM), optimizer, dim; train data-parallel
+over every visible device with row-sharded embedding tables (the reference needs
+Horovod + PS servers; here it is one SPMD program on a mesh).
+
+Flag map to the reference benchmark:
+  --model/--dim/--optimizer/--batch-size  same sweep axes
+  --mesh            reference `--server` (PS sharding) -> MeshTrainer on all devices
+  --cache N         reference `--cache` ("small tables dense-mirrored"): tables with
+                    input_dim <= N become sparse_as_dense
+  --prefetch        reference `--prefetch` (`pulling()` pipeline) -> device prefetch
+  --persist ROOT    reference pmem AutoPersist -> async persist every --persist-steps
+  --data/--synthetic  Criteo TSV file(s) or the synthetic Zipfian stream
+
+CPU smoke:
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python examples/criteo_deepctr.py --mesh --steps 20 --synthetic
+"""
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import openembedding_tpu as embed  # noqa: E402
+from openembedding_tpu.data import (CriteoBatcher, prefetch_to_device,  # noqa: E402
+                                    read_criteo_tsv, synthetic_criteo)
+from openembedding_tpu.model import Trainer  # noqa: E402
+from openembedding_tpu import models as zoo  # noqa: E402
+from openembedding_tpu.utils import metrics as M  # noqa: E402
+
+OPTIMIZERS = {
+    "adagrad": lambda lr: embed.Adagrad(learning_rate=lr),
+    "adam": lambda lr: embed.Adam(learning_rate=lr),
+    "ftrl": lambda lr: embed.Ftrl(learning_rate=lr),
+    "sgd": lambda lr: embed.SGD(learning_rate=lr),
+    "rmsprop": lambda lr: embed.RMSprop(learning_rate=lr),
+}
+
+
+def auc(labels: np.ndarray, scores: np.ndarray) -> float:
+    """Rank-based AUC (the reference prints keras AUC per epoch)."""
+    order = np.argsort(scores, kind="stable")
+    ranks = np.empty_like(order, dtype=np.float64)
+    ranks[order] = np.arange(1, len(scores) + 1)
+    pos = labels > 0.5
+    n_pos, n_neg = int(pos.sum()), int((~pos).sum())
+    if n_pos == 0 or n_neg == 0:
+        return float("nan")
+    return (ranks[pos].sum() - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="deepfm", choices=sorted(zoo._FAMILIES))
+    ap.add_argument("--dim", type=int, default=9)
+    ap.add_argument("--optimizer", default="adagrad", choices=sorted(OPTIMIZERS))
+    ap.add_argument("--learning-rate", type=float, default=0.05)
+    ap.add_argument("--batch-size", type=int, default=4096,
+                    help="global batch (split across devices with --mesh)")
+    ap.add_argument("--vocabulary", type=int, default=1 << 22)
+    ap.add_argument("--data", nargs="*", default=None, help="Criteo TSV file(s)")
+    ap.add_argument("--synthetic", action="store_true")
+    ap.add_argument("--steps", type=int, default=100)
+    ap.add_argument("--mesh", action="store_true",
+                    help="MeshTrainer over all visible devices")
+    ap.add_argument("--cache", type=int, default=0,
+                    help="sparse_as_dense for vocab <= N (reference --cache)")
+    ap.add_argument("--prefetch", action="store_true")
+    ap.add_argument("--persist", default="", help="async persist root dir")
+    ap.add_argument("--persist-steps", type=int, default=50)
+    ap.add_argument("--save", default="")
+    ap.add_argument("--load", default="")
+    ap.add_argument("--export", default="", help="standalone serving export dir")
+    ap.add_argument("--report-interval", type=float, default=0.0)
+    args = ap.parse_args()
+
+    if args.model == "two_tower":
+        ap.error("two_tower has its own batch schema; use the zoo API directly")
+
+    make = zoo._FAMILIES[args.model]
+    kwargs = dict(vocabulary=args.vocabulary, dim=args.dim)
+    if args.model == "lr":
+        kwargs.pop("dim")
+    model = make(**kwargs)
+    if args.cache > 0 and args.vocabulary <= args.cache:
+        import dataclasses
+        spec = model.specs["categorical"]
+        model.specs["categorical"] = dataclasses.replace(
+            spec, sparse_as_dense=True)
+        print(f"cache mode: categorical ({args.vocabulary}) is dense-mirrored")
+
+    opt = OPTIMIZERS[args.optimizer](args.learning_rate)
+    if args.mesh:
+        from openembedding_tpu.parallel import MeshTrainer
+        trainer = MeshTrainer(model, opt)
+        print(f"mesh: {trainer.num_shards} devices, tables row-sharded, "
+              f"batch data-parallel")
+    else:
+        trainer = Trainer(model, opt)
+
+    if args.data:
+        rows = read_criteo_tsv(args.data, args.batch_size,
+                               id_space=args.vocabulary, drop_remainder=True,
+                               repeat=True)
+        batches = iter(CriteoBatcher(rows, args.batch_size))
+    else:
+        batches = synthetic_criteo(args.batch_size, id_space=args.vocabulary,
+                                   ids_dtype=np.int32)
+    if args.prefetch:
+        batches = prefetch_to_device(batches)
+
+    first = next(batches)
+    state = trainer.init(first)
+    if args.load:
+        state = trainer.load(state, args.load)
+        print(f"resumed at step {int(state.step)}")
+    if args.mesh:
+        step = trainer.jit_train_step(first, state)
+    else:
+        step = trainer.jit_train_step()
+
+    persister = None
+    if args.persist:
+        persister = embed.AsyncPersister(
+            trainer, model, args.persist,
+            policy=embed.PersistPolicy(every_steps=args.persist_steps))
+
+    reporter = M.PeriodicReporter(args.report_interval).start()
+    all_labels, all_scores = [], []
+    t0 = time.perf_counter()
+    state, m = step(state, first)
+    for i in range(1, args.steps):
+        batch = next(batches)
+        with M.vtimer("train", "step"):
+            state, m = step(state, batch)
+        all_labels.append(np.asarray(batch["label"]))
+        all_scores.append(np.asarray(m["logits"]).reshape(-1))
+        M.record_step_stats({k: v for k, v in m.get("stats", {}).items()})
+        if persister is not None:
+            persister.maybe_persist(state)
+        if i % 20 == 0:
+            print(f"step {i}: loss {float(m['loss']):.4f}")
+    loss = float(m["loss"])  # fences the device work
+    dt = time.perf_counter() - t0
+    reporter.stop()
+    if persister is not None:
+        persister.close()
+
+    examples = args.steps * args.batch_size
+    print(f"trained {args.steps} steps, loss {loss:.4f}, "
+          f"{examples / dt:,.0f} examples/s "
+          f"({examples / dt / max(1, getattr(trainer, 'num_shards', 1)):,.0f}"
+          f"/chip)")
+    if all_labels:
+        print(f"train AUC {auc(np.concatenate(all_labels), np.concatenate(all_scores)):.4f}")
+    print(M.report_table())
+
+    if args.save:
+        trainer.save(state, args.save)
+        print(f"checkpoint -> {args.save}")
+    if args.export:
+        from openembedding_tpu.export import export_standalone
+        export_standalone(state, model, args.export,
+                          num_shards=getattr(trainer, "num_shards", 1))
+        print(f"standalone serving export -> {args.export}")
+
+
+if __name__ == "__main__":
+    main()

@@ -71,8 +71,11 @@ def _parse_rows(rows, id_space: int):
             v = cols[1 + i]
             x = float(v) if v else 0.0
             dense[r, i] = np.square(np.log(max(x, 0.0) + 4.0))
+        # tokens wider than 64 bits saturate (strtoull semantics — keeps the
+        # native C++ parser bit-identical on malformed/overlong tokens)
         toks = np.array(
-            [int(cols[1 + NUM_DENSE + i], 16) if cols[1 + NUM_DENSE + i] else i
+            [min(int(cols[1 + NUM_DENSE + i], 16), 0xFFFFFFFFFFFFFFFF)
+             if cols[1 + NUM_DENSE + i] else i
              for i in range(NUM_SPARSE)], dtype=np.uint64)
         sparse[r] = hash_category(toks, fields, id_space)
     return labels, dense, sparse

@@ -36,12 +36,14 @@ def build(force: bool = False) -> str:
         if (not force and os.path.exists(_LIB)
                 and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC)):
             return _LIB
+        tmp = f"{_LIB}.tmp.{os.getpid()}"  # unique per builder: concurrent
+        # processes (multi-host launch, pytest-xdist) must not share a tmp
         cmd = ["g++", "-O3", "-march=native", "-std=c++17", "-shared", "-fPIC",
-               "-pthread", _SRC, "-o", _LIB + ".tmp"]
+               "-pthread", _SRC, "-o", tmp]
         proc = subprocess.run(cmd, capture_output=True, text=True)
         if proc.returncode != 0:
             raise RuntimeError(f"native build failed:\n{proc.stderr}")
-        os.replace(_LIB + ".tmp", _LIB)
+        os.replace(tmp, _LIB)
         return _LIB
 
 
@@ -130,6 +132,10 @@ class NativeCriteoReader:
                 dense = np.empty((self.batch_size, NUM_DENSE), np.float32)
                 sparse = np.empty((self.batch_size, NUM_SPARSE), np.int64)
                 n = lib.oetpu_reader_next(handle, labels, dense, sparse)
+                if n < 0:
+                    raise IOError(
+                        f"native reader failed (unreadable input?) on "
+                        f"{self.paths}")
                 if n == 0:
                     return
                 if n < self.batch_size:

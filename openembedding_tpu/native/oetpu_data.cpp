@@ -93,10 +93,16 @@ class Reader {
     for (auto& t : workers_) t.join();
   }
 
-  // Fill caller buffers with up to batch_ rows; 0 means clean EOF.
+  // Fill caller buffers with up to batch_ rows; 0 = clean EOF, -1 = IO error
+  // (the Python reader raises on unreadable files; silently training on a
+  // subset would break the bit-identical parity contract).
   int next(float* labels, float* dense, int64_t* sparse) {
     int filled = 0;
     while (filled < batch_) {
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        if (!error_.empty()) return -1;
+      }
       if (cur_ && cur_off_ < cur_->n) {
         size_t take = std::min<size_t>(batch_ - filled, cur_->n - cur_off_);
         std::memcpy(labels + filled, cur_->labels.data() + cur_off_,
@@ -114,10 +120,11 @@ class Reader {
       // need the next block, in sequence order
       std::unique_lock<std::mutex> lk(mu_);
       cv_out_.wait(lk, [this] {
-        return stop_ || done_.count(next_seq_) ||
+        return stop_ || !error_.empty() || done_.count(next_seq_) ||
                (io_done_ && inflight_ == 0 && done_.empty());
       });
       if (stop_) return filled;
+      if (!error_.empty()) return -1;
       auto it = done_.find(next_seq_);
       if (it == done_.end()) return filled;  // drained: EOF
       cur_ = std::move(it->second);
@@ -134,28 +141,50 @@ class Reader {
   static constexpr size_t kChunkBytes = 1 << 20;
   static constexpr size_t kMaxInflight = 64;  // bounds memory (~64 MB of text)
 
+  void set_error(std::string msg) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (error_.empty()) error_ = std::move(msg);
+    io_done_ = true;
+    cv_in_.notify_all();
+    cv_out_.notify_all();
+  }
+
   void io_loop() {
     uint64_t seq = 0;
     for (const auto& path : paths_) {
       FILE* f = std::fopen(path.c_str(), "rb");
-      if (!f) continue;  // match Python: open() raises; here missing files skip —
-                         // the binding pre-checks existence so behavior aligns
+      if (!f) {  // unreadable file is an ERROR, matching the Python open()
+        set_error("cannot open " + path);
+        return;
+      }
       uint64_t row = 0;
-      std::string carry;
+      std::string carry;  // only the short unterminated tail of each read
       std::vector<char> buf(kChunkBytes);
       while (true) {
         size_t got = std::fread(buf.data(), 1, buf.size(), f);
         if (got == 0) break;
-        carry.append(buf.data(), got);
-        size_t last_nl = carry.rfind('\n');
-        if (last_nl == std::string::npos) continue;
+        const char* nl = static_cast<const char*>(
+            memrchr(buf.data(), '\n', got));
+        if (!nl) {  // no newline in the whole read: accumulate and continue
+          carry.append(buf.data(), got);
+          continue;
+        }
+        size_t head = static_cast<size_t>(nl - buf.data()) + 1;
         TextChunk chunk;
-        chunk.text = carry.substr(0, last_nl + 1);
-        carry.erase(0, last_nl + 1);
+        chunk.text.reserve(carry.size() + head);
+        chunk.text = std::move(carry);
+        chunk.text.append(buf.data(), head);
+        carry.assign(buf.data() + head, got - head);
         chunk.first_row = row;
-        row += std::count(chunk.text.begin(), chunk.text.end(), '\n');
+        row += static_cast<uint64_t>(
+            std::count(chunk.text.begin(), chunk.text.end(), '\n'));
         chunk.seq = seq++;
         if (!push_chunk(std::move(chunk))) { std::fclose(f); return; }
+      }
+      if (std::ferror(f)) {
+        std::fclose(f);
+        set_error("read error on " + path);
+        return;
       }
       std::fclose(f);
       if (!carry.empty()) {  // final unterminated line
@@ -279,6 +308,7 @@ class Reader {
   size_t inflight_ = 0;
   bool io_done_ = false;
   bool stop_ = false;
+  std::string error_;
 
   std::unique_ptr<RowBlock> cur_;
   size_t cur_off_ = 0;

@@ -131,6 +131,39 @@ def test_preprocess_relabel(native, tmp_path):
     assert first[0] == "1" and first[1] == "2"
 
 
+def test_unreadable_file_is_an_error_not_a_skip(native, tmp_path):
+    """fopen failure must surface as IOError (the Python reader raises too);
+    silently training on a subset would violate the parity contract."""
+    import ctypes
+    lib = native.load()
+    missing = str(tmp_path / "nope.tsv").encode()
+    arr = (ctypes.c_char_p * 1)(missing)
+    handle = lib.oetpu_reader_create(arr, 1, 8, 1 << 20, 0, 1, 2)
+    try:
+        labels = np.empty((8,), np.float32)
+        dense = np.empty((8, NUM_DENSE), np.float32)
+        sparse = np.empty((8, NUM_SPARSE), np.int64)
+        assert lib.oetpu_reader_next(handle, labels, dense, sparse) == -1
+    finally:
+        lib.oetpu_reader_destroy(handle)
+
+
+def test_long_line_spanning_reads(native, tmp_path):
+    """A line longer than one IO chunk exercises the carry path."""
+    path = str(tmp_path / "long.tsv")
+    filler = "f" * (1 << 21)  # 2 MB token > 1 MB chunk
+    with open(path, "w") as f:
+        cols = ["1"] + ["2"] * NUM_DENSE + [filler] + ["aa"] * (NUM_SPARSE - 1)
+        f.write("\t".join(cols) + "\n")
+        cols2 = ["0"] + ["3"] * NUM_DENSE + ["bb"] * NUM_SPARSE
+        f.write("\t".join(cols2) + "\n")
+    kw = dict(id_space=1 << 20, drop_remainder=False)
+    want = _collect(read_criteo_tsv(path, 4, native="off", **kw))
+    got = _collect(read_criteo_tsv(path, 4, native="on", **kw))
+    np.testing.assert_array_equal(want["sparse"], got["sparse"])
+    np.testing.assert_array_equal(want["label"], got["label"])
+
+
 def test_native_reader_throughput_smoke(native, tmp_path):
     """Not a benchmark, just proof the multi-threaded path moves real volume."""
     path = _write_tsv(str(tmp_path / "big.tsv"), 5000, seed=3)

@@ -1,0 +1,171 @@
+"""Async persistence (PMem-equivalent) tests: commit protocol, crash consistency,
+pending-window backpressure, policy, restore (reference: `pmem_c_api_test.cpp`,
+`pmem_embedding_table_test.cpp`, AutoPersist in `test/benchmark/criteo_deepctr.py`)."""
+
+import os
+import shutil
+import time
+
+import numpy as np
+import pytest
+
+import openembedding_tpu as embed
+from openembedding_tpu.data import synthetic_criteo
+from openembedding_tpu.model import Trainer
+from openembedding_tpu.models import make_deepfm
+from openembedding_tpu.persist import (AsyncPersister, PersistPolicy,
+                                       latest_persist, list_persists,
+                                       restore_server_model)
+
+VOCAB = 1 << 10
+
+
+@pytest.fixture()
+def setup():
+    model = make_deepfm(vocabulary=VOCAB, dim=4, hidden=(8,))
+    trainer = Trainer(model, embed.Adagrad(learning_rate=0.05), seed=0)
+    batches = list(synthetic_criteo(16, id_space=VOCAB, steps=6, seed=1))
+    state = trainer.init(batches[0])
+    return model, trainer, state, batches
+
+
+def test_policy_steps_and_seconds():
+    p = PersistPolicy(every_steps=10)
+    assert not p.should_persist(5)
+    assert p.should_persist(10)
+    p.mark(10)
+    assert not p.should_persist(15)
+    assert p.should_persist(20)
+    pt = PersistPolicy(every_seconds=0.05)
+    assert not pt.should_persist(1)
+    time.sleep(0.06)
+    assert pt.should_persist(1)
+    with pytest.raises(ValueError):
+        PersistPolicy()
+
+
+def test_persist_restore_round_trip(setup, tmp_path):
+    model, trainer, state, batches = setup
+    step = trainer.jit_train_step()
+    root = str(tmp_path / "persist")
+    with AsyncPersister(trainer, model, root, window=2, keep=10,
+                        policy=PersistPolicy(every_steps=2)) as p:
+        persisted_steps = []
+        for b in batches:
+            state, _ = step(state, b)
+            if p.maybe_persist(state):
+                persisted_steps.append(int(state.step))
+        p.wait()
+        expect_w = np.asarray(state.tables["categorical"].weights)
+    assert persisted_steps == [2, 4, 6]
+    assert [s for s, _ in list_persists(root)] == [2, 4, 6]
+
+    fresh = trainer.init(batches[0])
+    restored = restore_server_model(fresh, model, root, trainer=trainer)
+    assert int(restored.step) == 6
+    np.testing.assert_array_equal(
+        np.asarray(restored.tables["categorical"].weights), expect_w)
+
+
+def test_uncommitted_persist_ignored(setup, tmp_path):
+    model, trainer, state, batches = setup
+    root = str(tmp_path / "persist")
+    step = trainer.jit_train_step()
+    state, _ = step(state, batches[0])
+    with AsyncPersister(trainer, model, root, window=1,
+                        policy=PersistPolicy(every_steps=1)) as p:
+        p.persist(state)
+    # fake a crash mid-write: newer dir without COMMIT marker
+    committed = latest_persist(root)
+    crashed = os.path.join(root, "persist_000000000099")
+    shutil.copytree(committed, crashed)
+    os.unlink(os.path.join(crashed, "COMMIT"))
+    assert latest_persist(root) == committed  # step 99 not eligible
+    restored = restore_server_model(trainer.init(batches[0]), model, root,
+                                    trainer=trainer)
+    assert int(restored.step) == 1
+
+
+def test_gc_keeps_last_k(setup, tmp_path):
+    model, trainer, state, batches = setup
+    root = str(tmp_path / "persist")
+    step = trainer.jit_train_step()
+    with AsyncPersister(trainer, model, root, window=1, keep=2,
+                        policy=PersistPolicy(every_steps=1)) as p:
+        for b in batches[:5]:
+            state, _ = step(state, b)
+            p.persist(state)
+            p.wait()  # serialize so gc sees each commit
+    steps = [s for s, _ in list_persists(root)]
+    assert steps == [4, 5]
+
+
+def test_repersist_same_step_supersedes(setup, tmp_path):
+    """A restarted run re-reaching a step must overwrite the old persist of that
+    step (committed or crash-leftover), not die with ENOTEMPTY."""
+    model, trainer, state, batches = setup
+    root = str(tmp_path / "persist")
+    step = trainer.jit_train_step()
+    state, _ = step(state, batches[0])
+    for _ in range(2):  # second pass hits the existing committed persist_1 dir
+        with AsyncPersister(trainer, model, root, window=1,
+                            policy=PersistPolicy(every_steps=1)) as p:
+            p.persist(state)
+    assert [s for s, _ in list_persists(root)] == [1]
+    restored = restore_server_model(trainer.init(batches[0]), model, root,
+                                    trainer=trainer)
+    assert int(restored.step) == 1
+
+
+def test_restore_without_persist_raises(setup, tmp_path):
+    model, trainer, state, _ = setup
+    with pytest.raises(FileNotFoundError):
+        restore_server_model(state, model, str(tmp_path / "empty"),
+                             trainer=trainer)
+
+
+def test_writer_error_propagates(setup, tmp_path):
+    model, trainer, state, batches = setup
+    root = str(tmp_path / "persist")
+    step = trainer.jit_train_step()
+    state, _ = step(state, batches[0])
+    p = AsyncPersister(trainer, model, root, window=1,
+                       policy=PersistPolicy(every_steps=1))
+    try:
+        # poison the root: writer's os.replace onto a file must fail
+        p.persist(state)
+        p._q.join()
+        target = os.path.join(root, "persist_000000000002")
+        os.makedirs(os.path.dirname(target), exist_ok=True)
+        state, _ = step(state, batches[1])
+        with open(target, "w") as f:
+            f.write("in the way")
+        p.persist(state)
+        p._q.join()
+        with pytest.raises(RuntimeError, match="async persist failed"):
+            p._raise_pending_error()
+    finally:
+        p._error = None
+        p.close()
+
+
+def test_snapshot_isolated_from_donation(setup, tmp_path):
+    """persist() must copy to host before returning: the next step donates the
+    state's buffers, and the async write must still see the OLD values."""
+    model, trainer, state, batches = setup
+    root = str(tmp_path / "persist")
+    step = trainer.jit_train_step()
+    state, _ = step(state, batches[0])
+    want = np.asarray(state.tables["categorical"].weights).copy()
+    with AsyncPersister(trainer, model, root, window=2,
+                        policy=PersistPolicy(every_steps=1)) as p:
+        p.persist(state)
+        for b in batches[1:]:  # donates + mutates the tables while write runs
+            state, _ = step(state, b)
+        p.wait()
+    restored = restore_server_model(trainer.init(batches[0]), model, root,
+                                    trainer=trainer)
+    # the persist captured step-1 state, untouched by later steps
+    assert int(restored.step) == 1
+    np.testing.assert_array_equal(
+        np.asarray(restored.tables["categorical"].weights), want)
