@@ -120,7 +120,10 @@ class StandaloneModel:
             if os.path.exists(cfg_path):
                 from . import models as zoo
                 with open(cfg_path) as f:
-                    model = zoo.from_config(json.load(f))
+                    cfg = json.load(f)
+                # runtime parallelism knobs (e.g. SASRec attention="ring") do
+                # not survive into serving, which runs outside shard_map
+                model = zoo.from_config(cfg, **cfg.get("serving_overrides", {}))
         tables = {}
         for v in meta.variables:
             vdir = os.path.join(path, f"variable_{v.variable_id}")

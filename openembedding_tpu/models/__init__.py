@@ -18,10 +18,13 @@ from .ctr import (MLP, LogisticRegression, WideDeep, DeepFM, XDeepFM, DLRM,
                   make_lr, make_wdl, make_deepfm, make_xdeepfm, make_dlrm,
                   CRITEO_NUM_SPARSE, CRITEO_NUM_DENSE)
 from .two_tower import TwoTower, make_two_tower, in_batch_softmax_loss
+from .sequential import (SASRec, make_sasrec, sasrec_bce_loss,
+                         synthetic_sequences)
 
 _FAMILIES = {
     "lr": make_lr, "wdl": make_wdl, "deepfm": make_deepfm,
     "xdeepfm": make_xdeepfm, "dlrm": make_dlrm, "two_tower": make_two_tower,
+    "sasrec": make_sasrec,
 }
 
 
@@ -33,6 +36,7 @@ def from_config(config: dict, **overrides):
     import jax.numpy as jnp
 
     cfg = dict(config)
+    cfg.pop("serving_overrides", None)  # applied by callers (export.py) as overrides
     cfg.update(overrides)
     family = cfg.pop("family")
     if family not in _FAMILIES:
@@ -49,5 +53,6 @@ __all__ = [
     "make_lr", "make_wdl", "make_deepfm", "make_xdeepfm", "make_dlrm",
     "from_config",
     "TwoTower", "make_two_tower", "in_batch_softmax_loss",
+    "SASRec", "make_sasrec", "sasrec_bce_loss", "synthetic_sequences",
     "CRITEO_NUM_SPARSE", "CRITEO_NUM_DENSE",
 ]

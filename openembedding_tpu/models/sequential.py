@@ -1,0 +1,181 @@
+"""Sequential recommendation (SASRec-style) over long user histories.
+
+Not in the reference (CTR only — SURVEY.md §5 "long-context: absent"), but this
+framework treats long sequences as first-class: the item-id history runs through the
+SAME row-sharded embedding path as CTR ids, and self-attention over the history can
+be context-parallel (`attention="ring"|"ulysses"`, `parallel/sequence.py`) so
+histories can exceed a single chip's memory. Trained with the standard SASRec
+objective: causal transformer encodes the history, each position scores its next
+item against one positive and one sampled negative (BCE).
+
+Batch convention (Trainer-compatible):
+    {"sparse": {"item": (B, 3, S)},   # stacked [history, positives, negatives]
+     "label":  (B, S)}                # 1.0 = real position, 0.0 = padding
+A single table pull fetches all three id sets in one exchange (B*3*S ids).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..embedding import Embedding
+from ..initializers import Normal
+from ..model import EmbeddingModel
+
+ITEM = "item"
+
+
+def sasrec_bce_loss(logits: jax.Array, labels: jax.Array,
+                    weight=None, *, norm_axis=None) -> jax.Array:
+    """logits (B, S, 2) = [positive score, negative score]; labels (B, S) mask.
+    BCE(pos -> 1) + BCE(neg -> 0), averaged over real positions.
+
+    `norm_axis` (set by SeqMeshTrainer): normalize by the GLOBAL mask count
+    (psum over the mesh) instead of the local shard's — per-shard means would
+    weight positions on padding-heavy sequence shards higher than the same
+    batch trained without context parallelism."""
+    pos, neg = logits[..., 0], logits[..., 1]
+    per = jax.nn.softplus(-pos) + jax.nn.softplus(neg)
+    mask = labels.astype(per.dtype)
+    if weight is not None:
+        mask = mask * weight.reshape(-1, 1).astype(per.dtype)
+    denom = jnp.sum(mask)
+    if norm_axis is not None:
+        denom = jax.lax.psum(denom, norm_axis)
+    return jnp.sum(per * mask) / jnp.maximum(denom, 1.0)
+
+
+class SASRec(nn.Module):
+    """Causal transformer over the item history.
+
+    `attention`: "full" (single device / data-parallel), "ring" or "ulysses"
+    (context-parallel: REQUIRES running inside shard_map with a `seq_axis` mesh
+    axis — the sequence dim of the inputs is then the per-device shard)."""
+
+    dim: int = 32
+    num_heads: int = 2
+    num_blocks: int = 2
+    max_len: int = 512
+    attention: str = "full"
+    seq_axis: str = "seq"
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    def _attend(self, q, k, v):
+        from ..parallel.sequence import (reference_attention, ring_attention,
+                                         ulysses_attention)
+        if self.is_initializing() or self.attention == "full":
+            # flax init traces outside shard_map where the seq axis is unbound;
+            # attention owns no params, so initializing down the local path
+            # produces identical parameters
+            return reference_attention(q, k, v, causal=True)
+        if self.attention == "ring":
+            return ring_attention(q, k, v, axis=self.seq_axis, causal=True)
+        if self.attention == "ulysses":
+            return ulysses_attention(q, k, v, axis=self.seq_axis, causal=True)
+        raise ValueError(f"unknown attention {self.attention!r}")
+
+    def _pos_offset(self, s_local: int):
+        """Global position of this device's first sequence element."""
+        if self.is_initializing() or self.attention == "full":
+            return 0
+        return jax.lax.axis_index(self.seq_axis) * s_local
+
+    @nn.compact
+    def __call__(self, embedded, dense):
+        del dense
+        trio = embedded[ITEM]                       # (B, 3, S_local, d)
+        hist, e_pos, e_neg = trio[:, 0], trio[:, 1], trio[:, 2]
+        B, S, d = hist.shape
+        if d != self.dim:
+            raise ValueError(f"embedding dim {d} != module dim {self.dim}")
+        H = self.num_heads
+        Dh = d // H
+
+        global_s = S
+        if not self.is_initializing() and self.attention != "full":
+            global_s = S * jax.lax.axis_size(self.seq_axis)
+        if global_s > self.max_len:
+            # jnp.take would silently clamp every position past max_len onto
+            # one shared embedding; surface the misconfiguration instead
+            raise ValueError(f"sequence length {global_s} exceeds "
+                             f"max_len={self.max_len}")
+        pos_table = self.param("pos_emb", nn.initializers.normal(0.02),
+                               (self.max_len, d), jnp.float32)
+        positions = self._pos_offset(S) + jnp.arange(S)
+        x = (hist.astype(jnp.float32) * jnp.sqrt(jnp.float32(d))
+             + jnp.take(pos_table, positions, axis=0))
+        x = x.astype(self.compute_dtype)
+
+        for b in range(self.num_blocks):
+            a = nn.LayerNorm(dtype=self.compute_dtype,
+                             name=f"ln_attn_{b}")(x)
+            qkv = nn.Dense(3 * d, dtype=self.compute_dtype,
+                           param_dtype=jnp.float32, name=f"qkv_{b}")(a)
+            q, k, v = jnp.split(qkv.reshape(B, S, 3 * H, Dh), 3, axis=2)
+            o = self._attend(q, k, v).reshape(B, S, d)
+            x = x + nn.Dense(d, dtype=self.compute_dtype,
+                             param_dtype=jnp.float32, name=f"proj_{b}")(o)
+            f = nn.LayerNorm(dtype=self.compute_dtype, name=f"ln_ffn_{b}")(x)
+            f = nn.Dense(2 * d, dtype=self.compute_dtype,
+                         param_dtype=jnp.float32, name=f"ffn_in_{b}")(f)
+            x = x + nn.Dense(d, dtype=self.compute_dtype,
+                             param_dtype=jnp.float32,
+                             name=f"ffn_out_{b}")(nn.relu(f))
+
+        h = nn.LayerNorm(dtype=self.compute_dtype, name="ln_out")(x)
+        h = h.astype(jnp.float32)
+        logit_pos = jnp.sum(h * e_pos.astype(jnp.float32), axis=-1)
+        logit_neg = jnp.sum(h * e_neg.astype(jnp.float32), axis=-1)
+        return jnp.stack([logit_pos, logit_neg], axis=-1)    # (B, S, 2)
+
+
+def make_sasrec(vocabulary: int, dim: int = 32, *, num_heads: int = 2,
+                num_blocks: int = 2, max_len: int = 512,
+                attention: str = "full", seq_axis: str = "seq",
+                hashed: bool = False, capacity: int = 0, num_shards: int = -1,
+                optimizer=None, compute_dtype=jnp.bfloat16) -> EmbeddingModel:
+    from .ctr import _config
+    emb = Embedding(
+        input_dim=-1 if hashed else vocabulary, output_dim=dim, name=ITEM,
+        embeddings_initializer=Normal(stddev=0.02), optimizer=optimizer,
+        num_shards=num_shards, capacity=capacity)
+    module = SASRec(dim=dim, num_heads=num_heads, num_blocks=num_blocks,
+                    max_len=max_len, attention=attention, seq_axis=seq_axis,
+                    compute_dtype=compute_dtype)
+    return EmbeddingModel(
+        module, [emb], loss_fn=sasrec_bce_loss,
+        config=_config("sasrec", compute_dtype, vocabulary=vocabulary, dim=dim,
+                       num_heads=num_heads, num_blocks=num_blocks,
+                       max_len=max_len, attention=attention, seq_axis=seq_axis,
+                       hashed=hashed, capacity=capacity, num_shards=num_shards,
+                       # attention parallelism is a runtime property, not a
+                       # model property: a standalone export rebuilds with
+                       # local attention (serving runs outside shard_map)
+                       serving_overrides={"attention": "full"}))
+
+
+def synthetic_sequences(batch_size: int, seq_len: int, vocabulary: int, *,
+                        seed: int = 0, steps=None):
+    """Synthetic next-item data: Markov-ish item chains so the model has signal.
+    Yields Trainer-ready batches ((B,3,S) ids + (B,S) mask)."""
+    import itertools
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    it = itertools.count() if steps is None else range(steps)
+    for _ in it:
+        start = rng.integers(1, vocabulary, size=(batch_size, 1))
+        stride = rng.integers(1, 7, size=(batch_size, 1))
+        hist = (start + stride * np.arange(seq_len)) % vocabulary  # (B, S)
+        pos = (hist + stride) % vocabulary                         # next item
+        neg = rng.integers(0, vocabulary, size=(batch_size, seq_len))
+        neg = np.where(neg == pos, (neg + 1) % vocabulary, neg)
+        lengths = rng.integers(seq_len // 2, seq_len + 1, size=batch_size)
+        mask = (np.arange(seq_len)[None, :] < lengths[:, None])
+        ids = np.stack([hist, pos, neg], axis=1).astype(np.int64)  # (B,3,S)
+        ids = np.where(mask[:, None, :], ids, -1)  # padding ids pull zeros
+        yield {"sparse": {ITEM: ids}, "label": mask.astype(np.float32)}

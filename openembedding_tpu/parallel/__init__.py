@@ -1,4 +1,6 @@
 from .mesh import make_mesh, table_sharding, replicated, batch_sharding
 from .sharded import (sharded_lookup_train, sharded_lookup, sharded_apply_gradients,
                       deinterleave_rows, interleave_rows)
-from .trainer import MeshTrainer
+from .trainer import MeshTrainer, SeqMeshTrainer
+from .sequence import ring_attention, ulysses_attention, reference_attention
+from . import multihost

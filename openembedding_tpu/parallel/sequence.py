@@ -1,0 +1,122 @@
+"""Sequence/context parallelism: ring attention + Ulysses all-to-all attention.
+
+The reference has no sequence dimension (CTR models; SURVEY.md §5 "long-context:
+absent"), but this framework treats long-sequence models (e.g. sequential
+recommenders over long user histories, `models/sequential.py`) as first-class. Two
+standard TPU-native CP schemes over a mesh axis `seq`, both written as per-device
+code for `shard_map`:
+
+- `ring_attention`: q stays put; k/v blocks rotate around the ring via
+  `jax.lax.ppermute` while a flash-style online-softmax accumulator (running max /
+  denominator in f32) folds in one block per step. ICI-friendly: each step moves
+  only the (B, S/P, H, D) kv block to the neighbor, overlapping with the block
+  matmuls. Memory is O(S/P) per device — sequences can exceed single-chip HBM.
+- `ulysses_attention`: two `all_to_all`s re-shard (seq -> heads) so each device
+  runs FULL attention for H/P heads, then shards back. One collective round-trip,
+  but requires num_heads % P == 0 and O(S) activations per device.
+
+Both match `reference_attention` (plain softmax attention, the single-device
+oracle) to float tolerance — see `tests/test_sequence.py`.
+
+Conventions: q/k/v are (B, S_local, H, D); `causal` uses GLOBAL positions (device
+i's rows are positions [i*S_local, (i+1)*S_local)). Softmax math is float32
+regardless of input dtype (bf16-safe), outputs cast back to the input dtype.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def reference_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                        causal: bool = True, q_offset=0,
+                        k_offset=0) -> jax.Array:
+    """Plain softmax attention; the single-device oracle both CP schemes must
+    match. Offsets give q/k blocks their global positions for causal masking."""
+    B, Sq, H, D = q.shape
+    Sk = k.shape[1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) / jnp.sqrt(jnp.float32(D))
+    if causal:
+        qpos = q_offset + jnp.arange(Sq)[:, None]
+        kpos = k_offset + jnp.arange(Sk)[None, :]
+        scores = jnp.where((qpos >= kpos)[None, None], scores, NEG_INF)
+    out = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(scores, axis=-1),
+                     v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *, axis: str,
+                   causal: bool = True) -> jax.Array:
+    """Ring (context-parallel) attention inside shard_map over `axis`.
+
+    Per step t, this device (ring index i) holds the kv block of device
+    (i - t) mod P and folds it into a running flash accumulator; kv then moves to
+    the next neighbor (one ppermute per step — a bandwidth-optimal ring like the
+    reference's NCCL allreduce rings, but over ICI)."""
+    P = jax.lax.axis_size(axis)
+    i = jax.lax.axis_index(axis)
+    B, S, H, D = q.shape
+    qf = q.astype(jnp.float32)
+    scale = 1.0 / jnp.sqrt(jnp.float32(D))
+    qpos = i * S + jnp.arange(S)[:, None]                       # (S, 1)
+    perm = [(j, (j + 1) % P) for j in range(P)]
+
+    def step(t, carry):
+        kb, vb, m, l, o = carry
+        src = (i - t) % P                                        # kv block owner
+        scores = jnp.einsum("bqhd,bkhd->bhqk", qf,
+                            kb.astype(jnp.float32)) * scale
+        if causal:
+            kpos = src * S + jnp.arange(S)[None, :]              # (1, S)
+            scores = jnp.where((qpos >= kpos)[None, None], scores, NEG_INF)
+        m_blk = jnp.max(scores, axis=-1)                         # (B,H,Sq)
+        m_new = jnp.maximum(m, m_blk)
+        # fully-masked rows keep m == NEG_INF; freeze them so exp() stays 0
+        alpha = jnp.exp(jnp.where(m > NEG_INF / 2, m - m_new, 0.0))
+        # a fully-masked block has m_new == NEG_INF and scores - m_new == 0;
+        # gate on the raw scores so masked entries contribute exactly 0
+        p = jnp.where(scores > NEG_INF / 2,
+                      jnp.exp(scores - m_new[..., None]), 0.0)
+        l = l * alpha + jnp.sum(p, axis=-1)
+        o = o * alpha[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p,
+                                              vb.astype(jnp.float32))
+        kb = jax.lax.ppermute(kb, axis, perm)
+        vb = jax.lax.ppermute(vb, axis, perm)
+        return kb, vb, m_new, l, o
+
+    m0 = jnp.full((B, H, S), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, S), jnp.float32)
+    o0 = jnp.zeros((B, H, S, D), jnp.float32)
+    _, _, _, l, o = jax.lax.fori_loop(0, P, step, (k, v, m0, l0, o0))
+    out = o / jnp.maximum(l, 1e-30)[..., None]                   # (B,H,S,D)
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, *, axis: str,
+                      causal: bool = True,
+                      attn_fn: Optional[callable] = None) -> jax.Array:
+    """Ulysses (all-to-all) sequence parallelism inside shard_map over `axis`:
+    re-shard seq->heads, run full attention on H/P heads, re-shard back."""
+    P = jax.lax.axis_size(axis)
+    B, S, H, D = q.shape
+    if H % P != 0:
+        raise ValueError(f"num_heads {H} not divisible by seq-parallel size {P}")
+    attn = attn_fn or partial(reference_attention, causal=causal)
+
+    def to_heads(x):   # (B, S/P*, H, D) -> (B, S, H/P, D)
+        return jax.lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    def to_seq(x):     # (B, S, H/P, D) -> (B, S/P, H, D)
+        return jax.lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    out = attn(to_heads(q), to_heads(k), to_heads(v))
+    return to_seq(out).astype(q.dtype)

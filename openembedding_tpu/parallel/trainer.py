@@ -71,6 +71,9 @@ class MeshTrainer(Trainer):
     def _batch_pspec(self, batch):
         return jax.tree_util.tree_map(lambda _: P(self.axis), batch)
 
+    def _logits_pspec(self):
+        return P(self.axis)
+
     # -- init ----------------------------------------------------------------
 
     def init(self, sample_batch) -> TrainState:
@@ -119,9 +122,12 @@ class MeshTrainer(Trainer):
         return jax.tree_util.tree_map(
             lambda g: jax.lax.psum(g, self.axis), grads)
 
+    def _reduce_loss(self, loss):
+        return jax.lax.pmean(loss, self.axis)
+
     def reduce_metrics(self, metrics):
         out = dict(metrics)
-        out["loss"] = jax.lax.pmean(metrics["loss"], self.axis)
+        out["loss"] = self._reduce_loss(metrics["loss"])
         out["stats"] = {k: jax.lax.psum(v, self.axis)
                         for k, v in metrics.get("stats", {}).items()}
         return out
@@ -151,7 +157,7 @@ class MeshTrainer(Trainer):
             raise ValueError("first call needs (sample_batch, sample_state)")
         state_spec = self._state_pspec_tree(sample_state)
         batch_spec = self._batch_pspec(sample_batch)
-        metrics_spec = {"loss": P(), "logits": P(self.axis),
+        metrics_spec = {"loss": P(), "logits": self._logits_pspec(),
                         "stats": P()}
 
         stepped = jax.shard_map(
@@ -170,11 +176,11 @@ class MeshTrainer(Trainer):
             raise ValueError("first call needs (sample_batch, sample_state)")
         state_spec = self._state_pspec_tree(sample_state)
         batch_spec = self._batch_pspec(sample_batch)
-        out_spec = {"logits": P(self.axis), "loss": P()}
+        out_spec = {"logits": self._logits_pspec(), "loss": P()}
 
         def eval_fn(state, batch):
             out = self.eval_step(state, batch)
-            out["loss"] = jax.lax.pmean(out["loss"], self.axis)
+            out["loss"] = self._reduce_loss(out["loss"])
             return out
 
         self._eval_step_fn = jax.jit(jax.shard_map(
@@ -184,3 +190,80 @@ class MeshTrainer(Trainer):
             check_vma=False,
         ))
         return self._eval_step_fn
+
+
+class SeqMeshTrainer(MeshTrainer):
+    """Context-parallel trainer over a 2-D mesh ("data", "seq").
+
+    Layout (the long-context design SURVEY.md §5/§7 reserves the axis for):
+    - batch rows over 'data' (DP), the sequence dim over 'seq' (CP: ring or
+      Ulysses attention inside the module, `parallel/sequence.py`);
+    - embedding tables row-sharded over the WHOLE mesh (tuple axis
+      ('data','seq')): the pull/push all_to_all and the dense-grad psum ride
+      both ICI dimensions; per-device code in `parallel/sharded.py` is unchanged
+      because JAX collectives accept the flattened axis tuple;
+    - dense params replicated; dense grads psum'd over all devices (Horovod-SUM
+      parity like MeshTrainer — with CP the seq shards of one sample also sum,
+      matching the reference's sum-not-average convention).
+
+    The model's module must use attention="ring" or "ulysses" with seq_axis
+    equal to the mesh's second axis (e.g. `make_sasrec(..., attention="ring")`).
+    Batches follow the sequential convention: sparse ids (B, ..., S) — the LAST
+    dim is the sequence and is sharded over 'seq'; label (B, S)."""
+
+    def __init__(self, model, optimizer=None, *, mesh: Mesh, seed: int = 0,
+                 capacity_factor: float = 0.0):
+        if len(mesh.axis_names) != 2:
+            raise ValueError(
+                f"SeqMeshTrainer needs a 2-D (data, seq) mesh, got axes "
+                f"{mesh.axis_names}")
+        super().__init__(model, optimizer, mesh=mesh, seed=seed,
+                         capacity_factor=capacity_factor)
+        self.data_axis, self.seq_axis = mesh.axis_names
+        # collectives (sparse exchange, psum, metrics) span the flattened mesh
+        self.axis = tuple(mesh.axis_names)
+
+    def _batch_pspec(self, batch):
+        d, s = self.data_axis, self.seq_axis
+
+        def sparse_spec(x):
+            nd = jnp.ndim(x)
+            return P(d, *([None] * (nd - 2)), s)
+
+        out = {}
+        for key, value in batch.items():
+            if key == "sparse":
+                out[key] = {k: sparse_spec(v) for k, v in value.items()}
+            elif key == "label" and jnp.ndim(value) >= 2:
+                out[key] = P(d, s)
+            elif key == "dense":
+                out[key] = P(d)
+            else:
+                out[key] = P(d)
+        return out
+
+    def _logits_pspec(self):
+        # (B, S, ...) logits: batch over data, positions over seq
+        return P(self.data_axis, self.seq_axis)
+
+    def _loss(self, logits, batch):
+        """Normalize by the GLOBAL count when the loss fn supports it: with the
+        sequence dim sharded, a per-shard mean would upweight positions on
+        padding-heavy shards relative to non-CP training of the same batch."""
+        import inspect
+
+        loss_fn = self.model.loss_fn
+        if "norm_axis" in inspect.signature(loss_fn).parameters:
+            w = batch.get("weight")
+            args = (logits, batch["label"]) if w is None else (
+                logits, batch["label"], jnp.asarray(w))
+            return loss_fn(*args, norm_axis=self.axis)
+        return super()._loss(logits, batch)
+
+    def _reduce_loss(self, loss):
+        import inspect
+        if "norm_axis" in inspect.signature(self.model.loss_fn).parameters:
+            # per-device loss = local_sum / global_count: the global mean is
+            # the SUM over devices, not the mean of means
+            return jax.lax.psum(loss, self.axis)
+        return super()._reduce_loss(loss)

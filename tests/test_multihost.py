@@ -1,0 +1,50 @@
+"""Multi-host glue (single-process semantics; the real pod path differs only in
+jax.make_array_from_process_local_data wiring, which reduces to device_put here)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from openembedding_tpu.parallel import make_mesh, multihost
+
+
+def test_initialize_noop_single_process():
+    multihost.initialize()  # must not raise on a single process
+    assert multihost.num_hosts() == 1
+    assert multihost.host_id() == 0
+
+
+def test_global_batch_shards_over_mesh():
+    mesh = make_mesh()
+    batch = {"sparse": {"categorical": np.arange(16 * 4).reshape(16, 4)},
+             "label": np.ones((16,), np.float32)}
+    out = multihost.global_batch(batch, mesh)
+    assert out["sparse"]["categorical"].shape == (16, 4)
+    shard_shapes = {s.data.shape for s in out["sparse"]["categorical"]
+                    .addressable_shards}
+    assert shard_shapes == {(2, 4)}  # 16 rows over 8 devices
+    np.testing.assert_array_equal(np.asarray(out["sparse"]["categorical"]),
+                                  batch["sparse"]["categorical"])
+
+
+def test_host_sharded_reader_batches(tmp_path):
+    rng = np.random.default_rng(0)
+    path = str(tmp_path / "t.tsv")
+    with open(path, "w") as f:
+        for _ in range(64):
+            cols = ["1"] + [str(int(x)) for x in rng.integers(0, 9, 13)] + \
+                   [f"{int(x):x}" for x in rng.integers(0, 1 << 20, 26)]
+            f.write("\t".join(cols) + "\n")
+    mesh = make_mesh()
+    it = multihost.host_sharded_reader([path], 16, mesh, id_space=1 << 20)
+    batches = list(it)
+    assert len(batches) == 4
+    assert batches[0]["sparse"]["categorical"].shape == (16, 26)
+
+
+def test_host_sharded_reader_divisibility(tmp_path, monkeypatch):
+    monkeypatch.setattr(multihost, "num_hosts", lambda: 3)
+    mesh = make_mesh()
+    with pytest.raises(ValueError, match="divisible"):
+        next(iter(multihost.host_sharded_reader(["x.tsv"], 16, mesh)))

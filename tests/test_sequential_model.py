@@ -1,0 +1,164 @@
+"""SASRec sequential model + context-parallel SeqMeshTrainer integration.
+
+The forward-parity test transplants the CP-trained table (gathered to id-major
+order) and the replicated dense params into a single-device full-attention
+trainer and checks logits match — proving the 2-D (data, seq) mesh, the tuple-
+axis sparse exchange, and ring attention compose correctly end to end."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+import openembedding_tpu as embed
+from openembedding_tpu.model import Trainer
+from openembedding_tpu.models import make_sasrec, synthetic_sequences
+from openembedding_tpu.parallel import SeqMeshTrainer, deinterleave_rows
+from openembedding_tpu.parallel.trainer import MeshTrainer
+
+VOCAB = 512
+DIM = 16
+SEQ = 32
+
+
+def _mesh_2d(data, seq):
+    devs = np.array(jax.devices()[:data * seq]).reshape(data, seq)
+    return Mesh(devs, ("data", "seq"))
+
+
+def _batches(n, batch=8, seed=0):
+    return list(synthetic_sequences(batch, SEQ, VOCAB, seed=seed, steps=n))
+
+
+@pytest.mark.parametrize("attention", ["ring", "ulysses"])
+def test_cp_forward_matches_single_device(attention):
+    mesh = _mesh_2d(2, 4)
+    heads = 4  # ulysses re-shards heads over the seq axis: needs H % 4 == 0
+    model_cp = make_sasrec(VOCAB, DIM, attention=attention, num_heads=heads,
+                           compute_dtype=jnp.float32)
+    tr_cp = SeqMeshTrainer(model_cp, embed.Adagrad(learning_rate=0.1),
+                           mesh=mesh, seed=7)
+    batch = _batches(1)[0]
+    state_cp = tr_cp.init(batch)
+    out_cp = tr_cp.jit_eval_step(batch, state_cp)(state_cp, batch)
+    logits_cp = np.asarray(out_cp["logits"])
+
+    # transplant: gathered id-major table + replicated dense params -> 1 device
+    model_1 = make_sasrec(VOCAB, DIM, attention="full", num_heads=heads,
+                          compute_dtype=jnp.float32)
+    tr_1 = Trainer(model_1, embed.Adagrad(learning_rate=0.1), seed=7)
+    state_1 = tr_1.init(batch)
+    table_cp = state_cp.tables["item"]
+    id_major = deinterleave_rows(np.asarray(table_cp.weights), 8, VOCAB)
+    state_1 = state_1.replace(
+        dense_params=jax.device_get(state_cp.dense_params),
+        tables={"item": state_1.tables["item"].replace(
+            weights=jnp.asarray(id_major))})
+    logits_1 = np.asarray(tr_1.jit_eval_step()(state_1, batch)["logits"])
+    np.testing.assert_allclose(logits_cp, logits_1, rtol=2e-4, atol=2e-4)
+
+
+def test_cp_training_loss_drops():
+    mesh = _mesh_2d(2, 4)
+    model = make_sasrec(VOCAB, DIM, attention="ring")
+    tr = SeqMeshTrainer(model, embed.Adagrad(learning_rate=0.3), mesh=mesh)
+    batch = _batches(1, batch=16)[0]
+    state = tr.init(batch)
+    step = tr.jit_train_step(batch, state)
+    state, m0 = step(state, batch)
+    loss0 = float(m0["loss"])
+    for _ in range(40):
+        state, m = step(state, batch)
+    loss1 = float(m["loss"])
+    assert np.isfinite(loss1) and loss1 < loss0 * 0.8, (loss0, loss1)
+
+
+def test_single_device_sasrec_trains():
+    model = make_sasrec(VOCAB, DIM, attention="full")
+    tr = Trainer(model, embed.Adagrad(learning_rate=0.3))
+    batch = _batches(1, batch=16)[0]
+    state = tr.init(batch)
+    step = tr.jit_train_step()
+    state, m0 = step(state, batch)
+    for _ in range(40):
+        state, m = step(state, batch)
+    assert float(m["loss"]) < float(m0["loss"]) * 0.8
+
+
+def test_cp_loss_normalization_matches_single_device():
+    """Padding-heavy seq shards must not be upweighted: the CP loss equals the
+    single-device loss of the same batch and params (global mask count)."""
+    mesh = _mesh_2d(2, 4)
+    model_cp = make_sasrec(VOCAB, DIM, attention="ring",
+                           compute_dtype=jnp.float32)
+    tr_cp = SeqMeshTrainer(model_cp, embed.Adagrad(learning_rate=0.1),
+                           mesh=mesh, seed=7)
+    batch = _batches(1)[0]  # lengths in [S/2, S]: last shard is padding-heavy
+    assert (np.asarray(batch["label"]).sum(axis=1) < SEQ).any()
+    state_cp = tr_cp.init(batch)
+    loss_cp = float(tr_cp.jit_eval_step(batch, state_cp)(state_cp, batch)["loss"])
+
+    model_1 = make_sasrec(VOCAB, DIM, attention="full",
+                          compute_dtype=jnp.float32)
+    tr_1 = Trainer(model_1, embed.Adagrad(learning_rate=0.1), seed=7)
+    state_1 = tr_1.init(batch)
+    id_major = deinterleave_rows(
+        np.asarray(state_cp.tables["item"].weights), 8, VOCAB)
+    state_1 = state_1.replace(
+        dense_params=jax.device_get(state_cp.dense_params),
+        tables={"item": state_1.tables["item"].replace(
+            weights=jnp.asarray(id_major))})
+    loss_1 = float(tr_1.jit_eval_step()(state_1, batch)["loss"])
+    np.testing.assert_allclose(loss_cp, loss_1, rtol=1e-5)
+
+
+def test_cp_export_serves_with_local_attention(tmp_path):
+    """A ring-attention-trained model must export to a servable standalone
+    model (serving runs outside shard_map -> attention normalized to full)."""
+    from openembedding_tpu.export import StandaloneModel, export_standalone
+    mesh = _mesh_2d(2, 4)
+    model = make_sasrec(VOCAB, DIM, attention="ring")
+    tr = SeqMeshTrainer(model, embed.Adagrad(learning_rate=0.1), mesh=mesh)
+    batch = _batches(1)[0]
+    state = tr.init(batch)
+    path = str(tmp_path / "sasrec_export")
+    export_standalone(state, model, path, num_shards=tr.num_shards)
+    sm = StandaloneModel.load(path)
+    assert sm.model.module.attention == "full"
+    logits = np.asarray(sm.predict(batch))
+    assert logits.shape == np.asarray(batch["label"]).shape + (2,)
+    assert np.isfinite(logits).all()
+
+
+def test_sasrec_rejects_overlong_sequences():
+    model = make_sasrec(VOCAB, DIM, attention="full", max_len=16)
+    tr = Trainer(model, embed.Adagrad())
+    batch = _batches(1, batch=2)[0]  # SEQ=32 > max_len=16
+    with pytest.raises(ValueError, match="exceeds"):
+        tr.init(batch)
+
+
+def test_sasrec_padding_rows_do_not_train():
+    """Ids appearing ONLY at masked (label 0) positions are -1 in the synthetic
+    stream; craft a batch where a real id sits at a masked position and check
+    its row never trains (pull returns rows but loss-masking zeroes its grad —
+    id -1 padding additionally pulls zeros)."""
+    model = make_sasrec(VOCAB, DIM, attention="full", compute_dtype=jnp.float32)
+    tr = Trainer(model, embed.Adagrad(learning_rate=0.1))
+    base = _batches(1, batch=2)[0]
+    ids = np.asarray(base["sparse"]["item"]).copy()
+    label = np.asarray(base["label"]).copy()
+    label[:, -1] = 0.0          # mask the final position everywhere
+    used = set(np.unique(ids).tolist())
+    probe = next(i for i in range(VOCAB - 1, -1, -1) if i not in used)
+    ids[:, :, -1] = probe        # place it only at the masked position
+    batch = {"sparse": {"item": ids}, "label": label}
+    state = tr.init(batch)
+    before = np.asarray(state.tables["item"].weights)[probe].copy()
+    state, _ = tr.jit_train_step()(state, batch)
+    after = np.asarray(state.tables["item"].weights)[probe]
+    np.testing.assert_array_equal(before, after)
