@@ -320,5 +320,23 @@ class Trainer:
         `state` reference is dead after the call."""
         return jax.jit(self.train_step, donate_argnums=(0,))
 
+    def train_many(self, state: TrainState, batches) -> Tuple[TrainState, Dict]:
+        """K steps in ONE compiled program via lax.scan over stacked batches
+        (every leaf has a leading K dim). One dispatch per K steps instead of K —
+        host dispatch latency (worst over remote runtimes) amortizes away, the
+        TPU-idiomatic step-fusion the reference cannot do (its step spans 4 RPCs).
+        Returns (state, {"loss": (K,)})."""
+
+        def body(state, batch):
+            state, metrics = self.train_step(state, batch)
+            return state, metrics["loss"]
+
+        state, losses = jax.lax.scan(body, state, batches)
+        return state, {"loss": losses}
+
+    def jit_train_many(self):
+        """Scan-fused multi-step driver (state DONATED, like jit_train_step)."""
+        return jax.jit(self.train_many, donate_argnums=(0,))
+
     def jit_eval_step(self):
         return jax.jit(self.eval_step)

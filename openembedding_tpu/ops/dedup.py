@@ -24,28 +24,43 @@ class UniqueResult(NamedTuple):
     inverse: jax.Array      # (n,) int32 — ids[i] == unique_ids[inverse[i]]
     counts: jax.Array       # (n,) int32 — duplicate multiplicity; 0 = padding slot
     num_unique: jax.Array   # () int32
+    # sort permutation + SORTED segment ids: `payload[order]` has ascending segment
+    # ids `seg`, so downstream reductions run as segment_sum(payload[order], seg,
+    # indices_are_sorted=True) — the sorted path vectorizes on TPU while an
+    # unsorted segment scatter-add serializes (28 ms vs 2.5 ms for the benchmark
+    # batch; tools/step_bisect.py)
+    order: jax.Array        # (n,) int32
+    seg: jax.Array          # (n,) int32, ascending
+
+    def segment_reduce(self, payload: jax.Array) -> jax.Array:
+        """Sum per-occurrence `payload` (n, ...) into the unique slots (n, ...)."""
+        return jax.ops.segment_sum(payload[self.order], self.seg,
+                                   num_segments=self.order.shape[0],
+                                   indices_are_sorted=True)
 
 
 def unique_with_counts(ids: jax.Array) -> UniqueResult:
     """Sort-based unique with inverse mapping and counts, static output size n.
 
     Reference semantics: gradients of duplicate ids are summed and the count recorded
-    (`MpscGradientReducer.h:26-53`); here `inverse` lets the caller `segment_sum`
-    per-duplicate gradients into the unique slots.
+    (`MpscGradientReducer.h:26-53`); here `inverse`/`segment_reduce` let the caller
+    sum per-duplicate gradients into the unique slots.
     """
     n = ids.shape[0]
-    order = jnp.argsort(ids)
+    order = jnp.argsort(ids).astype(jnp.int32)
     sorted_ids = ids[order]
     is_new = jnp.concatenate(
         [jnp.ones((1,), dtype=bool), sorted_ids[1:] != sorted_ids[:-1]])
-    seg = jnp.cumsum(is_new) - 1  # segment index of each sorted element
+    seg = (jnp.cumsum(is_new) - 1).astype(jnp.int32)  # ascending segment ids
     num_unique = seg[-1] + 1
     # duplicate writes to one segment all carry the same value, so .set is deterministic
-    unique_ids = jnp.zeros((n,), ids.dtype).at[seg].set(sorted_ids, mode="drop")
-    counts = jax.ops.segment_sum(jnp.ones((n,), jnp.int32), seg, num_segments=n)
-    inverse = jnp.zeros((n,), jnp.int32).at[order].set(seg.astype(jnp.int32))
+    unique_ids = jnp.zeros((n,), ids.dtype).at[seg].set(
+        sorted_ids, mode="drop", indices_are_sorted=True)
+    counts = jax.ops.segment_sum(jnp.ones((n,), jnp.int32), seg, num_segments=n,
+                                 indices_are_sorted=True)
+    inverse = jnp.zeros((n,), jnp.int32).at[order].set(seg)
     return UniqueResult(unique_ids, inverse, counts.astype(jnp.int32),
-                        num_unique.astype(jnp.int32))
+                        num_unique.astype(jnp.int32), order, seg)
 
 
 class BucketResult(NamedTuple):

@@ -21,10 +21,14 @@ from .dedup import unique_with_counts
 
 
 def lookup_rows(weights: jax.Array, rows: jax.Array,
-                valid: jax.Array = None) -> jax.Array:
+                valid: jax.Array = None, *, sorted_unique: bool = False
+                ) -> jax.Array:
     """Gather rows (table read; reference `pull_weights` fast path). Out-of-range or
     invalid row indices return zeros — consistent with the gradient path, which drops
-    them, so a buggy id pipeline can't create train/serve skew."""
+    them, so a buggy id pipeline can't create train/serve skew.
+
+    `sorted_unique`: caller guarantees `rows` is ascending with no in-range
+    duplicates (the dedup output) — lets XLA use the vectorized gather path."""
     if weights.ndim == 2 and rows.ndim == 1:
         from .pallas_sparse import maybe_gather_rows
         out = maybe_gather_rows(weights, rows, valid)
@@ -34,18 +38,35 @@ def lookup_rows(weights: jax.Array, rows: jax.Array,
     in_range = (rows >= 0) & (rows < n_rows)
     if valid is not None:
         in_range = in_range & valid
-    safe = jnp.clip(rows, 0, n_rows - 1)
-    out = jnp.take(weights, safe, axis=0)
+    # fill-mode gather: positive out-of-bounds indices read 0 WITHOUT clipping
+    # (clipping would collapse distinct OOB sentinels onto row n_rows-1 and break
+    # the unique_indices promise); negative indices wrap in jax, so the explicit
+    # in_range mask below still zeroes those
+    out = weights.at[rows].get(mode="fill", fill_value=0,
+                               indices_are_sorted=sorted_unique,
+                               unique_indices=sorted_unique)
     return jnp.where(in_range.reshape(in_range.shape + (1,) * (out.ndim - in_range.ndim)),
                      out, jnp.zeros_like(out))
 
 
 def scatter_rows(weights: jax.Array, rows: jax.Array, values: jax.Array,
-                 valid: jax.Array) -> jax.Array:
-    """Overwrite rows; invalid slots are dropped via out-of-bounds scatter."""
+                 valid: jax.Array = None, *, sorted_unique: bool = False
+                 ) -> jax.Array:
+    """Overwrite rows; invalid slots are dropped via out-of-bounds scatter.
+
+    `valid=None` means `rows` is already fully routed (invalid entries already
+    carry out-of-bounds indices). `sorted_unique`: rows genuinely ascending and
+    duplicate-free — TPU scatters serialize without these hints; this is the
+    difference between a vectorized update and a 106k-iteration row loop (see
+    tools/step_bisect.py measurements)."""
     n_rows = weights.shape[0]
-    target = jnp.where(valid, rows, n_rows)  # n_rows is out of bounds -> dropped
-    return weights.at[target].set(values, mode="drop")
+    if valid is None:
+        target = rows
+    else:
+        target = jnp.where(valid, rows, n_rows)  # out of bounds -> dropped
+    return weights.at[target].set(values, mode="drop",
+                                  indices_are_sorted=sorted_unique,
+                                  unique_indices=sorted_unique)
 
 
 def sparse_apply_dense_table(
@@ -71,9 +92,13 @@ def sparse_apply_dense_table(
         pre_counts = jnp.ones((n,), jnp.int32)
     # Route padding (count==0) to an out-of-range sort key so dedup's padding slots
     # coincide with count-0 slots after the segment sums.
-    uniq = unique_with_counts(jnp.where(pre_counts > 0, row_ids, weights.shape[0]))
-    g = jax.ops.segment_sum(grads, uniq.inverse, num_segments=n)
-    counts = jax.ops.segment_sum(pre_counts, uniq.inverse, num_segments=n)
+    # negative ids route to the sentinel too: jax wraps negative scatter indices
+    # (id -1 would silently train the LAST row and break the sorted/unique
+    # promises below — mode='drop' only drops the high side)
+    uniq = unique_with_counts(jnp.where((pre_counts > 0) & (row_ids >= 0),
+                                        row_ids, weights.shape[0]))
+    g = uniq.segment_reduce(grads)
+    counts = uniq.segment_reduce(pre_counts)
     # padding slots (id == n_rows sentinel) get counts 0:
     counts = jnp.where(uniq.unique_ids < weights.shape[0], counts, 0)
 
@@ -86,12 +111,26 @@ def sparse_apply_dense_table(
     # beta_2^t rounds to 1.0 (killing Adam's lr_t) and g^2 accumulators lose most of
     # their mantissa. Slots are stored f32 (`SparseOptimizer.init_slots`); weights are
     # upcast for the update and cast back on scatter (TPU-idiomatic mixed precision).
-    w_rows = lookup_rows(weights, uniq.unique_ids).astype(jnp.float32)
-    s_rows = {k: lookup_rows(v, uniq.unique_ids) for k, v in slots.items()}
-    new_w, new_s = optimizer.apply(w_rows, s_rows, g.astype(jnp.float32), counts)
+    #
+    # Index vector: valid unique ids are ascending (sort-based dedup); every invalid
+    # slot i (padding / sentinel) maps to the DISTINCT out-of-bounds row n_rows + i,
+    # so the whole vector is genuinely ascending and duplicate-free — the
+    # indices_are_sorted/unique_indices promises hold exactly, and XLA emits the
+    # vectorized gather/scatter instead of a serialized row loop (the difference
+    # between 25 ms and sub-ms on v5e; tools/step_bisect.py).
     valid = counts > 0
-    weights = scatter_rows(weights, uniq.unique_ids, new_w.astype(weights.dtype), valid)
-    slots = {k: scatter_rows(slots[k], uniq.unique_ids,
-                             new_s[k].astype(slots[k].dtype), valid)
+    n_rows_t = weights.shape[0]
+    idx = jnp.where(valid, uniq.unique_ids,
+                    n_rows_t + jnp.arange(n, dtype=uniq.unique_ids.dtype))
+    w_rows = lookup_rows(weights, idx, sorted_unique=True).astype(jnp.float32)
+    s_rows = {k: lookup_rows(v, idx, sorted_unique=True)
+              for k, v in slots.items()}
+    new_w, new_s = optimizer.apply(w_rows, s_rows, g.astype(jnp.float32), counts)
+    # idx is fully routed (invalid -> distinct OOB rows): valid=None
+    weights = scatter_rows(weights, idx, new_w.astype(weights.dtype),
+                           sorted_unique=True)
+    slots = {k: scatter_rows(slots[k], idx,
+                             new_s[k].astype(slots[k].dtype),
+                             sorted_unique=True)
              for k in slots}
     return weights, slots

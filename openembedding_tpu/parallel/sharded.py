@@ -176,8 +176,9 @@ def sharded_apply_gradients(
     gflat = grads.reshape(-1, spec.output_dim)
     n = gflat.shape[0]
     uniq, buckets, cap = plan.uniq, plan.buckets, plan.cap
-    # client-side pre-sum over local duplicates (`EmbeddingPushOperator.cpp:29-62`)
-    g = jax.ops.segment_sum(gflat, uniq.inverse, num_segments=n)
+    # client-side pre-sum over local duplicates (`EmbeddingPushOperator.cpp:29-62`);
+    # sorted-segment path (see UniqueResult.segment_reduce)
+    g = uniq.segment_reduce(gflat)
     valid = (uniq.counts > 0) & _id_valid(spec, uniq.unique_ids)
     # scatter grads/counts into the plan's bucket positions (payload follows its id)
     flat_pos = jnp.where((buckets.owner < S) & (buckets.slot < cap),

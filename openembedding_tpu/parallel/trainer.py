@@ -169,6 +169,28 @@ class MeshTrainer(Trainer):
         self._train_step_fn = jax.jit(stepped, donate_argnums=(0,))
         return self._train_step_fn
 
+    def jit_train_many(self, sample_batches=None, sample_state=None):
+        """Scan-fused K-step driver under shard_map (see Trainer.train_many):
+        `sample_batches` has a leading K dim on every leaf. State DONATED."""
+        if getattr(self, "_train_many_fn", None) is not None:
+            return self._train_many_fn
+        if sample_batches is None or sample_state is None:
+            raise ValueError("first call needs (sample_batches, sample_state)")
+        state_spec = self._state_pspec_tree(sample_state)
+        one = jax.tree_util.tree_map(lambda x: x[0], sample_batches)
+        bspec = self._batch_pspec(one)
+        stacked_spec = jax.tree_util.tree_map(
+            lambda p: P(None, *p), bspec, is_leaf=lambda x: isinstance(x, P))
+
+        many = jax.shard_map(
+            self.train_many, mesh=self.mesh,
+            in_specs=(state_spec, stacked_spec),
+            out_specs=(state_spec, {"loss": P()}),
+            check_vma=False,
+        )
+        self._train_many_fn = jax.jit(many, donate_argnums=(0,))
+        return self._train_many_fn
+
     def jit_eval_step(self, sample_batch=None, sample_state=None):
         if self._eval_step_fn is not None:
             return self._eval_step_fn

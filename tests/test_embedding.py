@@ -163,3 +163,36 @@ def test_initializers_shapes_and_ranges():
     np.testing.assert_allclose(np.asarray(c), 3.0)
     again = embed.make_initializer(embed.Uniform(-1, 1).to_config())
     assert again == embed.Uniform(-1, 1)
+
+
+def test_negative_ids_never_train_any_row():
+    """id -1 must not wrap onto the last table row (jax scatter wraps negative
+    indices; regression for the sentinel-routing in sparse_apply_dense_table).
+    The last row trains ONLY from its own legitimate id, and the invalid slots
+    must not poison the sorted/unique scatter promises."""
+    import numpy as np
+    import jax.numpy as jnp
+    from openembedding_tpu import optimizers
+    from openembedding_tpu.ops.sparse import sparse_apply_dense_table
+
+    rng = np.random.default_rng(0)
+    n_rows, dim = 16, 4
+    opt = optimizers.Adagrad(learning_rate=0.5)
+    w = jnp.asarray(rng.standard_normal((n_rows, dim)), jnp.float32)
+    slots = opt.init_slots(n_rows, dim)
+    ids = jnp.asarray([-1, 3, -7, 5, n_rows - 1, -1], jnp.int32)
+    grads = jnp.asarray(rng.standard_normal((6, dim)), jnp.float32)
+    new_w, _ = sparse_apply_dense_table(opt, w, slots, ids, grads)
+    # rows 3, 5, 15 train; everything else (incl. nothing from the -1s) intact
+    for r in range(n_rows):
+        if r in (3, 5, n_rows - 1):
+            assert not np.allclose(np.asarray(new_w[r]), np.asarray(w[r])), r
+        else:
+            np.testing.assert_array_equal(np.asarray(new_w[r]),
+                                          np.asarray(w[r]), err_msg=str(r))
+    # the last row's update must come from ITS grad only, not the -1 grads
+    ref_w, _ = sparse_apply_dense_table(
+        opt, w, opt.init_slots(n_rows, dim),
+        jnp.asarray([n_rows - 1], jnp.int32), grads[4:5])
+    np.testing.assert_allclose(np.asarray(new_w[-1]), np.asarray(ref_w[-1]),
+                               rtol=1e-6)
