@@ -143,6 +143,108 @@ def read_criteo_tsv(paths, batch_size: int, *, id_space: int = 1 << 25,
             return
 
 
+def _fold_int_ids(sparse_cols: np.ndarray, id_space: Optional[int],
+                  vocab_sizes: Optional[Sequence[int]]) -> np.ndarray:
+    """Fold per-field integer ids (preprocessed/relabeled data) into the shared
+    table: contiguous offsets when per-field vocab sizes are known (reference
+    keeps separate variables; we concatenate), else field-salted hashing."""
+    n, f = sparse_cols.shape
+    if vocab_sizes is not None:
+        offs = criteo_fold_offsets(vocab_sizes)
+        return sparse_cols.astype(np.int64) + offs[None, :]
+    fields = np.broadcast_to(np.arange(f, dtype=np.uint64), (n, f))
+    return hash_category(sparse_cols.astype(np.uint64), fields,
+                         id_space or (1 << 25))
+
+
+def read_criteo_tfrecord(paths, batch_size: int, *,
+                         id_space: Optional[int] = None,
+                         vocab_sizes: Optional[Sequence[int]] = None,
+                         host_id: int = 0, num_hosts: int = 1,
+                         drop_remainder: bool = True,
+                         repeat: bool = False) -> Iterator[Dict]:
+    """Stream the reference's TFRecord format (`test/benchmark/criteo_tfrecord.py`:
+    label int64[1], I1..I13 float32[1], C1..C26 int64[1] — categorical already
+    relabeled to ints). Requires tensorflow (present in this image; the reader is
+    import-guarded so the core library never depends on TF)."""
+    import tensorflow as tf  # local import: optional dependency
+
+    if isinstance(paths, str):
+        paths = [paths]
+    columns = {"label": tf.io.FixedLenFeature([1], tf.int64)}
+    for i in range(1, NUM_DENSE + 1):
+        columns[f"I{i}"] = tf.io.FixedLenFeature([1], tf.float32)
+    for i in range(1, NUM_SPARSE + 1):
+        columns[f"C{i}"] = tf.io.FixedLenFeature([1], tf.int64)
+
+    ds = tf.data.Dataset.from_tensor_slices(list(paths))
+    ds = ds.interleave(lambda p: tf.data.TFRecordDataset(p),
+                       num_parallel_calls=tf.data.AUTOTUNE)
+    if num_hosts > 1:
+        ds = ds.shard(num_hosts, host_id)
+    if repeat:
+        ds = ds.repeat()
+    ds = ds.batch(batch_size, drop_remainder=drop_remainder)
+    ds = ds.map(lambda x: tf.io.parse_example(x, columns),
+                num_parallel_calls=tf.data.AUTOTUNE)
+    ds = ds.prefetch(tf.data.AUTOTUNE)
+    for ex in ds.as_numpy_iterator():
+        dense = np.concatenate([ex[f"I{i}"] for i in range(1, NUM_DENSE + 1)],
+                               axis=1).astype(np.float32)
+        cats = np.concatenate([ex[f"C{i}"] for i in range(1, NUM_SPARSE + 1)],
+                              axis=1)
+        yield {"sparse": {"categorical": _fold_int_ids(cats, id_space,
+                                                       vocab_sizes)},
+               "dense": dense,
+               "label": ex["label"].reshape(-1).astype(np.float32)}
+
+
+def read_criteo_csv(path, batch_size: int, *, id_space: Optional[int] = None,
+                    vocab_sizes: Optional[Sequence[int]] = None,
+                    host_id: int = 0, num_hosts: int = 1,
+                    drop_remainder: bool = True,
+                    repeat: bool = False) -> Iterator[Dict]:
+    """Stream the reference's preprocessed CSV (header `,label,I1..I13,C1..C26`,
+    dense already normalized floats, categorical already relabeled ints —
+    `examples/train100.csv`)."""
+    import csv
+
+    while True:
+        with open(path, newline="") as f:
+            reader = csv.reader(f)
+            header = next(reader)
+            col = {name: j for j, name in enumerate(header)}
+            ncol_i = [col[f"I{i}"] for i in range(1, NUM_DENSE + 1)]
+            ncol_c = [col[f"C{i}"] for i in range(1, NUM_SPARSE + 1)]
+            lcol = col["label"]
+            rows = []
+            for i, line in enumerate(reader):
+                if i % num_hosts != host_id:
+                    continue
+                rows.append(line)
+                if len(rows) == batch_size:
+                    yield _csv_batch(rows, lcol, ncol_i, ncol_c, id_space,
+                                     vocab_sizes)
+                    rows = []
+            if rows and not drop_remainder:
+                yield _csv_batch(rows, lcol, ncol_i, ncol_c, id_space,
+                                 vocab_sizes)
+        if not repeat:
+            return
+
+
+def _csv_batch(rows, lcol, ncol_i, ncol_c, id_space, vocab_sizes) -> Dict:
+    n = len(rows)
+    labels = np.asarray([float(r[lcol] or 0) for r in rows], np.float32)
+    dense = np.asarray([[float(r[j] or 0) for j in ncol_i] for r in rows],
+                       np.float32)
+    cats = np.asarray([[int(r[j] or 0) for j in ncol_c] for r in rows],
+                      np.int64)
+    return {"sparse": {"categorical": _fold_int_ids(cats, id_space,
+                                                    vocab_sizes)},
+            "dense": dense, "label": labels}
+
+
 def synthetic_criteo(batch_size: int, *, id_space: int = 1 << 25,
                      num_fields: int = NUM_SPARSE, dense_dim: int = NUM_DENSE,
                      seed: int = 0, alpha: float = 1.05,

@@ -1,0 +1,203 @@
+"""Two-tier embedding table: device hash-table cache in HBM + host-RAM store.
+
+The TPU-native counterpart of the reference's PMem backend architecture
+(`variable/PmemEmbeddingTable.h`: a DRAM LRU cache in front of persistent pools,
+ICDE 2023) and the reason the reference can train 175 GB+ models on small devices:
+here HBM holds a fixed-capacity hash-table cache (`tables/hash_table.py`) and the
+full (unbounded) table lives in host RAM, so table size is bounded by HOST memory,
+not HBM.
+
+Protocol (host-driven, between jitted steps — ids are known host-side from the
+input pipeline, like the reference's client-side request assembly):
+
+1. `prepare(ids)`: ids previously evicted to the host are ADMITTED back into the
+   device cache (one jitted scatter: rows + optimizer slots restored exactly);
+   brand-new ids are left to the device table's insert-on-pull (their slots carry
+   initializer values). If admission would push occupancy over the high-water
+   mark, the cache is FLUSHED first.
+2. the train step runs entirely on device against the cache (normal hash path).
+3. `flush()`: every resident (id, row, slots) is pulled host-side, merged into
+   the host store (id-sorted arrays + searchsorted, same layout as checkpoint and
+   standalone export), and the cache resets. Coarse whole-cache eviction — the
+   reference evicts per-item LRU; a slot-granular policy is a later refinement
+   (PERF.md lists it).
+
+Exactness: a row's weights AND optimizer state round-trip bit-identically through
+evict/admit, so training with a small cache equals training with an infinite table
+whenever the initializer is slot-independent (e.g. Constant) — tested in
+`tests/test_host_offload.py`. With slot-position-dependent random init, first-touch
+values differ (the documented init-on-slot divergence of `tables/hash_table.py`).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..embedding import EmbeddingSpec, EmbeddingTableState, init_table_state
+from ..optimizers import SparseOptimizer
+from ..utils import metrics
+
+
+class HostStore:
+    """Id-sorted host arrays (weights + slots) with merge-update."""
+
+    def __init__(self, dim: int, slot_widths: Dict[str, int]):
+        self.ids = np.empty((0,), np.int64)
+        self.weights = np.empty((0, dim), np.float32)
+        self.slots = {k: np.empty((0, w), np.float32)
+                      for k, w in slot_widths.items()}
+
+    def __len__(self) -> int:
+        return len(self.ids)
+
+    def lookup(self, ids: np.ndarray) -> Tuple[np.ndarray, np.ndarray, Dict]:
+        """-> (hit mask, weight rows, slot rows) for `ids` (unknown ids return
+        zero rows and hit=False)."""
+        if len(self.ids) == 0:
+            return (np.zeros((len(ids),), bool),
+                    np.zeros((len(ids),) + self.weights.shape[1:], np.float32),
+                    {k: np.zeros((len(ids),) + v.shape[1:], np.float32)
+                     for k, v in self.slots.items()})
+        pos = np.searchsorted(self.ids, ids)
+        pos_c = np.clip(pos, 0, len(self.ids) - 1)
+        hit = self.ids[pos_c] == ids
+        w = np.where(hit[:, None], self.weights[pos_c], 0.0)
+        s = {k: np.where(hit[:, None], v[pos_c], 0.0)
+             for k, v in self.slots.items()}
+        return hit, w, s
+
+    def merge(self, ids: np.ndarray, weights: np.ndarray,
+              slots: Dict[str, np.ndarray]) -> None:
+        """Upsert rows (ids need not be sorted; duplicates of existing update)."""
+        if len(ids) == 0:
+            return
+        order = np.argsort(ids, kind="stable")
+        ids, weights = ids[order], weights[order]
+        slots = {k: v[order] for k, v in slots.items()}
+        if len(self.ids) == 0:
+            exists = np.zeros((len(ids),), bool)
+            pos_c = np.zeros((len(ids),), np.int64)
+        else:
+            pos = np.searchsorted(self.ids, ids)
+            pos_c = np.clip(pos, 0, len(self.ids) - 1)
+            exists = self.ids[pos_c] == ids
+        # update existing in place
+        if exists.any():
+            self.weights[pos_c[exists]] = weights[exists]
+            for k in self.slots:
+                self.slots[k][pos_c[exists]] = slots[k][exists]
+        # insert the rest (merge two sorted runs)
+        new = ~exists
+        if new.any():
+            self.ids = np.concatenate([self.ids, ids[new]])
+            self.weights = np.concatenate([self.weights, weights[new]])
+            for k in self.slots:
+                self.slots[k] = np.concatenate([self.slots[k], slots[k][new]])
+            order = np.argsort(self.ids, kind="stable")
+            self.ids = self.ids[order]
+            self.weights = self.weights[order]
+            for k in self.slots:
+                self.slots[k] = self.slots[k][order]
+
+    def nbytes(self) -> int:
+        return (self.ids.nbytes + self.weights.nbytes
+                + sum(v.nbytes for v in self.slots.values()))
+
+
+def _admit_fn(state: EmbeddingTableState, ids, w_rows, s_rows, known):
+    """Jitted: insert ALL `ids` into the cache (claiming slots); overwrite rows
+    and optimizer slots only for host-`known` ids — brand-new ids keep their
+    claimed slot's initializer values (insert-on-pull semantics)."""
+    from .hash_table import hash_find_or_insert
+
+    keys, slot, overflow = hash_find_or_insert(state.keys, ids)
+    capacity = state.keys.shape[0]
+    ok = known & (slot < capacity)
+    target = jnp.where(ok, slot, capacity)
+    weights = state.weights.at[target].set(
+        w_rows.astype(state.weights.dtype), mode="drop")
+    slots = {k: state.slots[k].at[target].set(
+        s_rows[k].astype(state.slots[k].dtype), mode="drop")
+        for k in state.slots}
+    return state.replace(keys=keys, weights=weights, slots=slots,
+                         overflow=state.overflow + overflow)
+
+
+class HostOffloadTable:
+    """Owns the device cache state between steps; see module docstring for the
+    prepare -> step -> (rebind) protocol. `capacity` = device slots; the host
+    store is unbounded (host RAM)."""
+
+    def __init__(self, spec: EmbeddingSpec, optimizer: SparseOptimizer, *,
+                 seed: int = 0, high_water: float = 0.6):
+        if not spec.use_hash_table:
+            raise ValueError("host offload needs a hash-table spec "
+                             "(input_dim=-1 + capacity)")
+        if not 0 < high_water <= 1:
+            raise ValueError("high_water in (0, 1]")
+        self.spec = spec
+        self.optimizer = optimizer
+        self.seed = seed
+        self.high_water = high_water
+        self.state = init_table_state(spec, optimizer, seed=seed)
+        self._fresh = jax.device_get(self.state)  # template for cache resets
+        self.capacity = self.state.keys.shape[0]
+        self.store = HostStore(spec.output_dim,
+                               optimizer.slot_shapes(spec.output_dim))
+        self._resident: set = set()
+        self._admit = jax.jit(_admit_fn, donate_argnums=(0,))
+
+    @property
+    def resident_count(self) -> int:
+        return len(self._resident)
+
+    def prepare(self, ids) -> None:
+        """Make the cache ready for a batch: flush if needed, re-admit evicted
+        ids. Call BEFORE the train step; rebind `self.state` after it."""
+        flat = np.unique(np.asarray(ids).reshape(-1))
+        flat = flat[flat >= 0]
+        new = [int(i) for i in flat if int(i) not in self._resident]
+        if not new:
+            return
+        if len(self._resident) + len(new) > self.high_water * self.capacity:
+            self.flush()
+        known_hit, w, s = self.store.lookup(np.asarray(new, np.int64))
+        n = len(new)
+        ids_dev = jnp.asarray(np.asarray(new, np.int64))
+        with metrics.vtimer("offload", "admit"):
+            self.state = self._admit(
+                self.state, ids_dev, jnp.asarray(w),
+                {k: jnp.asarray(v) for k, v in s.items()},
+                jnp.asarray(known_hit))
+        self._resident.update(new)
+        metrics.observe("offload.admitted", n)
+
+    def flush(self) -> None:
+        """Evict the whole cache to the host store and reset the device table."""
+        with metrics.vtimer("offload", "flush"):
+            keys = np.asarray(self.state.keys)
+            sel = keys >= 0
+            self.store.merge(
+                keys[sel].astype(np.int64),
+                np.asarray(self.state.weights)[sel].astype(np.float32),
+                {k: np.asarray(v)[sel].astype(np.float32)
+                 for k, v in self.state.slots.items()})
+            self.state = jax.device_put(self._fresh)
+            self._resident.clear()
+        metrics.observe("offload.flushes", 1)
+
+    def lookup_anywhere(self, ids) -> np.ndarray:
+        """Read rows wherever they live (device cache first, then host store);
+        absent ids -> zeros. For eval/export, not the hot path."""
+        from ..embedding import lookup
+
+        flat = np.asarray(ids).reshape(-1)
+        dev = np.asarray(lookup(self.spec, self.state, jnp.asarray(flat)))
+        on_dev = np.asarray([int(i) in self._resident for i in flat])
+        _, host_rows, _ = self.store.lookup(flat.astype(np.int64))
+        out = np.where(on_dev[:, None], dev, host_rows)
+        return out.reshape(np.asarray(ids).shape + (self.spec.output_dim,))
