@@ -45,7 +45,21 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-_MODE = os.environ.get("OETPU_PALLAS", "off")
+_VALID_MODES = ("auto", "on", "off", "interpret")
+
+
+def _env_mode() -> str:
+    v = os.environ.get("OETPU_PALLAS", "off")
+    if v not in _VALID_MODES:
+        import warnings
+        warnings.warn(
+            f"OETPU_PALLAS={v!r} is not one of {_VALID_MODES}; defaulting to "
+            "'off' (use 'on' to enable the Pallas kernels)", RuntimeWarning)
+        return "off"
+    return v
+
+
+_MODE = _env_mode()
 
 DEFAULT_BLOCK = 256
 # DMA semaphores are a scarce scoped resource (a (2, 256) sem array blew the 2 KB
@@ -56,7 +70,7 @@ SEM_RING = 8
 def set_mode(mode: str) -> None:
     """"off" (default — XLA path, measured faster), "on", or "interpret"."""
     global _MODE
-    if mode not in ("auto", "on", "off", "interpret"):
+    if mode not in _VALID_MODES:
         raise ValueError(f"bad pallas mode {mode!r}")
     _MODE = mode
 

@@ -33,6 +33,10 @@ import numpy as np
 
 from .export import StandaloneModel
 
+
+class _BadRequest(Exception):
+    """Client sent a syntactically/semantically invalid request body (-> 400)."""
+
 MODEL_STATUS = ("CREATING", "NORMAL", "DELETING", "ERROR")
 
 
@@ -197,7 +201,10 @@ class ServingHandler(BaseHTTPRequestHandler):
         length = int(self.headers.get("Content-Length", 0))
         if length == 0:
             return {}
-        return json.loads(self.rfile.read(length))
+        data = json.loads(self.rfile.read(length))
+        if not isinstance(data, dict):
+            raise _BadRequest("request body must be a JSON object")
+        return data
 
     def _route(self):
         path = self.path.rstrip("/")
@@ -247,6 +254,26 @@ class ServingHandler(BaseHTTPRequestHandler):
         except Exception as e:  # noqa: BLE001 - every handler error becomes a 500
             return self._json(500, {"error": str(e)})
 
+    @staticmethod
+    def _field(body: dict, *names):
+        """Required request-body field: first present name wins; absence is the
+        CALLER's error (400), never a 404 — 404 is reserved for unknown
+        model/variable signs."""
+        for n in names:
+            if n in body:
+                return body[n]
+        raise _BadRequest(f"missing required field {names[0]!r}")
+
+    @staticmethod
+    def _coerce(fn, value, what: str):
+        """Convert a request value, mapping conversion failures to 400 at the
+        parse site — a ValueError/TypeError deep inside model code is a real
+        server error and must stay a 500."""
+        try:
+            return fn(value)
+        except (ValueError, TypeError) as e:
+            raise _BadRequest(f"bad {what!r}: {e}") from e
+
     def do_POST(self):  # noqa: N802
         kind, sign, action = self._route()
         try:
@@ -254,29 +281,41 @@ class ServingHandler(BaseHTTPRequestHandler):
             if kind == "models" or (kind == "model" and action is None):
                 # POST /models {model_sign, model_uri, replica_num, shard_num}
                 # (controller.proto CreateModelRequest fields)
-                sign = sign or body["model_sign"]
+                sign = sign or self._field(body, "model_sign")
                 entry = self.manager.load_model(
-                    sign, body.get("model_uri") or body["uri"],
-                    replica_num=int(body.get("replica_num", 1)),
-                    shard_num=int(body.get("shard_num", 1)))
+                    sign, self._field(body, "model_uri", "uri"),
+                    replica_num=self._coerce(int, body.get("replica_num", 1),
+                                             "replica_num"),
+                    shard_num=self._coerce(int, body.get("shard_num", 1),
+                                           "shard_num"))
                 return self._json(200, entry)
             if kind == "model" and action == "pull":
                 model, variable = self.manager.find_model_variable(
-                    sign, body["variable"])
-                ids = np.asarray(body["ids"], dtype=np.int64)
+                    sign, self._field(body, "variable"))
+                ids = self._coerce(
+                    lambda v: np.asarray(v, dtype=np.int64),
+                    self._field(body, "ids"), "ids")
                 rows = model.lookup(variable, ids)
                 return self._json(200, {"weights": np.asarray(rows).tolist()})
             if kind == "model" and action == "predict":
                 model = self.manager.find_model(sign)
                 batch = {
-                    "sparse": {k: np.asarray(v, dtype=np.int64)
-                               for k, v in body.get("sparse", {}).items()},
+                    "sparse": {k: self._coerce(
+                        lambda v: np.asarray(v, dtype=np.int64), v,
+                        f"sparse.{k}")
+                        for k, v in body.get("sparse", {}).items()},
                 }
                 if body.get("dense") is not None:
-                    batch["dense"] = np.asarray(body["dense"], dtype=np.float32)
+                    batch["dense"] = self._coerce(
+                        lambda v: np.asarray(v, dtype=np.float32),
+                        body["dense"], "dense")
                 logits = model.predict(batch)
                 return self._json(200, {"logits": np.asarray(logits).tolist()})
             return self._json(404, {"error": "not found"})
+        except _BadRequest as e:
+            return self._json(400, {"error": str(e)})
+        except json.JSONDecodeError as e:
+            return self._json(400, {"error": f"malformed request body: {e}"})
         except KeyError as e:
             return self._json(404, {"error": str(e)})
         except Exception as e:  # noqa: BLE001

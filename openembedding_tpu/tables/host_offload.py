@@ -31,6 +31,7 @@ values differ (the documented init-on-slot divergence of `tables/hash_table.py`)
 
 from __future__ import annotations
 
+import warnings
 from typing import Dict, Optional, Tuple
 
 import jax
@@ -111,20 +112,27 @@ class HostStore:
 def _admit_fn(state: EmbeddingTableState, ids, w_rows, s_rows, known):
     """Jitted: insert ALL `ids` into the cache (claiming slots); overwrite rows
     and optimizer slots only for host-`known` ids — brand-new ids keep their
-    claimed slot's initializer values (insert-on-pull semantics)."""
+    claimed slot's initializer values (insert-on-pull semantics).
+
+    Also returns the per-id admitted mask (slot actually claimed) so the host
+    can track residency truthfully: an overflowed id never got a row written,
+    and marking it resident would make later prepare() calls skip re-admitting
+    it while lookups read zeros from the device path."""
     from .hash_table import hash_find_or_insert
 
     keys, slot, overflow = hash_find_or_insert(state.keys, ids)
     capacity = state.keys.shape[0]
-    ok = known & (slot < capacity)
+    admitted = slot < capacity
+    ok = known & admitted
     target = jnp.where(ok, slot, capacity)
     weights = state.weights.at[target].set(
         w_rows.astype(state.weights.dtype), mode="drop")
     slots = {k: state.slots[k].at[target].set(
         s_rows[k].astype(state.slots[k].dtype), mode="drop")
         for k in state.slots}
-    return state.replace(keys=keys, weights=weights, slots=slots,
-                         overflow=state.overflow + overflow)
+    new_state = state.replace(keys=keys, weights=weights, slots=slots,
+                              overflow=state.overflow + overflow)
+    return new_state, admitted
 
 
 class HostOffloadTable:
@@ -165,16 +173,26 @@ class HostOffloadTable:
             return
         if len(self._resident) + len(new) > self.high_water * self.capacity:
             self.flush()
+            # The flush just evicted the batch's previously-resident ids too;
+            # admit the WHOLE batch back or the train step would reinsert those
+            # ids with initializer values, losing their weights/slots.
+            new = [int(i) for i in flat]
+            if len(new) > self.capacity:
+                warnings.warn(
+                    f"batch has {len(new)} unique ids > cache capacity "
+                    f"({self.capacity}); the device cache cannot hold one "
+                    "batch and some rows will overflow — raise `capacity` or "
+                    "shrink the batch", RuntimeWarning)
         known_hit, w, s = self.store.lookup(np.asarray(new, np.int64))
-        n = len(new)
         ids_dev = jnp.asarray(np.asarray(new, np.int64))
         with metrics.vtimer("offload", "admit"):
-            self.state = self._admit(
+            self.state, admitted = self._admit(
                 self.state, ids_dev, jnp.asarray(w),
                 {k: jnp.asarray(v) for k, v in s.items()},
                 jnp.asarray(known_hit))
-        self._resident.update(new)
-        metrics.observe("offload.admitted", n)
+        admitted = np.asarray(admitted)
+        self._resident.update(i for i, a in zip(new, admitted) if a)
+        metrics.observe("offload.admitted", int(admitted.sum()))
 
     def flush(self) -> None:
         """Evict the whole cache to the host store and reset the device table."""

@@ -45,6 +45,12 @@ class Accumulator:
             acc = _REGISTRY.get(name)
             if acc is None:
                 acc = _REGISTRY[name] = cls(name, kind, help)
+            elif acc.kind != kind:
+                # two call sites registering the same name with different kinds
+                # would silently aggregate with whichever ran first
+                raise ValueError(
+                    f"metric {name!r} already registered with kind "
+                    f"{acc.kind!r}, requested {kind!r}")
             return acc
 
     def observe(self, value: float) -> None:

@@ -135,6 +135,63 @@ def test_offload_with_trainer_step():
     assert off.resident_count > 0
 
 
+def test_flush_triggering_batch_readmits_resident_ids():
+    """Round-1 advisor (high): when prepare() trips the high-water flush, the
+    batch's PREVIOUSLY-RESIDENT ids are evicted by that flush and must be
+    admitted back with their state — otherwise the train step reinserts them
+    at initializer values and their weights/optimizer state are lost."""
+    opt = embed.Adagrad(learning_rate=0.5)
+    spec = _spec(32)
+    off = HostOffloadTable(spec, opt, high_water=0.5)
+    A = jnp.asarray([777], jnp.int64)
+    g1 = jnp.ones((1, DIM), jnp.float32)
+
+    off.prepare(A)
+    st, _ = lookup_train(spec, off.state, A)
+    off.state = apply_gradients(spec, st, opt, A, g1)
+    # raise residency close to the high-water mark (0.5 * 32 = 16)
+    filler = jnp.asarray(np.arange(100, 100 + 12, dtype=np.int64))
+    off.prepare(filler)
+    assert 777 in off._resident
+
+    # this batch CONTAINS resident id 777 and trips the flush (13 + 4 > 16)
+    batch = jnp.asarray([777, 900, 901, 902, 903], jnp.int64)
+    off.prepare(batch)
+    assert 777 in off._resident  # re-admitted after the flush, not dropped
+    st, _ = lookup_train(spec, off.state, batch)
+    g2 = jnp.full((5, DIM), 2.0, jnp.float32)
+    off.state = apply_gradients(spec, st, opt, batch, g2)
+
+    # oracle: infinite table, same two updates on id 777
+    ref_spec = _spec(4096)
+    ref = init_table_state(ref_spec, opt)
+    ref, _ = lookup_train(ref_spec, ref, A)
+    ref = apply_gradients(ref_spec, ref, opt, A, g1)
+    ref, _ = lookup_train(ref_spec, ref, batch)
+    ref = apply_gradients(ref_spec, ref, opt, batch, g2)
+    want = np.asarray(lookup(ref_spec, ref, A))
+    got = off.lookup_anywhere(np.asarray(A))
+    np.testing.assert_array_equal(want, got)
+
+
+def test_oversized_batch_warns_and_residency_is_truthful():
+    """A single batch with more unique ids than high_water*capacity cannot fit;
+    prepare() must warn, and ids whose admission overflowed must NOT be marked
+    resident (they'd otherwise read zeros from the device path forever)."""
+    opt = embed.Adagrad(learning_rate=0.5)
+    spec = _spec(16)
+    off = HostOffloadTable(spec, opt, high_water=0.5)
+    big = jnp.asarray(np.arange(100, 100 + 40, dtype=np.int64))
+    with pytest.warns(RuntimeWarning, match="unique ids"):
+        off.prepare(big)
+    assert off.resident_count <= off.capacity
+    # every id marked resident really does live in the device table
+    from openembedding_tpu.tables.hash_table import hash_find
+    slot = hash_find(off.state.keys, jnp.asarray(
+        np.asarray(sorted(off._resident), np.int64)))
+    assert bool((np.asarray(slot) < off.capacity).all())
+
+
 def test_offload_rejects_array_table():
     with pytest.raises(ValueError, match="hash-table"):
         HostOffloadTable(EmbeddingSpec(name="a", input_dim=100, output_dim=DIM,

@@ -31,6 +31,16 @@ def test_accumulator_kinds():
     assert rep["a.g"] == 9
 
 
+def test_accumulator_kind_conflict_raises():
+    """Round-1 advisor: re-registering a name with a different kind must not
+    silently aggregate with whichever kind ran first."""
+    metrics.Accumulator.get("k", "sum").observe(1)
+    with pytest.raises(ValueError, match="kind"):
+        metrics.Accumulator.get("k", "gauge")
+    metrics.Accumulator.get("k", "sum").observe(1)  # same kind still fine
+    assert metrics.report()["k"] == 2
+
+
 def test_vtimer_records():
     with metrics.vtimer("pull", "exchange"):
         time.sleep(0.01)

@@ -182,3 +182,12 @@ def test_single_device_train_step_with_pallas():
         np.testing.assert_allclose(
             np.asarray(state_ref.tables[name].weights),
             np.asarray(state_got.tables[name].weights), atol=1e-6)
+
+
+def test_env_mode_validated(monkeypatch):
+    """Round-1 advisor: OETPU_PALLAS=garbage must not silently enable Pallas."""
+    monkeypatch.setenv("OETPU_PALLAS", "TRUE")
+    with pytest.warns(RuntimeWarning, match="OETPU_PALLAS"):
+        assert pallas_sparse._env_mode() == "off"
+    monkeypatch.setenv("OETPU_PALLAS", "interpret")
+    assert pallas_sparse._env_mode() == "interpret"

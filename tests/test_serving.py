@@ -199,3 +199,20 @@ def test_rest_round_trip(trained, tmp_path, server):
 
     status, body = _req(f"{base}/models/nope")
     assert status == 404
+
+
+def test_rest_malformed_body_is_400_not_404(server):
+    """Round-1 advisor: a missing required field is the CALLER's error (400);
+    404 stays reserved for unknown model/variable signs."""
+    base, _ = server
+    status, body = _req(f"{base}/models", "POST", {})  # no model_sign
+    assert status == 400 and "model_sign" in body["error"]
+    status, body = _req(f"{base}/models", "POST", {"model_sign": "x"})  # no uri
+    assert status == 400 and "model_uri" in body["error"]
+    # unknown model sign on pull is still 404
+    status, body = _req(f"{base}/models/nope/pull", "POST",
+                        {"variable": "v", "ids": [1]})
+    assert status == 404
+    # known route, missing ids field -> 400 would need a loaded model; missing
+    # "variable" on an unknown model resolves the model first (404) — missing
+    # field on /models is the canonical 400 case covered above
