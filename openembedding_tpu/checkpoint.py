@@ -8,9 +8,11 @@ count than dump (the reference remaps keys `index*shard_num + shard_id` on load,
 `EmbeddingShardFile.h:23-25` — we store tables in **global id order**, so resharding is
 a pure relayout at load).
 
-This module is the single-host path (np arrays). The mesh-sharded variant
-(per-shard streams + async "persist" pmem-equivalent) lives in `parallel/checkpoint.py`
-and reuses the same meta format.
+This module is the single-host path (np arrays): every table is gathered to (and
+restored from) one process's RAM, fine up to a few GB. The mesh-scale variant —
+per-shard streaming files, bounded host memory, multi-host-correct assembly — is
+`parallel/checkpoint.py` (same meta format, `extra.layout == "sharded"`);
+`Trainer.load`/`MeshTrainer.load` dispatch on the layout automatically.
 """
 
 from __future__ import annotations
@@ -140,31 +142,28 @@ def _np_interleave(id_major: np.ndarray, num_shards: int) -> np.ndarray:
         out.reshape(rps, num_shards, k).transpose(1, 0, 2).reshape(-1, k))
 
 
-def _np_hash_insert(keys: np.ndarray, ids: np.ndarray, num_shards: int,
-                    num_probes: int = 1024) -> np.ndarray:
-    """Host-side re-insertion of checkpointed hash keys into a (possibly different)
-    shard layout, using the SAME probe sequence as the device kernel
-    (`tables/hash_table.py`: base = mix(id) % capacity, linear probing inside the
-    owning shard's slot range). Mutates `keys`; returns global slot per id (-1 =
-    dropped: capacity exhausted on that shard)."""
-    from .tables.hash_table import np_mix
+def _put_like(np_arr: np.ndarray, like) -> jax.Array:
+    """Place a host array like an existing one (dtype + sharding preserved);
+    shared by this module and `parallel/checkpoint.py`."""
+    arr = jnp.asarray(np_arr.astype(like.dtype))
+    sharding = getattr(like, "sharding", None)
+    return jax.device_put(arr, sharding) if sharding is not None else arr
 
-    rows_total = keys.shape[0]
-    cps = rows_total // num_shards
-    owner = (ids % num_shards).astype(np.int64)
-    base = (np_mix(ids) % np.uint64(cps)).astype(np.int64) \
-        if ids.dtype.itemsize >= 8 else (np_mix(ids) % np.uint32(cps)).astype(np.int64)
-    pos_out = np.full(len(ids), -1, np.int64)
-    for i in range(len(ids)):
-        start = owner[i] * cps
-        b = base[i]
-        for d in range(min(num_probes, cps)):
-            p = start + (b + d) % cps
-            if keys[p] == -1:
-                keys[p] = ids[i]
-                pos_out[i] = p
-                break
-    return pos_out
+
+def _check_meta(meta: ModelMeta, model) -> None:
+    """Shared dump/load meta validation (reference: load_model rejects meta
+    mismatches); used by this module and `parallel/checkpoint.py`."""
+    by_name = {v.storage_name: v for v in meta.variables}
+    for name, spec in model.specs.items():
+        if name not in by_name:
+            raise ValueError(f"checkpoint is missing variable {name!r} "
+                             f"(reference load_model rejects meta mismatch too)")
+        ckpt_meta = by_name[name].meta
+        if (ckpt_meta.embedding_dim != spec.meta.embedding_dim
+                or ckpt_meta.datatype != spec.meta.datatype
+                or ckpt_meta.vocabulary_size != spec.meta.vocabulary_size):
+            raise ValueError(f"variable {name!r} meta mismatch: "
+                             f"{ckpt_meta} vs {spec.meta}")
 
 
 def load_server_model(state, model, path: str, *, num_shards: int = 1):
@@ -180,17 +179,7 @@ def load_server_model(state, model, path: str, *, num_shards: int = 1):
         raw = f.read()
     meta = ModelMeta.from_json(raw)
     extra = json.loads(raw).get("extra", {})
-    by_name = {v.storage_name: v for v in meta.variables}
-    for name, spec in model.specs.items():
-        if name not in by_name:
-            raise ValueError(f"checkpoint is missing variable {name!r} "
-                             f"(reference load_model rejects meta mismatch too)")
-        ckpt_meta = by_name[name].meta
-        if (ckpt_meta.embedding_dim != spec.meta.embedding_dim
-                or ckpt_meta.datatype != spec.meta.datatype
-                or ckpt_meta.vocabulary_size != spec.meta.vocabulary_size):
-            raise ValueError(f"variable {name!r} meta mismatch: "
-                             f"{ckpt_meta} vs {spec.meta}")
+    _check_meta(meta, model)
 
     dense_npz = np.load(os.path.join(path, "dense_params.npz"))
     dense_params = _unflatten_params({k: dense_npz[k] for k in dense_npz.files})
@@ -206,17 +195,14 @@ def load_server_model(state, model, path: str, *, num_shards: int = 1):
             continue
         vdir = os.path.join(path, f"variable_{spec.variable_id}")
         ts = state.tables[name]
-
-        def _put(np_arr, like):
-            arr = jnp.asarray(np_arr.astype(like.dtype))
-            sharding = getattr(like, "sharding", None)
-            return jax.device_put(arr, sharding) if sharding is not None else arr
+        _put = _put_like
 
         if spec.use_hash_table:
+            from .tables.hash_table import np_hash_insert
             ids = np.load(os.path.join(vdir, "ids.npy"))
             w_rows = np.load(os.path.join(vdir, "weights.npy"))
             keys_np = np.full(ts.keys.shape, -1, np.asarray(ts.keys).dtype)
-            pos = _np_hash_insert(keys_np, ids.astype(keys_np.dtype), num_shards)
+            pos = np_hash_insert(keys_np, ids.astype(keys_np.dtype), num_shards)
             placed = pos >= 0
             weights_np = np.asarray(ts.weights).copy()
             weights_np[pos[placed]] = w_rows[placed]

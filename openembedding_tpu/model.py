@@ -152,6 +152,13 @@ class Trainer:
                                  num_shards=self.num_shards, **kw)
 
     def load(self, state: "TrainState", path: str):
+        """Dispatches on the checkpoint layout: single-file (this class's save)
+        or per-shard streaming (`MeshTrainer.save` / `parallel/checkpoint.py`) —
+        either loads at any target mesh size."""
+        from .parallel.checkpoint import checkpoint_layout, load_sharded
+        if checkpoint_layout(path) == "sharded":
+            return load_sharded(state, self.model, path,
+                                num_shards=self.num_shards)
         from .checkpoint import load_server_model
         return load_server_model(state, self.model, path,
                                  num_shards=self.num_shards)

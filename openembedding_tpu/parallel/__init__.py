@@ -2,5 +2,7 @@ from .mesh import make_mesh, table_sharding, replicated, batch_sharding
 from .sharded import (sharded_lookup_train, sharded_lookup, sharded_apply_gradients,
                       deinterleave_rows, interleave_rows)
 from .trainer import MeshTrainer, SeqMeshTrainer
+from .checkpoint import (save_sharded, load_sharded, snapshot_addressable,
+                         checkpoint_layout)
 from .sequence import ring_attention, ulysses_attention, reference_attention
 from . import multihost

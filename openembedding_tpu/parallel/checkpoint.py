@@ -1,0 +1,526 @@
+"""Sharded streaming checkpoint: per-shard files, bounded host memory.
+
+Counterpart of the reference's server-side per-shard dump/load streams
+(`server/EmbeddingDumpOperator.cpp:36-96` writes each shard's own file via
+`EmbeddingShardFile`; `client/Model.cpp:89-134` coordinates the per-node URIs) —
+the design that lets a 78 GB checkpoint of a 175 GB model work: no node ever
+holds more than its own shard. The round-1 single-host path
+(`checkpoint.save_server_model`) gathers every table into one process's RAM; at
+mesh scale that OOMs the host, and under multi-host a non-fully-addressable
+`jax.Array` cannot be `np.asarray`'d at all. This module fixes both:
+
+- `save_sharded` walks `jax.Array.addressable_shards` and streams each shard to
+  its own file in `chunk_rows`-row chunks (device -> memmap'd .npy), so peak
+  host memory is O(chunk), not O(table). Each process writes only the shards it
+  owns; process 0 writes the meta and the (replicated, small) dense params.
+- `load_sharded` assembles each *target* shard from memmap'd source-shard files
+  (reading only the rows that map to it) and builds the global array with
+  `jax.make_array_from_single_device_arrays` — works at ANY target mesh size
+  and never materializes a whole table (peak host memory: one target shard).
+- `snapshot_addressable` captures a host-side copy of this process's shards
+  (NOT the global table) so `persist.AsyncPersister` can snapshot before the
+  next train step donates the state and write to disk on its worker thread.
+
+Disk layout (meta format `tpu-1`, extra["layout"] == "sharded"):
+
+    <path>/model_meta                      JSON (+ extra.src_shards)
+    <path>/dense_params.npz, dense_slots.npz
+    <path>/variable_<id>/shard_<s>_of_<S>/weights.npy       array tables:
+        the shard's rows in LOCAL order (local row l holds global id l*S + s —
+        the reference's `id % S` interleave, `EmbeddingShardFile.h:23-25`)
+    <path>/variable_<id>/shard_<s>_of_<S>/{ids,weights,slot_*}.npy  hash
+        tables: the shard compacted to id-sorted (ids, rows, slots)
+
+Resharding S -> T is a pure index remap for array tables (id = l*S + s =
+m*T + t) and a re-insert for hash tables (vectorized `np_hash_insert`, same
+probe sequence as the device kernel).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import uuid as uuid_mod
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import SingleDeviceSharding
+
+from ..checkpoint import (MODEL_META_FILE, _flatten_params, _put_like,
+                          _unflatten_params)
+from ..meta import ModelMeta, ModelVariableMeta
+
+DEFAULT_CHUNK_ROWS = 1 << 16
+
+
+def _open_memmap(path: str, shape, dtype):
+    from numpy.lib.format import open_memmap
+    return open_memmap(path, mode="w+", dtype=dtype, shape=tuple(shape))
+
+
+class HostShardedArray:
+    """Host-side snapshot of this process's shards of one row-sharded array.
+    A pytree LEAF (deliberately not a NamedTuple): `shards` maps shard ordinal
+    -> np array of that shard's rows."""
+
+    def __init__(self, shape, num_shards: int, shards: Dict[int, np.ndarray]):
+        self.shape = tuple(shape)
+        self.num_shards = num_shards
+        self.shards = shards
+
+
+class _ShardReader:
+    """Uniform chunked access to one shard's rows, whatever holds them."""
+
+    def __init__(self, data, nrows: int):
+        self._data = data
+        self.nrows = nrows
+
+    def rows(self, a: int, b: int) -> np.ndarray:
+        return np.asarray(self._data[a:b])
+
+    def take(self, idx: np.ndarray) -> np.ndarray:
+        return np.asarray(self._data[idx])
+
+
+def _row_shards(x, num_shards: int) -> List[Tuple[int, _ShardReader]]:
+    """-> [(shard_ordinal, reader)] for the shards of `x` THIS process holds."""
+    if isinstance(x, HostShardedArray):
+        return [(o, _ShardReader(a, a.shape[0]))
+                for o, a in sorted(x.shards.items())]
+    if num_shards == 1 or not isinstance(x, jax.Array):
+        arr = np.asarray(x)
+        return [(0, _ShardReader(arr, arr.shape[0]))]
+    rows_per = x.shape[0] // num_shards
+    out = []
+    for s in x.addressable_shards:
+        if s.replica_id != 0:
+            continue
+        start = s.index[0].start or 0
+        out.append((start // rows_per, _ShardReader(s.data, s.data.shape[0])))
+    return sorted(out)
+
+
+def _stream_rows(reader: _ShardReader, path: str, chunk_rows: int,
+                 stats: Optional[dict]) -> None:
+    first = reader.rows(0, min(chunk_rows, reader.nrows))
+    mm = _open_memmap(path, (reader.nrows,) + first.shape[1:], first.dtype)
+    mm[:first.shape[0]] = first
+    for a in range(first.shape[0], reader.nrows, chunk_rows):
+        b = min(a + chunk_rows, reader.nrows)
+        mm[a:b] = reader.rows(a, b)
+        if stats is not None:
+            stats["max_host_rows"] = max(stats.get("max_host_rows", 0), b - a)
+    if stats is not None:
+        stats["max_host_rows"] = max(stats.get("max_host_rows", 0),
+                                     first.shape[0])
+    mm.flush()
+    del mm
+
+
+def _stream_take(reader: _ShardReader, pos: np.ndarray, path: str, ncols,
+                 dtype, chunk_rows: int, stats: Optional[dict]) -> None:
+    shape = (len(pos),) + tuple(ncols)
+    if len(pos) == 0:  # np.memmap cannot map an empty file
+        np.save(path, np.empty(shape, dtype))
+        return
+    mm = _open_memmap(path, shape, dtype)
+    for a in range(0, len(pos), chunk_rows):
+        b = min(a + chunk_rows, len(pos))
+        mm[a:b] = reader.take(pos[a:b])
+        if stats is not None:
+            stats["max_host_rows"] = max(stats.get("max_host_rows", 0), b - a)
+    mm.flush()
+    del mm
+
+
+def snapshot_addressable(state, num_shards: int):
+    """Host snapshot of this process's shards (peak memory: this process's own
+    state, never the global table). The result feeds `save_sharded` on a worker
+    thread after the caller's next step donates the device state."""
+    from ..model import TrainState
+    from ..embedding import EmbeddingTableState
+
+    def snap_rows(x):
+        if x is None:
+            return None
+        shards = _row_shards(x, num_shards)
+        if len(shards) == 1 and shards[0][1].nrows == x.shape[0]:
+            return np.asarray(x)  # unsharded (T == 1)
+        return HostShardedArray(x.shape, num_shards,
+                                {o: r.rows(0, r.nrows) for o, r in shards})
+
+    tables = {}
+    for name, ts in state.tables.items():
+        tables[name] = EmbeddingTableState(
+            weights=snap_rows(ts.weights),
+            slots={k: snap_rows(v) for k, v in ts.slots.items()},
+            keys=snap_rows(ts.keys),
+            overflow=None if ts.overflow is None else np.asarray(ts.overflow),
+        )
+    return TrainState(
+        step=np.asarray(state.step),
+        dense_params=jax.tree_util.tree_map(np.asarray, state.dense_params),
+        dense_slots=jax.tree_util.tree_map(np.asarray, state.dense_slots),
+        tables=tables,
+        model_version=np.asarray(state.model_version),
+    )
+
+
+# ---------------------------------------------------------------------------
+# save
+# ---------------------------------------------------------------------------
+
+
+def save_sharded(state, model, path: str, *, num_shards: int,
+                 include_optimizer: bool = True, model_sign: str = "",
+                 chunk_rows: int = DEFAULT_CHUNK_ROWS,
+                 _stats: Optional[dict] = None) -> ModelMeta:
+    """Stream the train state to per-shard files. `state` may be a live (device)
+    TrainState or a `snapshot_addressable` result. Each process writes its own
+    shards; process 0 writes meta + dense. Callers must barrier across
+    processes afterwards if they need the checkpoint complete (the
+    `AsyncPersister` COMMIT marker provides that for the persist path)."""
+    proc0 = jax.process_index() == 0
+    os.makedirs(path, exist_ok=True)
+    model_sign = model_sign or f"{uuid_mod.uuid4().hex}-{int(state.model_version)}"
+    meta = ModelMeta(model_sign=model_sign, uri=path, num_shards=num_shards)
+
+    for name, spec in model.specs.items():
+        mv = ModelVariableMeta(
+            variable_id=spec.variable_id,
+            storage_name=name,
+            meta=spec.meta,
+            optimizer=spec.optimizer.to_config() if spec.optimizer else {},
+            initializer=spec.initializer.to_config(),
+            table={"category": "hash" if spec.use_hash_table else "array",
+                   "capacity": spec.capacity},
+        )
+        meta.variables.append(mv)
+        if spec.sparse_as_dense:
+            continue  # lives in dense_params.npz (see checkpoint.py)
+        ts = state.tables[name]
+        vdir = os.path.join(path, f"variable_{spec.variable_id}")
+        os.makedirs(vdir, exist_ok=True)
+        w_shards = dict(_row_shards(ts.weights, num_shards))
+        slot_shards = {k: dict(_row_shards(v, num_shards))
+                       for k, v in ts.slots.items()} if include_optimizer else {}
+        if spec.use_hash_table:
+            k_shards = dict(_row_shards(ts.keys, num_shards))
+            for ordinal, kr in k_shards.items():
+                sdir = os.path.join(
+                    vdir, f"shard_{ordinal:05d}_of_{num_shards:05d}")
+                os.makedirs(sdir, exist_ok=True)
+                # pass 1 (chunked): resident positions + ids
+                pos_parts, id_parts = [], []
+                for a in range(0, kr.nrows, chunk_rows):
+                    kchunk = kr.rows(a, min(a + chunk_rows, kr.nrows))
+                    sel = kchunk >= 0
+                    pos_parts.append(a + np.nonzero(sel)[0])
+                    id_parts.append(kchunk[sel])
+                pos = np.concatenate(pos_parts) if pos_parts else \
+                    np.empty((0,), np.int64)
+                ids = np.concatenate(id_parts) if id_parts else \
+                    np.empty((0,), np.int64)
+                order = np.argsort(ids, kind="stable")
+                pos, ids = pos[order], ids[order]
+                np.save(os.path.join(sdir, "ids.npy"), ids)
+                # pass 2 (chunked): gather rows in id order
+                wr = w_shards[ordinal]
+                dim = spec.output_dim
+                _stream_take(wr, pos, os.path.join(sdir, "weights.npy"),
+                             (dim,), wr.rows(0, 1).dtype if wr.nrows else
+                             np.float32, chunk_rows, _stats)
+                for slot_name, srd in slot_shards.items():
+                    sr = srd[ordinal]
+                    width = sr.rows(0, 1).shape[1:] if sr.nrows else (dim,)
+                    _stream_take(sr, pos,
+                                 os.path.join(sdir, f"slot_{slot_name}.npy"),
+                                 width, sr.rows(0, 1).dtype if sr.nrows else
+                                 np.float32, chunk_rows, _stats)
+        else:
+            for ordinal, wr in w_shards.items():
+                sdir = os.path.join(
+                    vdir, f"shard_{ordinal:05d}_of_{num_shards:05d}")
+                os.makedirs(sdir, exist_ok=True)
+                _stream_rows(wr, os.path.join(sdir, "weights.npy"),
+                             chunk_rows, _stats)
+                for slot_name, srd in slot_shards.items():
+                    _stream_rows(srd[ordinal],
+                                 os.path.join(sdir, f"slot_{slot_name}.npy"),
+                                 chunk_rows, _stats)
+
+    if proc0:
+        dense = _flatten_params(state.dense_params)
+        np.savez(os.path.join(path, "dense_params.npz"), **dense)
+        if include_optimizer:
+            np.savez(os.path.join(path, "dense_slots.npz"),
+                     **_flatten_params(state.dense_slots))
+        meta.dense_manifest = {k: {"shape": list(v.shape),
+                                   "dtype": str(v.dtype)}
+                               for k, v in dense.items()}
+        extra = {"step": int(state.step),
+                 "model_version": int(state.model_version),
+                 "include_optimizer": include_optimizer,
+                 "layout": "sharded"}
+        with open(os.path.join(path, MODEL_META_FILE), "w") as f:
+            d = json.loads(meta.to_json())
+            d["extra"] = extra
+            json.dump(d, f, indent=2, sort_keys=True)
+    return meta
+
+
+def checkpoint_layout(path: str) -> str:
+    """'sharded' (this module's per-shard layout) or 'single'
+    (`checkpoint.save_server_model`'s id-major whole-table files)."""
+    with open(os.path.join(path, MODEL_META_FILE)) as f:
+        return json.load(f).get("extra", {}).get("layout", "single")
+
+
+# ---------------------------------------------------------------------------
+# load
+# ---------------------------------------------------------------------------
+
+
+def _src_shard_dirs(vdir: str) -> Dict[int, str]:
+    out = {}
+    for name in os.listdir(vdir):
+        if name.startswith("shard_"):
+            out[int(name.split("_")[1])] = os.path.join(vdir, name)
+    return out
+
+
+def _mmap(path: str):
+    return np.load(path, mmap_mode="r")
+
+
+def _target_devices(arr, num_shards: int):
+    """[(device, target_ordinal, existing_shard_data)] for this process."""
+    rows_per = arr.shape[0] // num_shards
+    out = []
+    for s in arr.addressable_shards:
+        if s.replica_id != 0:
+            continue
+        start = s.index[0].start or 0
+        out.append((s.device, start // rows_per, s.data))
+    return out
+
+
+def _assemble_global(like, per_device: Dict) -> jax.Array:
+    """Build a global array from this process's target-shard np arrays (the
+    multi-host-correct constructor: every process contributes only what it
+    holds)."""
+    arrays = [jax.device_put(a, SingleDeviceSharding(d))
+              for d, a in per_device.items()]
+    return jax.make_array_from_single_device_arrays(
+        like.shape, like.sharding, arrays)
+
+
+def _array_target_shard(t: int, T: int, rps_t: int, src: Dict[int, str],
+                        fname: str, S: int, vocab: int, dtype,
+                        width) -> np.ndarray:
+    """One target shard of an array table: local row m holds global id m*T + t;
+    source shard s = id % S, local row l = id // S. Reads only the needed rows
+    from memmap'd source files."""
+    ids = np.arange(rps_t, dtype=np.int64) * T + t
+    valid = ids < vocab
+    out = np.zeros((rps_t,) + tuple(width), dtype)
+    s_of = ids % S
+    l_of = ids // S
+    for s, sdir in src.items():
+        msk = valid & (s_of == s)
+        if not msk.any():
+            continue
+        mm = _mmap(os.path.join(sdir, fname))
+        out[msk] = mm[l_of[msk]]
+    return out
+
+
+def _hash_sources_for_target(t: int, T: int, src_ids: Dict[int, np.ndarray]
+                             ) -> Tuple[np.ndarray, Dict[int, np.ndarray]]:
+    """(ids, {src_shard: positions-in-src-file}) of the checkpointed ids this
+    target shard owns (id % T == t). `src_ids` is preloaded once per variable —
+    re-reading every ids file for every target shard would be S*T full reads."""
+    id_parts, pos_by_src = [], {}
+    for s, ids_s in src_ids.items():
+        msk = (ids_s % T) == t
+        if msk.any():
+            pos_by_src[s] = np.nonzero(msk)[0]
+            id_parts.append(ids_s[msk])
+    ids = (np.concatenate(id_parts) if id_parts
+           else np.empty((0,), np.int64))
+    return ids, pos_by_src
+
+
+def load_sharded(state, model, path: str, *, num_shards: int = 1):
+    """Restore a sharded checkpoint into `state` at ANY target mesh size
+    (`num_shards` = the layout of `state`). Per-target-shard assembly: peak
+    host memory is one shard, never a table. Single-device targets
+    (num_shards=1) get plain arrays."""
+    from ..tables.hash_table import np_hash_insert
+    from ..checkpoint import _check_meta  # shared meta validation
+
+    with open(os.path.join(path, MODEL_META_FILE)) as f:
+        raw = f.read()
+    meta = ModelMeta.from_json(raw)
+    extra = json.loads(raw).get("extra", {})
+    _check_meta(meta, model)
+    T = num_shards
+    S = meta.num_shards
+
+    dense_npz = np.load(os.path.join(path, "dense_params.npz"))
+    dense_params = _unflatten_params({k: dense_npz[k] for k in dense_npz.files})
+    slots_path = os.path.join(path, "dense_slots.npz")
+    dense_slots = state.dense_slots
+    if os.path.exists(slots_path):
+        z = np.load(slots_path)
+        dense_slots = _unflatten_params({k: z[k] for k in z.files})
+
+    new_tables = dict(state.tables)
+    for name, spec in model.specs.items():
+        if spec.sparse_as_dense:
+            continue
+        vdir = os.path.join(path, f"variable_{spec.variable_id}")
+        src = _src_shard_dirs(vdir)
+        if len(src) != S:
+            raise ValueError(
+                f"variable {name!r}: checkpoint has {len(src)} shard dirs, "
+                f"meta says {S} — incomplete dump (missing process?)")
+        ts = state.tables[name]
+        dim = spec.output_dim
+        sharded_target = (isinstance(ts.weights, jax.Array)
+                          and T > 1)
+
+        def one_slot_paths(s):
+            return {k: os.path.join(src[s], f"slot_{k}.npy")
+                    for k in ts.slots
+                    if os.path.exists(os.path.join(src[s], f"slot_{k}.npy"))}
+
+        have_slots = set(one_slot_paths(next(iter(src))))
+
+        if spec.use_hash_table:
+            src_ids = {s: np.load(os.path.join(sdir, "ids.npy"))
+                       for s, sdir in src.items()}
+
+            def build_target(t, rows_t, base_w, base_slots, key_dtype):
+                """-> (keys, weights, slots, dropped) np arrays for shard t."""
+                ids, pos_by_src = _hash_sources_for_target(t, T, src_ids)
+                keys_t = np.full((rows_t,), -1, key_dtype)
+                pos = np_hash_insert(keys_t, ids.astype(key_dtype), 1)
+                placed = pos >= 0
+                w = base_w.copy()
+                slots_np = {k: base_slots[k].copy() for k in base_slots}
+                off = 0
+                for s, p_src in pos_by_src.items():
+                    n = len(p_src)
+                    tgt = pos[off:off + n]
+                    ok = placed[off:off + n]
+                    w_mm = _mmap(os.path.join(src[s], "weights.npy"))
+                    w[tgt[ok]] = w_mm[p_src[ok]]
+                    for k, sp in one_slot_paths(s).items():
+                        slots_np[k][tgt[ok]] = _mmap(sp)[p_src[ok]]
+                    off += n
+                return keys_t, w, slots_np, int((~placed).sum())
+
+            if sharded_target:
+                var_dropped = 0
+                per_dev_w, per_dev_k = {}, {}
+                per_dev_slots = {k: {} for k in have_slots}
+                tmap_w = {t: (d, data) for d, t, data in
+                          _target_devices(ts.weights, T)}
+                tmap_k = {t: (d, data) for d, t, data in
+                          _target_devices(ts.keys, T)}
+                tmap_s = {k: {t: (d, data) for d, t, data in
+                              _target_devices(ts.slots[k], T)}
+                          for k in have_slots}
+                for t, (dev, wdata) in tmap_w.items():
+                    base_w = np.asarray(wdata)
+                    base_slots = {k: np.asarray(tmap_s[k][t][1])
+                                  for k in have_slots}
+                    keys_t, w, slots_np, dropped = build_target(
+                        t, wdata.shape[0], base_w, base_slots,
+                        np.dtype(tmap_k[t][1].dtype))
+                    var_dropped += dropped
+                    per_dev_w[dev] = w
+                    per_dev_k[tmap_k[t][0]] = keys_t
+                    for k in have_slots:
+                        per_dev_slots[k][tmap_s[k][t][0]] = slots_np[k]
+                slots = dict(ts.slots)
+                for k in have_slots:
+                    slots[k] = _assemble_global(ts.slots[k], per_dev_slots[k])
+                new_tables[name] = ts.replace(
+                    weights=_assemble_global(ts.weights, per_dev_w),
+                    keys=_assemble_global(ts.keys, per_dev_k),
+                    slots=slots,
+                    overflow=_replicated_like(
+                        ts.overflow, np.int32(var_dropped)))
+            else:
+                base_w = np.asarray(ts.weights)
+                base_slots = {k: np.asarray(ts.slots[k]) for k in have_slots}
+                keys_t, w, slots_np, dropped = build_target(
+                    0, ts.keys.shape[0], base_w, base_slots,
+                    np.dtype(ts.keys.dtype))
+                slots = dict(ts.slots)
+                for k in have_slots:
+                    slots[k] = _put_like(slots_np[k], ts.slots[k])
+                new_tables[name] = ts.replace(
+                    weights=_put_like(w, ts.weights),
+                    keys=_put_like(keys_t, ts.keys),
+                    slots=slots,
+                    overflow=_replicated_like(ts.overflow, np.int32(dropped)))
+        else:
+            vocab = spec.input_dim
+            if sharded_target:
+                rps_t = ts.weights.shape[0] // T
+                per_dev_w = {}
+                per_dev_slots = {k: {} for k in have_slots}
+                for dev, t, wdata in _target_devices(ts.weights, T):
+                    per_dev_w[dev] = _array_target_shard(
+                        t, T, rps_t, src, "weights.npy", S, vocab,
+                        np.asarray(wdata[:1]).dtype, (dim,))
+                for k in have_slots:
+                    for dev, t, sdata in _target_devices(ts.slots[k], T):
+                        width = np.asarray(sdata[:1]).shape[1:]
+                        per_dev_slots[k][dev] = _array_target_shard(
+                            t, T, rps_t, src, f"slot_{k}.npy", S, vocab,
+                            np.asarray(sdata[:1]).dtype, width)
+                slots = dict(ts.slots)
+                for k in have_slots:
+                    slots[k] = _assemble_global(ts.slots[k], per_dev_slots[k])
+                new_tables[name] = ts.replace(
+                    weights=_assemble_global(ts.weights, per_dev_w),
+                    slots=slots)
+            else:
+                rows_t = ts.weights.shape[0]
+                w = _array_target_shard(0, 1, rows_t, src, "weights.npy", S,
+                                        vocab, np.asarray(ts.weights[:1]).dtype,
+                                        (dim,))
+                slots = dict(ts.slots)
+                for k in have_slots:
+                    width = np.asarray(ts.slots[k][:1]).shape[1:]
+                    slots[k] = _put_like(
+                        _array_target_shard(0, 1, rows_t, src,
+                                            f"slot_{k}.npy", S, vocab,
+                                            np.asarray(ts.slots[k][:1]).dtype,
+                                            width),
+                        ts.slots[k])
+                new_tables[name] = ts.replace(weights=_put_like(w, ts.weights),
+                                              slots=slots)
+
+    return state.replace(
+        step=jnp.asarray(extra.get("step", 0), jnp.int32),
+        model_version=jnp.asarray(extra.get("model_version", 0), jnp.int32),
+        dense_params=dense_params,
+        dense_slots=dense_slots,
+        tables=new_tables,
+    )
+
+
+def _replicated_like(like, value):
+    if like is None:
+        return None
+    arr = jnp.asarray(np.asarray(value).astype(like.dtype))
+    sharding = getattr(like, "sharding", None)
+    return jax.device_put(arr, sharding) if sharding is not None else arr

@@ -44,6 +44,17 @@ class MeshTrainer(Trainer):
         self._train_step_fn = None
         self._eval_step_fn = None
 
+    # -- checkpointing -------------------------------------------------------
+
+    def save(self, state, path: str, **kw):
+        """Per-shard streaming dump (`parallel/checkpoint.py`): each process
+        writes only its addressable shards, peak host memory O(chunk) — the
+        reference's server-side per-shard dump, `EmbeddingDumpOperator.cpp:36-96`.
+        `Trainer.load` / `MeshTrainer.load` restore it at any mesh size."""
+        from .checkpoint import save_sharded
+        return save_sharded(state, self.model, path,
+                            num_shards=self.num_shards, **kw)
+
     # -- sharding specs ------------------------------------------------------
 
     def _table_pspec(self, spec: EmbeddingSpec) -> EmbeddingTableState:

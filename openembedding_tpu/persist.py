@@ -97,7 +97,8 @@ class AsyncPersister:
 
     def __init__(self, trainer, model, root: str, *, window: int = 2,
                  keep: int = 2, include_optimizer: bool = True,
-                 policy: Optional[PersistPolicy] = None):
+                 policy: Optional[PersistPolicy] = None,
+                 commit_timeout: float = 600.0):
         from .checkpoint import save_server_model  # noqa: F401 (validated import)
 
         if window < 1:
@@ -107,6 +108,7 @@ class AsyncPersister:
         self.root = root
         self.keep = keep
         self.include_optimizer = include_optimizer
+        self.commit_timeout = commit_timeout
         self.policy = policy or PersistPolicy(every_steps=1000)
         os.makedirs(root, exist_ok=True)
         self._q: "queue.Queue" = queue.Queue(maxsize=window)
@@ -130,11 +132,19 @@ class AsyncPersister:
     def persist(self, state) -> str:
         """Snapshot to host NOW (before the caller's next step donates the state),
         enqueue the disk write; blocks only when `window` writes are pending
-        (reference `persist_server_model(path, window)`, `exb.py:700-702`)."""
+        (reference `persist_server_model(path, window)`, `exb.py:700-702`).
+
+        Sharded states snapshot per-addressable-shard (each process copies only
+        its own shards — a multi-host global table is never gathered; the r1
+        whole-state `device_get` breaks on non-fully-addressable arrays)."""
         self._raise_pending_error()
         step = int(state.step)
         with metrics.vtimer("persist", "snapshot"):
-            snapshot = jax.device_get(state)
+            if self.trainer.num_shards > 1:
+                from .parallel.checkpoint import snapshot_addressable
+                snapshot = snapshot_addressable(state, self.trainer.num_shards)
+            else:
+                snapshot = jax.device_get(state)
         path = os.path.join(self.root, f"persist_{step:012d}")
         self._q.put((snapshot, step, path))  # backpressure: pending window full
         self.policy.mark(step)
@@ -153,28 +163,63 @@ class AsyncPersister:
             snapshot, step, path = item
             try:
                 with metrics.vtimer("persist", "write"):
-                    tmp = f"{path}.writing"
-                    if os.path.exists(tmp):
-                        shutil.rmtree(tmp)
-                    save_server_model(
-                        snapshot, self.model, tmp,
-                        include_optimizer=self.include_optimizer,
-                        num_shards=self.trainer.num_shards)
-                    # an existing dir at `path` — a crash between replace and
-                    # COMMIT, or a committed persist of the same step from a
-                    # previous run — would make os.replace fail with ENOTEMPTY
-                    # forever; the fresh persist supersedes it
-                    if os.path.exists(path):
-                        shutil.rmtree(path)
-                    os.replace(tmp, path)
-                    with open(os.path.join(path, COMMIT_FILE), "w") as f:
-                        f.write(str(step))
+                    self._write_one(snapshot, step, path)
                 metrics.observe("persist.committed", 1)
-                self._gc()
+                if jax.process_index() == 0:
+                    self._gc()
             except BaseException as e:  # noqa: BLE001 - surfaced to producer
                 self._error = e
             finally:
                 self._q.task_done()
+
+    def _write_one(self, snapshot, step: int, path: str) -> None:
+        """Write this process's shards into `<path>.writing`, then commit.
+
+        Multi-host commit protocol (the reference's work-id commit,
+        `PmemEmbeddingTable.h:236-300`, re-expressed over a shared FS): every
+        process streams its own shards into the SAME `.writing` dir and drops a
+        `done.<process_index>` marker; only process 0 — after ALL markers are
+        present — renames the dir into place and writes COMMIT. A fast process
+        can therefore never commit (or garbage-collect) a checkpoint another
+        host is still writing, and restore never sees a partial dump."""
+        from .checkpoint import save_server_model
+
+        tmp = f"{path}.writing"
+        pidx, pcount = jax.process_index(), jax.process_count()
+        if pidx == 0 and os.path.exists(tmp):
+            shutil.rmtree(tmp)
+        if self.trainer.num_shards > 1:
+            from .parallel.checkpoint import save_sharded
+            save_sharded(snapshot, self.model, tmp,
+                         include_optimizer=self.include_optimizer,
+                         num_shards=self.trainer.num_shards)
+        else:
+            save_server_model(snapshot, self.model, tmp,
+                              include_optimizer=self.include_optimizer,
+                              num_shards=self.trainer.num_shards)
+        with open(os.path.join(tmp, f"done.{pidx}"), "w") as f:
+            f.write(str(step))
+        if pidx != 0:
+            return  # process 0 owns the rename + COMMIT
+        deadline = time.monotonic() + self.commit_timeout
+        while True:
+            done = [p for p in range(pcount)
+                    if os.path.exists(os.path.join(tmp, f"done.{p}"))]
+            if len(done) == pcount:
+                break
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"persist commit: only {len(done)}/{pcount} processes "
+                    f"finished writing {tmp!r} within {self.commit_timeout}s")
+            time.sleep(0.05)
+        # an existing dir at `path` — a crash between replace and COMMIT, or a
+        # committed persist of the same step from a previous run — would make
+        # os.replace fail with ENOTEMPTY forever; the fresh persist supersedes
+        if os.path.exists(path):
+            shutil.rmtree(path)
+        os.replace(tmp, path)
+        with open(os.path.join(path, COMMIT_FILE), "w") as f:
+            f.write(str(step))
 
     def _gc(self) -> None:
         persists = list_persists(self.root)
@@ -229,10 +274,12 @@ def restore_server_model(state, model, root: str, *, trainer=None):
     """Restore the newest COMMITTED persist under `root` (crash-consistent:
     uncommitted directories are ignored; reference `restore_server_model`,
     `exb.py:703-705`)."""
-    from .checkpoint import load_server_model
-
     path = latest_persist(root)
     if path is None:
         raise FileNotFoundError(f"no committed persist under {root!r}")
     num_shards = trainer.num_shards if trainer is not None else 1
+    from .parallel.checkpoint import checkpoint_layout, load_sharded
+    if checkpoint_layout(path) == "sharded":
+        return load_sharded(state, model, path, num_shards=num_shards)
+    from .checkpoint import load_server_model
     return load_server_model(state, model, path, num_shards=num_shards)

@@ -57,6 +57,60 @@ def np_mix(ids):
     return u ^ (u >> np.uint32(16))
 
 
+def np_hash_insert(keys, ids, num_shards: int,
+                   num_probes: int = DEFAULT_NUM_PROBES):
+    """Vectorized host-side insertion of checkpointed keys into a (possibly
+    different) shard layout, same probe sequence as the device kernel: owner
+    shard = id % S, base = np_mix(id) % capacity_per_shard, linear probing
+    inside the owner's slot range. `keys` ((S*cps,) np array, EMPTY = -1) is
+    mutated; `ids` must be unique and non-negative. Returns the global slot per
+    id (-1 = dropped: no empty slot within `num_probes`).
+
+    Replaces a per-id Python loop (a 10^8-row restore would take hours,
+    reference load streams batched inserts, `EmbeddingLoadOperator.cpp:58-111`).
+    One round per probe distance, all pending ids at once; among ids contending
+    for the same empty slot the lowest-index wins (the sequential insertion
+    order), losers advance — their probed slot is occupied from then on, so the
+    resulting placement is a valid open-addressing state: every slot on an id's
+    probe path before its final position is non-empty, which is exactly the
+    invariant `hash_find` needs.
+
+    `num_probes` deliberately defaults to the device kernel's probe budget:
+    placing a row deeper than `hash_find` ever probes would make it silently
+    unreachable — better to drop it and count it in overflow.
+    """
+    import numpy as np
+
+    rows_total = keys.shape[0]
+    cps = rows_total // num_shards
+    owner = (np.asarray(ids, np.int64) % num_shards) * cps
+    mixed = np_mix(ids)
+    base = (mixed % np.uint64(cps) if ids.dtype.itemsize >= 8
+            else mixed % np.uint32(cps)).astype(np.int64)
+    pos_out = np.full(len(ids), -1, np.int64)
+    max_d = min(num_probes, cps)
+    active = np.arange(len(ids))
+    dist = np.zeros(len(ids), np.int64)
+    while active.size:
+        p = owner[active] + (base[active] + dist[active]) % cps
+        empty = keys[p] == EMPTY
+        cand, cp = active[empty], p[empty]
+        order = np.argsort(cp, kind="stable")
+        cp_s, cand_s = cp[order], cand[order]
+        first = np.ones(cp_s.size, bool)
+        if cp_s.size:
+            first[1:] = cp_s[1:] != cp_s[:-1]
+        win, wp = cand_s[first], cp_s[first]
+        keys[wp] = ids[win]
+        pos_out[win] = wp
+        placed = np.zeros(len(ids), bool)
+        placed[win] = True
+        rem = active[~placed[active]]
+        dist[rem] += 1
+        active = rem[dist[rem] < max_d]
+    return pos_out
+
+
 def hash_find_or_insert(keys: jax.Array, ids: jax.Array,
                         num_probes: int = DEFAULT_NUM_PROBES
                         ) -> Tuple[jax.Array, jax.Array, jax.Array]:

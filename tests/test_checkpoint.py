@@ -121,9 +121,16 @@ def test_hash_table_topology_change(tmp_path):
                             jnp.asarray(ids)))
     want = np.asarray(tr_mesh.jit_eval_step(batch, state)(
         state, batch))  # not comparable directly; instead compare via mesh lookup
-    # simpler oracle: compacted dump itself
-    dumped_ids = np.load(tmp_path / "ckpt" / "variable_0" / "ids.npy")
-    dumped_w = np.load(tmp_path / "ckpt" / "variable_0" / "weights.npy")
+    # simpler oracle: the compacted dump itself (MeshTrainer.save writes the
+    # per-shard streaming layout, one id-sorted (ids, weights) pair per shard)
+    import os
+    vdir = tmp_path / "ckpt" / "variable_0"
+    dumped_ids, dumped_w = [], []
+    for sd in sorted(os.listdir(vdir)):
+        dumped_ids.append(np.load(vdir / sd / "ids.npy"))
+        dumped_w.append(np.load(vdir / sd / "weights.npy"))
+    dumped_ids = np.concatenate(dumped_ids)
+    dumped_w = np.concatenate(dumped_w)
     lut = {int(i): dumped_w[k] for k, i in enumerate(dumped_ids)}
     for k, i in enumerate(ids):
         np.testing.assert_array_equal(got[k], lut[int(i)], err_msg=f"id {i}")

@@ -1,0 +1,335 @@
+"""Sharded streaming checkpoint (`parallel/checkpoint.py`): per-shard files,
+bounded host memory, reshard-on-load at any mesh size, async persist interop.
+
+Reference parity targets: per-shard dump streams
+(`server/EmbeddingDumpOperator.cpp:36-96`), coordinated per-node load
+(`client/Model.cpp:89-134`), topology-change restore (np=2 -> np=8 e2e sweep,
+`build.sh:91-150`), batched key re-insertion (`EmbeddingLoadOperator.cpp:58-111`).
+"""
+
+import os
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import openembedding_tpu as embed
+from openembedding_tpu.parallel import (MeshTrainer, load_sharded, make_mesh,
+                                        save_sharded, snapshot_addressable,
+                                        checkpoint_layout)
+
+S = 8
+
+
+class TinyDense(nn.Module):
+    @nn.compact
+    def __call__(self, embedded, dense_inputs):
+        parts = [embedded[k].reshape(embedded[k].shape[0], -1)
+                 for k in sorted(embedded)]
+        x = jnp.concatenate(parts, axis=-1)
+        return nn.Dense(1)(x)[:, 0]
+
+
+def make_batch(rng, vocab, B, hash_ids=False):
+    if hash_ids:
+        ids = rng.integers(0, 2**61, size=(B, 3), dtype=np.int64)
+    else:
+        ids = rng.integers(0, vocab, size=(B, 3))
+    y = (ids.sum(axis=1) % 2).astype(np.float32)
+    return {"sparse": {"emb": jnp.asarray(ids)}, "label": jnp.asarray(y)}
+
+
+def build(vocab, trainer_cls, capacity=0, **kw):
+    layer = embed.Embedding(vocab, 8, name="emb", capacity=capacity)
+    model = embed.EmbeddingModel(TinyDense(), [layer])
+    return embed.Trainer(model, optimizer=embed.Adagrad(learning_rate=0.05)) \
+        if trainer_cls is embed.Trainer else \
+        trainer_cls(model, optimizer=embed.Adagrad(learning_rate=0.05), **kw)
+
+
+def train_some(trainer, batch, steps=6, mesh=True):
+    state = trainer.init(batch)
+    step = (trainer.jit_train_step(batch, state) if mesh
+            else trainer.jit_train_step())
+    for _ in range(steps):
+        state, m = step(state, batch)
+    return state, m
+
+
+def all_rows(trainer, state, ids):
+    """id-major rows via the trainer's own lookup path."""
+    spec = trainer.model.specs["emb"]
+    if isinstance(trainer, MeshTrainer):
+        eval_fn = trainer.jit_eval_step  # noqa: F841 (compiled elsewhere)
+        # use the sharded read-only pull through a tiny jit
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def pull(st, i):
+            return trainer.table_lookup(spec, st.tables["emb"], i)
+
+        shard = jax.shard_map(
+            pull, mesh=trainer.mesh,
+            in_specs=(trainer._state_pspec_tree(state),
+                      P(trainer.mesh.axis_names[0])),
+            out_specs=P(trainer.mesh.axis_names[0]),
+            check_vma=False)
+        return np.asarray(jax.jit(shard)(state, jnp.asarray(ids)))
+    from openembedding_tpu.embedding import lookup
+    return np.asarray(lookup(spec, state.tables["emb"], jnp.asarray(ids)))
+
+
+# ---------------------------------------------------------------------------
+# array tables
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_roundtrip_array_same_mesh(tmp_path):
+    rng = np.random.default_rng(0)
+    vocab = 201  # not divisible by 8: padding rows in play
+    mesh = make_mesh()
+    tr = build(vocab, MeshTrainer, mesh=mesh)
+    batch = make_batch(rng, vocab, 16 * S)
+    state, _ = train_some(tr, batch)
+
+    stats = {}
+    save_sharded(state, tr.model, str(tmp_path), num_shards=S,
+                 chunk_rows=7, _stats=stats)
+    assert checkpoint_layout(str(tmp_path)) == "sharded"
+    # per-shard files on disk, not one big table
+    vdir = tmp_path / "variable_0"
+    shard_dirs = sorted(os.listdir(vdir))
+    assert len(shard_dirs) == S and shard_dirs[0] == "shard_00000_of_00008"
+    # bounded host memory: no chunk bigger than chunk_rows ever materialized
+    assert 0 < stats["max_host_rows"] <= 7
+
+    tr2 = build(vocab, MeshTrainer, mesh=mesh)
+    state2 = tr2.init(batch)
+    restored = load_sharded(state2, tr2.model, str(tmp_path), num_shards=S)
+    ids = np.arange(vocab)
+    np.testing.assert_array_equal(all_rows(tr, state, np.tile(ids, 2)[:208]),
+                                  all_rows(tr2, restored,
+                                           np.tile(ids, 2)[:208]))
+    # optimizer slots restored exactly: one more identical step stays identical
+    s1, m1 = tr.jit_train_step(batch, state)(state, batch)
+    s2, m2 = tr2.jit_train_step(batch, restored)(restored, batch)
+    np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]), rtol=1e-6)
+
+
+def test_sharded_mesh_to_single_and_back(tmp_path):
+    """8-way sharded dump -> single-device restore -> single-file dump -> 4-way
+    mesh restore: every row identical at every hop."""
+    rng = np.random.default_rng(1)
+    vocab = 97
+    mesh = make_mesh()
+    tr8 = build(vocab, MeshTrainer, mesh=mesh)
+    batch = make_batch(rng, vocab, 16 * S)
+    state8, _ = train_some(tr8, batch)
+    tr8.save(state8, str(tmp_path / "c8"))  # MeshTrainer.save = sharded
+    assert checkpoint_layout(str(tmp_path / "c8")) == "sharded"
+
+    tr1 = build(vocab, embed.Trainer)
+    state1 = tr1.init(batch)
+    restored1 = tr1.load(state1, str(tmp_path / "c8"))  # dispatches on layout
+    ids = np.arange(vocab)
+    want = all_rows(tr8, state8, np.tile(ids, 2)[:104])
+    np.testing.assert_array_equal(want, all_rows(tr1, restored1,
+                                                 np.tile(ids, 2)[:104]))
+
+    # sharded checkpoint restored at a DIFFERENT mesh size (8 -> 4)
+    mesh4 = make_mesh(jax.devices("cpu")[:4])
+    tr4 = build(vocab, MeshTrainer, mesh=mesh4)
+    batch4 = make_batch(rng, vocab, 16 * 4)
+    state4 = tr4.init(batch4)
+    restored4 = tr4.load(state4, str(tmp_path / "c8"))
+    np.testing.assert_array_equal(want[:100],
+                                  all_rows(tr4, restored4,
+                                           np.tile(ids, 2)[:100]))
+
+
+# ---------------------------------------------------------------------------
+# hash tables
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_hash_topology_change(tmp_path):
+    rng = np.random.default_rng(2)
+    mesh = make_mesh()
+    tr8 = build(-1, MeshTrainer, capacity=2048, mesh=mesh)
+    batch = make_batch(rng, -1, 16 * S, hash_ids=True)
+    state8, _ = train_some(tr8, batch)
+    trained_ids = np.unique(np.asarray(batch["sparse"]["emb"]).reshape(-1))
+
+    stats = {}
+    save_sharded(state8, tr8.model, str(tmp_path), num_shards=S,
+                 chunk_rows=13, _stats=stats)
+    assert stats["max_host_rows"] <= 13
+    # compacted per-shard ids are id-sorted
+    ids0 = np.load(tmp_path / "variable_0" / "shard_00000_of_00008" / "ids.npy")
+    assert (np.diff(ids0) > 0).all()
+    # every shard's ids belong to it (id % S == shard)
+    assert (ids0 % S == 0).all()
+
+    # restore at 4-way mesh
+    mesh4 = make_mesh(jax.devices("cpu")[:4])
+    tr4 = build(-1, MeshTrainer, capacity=2048, mesh=mesh4)
+    batch4 = make_batch(rng, -1, 16 * 4, hash_ids=True)
+    state4 = tr4.init(batch4)
+    restored4 = tr4.load(state4, str(tmp_path))
+    pad = -(len(trained_ids) % -8)
+    probe = np.concatenate([trained_ids, trained_ids[:pad]])
+    np.testing.assert_array_equal(all_rows(tr8, state8, probe),
+                                  all_rows(tr4, restored4, probe))
+
+    # and into a single-device trainer
+    tr1 = build(-1, embed.Trainer, capacity=2048)
+    restored1 = tr1.load(tr1.init(batch), str(tmp_path))
+    np.testing.assert_array_equal(all_rows(tr8, state8, probe),
+                                  all_rows(tr1, restored1, probe))
+
+
+def test_overflow_counter_is_per_variable(tmp_path):
+    """A table that drops rows on restore (capacity pressure) must not leak its
+    drop count into other tables' overflow counters."""
+    rng = np.random.default_rng(7)
+    mesh = make_mesh()
+    # A: capacity so tight that a sharded restore must drop rows; B: roomy
+    la = embed.Embedding(-1, 4, name="a", capacity=64)
+    lb = embed.Embedding(-1, 4, name="b", capacity=4096)
+    model = embed.EmbeddingModel(TinyDense(), [la, lb])
+    tr = MeshTrainer(model, embed.Adagrad(learning_rate=0.1), mesh=mesh)
+    ids_a = rng.integers(0, 2**61, size=(16 * S, 2), dtype=np.int64)
+    ids_b = rng.integers(0, 2**61, size=(16 * S, 2), dtype=np.int64)
+    batch = {"sparse": {"a": jnp.asarray(ids_a), "b": jnp.asarray(ids_b)},
+             "label": jnp.asarray((ids_a.sum(1) % 2).astype(np.float32))}
+    state = tr.init(batch)
+    step = tr.jit_train_step(batch, state)
+    for _ in range(4):
+        state, _ = step(state, batch)
+    save_sharded(state, model, str(tmp_path), num_shards=S)
+
+    # restore table A into HALF the capacity: ~32 resident rows cannot fit in
+    # 32 slots minus probe collisions, so the restore must drop some
+    la2 = embed.Embedding(-1, 4, name="a", capacity=32)
+    lb2 = embed.Embedding(-1, 4, name="b", capacity=4096)
+    model2 = embed.EmbeddingModel(TinyDense(), [la2, lb2])
+    tr2 = MeshTrainer(model2, embed.Adagrad(learning_rate=0.1), mesh=mesh)
+    restored = load_sharded(tr2.init(batch), model2, str(tmp_path),
+                            num_shards=S)
+    a_over = int(np.asarray(restored.tables["a"].overflow))
+    b_over = int(np.asarray(restored.tables["b"].overflow))
+    assert a_over > 0  # the shrunken table really dropped rows
+    assert b_over == 0  # ...and did not contaminate the roomy one
+
+
+def test_np_hash_insert_vectorized_matches_sequential():
+    """The vectorized host insert must be a valid open-addressing placement with
+    the device kernel's probe sequence: every id findable, first-come slot wins."""
+    from openembedding_tpu.tables.hash_table import np_hash_insert, np_mix
+
+    def sequential(keys, ids, num_shards, num_probes=64):
+        cps = keys.shape[0] // num_shards
+        out = np.full(len(ids), -1, np.int64)
+        base = (np_mix(ids) % np.uint64(cps)).astype(np.int64)
+        for i in range(len(ids)):
+            start = int(ids[i] % num_shards) * cps
+            for d in range(min(num_probes, cps)):
+                p = start + (int(base[i]) + d) % cps
+                if keys[p] == -1:
+                    keys[p] = ids[i]
+                    out[i] = p
+                    break
+        return out
+
+    rng = np.random.default_rng(3)
+    for S_, cap, n in [(1, 64, 40), (4, 256, 150), (8, 64, 70)]:
+        ids = np.unique(rng.integers(0, 2**61, size=n, dtype=np.int64))
+        kv = np.full((cap,), -1, np.int64)
+        ks = kv.copy()
+        pv = np_hash_insert(kv, ids, S_)
+        ps = sequential(ks, ids, S_)
+        # Same per-shard fill: when the probe path covers the shard (cases
+        # chosen so min(64, cps) == cps), both strategies fill each shard to
+        # min(#owned, cps); under overload WHICH ids drop may differ (placement
+        # races resolve in a different order), but never HOW MANY.
+        cps = cap // S_
+        for sh in range(S_):
+            assert ((kv[sh * cps:(sh + 1) * cps] >= 0).sum()
+                    == (ks[sh * cps:(sh + 1) * cps] >= 0).sum())
+        assert (pv >= 0).sum() == (ps >= 0).sum()
+        if (ps >= 0).all():  # no drops: identical resident sets
+            np.testing.assert_array_equal(np.sort(kv), np.sort(ks))
+        # findability: every placed id sits in its owner's range on its own
+        # probe path with no EMPTY slot before it
+        cps = cap // S_
+        for i in np.nonzero(pv >= 0)[0]:
+            start = int(ids[i] % S_) * cps
+            base = int((np_mix(ids[i:i+1]) % np.uint64(cps))[0])
+            d = 0
+            while True:
+                p = start + (base + d) % cps
+                assert kv[p] != -1, "EMPTY slot on probe path before the id"
+                if kv[p] == ids[i]:
+                    break
+                d += 1
+                assert d < cps
+
+
+# ---------------------------------------------------------------------------
+# async persist through the sharded path
+# ---------------------------------------------------------------------------
+
+
+def test_persist_sharded_roundtrip(tmp_path):
+    from openembedding_tpu.persist import AsyncPersister, PersistPolicy
+
+    rng = np.random.default_rng(4)
+    vocab = 120
+    mesh = make_mesh()
+    tr = build(vocab, MeshTrainer, mesh=mesh)
+    batch = make_batch(rng, vocab, 16 * S)
+    state = tr.init(batch)
+    step = tr.jit_train_step(batch, state)
+
+    with AsyncPersister(tr, tr.model, str(tmp_path), window=2,
+                        policy=PersistPolicy(every_steps=2)) as p:
+        for _ in range(5):
+            state, _ = step(state, batch)
+            p.maybe_persist(state)
+        p.wait()
+        # snapshots are per-shard (layout "sharded" on disk)
+        from openembedding_tpu.persist import latest_persist
+        newest = latest_persist(str(tmp_path))
+        assert newest is not None and checkpoint_layout(newest) == "sharded"
+        rows_before = all_rows(tr, state, np.arange(120)[:120])
+
+        tr2 = build(vocab, MeshTrainer, mesh=mesh)
+        restored = p.restore(tr2.init(batch))
+    # restored state equals the persisted step's state: retrain remaining steps
+    assert int(restored.step) in (2, 4)
+    assert np.isfinite(rows_before).all()
+    # the newest persist was at step 4; stepping restored forward once works
+    step2 = tr2.jit_train_step(batch, restored)
+    restored, m = step2(restored, batch)
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_snapshot_addressable_isolated_from_donation(tmp_path):
+    """The host snapshot must be a COPY: donating the state to the next step
+    right after snapshotting must not corrupt the pending write."""
+    rng = np.random.default_rng(5)
+    mesh = make_mesh()
+    tr = build(64, MeshTrainer, mesh=mesh)
+    batch = make_batch(rng, 64, 16 * S)
+    state, _ = train_some(tr, batch, steps=2)
+    snap = snapshot_addressable(state, S)
+    rows_before = all_rows(tr, state, np.arange(64))
+    step = tr.jit_train_step(batch, state)
+    state, _ = step(state, batch)  # donates the snapshotted state's buffers
+    save_sharded(snap, tr.model, str(tmp_path), num_shards=S)
+    tr2 = build(64, MeshTrainer, mesh=mesh)
+    restored = load_sharded(tr2.init(batch), tr2.model, str(tmp_path),
+                            num_shards=S)
+    np.testing.assert_array_equal(rows_before, all_rows(tr2, restored,
+                                                        np.arange(64)))
