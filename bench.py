@@ -1,72 +1,313 @@
-"""Headline benchmark: DeepFM on synthetic Criteo, examples/sec/chip.
+"""Headline benchmark suite: DeepFM on synthetic Criteo, examples/sec/chip.
 
 Mirrors the reference's headline number (`documents/en/benchmark.md:41-56`): DeepFM,
-embedding dim 9, Adagrad, batch 4096/chip, Criteo-like Zipfian ids over a 2^24-row
-table. The reference reports 692k examples/s on 8x Tesla T4 + 1 remote PS =
-86.5k examples/s/chip, which is the `vs_baseline` denominator.
+Adagrad, batch 4096/chip, Criteo-like Zipfian ids over a 2^24-row table. The reference
+reports 692k examples/s on 8x Tesla T4 + 1 remote PS = 86.5k examples/s/chip, which is
+the `vs_baseline` denominator. The reference sweep also covers dim 64
+(`documents/en/benchmark.md:6-16`) and the north-star metric list includes
+embedding-pull p50 latency (BASELINE.md), so both are measured here too, plus the
+MeshTrainer path on a 1-device mesh (captures the dedup/bucket/all_to_all exchange
+overhead that the single-device Trainer path does not pay).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line on stdout:
+  {"metric", "value", "unit", "vs_baseline",            # primary: deepfm dim-9 ex/s/chip
+   "extra": {case: {...}},                              # secondary case results
+   "errors": {case: "..."},                             # failed/skipped secondaries
+   "stage": "...", "error": "..."}                      # only when the primary failed
+
+Robustness (the round-2 artifact was an undiagnosable rc=1 with no output):
+- per-stage progress lines on stderr with elapsed time, flushed immediately;
+- every TPU stage runs under a watchdog deadline — on expiry the partial result JSON
+  is printed and the process force-exits (rc 1 only if the primary case is missing);
+- each case retries once on jax UNAVAILABLE/INTERNAL runtime errors (transient axon
+  relay flakes) with a cool-down in between;
+- SIGTERM/SIGINT print the partial JSON before dying, so an external `timeout`
+  still yields a diagnosable artifact;
+- a wall-clock budget (OETPU_BENCH_BUDGET_S, default 540s) skips remaining
+  SECONDARY cases so the primary result always gets flushed well inside any
+  reasonable driver timeout.
+
+Known failure mode OUTSIDE this script's control: every Python interpreter in this
+image performs an axon TPU handshake at startup (`/root/.axon_site/sitecustomize.py`,
+before any bench.py line runs). When the relay is unhealthy that handshake hangs
+pre-main — the symptom is rc 124/143 with NO output at all, not even the boot line.
+That is an environment outage, not a repo defect; re-run when the relay recovers.
 
 Measurement: K train steps are fused into one compiled program with lax.scan
 (`Trainer.jit_train_many`) over device-staged batches, so the number is device
 throughput, not host dispatch latency — the same way production input pipelines
-drive TPUs (and the axon tunnel here adds ~40 ms per dispatch that would otherwise
-swamp the measurement; stage-level timings in tools/step_profile.py corroborate).
+drive TPUs (the axon tunnel adds ~40 ms per dispatch that would otherwise swamp
+the measurement; see PERF.md "Measurement hygiene").
+
+Env knobs: OETPU_BENCH_CASES=dim9[,dim64][,mesh1][,pull] (default: all),
+OETPU_BENCH_BUDGET_S (default 540), OETPU_BENCH_SCAN_STEPS / _REPEATS (smoke runs).
 """
 
 import json
+import os
+import signal
 import sys
+import threading
 import time
 
 import numpy as np
 
-BATCH = 4096
-VOCAB = 1 << 24
-DIM = 9
-SCAN_STEPS = 50
-REPEATS = 3
-BASELINE_PER_CHIP = 692_000 / 8  # reference Criteo-1TB DeepFM, per chip
+BATCH = int(os.environ.get("OETPU_BENCH_BATCH", "4096"))
+VOCAB = int(os.environ.get("OETPU_BENCH_VOCAB", str(1 << 24)))
+SCAN_STEPS = int(os.environ.get("OETPU_BENCH_SCAN_STEPS", "50"))
+REPEATS = int(os.environ.get("OETPU_BENCH_REPEATS", "3"))
+BUDGET_S = float(os.environ.get("OETPU_BENCH_BUDGET_S", "540"))
+BASELINE_PER_CHIP = 692_000 / 8  # reference Criteo-1TB DeepFM dim 9, per chip
+PULL_SCAN = 64  # pulls fused per dispatch for the p50 case
+
+T0 = time.time()
+RESULT = {"metric": "deepfm_dim9_examples_per_sec_per_chip", "value": None,
+          "unit": "examples/s/chip", "vs_baseline": None}
+EXTRA = {}
+ERRORS = {}
+_STAGE = ["boot"]
+_EMITTED = [False]
 
 
-def main():
+def log(msg):
+    print(f"[bench t={time.time() - T0:6.1f}s] {msg}", file=sys.stderr, flush=True)
+
+
+def emit(rc=None):
+    """Print the single stdout JSON line (idempotent) and return an exit code."""
+    if not _EMITTED[0]:
+        _EMITTED[0] = True
+        out = dict(RESULT)
+        if EXTRA:
+            out["extra"] = EXTRA
+        if ERRORS:
+            out["errors"] = ERRORS
+        if out["value"] is None:
+            out["stage"] = _STAGE[0]
+            out.setdefault("error", ERRORS.get("dim9", "did not reach measurement"))
+        print(json.dumps(out), flush=True)
+    return (1 if RESULT["value"] is None else 0) if rc is None else rc
+
+
+class Watchdog:
+    """Deadline enforcer for TPU stages: a hung collective/compile through the axon
+    tunnel blocks the main thread in C++ (uninterruptible by signals), so on expiry
+    the partial result is flushed and the process hard-exits."""
+
+    def __init__(self):
+        self._deadline = None
+        self._lock = threading.Lock()
+        t = threading.Thread(target=self._run, daemon=True)
+        t.start()
+
+    def stage(self, name, timeout_s):
+        _STAGE[0] = name
+        with self._lock:
+            self._deadline = time.time() + timeout_s
+        log(f"stage={name} (timeout {timeout_s:.0f}s)")
+
+    def clear(self):
+        with self._lock:
+            self._deadline = None
+
+    def _run(self):
+        while True:
+            time.sleep(1.0)
+            with self._lock:
+                d = self._deadline
+            if d is not None and time.time() > d:
+                log(f"WATCHDOG: stage {_STAGE[0]!r} exceeded its deadline")
+                ERRORS.setdefault(_STAGE[0].split(":")[0],
+                                  f"watchdog timeout in {_STAGE[0]}")
+                rc = emit()
+                sys.stderr.flush()
+                os._exit(rc)
+
+
+WD = Watchdog()
+
+
+def _on_signal(signum, frame):
+    log(f"received signal {signum}")
+    ERRORS.setdefault(_STAGE[0].split(":")[0], f"killed by signal {signum}")
+    os._exit(emit())
+
+
+signal.signal(signal.SIGTERM, _on_signal)
+signal.signal(signal.SIGINT, _on_signal)
+
+
+def _retryable(e):
+    s = str(e)
+    return "UNAVAILABLE" in s or "INTERNAL" in s or "DEADLINE_EXCEEDED" in s
+
+
+def run_case(name, fn, attempts=2, cooldown_s=20):
+    for attempt in range(attempts):
+        try:
+            WD.stage(f"{name}:start", 60)
+            out = fn()
+            WD.clear()
+            EXTRA[name] = out
+            ERRORS.pop(name, None)
+            log(f"case {name} OK: {out}")
+            return out
+        except Exception as e:  # noqa: BLE001 — recorded, not swallowed
+            WD.clear()
+            ERRORS[name] = f"{type(e).__name__}: {e}"[:500]
+            log(f"case {name} attempt {attempt + 1} FAILED: {ERRORS[name]}")
+            if attempt + 1 < attempts and _retryable(e):
+                log(f"retrying {name} after {cooldown_s}s cool-down")
+                time.sleep(cooldown_s)
+            else:
+                return None
+
+
+def _stacked_batches(dim_unused, steps, ids_dtype=np.int32, seed=7):
     import jax
-    import openembedding_tpu as embed
-    from openembedding_tpu.model import Trainer
-    from openembedding_tpu.models import make_deepfm
     from openembedding_tpu.data import synthetic_criteo
-
-    model = make_deepfm(vocabulary=VOCAB, dim=DIM)
-    trainer = Trainer(model, embed.Adagrad(learning_rate=0.05))
-
-    # int32 ids: keep x64 off on TPU (VOCAB < 2^31); stack K batches on device
-    batches = list(synthetic_criteo(BATCH, id_space=VOCAB, steps=SCAN_STEPS,
-                                    seed=7, ids_dtype=np.int32))
+    batches = list(synthetic_criteo(BATCH, id_space=VOCAB, steps=steps,
+                                    seed=seed, ids_dtype=ids_dtype))
     stacked = jax.device_put(jax.tree_util.tree_map(
         lambda *xs: np.stack(xs), *batches))
+    return batches, stacked
 
-    state = trainer.init(batches[0])
-    many = trainer.jit_train_many()
 
-    # warmup (compile) + fence via a scalar that depends on the whole scan
+def _measure_many(name, many, state, stacked):
+    WD.stage(f"{name}:compile", 420)
     state, metrics = many(state, stacked)
-    float(metrics["loss"][-1])
-
+    loss = float(metrics["loss"][-1])  # fence: forces the whole scan
+    log(f"{name}: compile+warmup done, loss={loss:.4f}")
+    WD.stage(f"{name}:measure", 240)
     best = None
     for _ in range(REPEATS):
         t0 = time.perf_counter()
         state, metrics = many(state, stacked)
-        loss = float(metrics["loss"][-1])  # forces the round trip
+        loss = float(metrics["loss"][-1])
         dt = time.perf_counter() - t0
         best = dt if best is None else min(best, dt)
-
-    examples_per_sec = BATCH * SCAN_STEPS / best
     assert np.isfinite(loss), f"non-finite loss {loss}"
-    print(json.dumps({
-        "metric": "deepfm_dim9_examples_per_sec_per_chip",
-        "value": round(examples_per_sec, 1),
-        "unit": "examples/s/chip",
-        "vs_baseline": round(examples_per_sec / BASELINE_PER_CHIP, 3),
-    }))
+    return BATCH * SCAN_STEPS / best
+
+
+def case_trainer(dim):
+    import openembedding_tpu as embed
+    from openembedding_tpu.model import Trainer
+    from openembedding_tpu.models import make_deepfm
+
+    name = f"dim{dim}"
+    WD.stage(f"{name}:init", 240)
+    model = make_deepfm(vocabulary=VOCAB, dim=dim)
+    trainer = Trainer(model, embed.Adagrad(learning_rate=0.05))
+    # int32 ids: keep x64 off on TPU (VOCAB < 2^31)
+    batches, stacked = _stacked_batches(dim, SCAN_STEPS)
+    state = trainer.init(batches[0])
+    eps = _measure_many(name, trainer.jit_train_many(), state, stacked)
+    return {"examples_per_sec_per_chip": round(eps, 1),
+            "vs_baseline_dim9": round(eps / BASELINE_PER_CHIP, 3)}
+
+
+def case_mesh1():
+    """MeshTrainer on a 1-device mesh: same workload as dim9, but through the full
+    sharded pull/push protocol (dedup -> owner bucketing -> all_to_all -> fused
+    apply, `parallel/sharded.py`) — the honest number for the multi-chip path's
+    per-chip overhead."""
+    import jax
+    import openembedding_tpu as embed
+    from openembedding_tpu.models import make_deepfm
+    from openembedding_tpu.parallel import MeshTrainer, make_mesh
+
+    WD.stage("mesh1:init", 240)
+    model = make_deepfm(vocabulary=VOCAB, dim=9)
+    mesh = make_mesh(jax.devices()[:1])
+    trainer = MeshTrainer(model, embed.Adagrad(learning_rate=0.05), mesh=mesh)
+    batches, stacked = _stacked_batches(9, SCAN_STEPS)
+    state = trainer.init(batches[0])
+    many = trainer.jit_train_many(stacked, state)
+    eps = _measure_many("mesh1", many, state, stacked)
+    return {"examples_per_sec_per_chip": round(eps, 1),
+            "vs_baseline_dim9": round(eps / BASELINE_PER_CHIP, 3)}
+
+
+def case_pull():
+    """Embedding-pull p50 (BASELINE.md metric). A pull = the serving/forward read:
+    dedup + row gather for one 4096x26 Zipfian batch against the 2^24-row dim-9
+    table. PULL_SCAN pulls over DISTINCT id batches are fused into one program
+    (distinct batches so XLA cannot CSE them away); per-pull latency = program
+    time / PULL_SCAN; p50 is the median over dispatch repeats. This is device
+    latency — the reference's p50 additionally includes its PS RPC wire time,
+    while ours has no wire (the table is in local HBM)."""
+    import jax
+    import jax.numpy as jnp
+    import openembedding_tpu as embed
+    from openembedding_tpu.embedding import lookup
+    from openembedding_tpu.model import Trainer
+    from openembedding_tpu.models import make_deepfm
+
+    WD.stage("pull:init", 240)
+    model = make_deepfm(vocabulary=VOCAB, dim=9)
+    trainer = Trainer(model, embed.Adagrad(learning_rate=0.05))
+    batches, _ = _stacked_batches(9, 1)
+    state = trainer.init(batches[0])
+    (name, spec), = model.ps_specs().items()
+    table = state.tables[name]
+
+    ids = np.stack([b["sparse"][name] for b in
+                    _stacked_batches(9, PULL_SCAN, seed=11)[0]])
+    ids = jax.device_put(ids.astype(np.int32))
+
+    def pulls(table, all_ids):
+        def body(acc, ids):
+            rows = lookup(spec, table, ids)
+            return acc + rows.astype(jnp.float32).sum(), None
+        acc, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), all_ids)
+        return acc
+
+    jpulls = jax.jit(pulls)
+    WD.stage("pull:compile", 300)
+    float(jpulls(table, ids))
+    WD.stage("pull:measure", 240)
+    times = []
+    for _ in range(max(REPEATS, 5)):
+        t0 = time.perf_counter()
+        float(jpulls(table, ids))
+        times.append((time.perf_counter() - t0) / PULL_SCAN)
+    p50_us = float(np.median(times) * 1e6)
+    return {"pull_p50_us": round(p50_us, 1), "batch": BATCH,
+            "fields": int(ids.shape[-1]), "scan": PULL_SCAN}
+
+
+def main():
+    WD.stage("boot", 300)
+    log(f"python up; initializing backend (platform={os.environ.get('JAX_PLATFORMS')})")
+    import jax
+    devs = jax.devices()
+    log(f"devices: {devs}")
+    EXTRA["platform"] = devs[0].platform
+
+    cases = os.environ.get("OETPU_BENCH_CASES", "dim9,dim64,mesh1,pull").split(",")
+
+    # PRIMARY first: whatever happens later, this number is in the artifact.
+    if "dim9" in cases:
+        out = run_case("dim9", lambda: case_trainer(9))
+        if out:
+            RESULT["value"] = out["examples_per_sec_per_chip"]
+            RESULT["vs_baseline"] = out["vs_baseline_dim9"]
+
+    secondary = [("dim64", lambda: case_trainer(64)),
+                 ("mesh1", case_mesh1),
+                 ("pull", case_pull)]
+    for name, fn in secondary:
+        if name not in cases:
+            continue
+        if time.time() - T0 > BUDGET_S:
+            ERRORS[name] = f"skipped: over wall-clock budget ({BUDGET_S:.0f}s)"
+            log(ERRORS[name])
+            continue
+        run_case(name, fn)
+
+    WD.clear()
+    return emit()
 
 
 if __name__ == "__main__":
