@@ -117,6 +117,22 @@ class Watchdog:
                 d = self._deadline
             if d is not None and time.time() > d:
                 log(f"WATCHDOG: stage {_STAGE[0]!r} exceeded its deadline")
+                if (_STAGE[0] == "boot"
+                        and not os.environ.get("OETPU_BENCH_RETRIED")):
+                    # A hung backend claim sits in C++ and cannot be recovered
+                    # in-process; one whole-process retry (execve replaces the
+                    # stuck threads) often succeeds on a flaky relay. Nothing
+                    # has been printed to stdout yet, so the ONE-line contract
+                    # holds: only the final process emits JSON.
+                    log("boot hang: re-exec'ing once for a fresh backend claim")
+                    sys.stderr.flush()
+                    env = dict(os.environ, OETPU_BENCH_RETRIED="1")
+                    try:
+                        os.execve(sys.executable,
+                                  [sys.executable] + list(sys.argv), env)
+                    except OSError as e:
+                        # fall through to the normal emit+exit guarantee
+                        log(f"re-exec failed ({e}); emitting partial result")
                 ERRORS.setdefault(_STAGE[0].split(":")[0],
                                   f"watchdog timeout in {_STAGE[0]}")
                 rc = emit()
@@ -278,7 +294,7 @@ def case_pull():
 
 
 def main():
-    WD.stage("boot", 300)
+    WD.stage("boot", 240)
     log(f"python up; initializing backend (platform={os.environ.get('JAX_PLATFORMS')})")
     import jax
     devs = jax.devices()

@@ -70,6 +70,10 @@ def main():
     ap.add_argument("--steps", type=int, default=100)
     ap.add_argument("--mesh", action="store_true",
                     help="MeshTrainer over all visible devices")
+    ap.add_argument("--offload", type=int, default=0, metavar="SLOTS",
+                    help="train the table bigger than HBM: keep a SLOTS-row "
+                         "device cache, full table in host RAM "
+                         "(storage='host_cached', tables/host_offload.py)")
     ap.add_argument("--cache", type=int, default=0,
                     help="sparse_as_dense for vocab <= N (reference --cache)")
     ap.add_argument("--prefetch", action="store_true")
@@ -95,6 +99,13 @@ def main():
         model.specs["categorical"] = dataclasses.replace(
             spec, sparse_as_dense=True)
         print(f"cache mode: categorical ({args.vocabulary}) is dense-mirrored")
+    if args.offload > 0:
+        import dataclasses
+        spec = model.specs["categorical"]
+        model.specs["categorical"] = dataclasses.replace(
+            spec, input_dim=-1, capacity=args.offload, storage="host_cached")
+        print(f"offload mode: {args.offload}-row device cache, "
+              "full table in host RAM")
 
     opt = OPTIMIZERS[args.optimizer](args.learning_rate)
     if args.mesh:
@@ -135,10 +146,12 @@ def main():
     reporter = M.PeriodicReporter(args.report_interval).start()
     all_labels, all_scores = [], []
     t0 = time.perf_counter()
+    state = trainer.offload_prepare(state, first)
     state, m = step(state, first)
     for i in range(1, args.steps):
         batch = next(batches)
         with M.vtimer("train", "step"):
+            state = trainer.offload_prepare(state, batch)
             state, m = step(state, batch)
         all_labels.append(np.asarray(batch["label"]))
         all_scores.append(np.asarray(m["logits"]).reshape(-1))
@@ -147,6 +160,14 @@ def main():
             persister.maybe_persist(state)
         if i % 20 == 0:
             print(f"step {i}: loss {float(m['loss']):.4f}")
+            # the static-capacity divergence must be *managed*, not just
+            # counted: surface dropped ids as they happen (see also the
+            # pull/push_overflow step stats on the mesh path)
+            for name, ts in state.tables.items():
+                if ts.overflow is not None and int(ts.overflow) > 0:
+                    print(f"  WARNING: {name}: {int(ts.overflow)} ids have "
+                          "overflowed the hash capacity (rows dropped) — "
+                          "raise capacity or capacity_factor")
     loss = float(m["loss"])  # fences the device work
     dt = time.perf_counter() - t0
     reporter.stop()
@@ -168,7 +189,8 @@ def main():
     if args.export:
         from openembedding_tpu.export import export_standalone
         export_standalone(state, model, args.export,
-                          num_shards=getattr(trainer, "num_shards", 1))
+                          num_shards=getattr(trainer, "num_shards", 1),
+                          offload_stores=trainer.offload_store_snapshots(state))
         print(f"standalone serving export -> {args.export}")
 
 

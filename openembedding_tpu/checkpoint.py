@@ -53,7 +53,8 @@ def _unflatten_params(flat: Dict[str, np.ndarray]) -> Any:
 
 
 def save_server_model(state, model, path: str, *, include_optimizer: bool = True,
-                      model_sign: str = "", num_shards: int = 1) -> ModelMeta:
+                      model_sign: str = "", num_shards: int = 1,
+                      offload_stores: Optional[Dict[str, Any]] = None) -> ModelMeta:
     """Dump the full train state (reference: `exb.save_server_model` /
     `Model::dump_model`).
 
@@ -87,6 +88,17 @@ def save_server_model(state, model, path: str, *, include_optimizer: bool = True
         if spec.sparse_as_dense:
             # sad tables live (and are restored from) dense_params.npz; writing a
             # second copy here would just be dead weight on disk
+            continue
+        if offload_stores and name in offload_stores:
+            # host-cached variable: the synced host store IS the full table,
+            # already id-sorted — same on-disk shape as a hash table, so any
+            # trainer (offloaded or not) can load it
+            st = offload_stores[name]
+            np.save(os.path.join(vdir, "ids.npy"), st.ids)
+            np.save(os.path.join(vdir, "weights.npy"), st.weights)
+            if include_optimizer:
+                for slot_name, arr in st.slots.items():
+                    np.save(os.path.join(vdir, f"slot_{slot_name}.npy"), arr)
             continue
         ts = state.tables[name]
         if spec.use_hash_table:
@@ -166,7 +178,8 @@ def _check_meta(meta: ModelMeta, model) -> None:
                              f"{ckpt_meta} vs {spec.meta}")
 
 
-def load_server_model(state, model, path: str, *, num_shards: int = 1):
+def load_server_model(state, model, path: str, *, num_shards: int = 1,
+                      offload: Optional[Dict[str, Any]] = None):
     """Restore into an existing TrainState (reference: `exb.load_server_model` /
     `Model::load_model` — meta check, clear all weights, stream per-variable files).
 
@@ -196,6 +209,22 @@ def load_server_model(state, model, path: str, *, num_shards: int = 1):
         vdir = os.path.join(path, f"variable_{spec.variable_id}")
         ts = state.tables[name]
         _put = _put_like
+
+        if offload and name in offload:
+            # host-cached target: rows go to the host store (cache invalidated,
+            # rows re-admitted on demand) — the checkpoint's (ids, weights,
+            # slots) layout matches the store exactly
+            ot = offload[name]
+            ids = np.load(os.path.join(vdir, "ids.npy"))
+            w_rows = np.load(os.path.join(vdir, "weights.npy"))
+            slots = {}
+            for slot_name in ts.slots:
+                p = os.path.join(vdir, f"slot_{slot_name}.npy")
+                if os.path.exists(p):
+                    slots[slot_name] = np.load(p)
+            ot.load_store(ids, w_rows, slots)
+            new_tables[name] = ot.state
+            continue
 
         if spec.use_hash_table:
             from .tables.hash_table import np_hash_insert

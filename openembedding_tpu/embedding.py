@@ -61,6 +61,11 @@ class EmbeddingSpec:
     num_shards: int = -1                 # -1 -> all mesh devices
     sparse_as_dense: bool = False        # small tables: dense mirrored param instead
     capacity: int = 0                    # hash tables: slots per build; 0 = auto
+    # "hbm": the whole table lives in device memory. "host_cached": HBM holds a
+    # fixed-capacity cache (`capacity` slots) and the full table lives in host RAM
+    # (`tables/host_offload.py`) — tables larger than HBM, the reference's per-
+    # variable PMem table selection (`EmbeddingInitOperator.cpp:146-168`).
+    storage: str = "hbm"
     variable_id: int = -1
 
     def __post_init__(self):
@@ -68,6 +73,14 @@ class EmbeddingSpec:
             raise ValueError(f"invalid input_dim {self.input_dim}")
         if self.output_dim <= 0:
             raise ValueError(f"invalid output_dim {self.output_dim}")
+        if self.storage not in ("hbm", "host_cached"):
+            raise ValueError(f"invalid storage {self.storage!r} "
+                             "(expected 'hbm' or 'host_cached')")
+        if self.storage == "host_cached" and not self.use_hash_table:
+            raise ValueError(
+                f"embedding {self.name!r}: storage='host_cached' needs a "
+                "hash-table variable (input_dim=-1 + capacity) — the device "
+                "cache is keyed by id, not by dense row position")
 
     @property
     def use_hash_table(self) -> bool:
@@ -110,6 +123,7 @@ class EmbeddingSpec:
             "num_shards": self.num_shards,
             "sparse_as_dense": self.sparse_as_dense,
             "capacity": self.capacity,
+            "storage": self.storage,
             "variable_id": self.variable_id,
         }
 
@@ -206,7 +220,8 @@ class Embedding:
                  optimizer: Optional[SparseOptimizer] = None,
                  num_shards: int = -1,
                  sparse_as_dense: bool = False,
-                 capacity: int = 0):
+                 capacity: int = 0,
+                 storage: str = "hbm"):
         self.spec = EmbeddingSpec(
             name=name,
             input_dim=input_dim,
@@ -217,6 +232,7 @@ class Embedding:
             num_shards=num_shards,
             sparse_as_dense=sparse_as_dense,
             capacity=capacity,
+            storage=storage,
         )
 
     def __repr__(self):

@@ -37,11 +37,15 @@ EXPORT_CHUNK_ELEMS = 1 << 20
 
 
 def export_standalone(state, model: EmbeddingModel, path: str, *,
-                      num_shards: int = 1, model_sign: str = "") -> ModelMeta:
+                      num_shards: int = 1, model_sign: str = "",
+                      offload_stores: Optional[Dict[str, Any]] = None) -> ModelMeta:
     """Materialize every embedding variable into a self-contained directory.
 
     Weights only — never optimizer slots (parity: `save_as_original_model` exports a
     pure inference model). Hash tables export their resident (id, row) pairs.
+    `offload_stores` ({name: synced HostStore}) supplies the FULL table for
+    host-cached variables — the device state alone holds only cache-resident
+    rows; pass `trainer.offload_store_snapshots(state)`.
     """
     from .parallel.sharded import deinterleave_rows
 
@@ -64,6 +68,10 @@ def export_standalone(state, model: EmbeddingModel, path: str, *,
         if spec.sparse_as_dense:
             arr = np.asarray(state.dense_params["__embeddings__"][name])
             np.save(os.path.join(vdir, "weights.npy"), arr)
+        elif offload_stores and name in offload_stores:
+            st = offload_stores[name]  # host store = the whole table, id-sorted
+            np.save(os.path.join(vdir, "ids.npy"), st.ids)
+            np.save(os.path.join(vdir, "weights.npy"), st.weights)
         elif spec.use_hash_table:
             ts = state.tables[name]
             keys = np.asarray(ts.keys)

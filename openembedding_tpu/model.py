@@ -143,13 +143,18 @@ class Trainer:
         self.model = model
         self.optimizer = optimizer or Adagrad()
         self.seed = seed
+        # storage="host_cached" variables (tables/host_offload.py), filled by
+        # init_tables; empty when every table lives fully in HBM
+        self.offload: Dict[str, Any] = {}
 
     # -- checkpointing (reference: model.save/save_weights/load_weights wiring,
     #    `exb.py:550-583`) -------------------------------------------------------
     def save(self, state: "TrainState", path: str, **kw):
         from .checkpoint import save_server_model
         return save_server_model(state, self.model, path,
-                                 num_shards=self.num_shards, **kw)
+                                 num_shards=self.num_shards,
+                                 offload_stores=self.offload_store_snapshots(state),
+                                 **kw)
 
     def load(self, state: "TrainState", path: str):
         """Dispatches on the checkpoint layout: single-file (this class's save)
@@ -158,10 +163,63 @@ class Trainer:
         from .parallel.checkpoint import checkpoint_layout, load_sharded
         if checkpoint_layout(path) == "sharded":
             return load_sharded(state, self.model, path,
-                                num_shards=self.num_shards)
+                                num_shards=self.num_shards,
+                                offload=self.offload)
         from .checkpoint import load_server_model
         return load_server_model(state, self.model, path,
-                                 num_shards=self.num_shards)
+                                 num_shards=self.num_shards,
+                                 offload=self.offload)
+
+    # -- host offload drivers (storage="host_cached" variables) ---------------
+    #
+    # The reference picks the PMem-backed table per variable at init
+    # (`EmbeddingInitOperator.cpp:146-168`) and its cache admission rides pull
+    # requests server-side; here ids are known host-side from the input
+    # pipeline, so the Trainer drives the cache around the jitted step:
+    #
+    #     state = trainer.offload_prepare(state, batch)   # admit/flush
+    #     state, metrics = step(state, batch)             # pure device step
+    #
+    # For scan-fused multi-step driving (`jit_train_many`), pass the stacked
+    # batches: the union of the K batches' ids is admitted up front.
+
+    def offload_prepare(self, state: "TrainState", batch) -> "TrainState":
+        """Admit the batch's ids into each host-cached table's device cache
+        (flushing first if the cache would exceed its high-water mark) and
+        return the state with the refreshed cache tables. No-op without
+        host-cached variables."""
+        if not self.offload:
+            return state
+        new_tables = dict(state.tables)
+        for name, ot in self.offload.items():
+            ot.adopt(state.tables[name])
+            ot.prepare(batch["sparse"][name])
+            new_tables[name] = ot.state
+        return state.replace(tables=new_tables)
+
+    def offload_flush(self, state: "TrainState") -> "TrainState":
+        """Write every resident row back to the host store and reset the
+        caches (end of training / before handing tables elsewhere)."""
+        if not self.offload:
+            return state
+        new_tables = dict(state.tables)
+        for name, ot in self.offload.items():
+            ot.adopt(state.tables[name])
+            ot.flush()
+            new_tables[name] = ot.state
+        return state.replace(tables=new_tables)
+
+    def offload_store_snapshots(self, state: Optional["TrainState"] = None):
+        """{name: HostStore snapshot} with all resident rows written back —
+        what the checkpoint writers serialize for host-cached variables.
+        Empty dict when nothing is offloaded."""
+        out = {}
+        for name, ot in self.offload.items():
+            if state is not None:
+                ot.adopt(state.tables[name])
+            ot.sync_to_store()
+            out[name] = ot.store.snapshot()
+        return out
 
     def opt_for(self, spec: EmbeddingSpec) -> SparseOptimizer:
         return spec.optimizer or self.optimizer
@@ -204,10 +262,17 @@ class Trainer:
     def init_tables(self) -> Dict[str, EmbeddingTableState]:
         """Hook: single-device tables. MeshTrainer overrides to create the tables
         directly sharded (a huge table must never materialize on one device)."""
-        return {
-            name: init_table_state(spec, self.opt_for(spec), seed=self.seed)
-            for name, spec in self.model.ps_specs().items()
-        }
+        tables = {}
+        for name, spec in self.model.ps_specs().items():
+            if spec.storage == "host_cached":
+                from .tables.host_offload import HostOffloadTable
+                ot = HostOffloadTable(spec, self.opt_for(spec), seed=self.seed)
+                self.offload[name] = ot
+                tables[name] = ot.state
+            else:
+                tables[name] = init_table_state(spec, self.opt_for(spec),
+                                                seed=self.seed)
+        return tables
 
     def module_init(self, key, embedded, dense_inputs):
         return self.model.module.init(key, embedded, dense_inputs)

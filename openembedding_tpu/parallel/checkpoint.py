@@ -177,6 +177,7 @@ def snapshot_addressable(state, num_shards: int):
 def save_sharded(state, model, path: str, *, num_shards: int,
                  include_optimizer: bool = True, model_sign: str = "",
                  chunk_rows: int = DEFAULT_CHUNK_ROWS,
+                 offload_stores: Optional[Dict] = None,
                  _stats: Optional[dict] = None) -> ModelMeta:
     """Stream the train state to per-shard files. `state` may be a live (device)
     TrainState or a `snapshot_addressable` result. Each process writes its own
@@ -201,9 +202,24 @@ def save_sharded(state, model, path: str, *, num_shards: int,
         meta.variables.append(mv)
         if spec.sparse_as_dense:
             continue  # lives in dense_params.npz (see checkpoint.py)
-        ts = state.tables[name]
         vdir = os.path.join(path, f"variable_{spec.variable_id}")
         os.makedirs(vdir, exist_ok=True)
+        if offload_stores and name in offload_stores:
+            # host-cached variable: the synced host store is the whole table,
+            # process-global — written as ONE source shard (`src_shards` in the
+            # variable meta tells the loader; other variables keep the mesh's
+            # shard count)
+            mv.table["src_shards"] = 1
+            st = offload_stores[name]
+            sdir = os.path.join(vdir, "shard_00000_of_00001")
+            os.makedirs(sdir, exist_ok=True)
+            np.save(os.path.join(sdir, "ids.npy"), st.ids)
+            np.save(os.path.join(sdir, "weights.npy"), st.weights)
+            if include_optimizer:
+                for slot_name, arr in st.slots.items():
+                    np.save(os.path.join(sdir, f"slot_{slot_name}.npy"), arr)
+            continue
+        ts = state.tables[name]
         w_shards = dict(_row_shards(ts.weights, num_shards))
         slot_shards = {k: dict(_row_shards(v, num_shards))
                        for k, v in ts.slots.items()} if include_optimizer else {}
@@ -354,11 +370,14 @@ def _hash_sources_for_target(t: int, T: int, src_ids: Dict[int, np.ndarray]
     return ids, pos_by_src
 
 
-def load_sharded(state, model, path: str, *, num_shards: int = 1):
+def load_sharded(state, model, path: str, *, num_shards: int = 1,
+                 offload: Optional[Dict] = None):
     """Restore a sharded checkpoint into `state` at ANY target mesh size
     (`num_shards` = the layout of `state`). Per-target-shard assembly: peak
     host memory is one shard, never a table. Single-device targets
-    (num_shards=1) get plain arrays."""
+    (num_shards=1) get plain arrays. `offload` maps host-cached variable names
+    to their `HostOffloadTable`s: those variables restore into the host store
+    (cache invalidated) instead of device shards."""
     from ..tables.hash_table import np_hash_insert
     from ..checkpoint import _check_meta  # shared meta validation
 
@@ -368,7 +387,10 @@ def load_sharded(state, model, path: str, *, num_shards: int = 1):
     extra = json.loads(raw).get("extra", {})
     _check_meta(meta, model)
     T = num_shards
-    S = meta.num_shards
+    # host-cached variables dump ONE source shard whatever the mesh size
+    # (`save_sharded` records it in the variable meta)
+    src_shards_of = {v.storage_name: v.table.get("src_shards", meta.num_shards)
+                     for v in meta.variables}
 
     dense_npz = np.load(os.path.join(path, "dense_params.npz"))
     dense_params = _unflatten_params({k: dense_npz[k] for k in dense_npz.files})
@@ -384,11 +406,32 @@ def load_sharded(state, model, path: str, *, num_shards: int = 1):
             continue
         vdir = os.path.join(path, f"variable_{spec.variable_id}")
         src = _src_shard_dirs(vdir)
+        S = src_shards_of.get(name, meta.num_shards)
         if len(src) != S:
             raise ValueError(
                 f"variable {name!r}: checkpoint has {len(src)} shard dirs, "
                 f"meta says {S} — incomplete dump (missing process?)")
         ts = state.tables[name]
+        if offload and name in offload:
+            # host-cached target: concatenate every source shard's rows into
+            # the host store; rows re-admit on demand
+            ot = offload[name]
+            ids = np.concatenate([np.load(os.path.join(sdir, "ids.npy"))
+                                  for sdir in src.values()]) \
+                if src else np.empty((0,), np.int64)
+            w = np.concatenate([np.load(os.path.join(sdir, "weights.npy"))
+                                for sdir in src.values()]) \
+                if src else np.empty((0, spec.output_dim), np.float32)
+            slots = {}
+            for slot_name in ts.slots:
+                parts = [os.path.join(sdir, f"slot_{slot_name}.npy")
+                         for sdir in src.values()]
+                if all(os.path.exists(p) for p in parts) and parts:
+                    slots[slot_name] = np.concatenate(
+                        [np.load(p) for p in parts])
+            ot.load_store(ids, w, slots)
+            new_tables[name] = ot.state
+            continue
         dim = spec.output_dim
         sharded_target = (isinstance(ts.weights, jax.Array)
                           and T > 1)

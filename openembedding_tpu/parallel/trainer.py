@@ -53,7 +53,9 @@ class MeshTrainer(Trainer):
         `Trainer.load` / `MeshTrainer.load` restore it at any mesh size."""
         from .checkpoint import save_sharded
         return save_sharded(state, self.model, path,
-                            num_shards=self.num_shards, **kw)
+                            num_shards=self.num_shards,
+                            offload_stores=self.offload_store_snapshots(state),
+                            **kw)
 
     # -- sharding specs ------------------------------------------------------
 
@@ -104,6 +106,13 @@ class MeshTrainer(Trainer):
         mesh = self.mesh
         tables = {}
         for name, spec in self.model.ps_specs().items():
+            if spec.storage == "host_cached":
+                from ..tables.host_offload import HostOffloadTable
+                ot = HostOffloadTable(spec, self.opt_for(spec), seed=self.seed,
+                                      mesh=mesh, axis=self.axis)
+                self.offload[name] = ot
+                tables[name] = ot.state
+                continue
             opt = self.opt_for(spec)
             rows = spec.rows_per_shard(self.num_shards) * self.num_shards
 

@@ -145,8 +145,13 @@ class AsyncPersister:
                 snapshot = snapshot_addressable(state, self.trainer.num_shards)
             else:
                 snapshot = jax.device_get(state)
+            # host-cached tables: resident rows are synced into each host store
+            # and a decoupled copy rides along (later flushes mutate the live
+            # store in place; the writer thread must not see them)
+            stores = self.trainer.offload_store_snapshots(state) \
+                if getattr(self.trainer, "offload", None) else {}
         path = os.path.join(self.root, f"persist_{step:012d}")
-        self._q.put((snapshot, step, path))  # backpressure: pending window full
+        self._q.put((snapshot, stores, step, path))  # backpressure when full
         self.policy.mark(step)
         metrics.observe("persist.submitted", 1)
         return path
@@ -160,10 +165,10 @@ class AsyncPersister:
             item = self._q.get()
             if item is None:
                 return
-            snapshot, step, path = item
+            snapshot, stores, step, path = item
             try:
                 with metrics.vtimer("persist", "write"):
-                    self._write_one(snapshot, step, path)
+                    self._write_one(snapshot, stores, step, path)
                 metrics.observe("persist.committed", 1)
                 if jax.process_index() == 0:
                     self._gc()
@@ -172,7 +177,7 @@ class AsyncPersister:
             finally:
                 self._q.task_done()
 
-    def _write_one(self, snapshot, step: int, path: str) -> None:
+    def _write_one(self, snapshot, stores, step: int, path: str) -> None:
         """Write this process's shards into `<path>.writing`, then commit.
 
         Multi-host commit protocol (the reference's work-id commit,
@@ -192,11 +197,13 @@ class AsyncPersister:
             from .parallel.checkpoint import save_sharded
             save_sharded(snapshot, self.model, tmp,
                          include_optimizer=self.include_optimizer,
-                         num_shards=self.trainer.num_shards)
+                         num_shards=self.trainer.num_shards,
+                         offload_stores=stores)
         else:
             save_server_model(snapshot, self.model, tmp,
                               include_optimizer=self.include_optimizer,
-                              num_shards=self.trainer.num_shards)
+                              num_shards=self.trainer.num_shards,
+                              offload_stores=stores)
         with open(os.path.join(tmp, f"done.{pidx}"), "w") as f:
             f.write(str(step))
         if pidx != 0:
@@ -278,8 +285,11 @@ def restore_server_model(state, model, root: str, *, trainer=None):
     if path is None:
         raise FileNotFoundError(f"no committed persist under {root!r}")
     num_shards = trainer.num_shards if trainer is not None else 1
+    offload = getattr(trainer, "offload", None) or None
     from .parallel.checkpoint import checkpoint_layout, load_sharded
     if checkpoint_layout(path) == "sharded":
-        return load_sharded(state, model, path, num_shards=num_shards)
+        return load_sharded(state, model, path, num_shards=num_shards,
+                            offload=offload)
     from .checkpoint import load_server_model
-    return load_server_model(state, model, path, num_shards=num_shards)
+    return load_server_model(state, model, path, num_shards=num_shards,
+                             offload=offload)

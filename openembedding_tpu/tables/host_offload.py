@@ -108,6 +108,23 @@ class HostStore:
         return (self.ids.nbytes + self.weights.nbytes
                 + sum(v.nbytes for v in self.slots.values()))
 
+    def snapshot(self) -> "HostStore":
+        """Copy for async writers: `merge` mutates rows in place, so a store
+        handed to a persist worker thread must be decoupled from later flushes."""
+        out = HostStore.__new__(HostStore)
+        out.ids = self.ids.copy()
+        out.weights = self.weights.copy()
+        out.slots = {k: v.copy() for k, v in self.slots.items()}
+        return out
+
+    def replace_all(self, ids: np.ndarray, weights: np.ndarray,
+                    slots: Dict[str, np.ndarray]) -> None:
+        """Wholesale replacement (checkpoint load); ids must be unique."""
+        order = np.argsort(ids, kind="stable")
+        self.ids = ids[order].astype(np.int64)
+        self.weights = weights[order].astype(np.float32)
+        self.slots = {k: v[order].astype(np.float32) for k, v in slots.items()}
+
 
 def _admit_fn(state: EmbeddingTableState, ids, w_rows, s_rows, known):
     """Jitted: insert ALL `ids` into the cache (claiming slots); overwrite rows
@@ -135,13 +152,62 @@ def _admit_fn(state: EmbeddingTableState, ids, w_rows, s_rows, known):
     return new_state, admitted
 
 
+def _make_mesh_admit(mesh, axis, state_pspec, slot_names):
+    """shard_map'd admission for a row-sharded cache: each device claims only
+    the ids it owns (`id % S == shard_index`, the layout `parallel/sharded.py`
+    routes by) and probes its LOCAL key range — the same probe sequence the
+    in-step `hash_lookup_train` uses on that shard, so admitted rows are found
+    by the train step."""
+    from jax.sharding import PartitionSpec as P
+    from .hash_table import hash_find_or_insert
+
+    def admit(state, ids, w_rows, s_rows, known):
+        S = jax.lax.axis_size(axis)
+        idx = jax.lax.axis_index(axis)
+        keys = state.keys
+        mine = (ids >= 0) & ((ids % S).astype(jnp.int32) == idx)
+        probe = jnp.where(mine, ids, -1).astype(keys.dtype)
+        new_keys, slot, oflow = hash_find_or_insert(keys, probe)
+        cps = keys.shape[0]
+        admitted_local = mine & (slot < cps)
+        ok = known & admitted_local
+        target = jnp.where(ok, slot, cps)
+        weights = state.weights.at[target].set(
+            w_rows.astype(state.weights.dtype), mode="drop")
+        slots = {k: state.slots[k].at[target].set(
+            s_rows[k].astype(state.slots[k].dtype), mode="drop")
+            for k in state.slots}
+        admitted = jax.lax.psum(admitted_local.astype(jnp.int32), axis) > 0
+        overflow = state.overflow + jax.lax.psum(oflow, axis)
+        new_state = state.replace(keys=new_keys, weights=weights, slots=slots,
+                                  overflow=overflow)
+        return new_state, admitted
+
+    in_specs = (state_pspec, P(), P(), {k: P() for k in slot_names}, P())
+    out_specs = (state_pspec, P())
+    return jax.jit(jax.shard_map(admit, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_vma=False),
+                   donate_argnums=(0,))
+
+
 class HostOffloadTable:
     """Owns the device cache state between steps; see module docstring for the
     prepare -> step -> (rebind) protocol. `capacity` = device slots; the host
-    store is unbounded (host RAM)."""
+    store is unbounded (host RAM).
+
+    With `mesh`/`axis` the cache is row-sharded over the mesh exactly like a
+    normal `MeshTrainer` hash table (keys `P(axis)`, rows `P(axis, None)`) and
+    admission runs under shard_map; the host store stays process-global. The
+    reference's analogue selects the PMem-backed table per variable at init
+    (`EmbeddingInitOperator.cpp:146-168`) with a DRAM cache in front
+    (`PmemEmbeddingOptimizerVariable.h:88-198`). Multi-host note: `flush()`
+    gathers the cache with `np.asarray`, which requires the table to be
+    process-addressable — single-process meshes (one host driving its chips)
+    only; a per-process flush is the multi-host extension point."""
 
     def __init__(self, spec: EmbeddingSpec, optimizer: SparseOptimizer, *,
-                 seed: int = 0, high_water: float = 0.6):
+                 seed: int = 0, high_water: float = 0.6,
+                 mesh=None, axis=None):
         if not spec.use_hash_table:
             raise ValueError("host offload needs a hash-table spec "
                              "(input_dim=-1 + capacity)")
@@ -151,52 +217,133 @@ class HostOffloadTable:
         self.optimizer = optimizer
         self.seed = seed
         self.high_water = high_water
-        self.state = init_table_state(spec, optimizer, seed=seed)
+        self.mesh = mesh
+        self.axis = axis
+        self.num_shards = int(mesh.devices.size) if mesh is not None else 1
+        self._pspec = None
+        if mesh is not None:
+            from jax.sharding import PartitionSpec as P
+            # ONE copy of the mesh table layout (must agree with
+            # `MeshTrainer._table_pspec`): init shardings, admit in/out specs
+            self._pspec = EmbeddingTableState(
+                weights=P(axis, None),
+                slots={k: P(axis, None)
+                       for k in optimizer.slot_shapes(spec.output_dim)},
+                keys=P(axis), overflow=P())
+            self.state = self._init_sharded_state()
+        else:
+            self.state = init_table_state(spec, optimizer, seed=seed)
         self._fresh = jax.device_get(self.state)  # template for cache resets
+        self._shardings = jax.tree_util.tree_map(
+            lambda x: x.sharding, self.state)
         self.capacity = self.state.keys.shape[0]
+        self.rows_per_shard = self.capacity // self.num_shards
         self.store = HostStore(spec.output_dim,
                                optimizer.slot_shapes(spec.output_dim))
         self._resident: set = set()
-        self._admit = jax.jit(_admit_fn, donate_argnums=(0,))
+        # sorted twin of _resident for O(batch log cache) membership in
+        # prepare() — rebuilding an array from the set every step would cost
+        # O(occupancy) right when the cache is large (the feature's point)
+        self._resident_sorted = np.empty((0,), np.int64)
+        self._shard_counts = np.zeros((self.num_shards,), np.int64)
+        if mesh is not None:
+            self._admit = _make_mesh_admit(mesh, axis, self._pspec,
+                                           list(self.state.slots))
+        else:
+            self._admit = jax.jit(_admit_fn, donate_argnums=(0,))
+
+    def _init_sharded_state(self) -> EmbeddingTableState:
+        """Create the cache directly sharded (same recipe as
+        `MeshTrainer.init_tables`: jit + out_shardings, never materialized on
+        one device — though an offload cache is small by design)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        spec, opt = self.spec, self.optimizer
+        S = self.num_shards
+        rows = spec.rows_per_shard(S) * S
+
+        def mk():
+            key = jax.random.fold_in(jax.random.PRNGKey(self.seed),
+                                     spec.variable_id * 131071)
+            weights = spec.initializer(key, (rows, spec.output_dim), spec.dtype)
+            slots = opt.init_slots(rows, spec.output_dim)
+            keys = jnp.full((rows,), -1, jnp.int64)
+            overflow = jnp.zeros((), jnp.int32)
+            return EmbeddingTableState(weights=weights, slots=slots, keys=keys,
+                                       overflow=overflow)
+
+        shardings = jax.tree_util.tree_map(
+            lambda p: NamedSharding(self.mesh, p), self._pspec,
+            is_leaf=lambda x: isinstance(x, P))
+        return jax.jit(mk, out_shardings=shardings)()
 
     @property
     def resident_count(self) -> int:
         return len(self._resident)
 
+    def adopt(self, table_state: EmbeddingTableState) -> None:
+        """Take ownership of the (post-step) table pytree. The Trainer's jitted
+        step donates and replaces the arrays, so the Trainer hands the current
+        state back before every prepare/flush."""
+        self.state = table_state
+
+    def _would_exceed(self, new_ids: np.ndarray) -> bool:
+        """Per-shard high-water check: a hot shard can fill while global
+        occupancy is low (owner shard = id % S)."""
+        counts = self._shard_counts + np.bincount(
+            new_ids % self.num_shards, minlength=self.num_shards)
+        return bool((counts > self.high_water * self.rows_per_shard).any())
+
     def prepare(self, ids) -> None:
         """Make the cache ready for a batch: flush if needed, re-admit evicted
         ids. Call BEFORE the train step; rebind `self.state` after it."""
-        flat = np.unique(np.asarray(ids).reshape(-1))
+        flat = np.unique(np.asarray(ids).reshape(-1).astype(np.int64))
         flat = flat[flat >= 0]
-        new = [int(i) for i in flat if int(i) not in self._resident]
-        if not new:
+        if self._resident_sorted.size:
+            pos = np.searchsorted(self._resident_sorted, flat)
+            pos_c = np.minimum(pos, self._resident_sorted.size - 1)
+            new = flat[self._resident_sorted[pos_c] != flat]
+        else:
+            new = flat
+        if new.size == 0:
             return
-        if len(self._resident) + len(new) > self.high_water * self.capacity:
+        if self._would_exceed(new):
             self.flush()
             # The flush just evicted the batch's previously-resident ids too;
             # admit the WHOLE batch back or the train step would reinsert those
             # ids with initializer values, losing their weights/slots.
-            new = [int(i) for i in flat]
-            if len(new) > self.capacity:
+            new = flat
+            per_shard = np.bincount(new % self.num_shards,
+                                    minlength=self.num_shards)
+            if per_shard.max(initial=0) > self.rows_per_shard:
                 warnings.warn(
-                    f"batch has {len(new)} unique ids > cache capacity "
-                    f"({self.capacity}); the device cache cannot hold one "
-                    "batch and some rows will overflow — raise `capacity` or "
-                    "shrink the batch", RuntimeWarning)
-        known_hit, w, s = self.store.lookup(np.asarray(new, np.int64))
-        ids_dev = jnp.asarray(np.asarray(new, np.int64))
+                    f"batch puts {int(per_shard.max())} unique ids on one "
+                    f"shard (> {self.rows_per_shard} slots); the device cache "
+                    "cannot hold one batch and some rows will overflow — "
+                    "raise `capacity` or shrink the batch", RuntimeWarning)
+        known_hit, w, s = self.store.lookup(new)
+        ids_dev = jnp.asarray(new)
         with metrics.vtimer("offload", "admit"):
             self.state, admitted = self._admit(
                 self.state, ids_dev, jnp.asarray(w),
                 {k: jnp.asarray(v) for k, v in s.items()},
                 jnp.asarray(known_hit))
         admitted = np.asarray(admitted)
-        self._resident.update(i for i, a in zip(new, admitted) if a)
+        got = new[admitted]
+        self._resident.update(int(i) for i in got)
+        # O(n+m) sorted merge (got is sorted: a subset of np.unique output)
+        self._resident_sorted = np.insert(
+            self._resident_sorted,
+            np.searchsorted(self._resident_sorted, got), got)
+        self._shard_counts += np.bincount(got % self.num_shards,
+                                          minlength=self.num_shards)
         metrics.observe("offload.admitted", int(admitted.sum()))
 
-    def flush(self) -> None:
-        """Evict the whole cache to the host store and reset the device table."""
-        with metrics.vtimer("offload", "flush"):
+    def sync_to_store(self) -> None:
+        """Write every resident (id, row, slots) back to the host store WITHOUT
+        resetting the cache — a consistent full snapshot for checkpoint/persist
+        while training continues undisturbed."""
+        with metrics.vtimer("offload", "sync"):
             keys = np.asarray(self.state.keys)
             sel = keys >= 0
             self.store.merge(
@@ -204,18 +351,49 @@ class HostOffloadTable:
                 np.asarray(self.state.weights)[sel].astype(np.float32),
                 {k: np.asarray(v)[sel].astype(np.float32)
                  for k, v in self.state.slots.items()})
-            self.state = jax.device_put(self._fresh)
-            self._resident.clear()
+
+    def flush(self) -> None:
+        """Evict the whole cache to the host store and reset the device table."""
+        with metrics.vtimer("offload", "flush"):
+            self.sync_to_store()
+            self.reset_cache()
         metrics.observe("offload.flushes", 1)
 
-    def lookup_anywhere(self, ids) -> np.ndarray:
-        """Read rows wherever they live (device cache first, then host store);
-        absent ids -> zeros. For eval/export, not the hot path."""
-        from ..embedding import lookup
+    def reset_cache(self) -> None:
+        """Fresh device cache + empty residency WITHOUT writing to the store
+        (checkpoint load: the store was just replaced wholesale and the cache
+        contents are stale)."""
+        self.state = jax.tree_util.tree_map(
+            jax.device_put, self._fresh, self._shardings)
+        self._resident.clear()
+        self._resident_sorted = np.empty((0,), np.int64)
+        self._shard_counts[:] = 0
 
-        flat = np.asarray(ids).reshape(-1)
-        dev = np.asarray(lookup(self.spec, self.state, jnp.asarray(flat)))
-        on_dev = np.asarray([int(i) in self._resident for i in flat])
-        _, host_rows, _ = self.store.lookup(flat.astype(np.int64))
-        out = np.where(on_dev[:, None], dev, host_rows)
-        return out.reshape(np.asarray(ids).shape + (self.spec.output_dim,))
+    def load_store(self, ids: np.ndarray, weights: np.ndarray,
+                   slots: Dict[str, np.ndarray]) -> None:
+        """Checkpoint restore: replace the host store and invalidate the cache.
+        Missing optimizer slots (include_optimizer=False dumps) get fresh
+        optimizer init values, like the reference's state reset on such loads."""
+        full_slots = {}
+        fresh = {k: np.asarray(v)
+                 for k, v in jax.device_get(
+                     self.optimizer.init_slots(1, self.spec.output_dim)).items()}
+        for k in fresh:
+            if k in slots:
+                full_slots[k] = slots[k]
+            else:
+                full_slots[k] = np.broadcast_to(
+                    fresh[k], (len(ids),) + fresh[k].shape[1:]).copy()
+        self.store.replace_all(np.asarray(ids, np.int64),
+                               np.asarray(weights), full_slots)
+        self.reset_cache()
+
+    def lookup_anywhere(self, ids) -> np.ndarray:
+        """Read rows wherever they live; absent ids -> zeros. Implemented as a
+        store write-back + host read so it is correct for any mesh layout.
+        For eval/export, not the hot path."""
+        self.sync_to_store()
+        flat = np.asarray(ids).reshape(-1).astype(np.int64)
+        _, host_rows, _ = self.store.lookup(flat)
+        return host_rows.reshape(np.asarray(ids).shape
+                                 + (self.spec.output_dim,))
