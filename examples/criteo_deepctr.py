@@ -100,10 +100,14 @@ def main():
             spec, sparse_as_dense=True)
         print(f"cache mode: categorical ({args.vocabulary}) is dense-mirrored")
     if args.offload > 0:
+        if args.cache > 0:
+            ap.error("--cache (dense-mirrored) and --offload (host-cached) "
+                     "are mutually exclusive")
         import dataclasses
         spec = model.specs["categorical"]
         model.specs["categorical"] = dataclasses.replace(
-            spec, input_dim=-1, capacity=args.offload, storage="host_cached")
+            spec, input_dim=-1, capacity=args.offload, storage="host_cached",
+            sparse_as_dense=False)
         print(f"offload mode: {args.offload}-row device cache, "
               "full table in host RAM")
 
@@ -162,12 +166,14 @@ def main():
             print(f"step {i}: loss {float(m['loss']):.4f}")
             # the static-capacity divergence must be *managed*, not just
             # counted: surface dropped ids as they happen (see also the
-            # pull/push_overflow step stats on the mesh path)
-            for name, ts in state.tables.items():
-                if ts.overflow is not None and int(ts.overflow) > 0:
-                    print(f"  WARNING: {name}: {int(ts.overflow)} ids have "
-                          "overflowed the hash capacity (rows dropped) — "
-                          "raise capacity or capacity_factor")
+            # pull/push_overflow step stats on the mesh path).
+            # table_overflow includes counts banked across offload flushes.
+            for name in state.tables:
+                ov = trainer.table_overflow(state, name)
+                if ov > 0:
+                    print(f"  WARNING: {name}: {ov} ids have overflowed the "
+                          "hash capacity (rows dropped) — raise capacity or "
+                          "capacity_factor")
     loss = float(m["loss"])  # fences the device work
     dt = time.perf_counter() - t0
     reporter.stop()

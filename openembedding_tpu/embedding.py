@@ -81,6 +81,11 @@ class EmbeddingSpec:
                 f"embedding {self.name!r}: storage='host_cached' needs a "
                 "hash-table variable (input_dim=-1 + capacity) — the device "
                 "cache is keyed by id, not by dense row position")
+        if self.storage == "host_cached" and self.sparse_as_dense:
+            raise ValueError(
+                f"embedding {self.name!r}: sparse_as_dense (dense-mirrored "
+                "'Cache' mode) and storage='host_cached' are mutually "
+                "exclusive — a dense mirror bypasses the two-tier table")
 
     @property
     def use_hash_table(self) -> bool:

@@ -209,6 +209,17 @@ class Trainer:
             new_tables[name] = ot.state
         return state.replace(tables=new_tables)
 
+    def table_overflow(self, state: "TrainState", name: str) -> int:
+        """Lifetime dropped-id count for one table — includes overflow banked
+        across host-offload cache resets (the device counter alone restarts at
+        0 on every flush)."""
+        ts = state.tables.get(name)
+        dev = int(ts.overflow) if ts is not None and ts.overflow is not None \
+            else 0
+        if name in self.offload:
+            return self.offload[name]._overflow_flushed + dev
+        return dev
+
     def offload_store_snapshots(self, state: Optional["TrainState"] = None):
         """{name: HostStore snapshot} with all resident rows written back —
         what the checkpoint writers serialize for host-cached variables.

@@ -139,6 +139,14 @@ class AsyncPersister:
         whole-state `device_get` breaks on non-fully-addressable arrays)."""
         self._raise_pending_error()
         step = int(state.step)
+        if getattr(self.trainer, "offload", None):
+            # host-cached tables snapshot their WHOLE host store (a consistent
+            # copy — the live store keeps mutating under later flushes). Bound
+            # peak host memory at one pending copy by draining earlier writes
+            # first: effective window=1 for the store, the device-state window
+            # is unchanged.
+            self._q.join()
+            self._raise_pending_error()
         with metrics.vtimer("persist", "snapshot"):
             if self.trainer.num_shards > 1:
                 from .parallel.checkpoint import snapshot_addressable

@@ -240,12 +240,15 @@ class HostOffloadTable:
         self.rows_per_shard = self.capacity // self.num_shards
         self.store = HostStore(spec.output_dim,
                                optimizer.slot_shapes(spec.output_dim))
-        self._resident: set = set()
-        # sorted twin of _resident for O(batch log cache) membership in
-        # prepare() — rebuilding an array from the set every step would cost
-        # O(occupancy) right when the cache is large (the feature's point)
+        # sorted id array: O(batch log cache) membership in prepare() with no
+        # per-id Python boxing (a set would cost O(occupancy) host work right
+        # when the cache is large — the feature's point)
         self._resident_sorted = np.empty((0,), np.int64)
         self._shard_counts = np.zeros((self.num_shards,), np.int64)
+        # cumulative overflow carried across cache resets: the device counter
+        # restarts at 0 every flush, but dropped ids must stay observable
+        # ("managed, not just counted")
+        self._overflow_flushed = 0
         if mesh is not None:
             self._admit = _make_mesh_admit(mesh, axis, self._pspec,
                                            list(self.state.slots))
@@ -279,7 +282,21 @@ class HostOffloadTable:
 
     @property
     def resident_count(self) -> int:
-        return len(self._resident)
+        return int(self._resident_sorted.size)
+
+    def is_resident(self, id_: int) -> bool:
+        i = int(np.searchsorted(self._resident_sorted, id_))
+        return (i < self._resident_sorted.size
+                and int(self._resident_sorted[i]) == int(id_))
+
+    def resident_ids(self) -> np.ndarray:
+        return self._resident_sorted.copy()
+
+    @property
+    def total_overflow(self) -> int:
+        """Dropped-id count across the table's lifetime, surviving cache
+        resets (reads the live device counter — cheap scalar transfer)."""
+        return self._overflow_flushed + int(np.asarray(self.state.overflow))
 
     def adopt(self, table_state: EmbeddingTableState) -> None:
         """Take ownership of the (post-step) table pytree. The Trainer's jitted
@@ -330,7 +347,6 @@ class HostOffloadTable:
                 jnp.asarray(known_hit))
         admitted = np.asarray(admitted)
         got = new[admitted]
-        self._resident.update(int(i) for i in got)
         # O(n+m) sorted merge (got is sorted: a subset of np.unique output)
         self._resident_sorted = np.insert(
             self._resident_sorted,
@@ -362,10 +378,11 @@ class HostOffloadTable:
     def reset_cache(self) -> None:
         """Fresh device cache + empty residency WITHOUT writing to the store
         (checkpoint load: the store was just replaced wholesale and the cache
-        contents are stale)."""
+        contents are stale). The device overflow counter restarts at 0, so its
+        current value is banked first (`total_overflow` stays monotonic)."""
+        self._overflow_flushed += int(np.asarray(self.state.overflow))
         self.state = jax.tree_util.tree_map(
             jax.device_put, self._fresh, self._shardings)
-        self._resident.clear()
         self._resident_sorted = np.empty((0,), np.int64)
         self._shard_counts[:] = 0
 

@@ -1,0 +1,200 @@
+"""Worker process for tests/test_multiprocess.py — a REAL multi-process
+cluster member (the reference's test strategy forks real server processes,
+`entry/c_api_test.h:195,285`; here each process runs `jax.distributed` with 2
+local CPU devices and the mesh spans all processes).
+
+Invoked as:  python multiprocess_worker.py <scenario> <pid> <nprocs> <port> <tmp>
+Scenarios:
+  train_ckpt  — multihost.global_batch + MeshTrainer steps (losses recorded for
+                the single-process oracle) + save_sharded/load_sharded across
+                processes with shard-exact restore.
+  persist_ok  — AsyncPersister multi-host commit: every process writes its
+                shards + done marker, process 0 commits; restore verified.
+  persist_kill— process N-1 dies before persisting (crash mid-checkpoint):
+                process 0 must time out waiting for the done marker and NO
+                COMMIT may appear (crash consistency).
+"""
+
+import json
+import os
+import sys
+
+
+def log(pid, msg):
+    print(f"[worker {pid}] {msg}", file=sys.stderr, flush=True)
+
+
+def make_global_batch(step, gb):
+    import numpy as np
+    rng = np.random.default_rng(100 + step)
+    ids = rng.integers(0, 1024, size=(gb, 3)).astype(np.int64)
+    dense = rng.standard_normal((gb, 4)).astype(np.float32)
+    label = (rng.random(gb) < 0.5).astype(np.float32)
+    return {"sparse": {"categorical": ids}, "dense": dense, "label": label}
+
+
+def local_slice(full, pid, n):
+    import jax.tree_util as jtu
+    gb = full["label"].shape[0]
+    lo, hi = pid * gb // n, (pid + 1) * gb // n
+    return jtu.tree_map(lambda x: x[lo:hi], full)
+
+
+def build_trainer(mesh):
+    import openembedding_tpu as embed
+    from openembedding_tpu.models import make_wdl
+    from openembedding_tpu.parallel import MeshTrainer
+
+    model = make_wdl(vocabulary=1024, dim=4, hidden=(16,))
+    return MeshTrainer(model, embed.Adagrad(learning_rate=0.1), mesh=mesh,
+                       seed=0)
+
+
+def scenario_train_ckpt(pid, n, tmp):
+    import numpy as np
+    import jax
+    from jax.experimental import multihost_utils
+    from openembedding_tpu.parallel import make_mesh, multihost
+
+    mesh = make_mesh()
+    trainer = build_trainer(mesh)
+    gb = 32
+    batches = [multihost.global_batch(
+        local_slice(make_global_batch(s, gb), pid, n), mesh)
+        for s in range(4)]
+    state = trainer.init(batches[0])
+    step = trainer.jit_train_step(batches[0], state)
+    losses = []
+    for b in batches:
+        state, m = step(state, b)
+        losses.append(float(m["loss"]))
+    log(pid, f"losses {losses}")
+
+    ck = os.path.join(tmp, "ckpt")
+    # keep host copies of this process's shards for the post-load comparison
+    before = {s.device: np.asarray(s.data)
+              for s in state.tables["categorical"].weights.addressable_shards}
+    trainer.save(state, ck)
+    multihost_utils.sync_global_devices("ckpt_written")
+
+    trainer2 = build_trainer(mesh)
+    state2 = trainer2.init(batches[0])
+    state2 = trainer2.load(state2, ck)
+    for s in state2.tables["categorical"].weights.addressable_shards:
+        np.testing.assert_allclose(np.asarray(s.data), before[s.device],
+                                   rtol=0, atol=0)
+    assert int(state2.step) == 4
+    multihost_utils.sync_global_devices("ckpt_verified")
+
+    if pid == 0:
+        with open(os.path.join(tmp, "result.json"), "w") as f:
+            json.dump({"ok": True, "losses": losses,
+                       "num_processes": n,
+                       "num_devices": len(jax.devices())}, f)
+
+
+def scenario_persist_ok(pid, n, tmp):
+    import openembedding_tpu as embed
+    from jax.experimental import multihost_utils
+    from openembedding_tpu.parallel import make_mesh, multihost
+    from openembedding_tpu.persist import latest_persist, restore_server_model
+
+    mesh = make_mesh()
+    trainer = build_trainer(mesh)
+    gb = 16
+    b = multihost.global_batch(
+        local_slice(make_global_batch(0, gb), pid, n), mesh)
+    state = trainer.init(b)
+    step = trainer.jit_train_step(b, state)
+    state, _ = step(state, b)
+
+    root = os.path.join(tmp, "persists")
+    with embed.AsyncPersister(trainer, trainer.model, root,
+                              policy=embed.PersistPolicy(every_steps=1),
+                              commit_timeout=60.0) as p:
+        p.persist(state)
+        p.wait()
+    multihost_utils.sync_global_devices("persist_done")
+
+    path = latest_persist(root)
+    assert path is not None, "no committed persist"
+    # restore is a COLLECTIVE (init + load compile global-mesh programs):
+    # every process participates, exactly like a real pod relaunch
+    trainer2 = build_trainer(mesh)
+    state2 = trainer2.init(b)
+    state2 = restore_server_model(state2, trainer2.model, root,
+                                  trainer=trainer2)
+    assert int(state2.step) == 1
+    multihost_utils.sync_global_devices("persist_verified")
+    if pid == 0:
+        with open(os.path.join(tmp, "result.json"), "w") as f:
+            json.dump({"ok": True, "committed": path}, f)
+
+
+def scenario_persist_kill(pid, n, tmp):
+    import openembedding_tpu as embed
+    from openembedding_tpu.parallel import make_mesh, multihost
+    from openembedding_tpu.persist import list_persists
+
+    mesh = make_mesh()
+    trainer = build_trainer(mesh)
+    gb = 16
+    b = multihost.global_batch(
+        local_slice(make_global_batch(0, gb), pid, n), mesh)
+    state = trainer.init(b)
+    step = trainer.jit_train_step(b, state)
+    state, _ = step(state, b)
+
+    root = os.path.join(tmp, "persists")
+    if pid == n - 1:
+        # Simulate a process wedging mid-checkpoint: its shards and done
+        # marker never appear. (A hard os._exit would ALSO make the jax
+        # coordination service kill the healthy processes before they can
+        # observe the timeout — a different failure domain than the commit
+        # protocol under test.) Wait for process 0's verdict, then exit.
+        log(pid, "simulating wedged writer (no shards, no done marker)")
+        import time
+        deadline = time.monotonic() + 120
+        while (not os.path.exists(os.path.join(tmp, "result.json"))
+               and time.monotonic() < deadline):
+            time.sleep(0.2)
+        return
+
+    err = None
+    try:
+        with embed.AsyncPersister(trainer, trainer.model, root,
+                                  policy=embed.PersistPolicy(every_steps=1),
+                                  commit_timeout=5.0) as p:
+            p.persist(state)
+            p.wait()
+    except RuntimeError as e:
+        err = str(e)
+    if pid == 0:
+        assert err is not None and "finished writing" in err, \
+            f"commit wait should have timed out, got {err!r}"
+        assert list_persists(root) == [], "a COMMIT appeared despite the crash"
+        with open(os.path.join(tmp, "result.json"), "w") as f:
+            json.dump({"ok": True, "error_surfaced": err}, f)
+
+
+def main():
+    scenario, pid, n, port, tmp = (sys.argv[1], int(sys.argv[2]),
+                                   int(sys.argv[3]), sys.argv[4], sys.argv[5])
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=2")
+    import jax
+    jax.config.update("jax_enable_x64", True)
+    from openembedding_tpu.parallel import multihost
+    multihost.initialize(f"127.0.0.1:{port}", n, pid)
+    assert jax.process_count() == n, (jax.process_count(), n)
+    assert multihost.num_hosts() == n and multihost.host_id() == pid
+    log(pid, f"initialized: {len(jax.devices())} global devices")
+    {"train_ckpt": scenario_train_ckpt,
+     "persist_ok": scenario_persist_ok,
+     "persist_kill": scenario_persist_kill}[scenario](pid, n, tmp)
+    log(pid, "done")
+
+
+if __name__ == "__main__":
+    main()

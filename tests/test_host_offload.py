@@ -96,7 +96,7 @@ def test_offload_optimizer_state_round_trips():
     # evict A by filling the cache past high water
     filler = jnp.asarray(np.arange(100, 100 + 12, dtype=np.int64))
     off.prepare(filler)
-    assert 12345 not in off._resident  # flushed to host
+    assert not off.is_resident(12345)  # flushed to host
     off.prepare(A)                      # re-admitted with state
     st, _ = lookup_train(spec, off.state, A)
     off.state = apply_gradients(spec, st, opt, A, g)
@@ -152,12 +152,12 @@ def test_flush_triggering_batch_readmits_resident_ids():
     # raise residency close to the high-water mark (0.5 * 32 = 16)
     filler = jnp.asarray(np.arange(100, 100 + 12, dtype=np.int64))
     off.prepare(filler)
-    assert 777 in off._resident
+    assert off.is_resident(777)
 
     # this batch CONTAINS resident id 777 and trips the flush (13 + 4 > 16)
     batch = jnp.asarray([777, 900, 901, 902, 903], jnp.int64)
     off.prepare(batch)
-    assert 777 in off._resident  # re-admitted after the flush, not dropped
+    assert off.is_resident(777)  # re-admitted after the flush, not dropped
     st, _ = lookup_train(spec, off.state, batch)
     g2 = jnp.full((5, DIM), 2.0, jnp.float32)
     off.state = apply_gradients(spec, st, opt, batch, g2)
@@ -187,8 +187,7 @@ def test_oversized_batch_warns_and_residency_is_truthful():
     assert off.resident_count <= off.capacity
     # every id marked resident really does live in the device table
     from openembedding_tpu.tables.hash_table import hash_find
-    slot = hash_find(off.state.keys, jnp.asarray(
-        np.asarray(sorted(off._resident), np.int64)))
+    slot = hash_find(off.state.keys, jnp.asarray(off.resident_ids()))
     assert bool((np.asarray(slot) < off.capacity).all())
 
 

@@ -1,5 +1,7 @@
-"""Multi-host glue (single-process semantics; the real pod path differs only in
-jax.make_array_from_process_local_data wiring, which reduces to device_put here)."""
+"""Multi-host glue, single-process fast checks (divisibility, reader sharding).
+The REAL multi-process paths — make_array_from_process_local_data, per-process
+shard writes, the persist commit protocol — are exercised with spawned
+jax.distributed processes in `tests/test_multiprocess.py`."""
 
 import numpy as np
 import pytest
