@@ -82,7 +82,8 @@ def save_server_model(state, model, path: str, *, include_optimizer: bool = True
             optimizer=spec.optimizer.to_config() if spec.optimizer else {},
             initializer=spec.initializer.to_config(),
             table={"category": "hash" if spec.use_hash_table else "array",
-                   "capacity": spec.capacity},
+                   "capacity": spec.capacity,
+                   "sparse_as_dense": spec.sparse_as_dense},
         )
         meta.variables.append(mv)
         if spec.sparse_as_dense:
@@ -135,6 +136,12 @@ def save_server_model(state, model, path: str, *, include_optimizer: bool = True
         d = json.loads(meta.to_json())
         d["extra"] = extra
         json.dump(d, f, indent=2, sort_keys=True)
+    if model.config is not None:
+        # the module-rebuild recipe makes the checkpoint directly servable
+        # (used by StandaloneModel/ShardedModel)
+        from .export import MODEL_CONFIG_FILE
+        with open(os.path.join(path, MODEL_CONFIG_FILE), "w") as f:
+            json.dump(model.config, f, indent=2, sort_keys=True)
     return meta
 
 

@@ -31,6 +31,22 @@ from .meta import ModelMeta, ModelVariableMeta
 from .model import EmbeddingModel
 
 MODEL_CONFIG_FILE = "model_config.json"
+
+
+def load_model_config(path: str, **overrides) -> Optional[EmbeddingModel]:
+    """Rebuild the EmbeddingModel from a directory's model_config.json recipe
+    (None when absent). Shared by StandaloneModel and parallel.ShardedModel so
+    the rebuild semantics live in one place."""
+    cfg_path = os.path.join(path, MODEL_CONFIG_FILE)
+    if not os.path.exists(cfg_path):
+        return None
+    from . import models as zoo
+    with open(cfg_path) as f:
+        cfg = json.load(f)
+    # runtime parallelism knobs (e.g. SASRec attention="ring") do not survive
+    # into serving, which runs outside shard_map
+    return zoo.from_config(cfg, **{**cfg.get("serving_overrides", {}),
+                                   **overrides})
 # reference batches its export pulls at 2^20/dim rows (`exb.py:506-547`); same chunking
 # bounds host RAM while we stream a sharded table out
 EXPORT_CHUNK_ELEMS = 1 << 20
@@ -124,14 +140,7 @@ class StandaloneModel:
         with open(os.path.join(path, MODEL_META_FILE)) as f:
             meta = ModelMeta.from_json(f.read())
         if model is None:
-            cfg_path = os.path.join(path, MODEL_CONFIG_FILE)
-            if os.path.exists(cfg_path):
-                from . import models as zoo
-                with open(cfg_path) as f:
-                    cfg = json.load(f)
-                # runtime parallelism knobs (e.g. SASRec attention="ring") do
-                # not survive into serving, which runs outside shard_map
-                model = zoo.from_config(cfg, **cfg.get("serving_overrides", {}))
+            model = load_model_config(path)
         tables = {}
         for v in meta.variables:
             vdir = os.path.join(path, f"variable_{v.variable_id}")

@@ -197,7 +197,8 @@ def save_sharded(state, model, path: str, *, num_shards: int,
             optimizer=spec.optimizer.to_config() if spec.optimizer else {},
             initializer=spec.initializer.to_config(),
             table={"category": "hash" if spec.use_hash_table else "array",
-                   "capacity": spec.capacity},
+                   "capacity": spec.capacity,
+                   "sparse_as_dense": spec.sparse_as_dense},
         )
         meta.variables.append(mv)
         if spec.sparse_as_dense:
@@ -285,6 +286,10 @@ def save_sharded(state, model, path: str, *, num_shards: int,
             d = json.loads(meta.to_json())
             d["extra"] = extra
             json.dump(d, f, indent=2, sort_keys=True)
+        if model.config is not None:
+            from ..export import MODEL_CONFIG_FILE
+            with open(os.path.join(path, MODEL_CONFIG_FILE), "w") as f:
+                json.dump(model.config, f, indent=2, sort_keys=True)
     return meta
 
 

@@ -1,0 +1,261 @@
+"""Sharded serving: answer pulls/predicts straight from a (sharded) checkpoint
+on a serving mesh — the model is NEVER materialized in one device or host.
+
+Reference counterpart: TF-Serving's `PullWeights` op resolves the model by sign
+and pulls from the *sharded* parameter server with the read-only handler
+(`tensorflow/exb_ops.cpp:261-276`, `server/EmbeddingPullOperator.cpp:50-58,
+149-205`) — a 45 GB Criteo-1TB model is served by N PS shards, no process holds
+it whole. `export.StandaloneModel` (the `save_as_original_model` analogue)
+covers the small case by materializing everything; this module is the big case:
+
+- table weights (and hash keys) load DIRECTLY sharded over a serving mesh via
+  the checkpoint loaders' per-target-shard assembly (`parallel/checkpoint.py`);
+  optimizer slots are never read (a serving replica needs none — the reference
+  serving dump drops them too, `include_optimizer`);
+- `lookup` is the read-only sharded pull (`sharded_lookup` under shard_map):
+  dedup -> owner bucket -> all_to_all -> local gather -> reassemble;
+- `predict` runs the dense tower on every device over the replicated request
+  batch (serving requests are small; the sparse side stays sharded).
+
+The REST layer (`serving.py`) selects this path when a model is registered
+with `shard_num > 1`, making that controller field meaningful
+(`entry/controller.cc:100-205` places shard_num shards the same way).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from functools import partial
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..checkpoint import MODEL_META_FILE
+from ..embedding import EmbeddingSpec, EmbeddingTableState
+from ..meta import ModelMeta
+from .mesh import make_mesh
+
+
+def _specs_from_meta(meta: ModelMeta) -> Dict[str, EmbeddingSpec]:
+    """Rebuild just enough of each variable's spec from the checkpoint meta to
+    serve it (no initializer/optimizer needed read-only)."""
+    out = {}
+    for v in meta.variables:
+        table = v.table or {}
+        out[v.storage_name] = EmbeddingSpec(
+            name=v.storage_name,
+            input_dim=v.meta.vocabulary_size,
+            output_dim=v.meta.embedding_dim,
+            datatype=v.meta.datatype,
+            capacity=int(table.get("capacity", 0)),
+            sparse_as_dense=bool(table.get("sparse_as_dense", False)),
+            variable_id=v.variable_id,
+        )
+    return out
+
+
+def _ckpt_hash_rows(path: str, variable_id: int) -> int:
+    """Number of resident ids a checkpoint holds for one hash variable, read
+    from the .npy headers (no data loaded). Serving tables are sized from THIS,
+    not from the training `capacity`: a host-cached variable's store holds far
+    more rows than its HBM cache capacity, and sizing from capacity would
+    silently serve zeros for the rest."""
+    vdir = os.path.join(path, f"variable_{variable_id}")
+    total = 0
+    direct = os.path.join(vdir, "ids.npy")
+    if os.path.exists(direct):
+        return int(np.load(direct, mmap_mode="r").shape[0])
+    for name in sorted(os.listdir(vdir)):
+        p = os.path.join(vdir, name, "ids.npy")
+        if name.startswith("shard_") and os.path.exists(p):
+            total += int(np.load(p, mmap_mode="r").shape[0])
+    return total
+
+
+class _ServingState:
+    """Duck-typed stand-ins for the checkpoint loaders: a TrainState-shaped
+    object (`.tables/.dense_params/...` + `.replace`) and a model-shaped one
+    (`.specs`) — serving has no Trainer and must not pay for one."""
+
+    def __init__(self, **kw):
+        self.__dict__.update(kw)
+
+    def replace(self, **kw):
+        d = dict(self.__dict__)
+        d.update(kw)
+        return _ServingState(**d)
+
+
+class _SpecsModel:
+    def __init__(self, specs):
+        self.specs = specs
+
+
+class ShardedModel:
+    """A checkpoint served sharded over a mesh: read-only pulls + predict.
+
+    `load()` accepts both checkpoint layouts (per-shard streaming or single
+    file) at ANY serving mesh size; weights land directly in their target
+    shards. `model` (an `EmbeddingModel`) or an in-checkpoint
+    `model_config.json` recipe enables `predict`; `lookup` works without.
+    """
+
+    def __init__(self, meta: ModelMeta, specs: Dict[str, EmbeddingSpec],
+                 tables: Dict[str, EmbeddingTableState], dense_params: Any,
+                 mesh: Mesh, model=None):
+        self.meta = meta
+        self.specs = specs
+        self.tables = tables
+        self.dense_params = dense_params
+        self.mesh = mesh
+        self.axis = mesh.axis_names[0]
+        self.model = model
+        self._lookup_fns: Dict[str, Any] = {}
+        self._predict_fn = None
+
+    # -- loading -------------------------------------------------------------
+
+    @classmethod
+    def load(cls, path: str, *, mesh: Optional[Mesh] = None,
+             model=None) -> "ShardedModel":
+        from .checkpoint import checkpoint_layout, load_sharded
+        from ..checkpoint import load_server_model
+
+        mesh = mesh if mesh is not None else make_mesh()
+        axis = mesh.axis_names[0]
+        T = int(mesh.devices.size)
+        with open(os.path.join(path, MODEL_META_FILE)) as f:
+            meta = ModelMeta.from_json(f.read())
+
+        if model is None:
+            from ..export import load_model_config
+            model = load_model_config(path)
+        specs = (dict(model.specs) if model is not None
+                 else _specs_from_meta(meta))
+
+        # zero templates, directly sharded, NO optimizer slots: the loaders
+        # fill exactly what a template carries, so serving never reads slots
+        tables = {}
+        for name, spec in specs.items():
+            if spec.sparse_as_dense:
+                continue  # rows live in dense_params["__embeddings__"]
+            if spec.use_hash_table:
+                # size from what the checkpoint actually holds (+43% open-
+                # addressing headroom, min one probe window per shard) — the
+                # training `capacity` is an HBM-cache size, not the table size
+                need = _ckpt_hash_rows(path, spec.variable_id)
+                rps = max(-(-need * 10 // (7 * T)), 64)
+                rows = rps * T
+            else:
+                rows = spec.rows_per_shard(T) * T
+
+            def mk(spec=spec, rows=rows):
+                return EmbeddingTableState(
+                    weights=jnp.zeros((rows, spec.output_dim), spec.dtype),
+                    slots={},
+                    keys=(jnp.full((rows,), -1, jnp.int64)
+                          if spec.use_hash_table else None),
+                    overflow=(jnp.zeros((), jnp.int32)
+                              if spec.use_hash_table else None),
+                )
+
+            pspec = EmbeddingTableState(
+                weights=P(axis, None), slots={},
+                keys=P(axis) if spec.use_hash_table else None,
+                overflow=P() if spec.use_hash_table else None)
+            shardings = jax.tree_util.tree_map(
+                lambda p: NamedSharding(mesh, p), pspec,
+                is_leaf=lambda x: isinstance(x, P))
+            tables[name] = jax.jit(mk, out_shardings=shardings)()
+
+        state = _ServingState(step=jnp.zeros((), jnp.int32),
+                              dense_params={}, dense_slots={},
+                              tables=tables,
+                              model_version=jnp.zeros((), jnp.int32))
+        shim = _SpecsModel(specs)
+        if checkpoint_layout(path) == "sharded":
+            state = load_sharded(state, shim, path, num_shards=T)
+        else:
+            state = load_server_model(state, shim, path, num_shards=T)
+        for name, ts in state.tables.items():
+            if ts.overflow is not None and int(np.asarray(ts.overflow)) > 0:
+                # a serving table must hold EVERY checkpointed row — silently
+                # pulling zeros for dropped ids is a wrong-answer mode, not a
+                # capacity stat (the headroom above makes this unreachable
+                # except under extreme id skew mod the serving shard count)
+                raise RuntimeError(
+                    f"variable {name!r}: {int(np.asarray(ts.overflow))} "
+                    f"checkpointed ids did not fit the serving hash table "
+                    f"(shard skew?); raise the serving shard count")
+        return cls(meta, specs, state.tables, state.dense_params, mesh,
+                   model=model)
+
+    # -- serving reads ---------------------------------------------------------
+
+    @property
+    def variable_names(self) -> List[str]:
+        return [n for n, s in self.specs.items()]
+
+    def _table_pspec(self, spec: EmbeddingSpec):
+        return EmbeddingTableState(
+            weights=P(self.axis, None), slots={},
+            keys=P(self.axis) if spec.use_hash_table else None,
+            overflow=P() if spec.use_hash_table else None)
+
+    def _lookup_fn(self, name: str):
+        """shard_map'd read-only pull; the request ids are replicated (serving
+        batches are small), every device serves its own rows, the reassembled
+        result is replicated."""
+        if name not in self._lookup_fns:
+            from .sharded import sharded_lookup
+            spec = self.specs[name]
+            fn = jax.jit(jax.shard_map(
+                partial(sharded_lookup, spec, axis=self.axis),
+                mesh=self.mesh,
+                in_specs=(self._table_pspec(spec), P()),
+                out_specs=P(), check_vma=False))
+            self._lookup_fns[name] = fn
+        return self._lookup_fns[name]
+
+    def lookup(self, name: str, ids) -> jax.Array:
+        """Read-only sharded pull (absent/out-of-range ids -> zero rows),
+        reference `read_only_pull` (`EmbeddingPullOperator.cpp:149-205`)."""
+        spec = self.specs[name]
+        if spec.sparse_as_dense:
+            table = self.dense_params["__embeddings__"][name]
+            flat = jnp.asarray(ids).reshape(-1)
+            ok = (flat >= 0) & (flat < table.shape[0])
+            rows = jnp.where(ok[:, None],
+                             jnp.take(table, jnp.clip(flat, 0,
+                                                      table.shape[0] - 1),
+                                      axis=0),
+                             0)
+            return rows.reshape(jnp.asarray(ids).shape + (spec.output_dim,))
+        ids = jnp.asarray(ids)
+        if ids.dtype not in (jnp.int32, jnp.int64):
+            ids = ids.astype(jnp.int64)
+        return self._lookup_fn(name)(self.tables[name], ids)
+
+    def predict(self, batch: Dict[str, Any]) -> jax.Array:
+        """Forward pass -> logits: sparse pulls sharded, dense tower replicated
+        over the request batch. Needs the module recipe (model_config.json in
+        the checkpoint, or `model=` at load)."""
+        if self.model is None:
+            raise ValueError(
+                "checkpoint has no model_config recipe; pass the "
+                "EmbeddingModel to ShardedModel.load(path, model=...)")
+        embedded = {name: self.lookup(name, batch["sparse"][name])
+                    for name in self.specs}
+        if self._predict_fn is None:
+            module = self.model.module
+
+            def fwd(dense_params, embedded, dense):
+                return module.apply({"params": dense_params}, embedded, dense)
+
+            self._predict_fn = jax.jit(fwd)
+        return self._predict_fn(self.dense_params, embedded,
+                                batch.get("dense"))

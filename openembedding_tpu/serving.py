@@ -123,28 +123,58 @@ class ModelRegistry:
 
 
 class ModelManager:
-    """model_sign -> cached StandaloneModel; refuses models not in NORMAL state
-    (reference `ModelManager::find_model_variable`, `ModelController.cpp:24-44`)."""
+    """model_sign -> cached servable; refuses models not in NORMAL state
+    (reference `ModelManager::find_model_variable`, `ModelController.cpp:24-44`).
+
+    `shard_num == 1` loads a materialized `StandaloneModel` (export layout,
+    small models). `shard_num > 1` loads the model SHARDED over `shard_num`
+    devices straight from a (sharded) checkpoint — never materialized in one
+    place (`parallel/serving.ShardedModel`) — the reference's serving-from-
+    the-sharded-PS path (`exb_ops.cpp:261-276`)."""
 
     def __init__(self, registry: ModelRegistry):
         self.registry = registry
-        self._cache: Dict[str, StandaloneModel] = {}
+        self._cache: Dict[str, object] = {}
         self._lock = threading.Lock()
+        # per-sign load guards: two first requests racing for the same model
+        # must not both run a (device-memory-heavy) sharded load
+        self._loading: Dict[str, threading.Lock] = {}
 
-    def find_model(self, model_sign: str) -> StandaloneModel:
+    @staticmethod
+    def _load_entry(entry: dict):
+        shard_num = int(entry.get("shard_num", 1))
+        if shard_num <= 1:
+            return StandaloneModel.load(entry["uri"])
+        import jax
+        from .parallel.mesh import make_mesh
+        from .parallel.serving import ShardedModel
+        devices = jax.devices()
+        if shard_num > len(devices):
+            raise ValueError(
+                f"shard_num={shard_num} exceeds the {len(devices)} devices "
+                "on this serving node")
+        return ShardedModel.load(entry["uri"],
+                                 mesh=make_mesh(devices[:shard_num]))
+
+    def find_model(self, model_sign: str):
         with self._lock:
             if model_sign in self._cache:
                 return self._cache[model_sign]
-        entry = self.registry.get(model_sign)
-        if entry is None:
-            raise KeyError(f"unknown model {model_sign!r}")
-        if entry["status"] != "NORMAL":
-            raise RuntimeError(
-                f"model {model_sign!r} is {entry['status']}, not servable")
-        loaded = StandaloneModel.load(entry["uri"])
-        with self._lock:
-            self._cache[model_sign] = loaded
-        return loaded
+            guard = self._loading.setdefault(model_sign, threading.Lock())
+        with guard:
+            with self._lock:  # the winner may have finished while we waited
+                if model_sign in self._cache:
+                    return self._cache[model_sign]
+            entry = self.registry.get(model_sign)
+            if entry is None:
+                raise KeyError(f"unknown model {model_sign!r}")
+            if entry["status"] != "NORMAL":
+                raise RuntimeError(
+                    f"model {model_sign!r} is {entry['status']}, not servable")
+            loaded = self._load_entry(entry)
+            with self._lock:
+                self._cache[model_sign] = loaded
+            return loaded
 
     def find_model_variable(self, model_sign: str, variable: str):
         m = self.find_model(model_sign)
@@ -164,7 +194,7 @@ class ModelManager:
                                            replica_num=replica_num,
                                            shard_num=shard_num)
         try:
-            loaded = StandaloneModel.load(uri)
+            loaded = self._load_entry(entry)
             with self._lock:
                 self._cache[model_sign] = loaded
             return self.registry.set_status(model_sign, "NORMAL")
@@ -309,7 +339,14 @@ class ServingHandler(BaseHTTPRequestHandler):
                     batch["dense"] = self._coerce(
                         lambda v: np.asarray(v, dtype=np.float32),
                         body["dense"], "dense")
-                logits = model.predict(batch)
+                try:
+                    logits = model.predict(batch)
+                except KeyError as e:
+                    # a feature the model needs is absent from the request
+                    # body — the CALLER's error (400), not an unknown sign
+                    raise _BadRequest(
+                        f"predict request is missing sparse feature {e}"
+                    ) from e
                 return self._json(200, {"logits": np.asarray(logits).tolist()})
             return self._json(404, {"error": "not found"})
         except _BadRequest as e:
