@@ -103,11 +103,12 @@ def save_server_model(state, model, path: str, *, include_optimizer: bool = True
             continue
         ts = state.tables[name]
         if spec.use_hash_table:
-            # compact to id-sorted (ids, rows, slots): layout-independent on disk
-            keys = np.asarray(ts.keys)
-            sel = keys >= 0
-            order = np.argsort(keys[sel], kind="stable")
-            np.save(os.path.join(vdir, "ids.npy"), keys[sel][order])
+            # compact to id-sorted (ids, rows, slots): layout-independent on
+            # disk — ALWAYS plain int64 whatever the device key layout
+            from .ops.id64 import np_resident_ids
+            sel, ids64 = np_resident_ids(np.asarray(ts.keys))
+            order = np.argsort(ids64, kind="stable")
+            np.save(os.path.join(vdir, "ids.npy"), ids64[order])
             np.save(os.path.join(vdir, "weights.npy"),
                     np.asarray(ts.weights)[sel][order])
             if include_optimizer:
@@ -234,11 +235,11 @@ def load_server_model(state, model, path: str, *, num_shards: int = 1,
             continue
 
         if spec.use_hash_table:
-            from .tables.hash_table import np_hash_insert
+            from .tables.hash_table import np_fresh_keys, np_hash_insert
             ids = np.load(os.path.join(vdir, "ids.npy"))
             w_rows = np.load(os.path.join(vdir, "weights.npy"))
-            keys_np = np.full(ts.keys.shape, -1, np.asarray(ts.keys).dtype)
-            pos = np_hash_insert(keys_np, ids.astype(keys_np.dtype), num_shards)
+            keys_np = np_fresh_keys(ts.keys.shape[0], like=ts.keys)
+            pos = np_hash_insert(keys_np, ids.astype(np.int64), num_shards)
             placed = pos >= 0
             weights_np = np.asarray(ts.weights).copy()
             weights_np[pos[placed]] = w_rows[placed]

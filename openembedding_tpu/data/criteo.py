@@ -264,10 +264,16 @@ def synthetic_criteo(batch_size: int, *, id_space: int = 1 << 25,
                        ).astype(np.int64)
         fields = np.broadcast_to(np.arange(num_fields, dtype=np.uint64),
                                  (batch_size, num_fields))
-        ids = hash_category(raw.astype(np.uint64), fields, id_space
-                            ).astype(ids_dtype)
+        ids64 = hash_category(raw.astype(np.uint64), fields, id_space)
+        if ids_dtype == "pair":
+            # the split-pair 63-bit layout for x64-off runs (ops/id64.py)
+            from ..ops.id64 import np_split_ids
+            ids = np_split_ids(ids64)
+        else:
+            ids = ids64.astype(ids_dtype)
         dense = rng.normal(size=(batch_size, dense_dim)).astype(np.float32)
-        logit = dense @ w_dense + 0.01 * (ids % 97 - 48).sum(axis=1) / num_fields
+        logit = (dense @ w_dense
+                 + 0.01 * (ids64 % 97 - 48).sum(axis=1) / num_fields)
         labels = (rng.random(batch_size) < 1.0 / (1.0 + np.exp(-logit))
                   ).astype(np.float32)
         yield {"sparse": {"categorical": ids}, "dense": dense, "label": labels}

@@ -159,41 +159,50 @@ def init_table_state(spec: EmbeddingSpec, optimizer: SparseOptimizer,
     keys = None
     overflow = None
     if spec.use_hash_table:
-        if not jax.config.jax_enable_x64:
-            warnings.warn(
-                f"hash-table variable {spec.name!r}: jax_enable_x64 is off, so keys "
-                "are int32 and the id space is 32-bit (ids congruent mod 2^32 "
-                "collide). Enable x64 for the full 63-bit hashed id space.")
-        keys = jnp.full((rows,), -1, dtype=jnp.int64)
+        # x64 on: int64 single-lane keys; x64 off (the default): uint32
+        # split-pair keys — 63-bit ids in EITHER config (ops/id64.py)
+        from .tables.hash_table import fresh_keys
+        keys = fresh_keys(rows)
         overflow = jnp.zeros((), jnp.int32)
     return EmbeddingTableState(weights=weights, slots=slots, keys=keys,
                                overflow=overflow)
+
+
+def _flat_ids(spec: EmbeddingSpec, ids: jax.Array):
+    """-> (flat ids, row-output shape): split-pair ids ((..., 2) uint32,
+    `ops/id64.py`) keep their lane dim flat and drop it from the output.
+    Pair dispatch is gated on `use_hash_table`: a uint32 two-field batch on an
+    array table must NOT be misread as one 63-bit id per row."""
+    from .ops.id64 import is_pair
+    if spec.use_hash_table and is_pair(ids):
+        return ids.reshape(-1, 2), ids.shape[:-1]
+    return ids.reshape(-1), ids.shape
 
 
 def lookup(spec: EmbeddingSpec, state: EmbeddingTableState,
            ids: jax.Array) -> jax.Array:
     """Single-shard pull: ids (any shape) -> rows (ids.shape + (dim,)).
     reference: `Variable.sparse_read`/`pull_weights` (`exb.py:308-327`)."""
-    flat = ids.reshape(-1)
+    flat, out_shape = _flat_ids(spec, ids)
     if spec.use_hash_table:
         from .tables.hash_table import hash_lookup
         rows = hash_lookup(state, flat)
     else:
         rows = lookup_rows(state.weights, flat)
-    return rows.reshape(ids.shape + (spec.output_dim,))
+    return rows.reshape(out_shape + (spec.output_dim,))
 
 
 def lookup_train(spec: EmbeddingSpec, state: EmbeddingTableState,
                  ids: jax.Array):
     """Training pull: like `lookup` but hash tables insert unseen ids (lazy init).
     Returns (new_state, rows). Array tables never mutate on pull."""
-    flat = ids.reshape(-1)
+    flat, out_shape = _flat_ids(spec, ids)
     if spec.use_hash_table:
         from .tables.hash_table import hash_lookup_train
         state, rows = hash_lookup_train(state, flat)
     else:
         rows = lookup_rows(state.weights, flat)
-    return state, rows.reshape(ids.shape + (spec.output_dim,))
+    return state, rows.reshape(out_shape + (spec.output_dim,))
 
 
 def apply_gradients(spec: EmbeddingSpec, state: EmbeddingTableState,
@@ -202,7 +211,7 @@ def apply_gradients(spec: EmbeddingSpec, state: EmbeddingTableState,
     """Single-shard push+update fused: duplicate grads summed, optimizer applied once
     per unique id (reference: push `EmbeddingPushOperator.cpp` + store
     `EmbeddingStoreOperator.cpp` collapsed into one step — SPMD needs no batch gate)."""
-    flat_ids = ids.reshape(-1)
+    flat_ids, _ = _flat_ids(spec, ids)
     flat_grads = grads.reshape(-1, spec.output_dim)
     if spec.use_hash_table:
         from .tables.hash_table import hash_apply_gradients

@@ -47,6 +47,8 @@ def load_model_config(path: str, **overrides) -> Optional[EmbeddingModel]:
     # into serving, which runs outside shard_map
     return zoo.from_config(cfg, **{**cfg.get("serving_overrides", {}),
                                    **overrides})
+
+
 # reference batches its export pulls at 2^20/dim rows (`exb.py:506-547`); same chunking
 # bounds host RAM while we stream a sharded table out
 EXPORT_CHUNK_ELEMS = 1 << 20
@@ -90,10 +92,10 @@ def export_standalone(state, model: EmbeddingModel, path: str, *,
             np.save(os.path.join(vdir, "weights.npy"), st.weights)
         elif spec.use_hash_table:
             ts = state.tables[name]
-            keys = np.asarray(ts.keys)
-            sel = keys >= 0
-            order = np.argsort(keys[sel], kind="stable")
-            np.save(os.path.join(vdir, "ids.npy"), keys[sel][order])
+            from .ops.id64 import np_resident_ids
+            sel, ids64 = np_resident_ids(np.asarray(ts.keys))
+            order = np.argsort(ids64, kind="stable")
+            np.save(os.path.join(vdir, "ids.npy"), ids64[order])
             np.save(os.path.join(vdir, "weights.npy"),
                     np.asarray(ts.weights)[sel][order])
         else:
@@ -149,7 +151,9 @@ class StandaloneModel:
             ids_path = os.path.join(vdir, "ids.npy")
             if os.path.exists(ids_path):
                 entry["kind"] = "hash"
-                entry["ids"] = jnp.asarray(np.load(ids_path))
+                # host-side int64: under x64-off a device copy would truncate
+                # to int32 and collide ids congruent mod 2^32
+                entry["ids"] = np.load(ids_path)
             else:
                 entry["kind"] = "array"
             tables[v.storage_name] = entry
@@ -165,20 +169,31 @@ class StandaloneModel:
         """Read-only pull: absent/out-of-range ids -> zero rows (reference
         `get_weights` serving semantics)."""
         t = self._tables[name]
-        ids = jnp.asarray(ids)
-        flat = ids.reshape(-1)
         w = t["weights"]
         if t["kind"] == "hash":
-            # ids.npy is sorted: binary search instead of the device probe table
-            pos = jnp.searchsorted(t["ids"], flat)
-            pos_c = jnp.clip(pos, 0, t["ids"].shape[0] - 1)
-            hit = t["ids"][pos_c] == flat
-            rows = jnp.where(hit[:, None], w[pos_c], jnp.zeros_like(w[:1]))
-        else:
-            in_range = (flat >= 0) & (flat < w.shape[0])
-            rows = jnp.where(in_range[:, None],
-                             w[jnp.clip(flat, 0, w.shape[0] - 1)],
-                             jnp.zeros((1, w.shape[1]), w.dtype))
+            # ids.npy is sorted: HOST binary search in full int64 (a device
+            # search under x64-off would truncate 63-bit ids), then a device
+            # row gather
+            from .ops.id64 import is_pair, np_join_ids
+            flat_np = np.asarray(ids)
+            if is_pair(flat_np):
+                flat_np = np_join_ids(flat_np)
+            ids_shape = flat_np.shape
+            flat_np = flat_np.reshape(-1).astype(np.int64)
+            n = t["ids"].shape[0]
+            pos = np.searchsorted(t["ids"], flat_np)
+            pos_c = np.minimum(pos, max(n - 1, 0))
+            hit = (t["ids"][pos_c] == flat_np) if n else \
+                np.zeros(flat_np.shape, bool)
+            rows = jnp.where(jnp.asarray(hit)[:, None],
+                             w[jnp.asarray(pos_c)], jnp.zeros_like(w[:1]))
+            return rows.reshape(tuple(ids_shape) + (t["dim"],))
+        ids = jnp.asarray(ids)
+        flat = ids.reshape(-1)
+        in_range = (flat >= 0) & (flat < w.shape[0])
+        rows = jnp.where(in_range[:, None],
+                         w[jnp.clip(flat, 0, w.shape[0] - 1)],
+                         jnp.zeros((1, w.shape[1]), w.dtype))
         return rows.reshape(ids.shape + (t["dim"],))
 
     def predict(self, batch: Dict[str, Any]) -> jax.Array:

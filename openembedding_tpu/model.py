@@ -289,10 +289,13 @@ class Trainer:
         return self.model.module.init(key, embedded, dense_inputs)
 
     def _fake_embedded(self, batch):
+        from .ops.id64 import is_pair
         out = {}
         for name, spec in self.model.specs.items():
             ids = jnp.asarray(batch["sparse"][name])
-            out[name] = jnp.zeros(ids.shape + (spec.output_dim,), spec.dtype)
+            shape = (ids.shape[:-1] if spec.use_hash_table and is_pair(ids)
+                     else ids.shape)
+            out[name] = jnp.zeros(shape + (spec.output_dim,), spec.dtype)
         return out
 
     # -- the per-device step (pure; shard_map-able) -------------------------

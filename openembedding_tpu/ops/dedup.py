@@ -45,22 +45,36 @@ def unique_with_counts(ids: jax.Array) -> UniqueResult:
     Reference semantics: gradients of duplicate ids are summed and the count recorded
     (`MpscGradientReducer.h:26-53`); here `inverse`/`segment_reduce` let the caller
     sum per-duplicate gradients into the unique slots.
+
+    `ids` may be single-lane ((n,) int) or the split-pair 63-bit layout
+    ((n, 2) uint32, `ops/id64.py`): pairs sort lexicographically with a
+    two-key `lax.sort`, everything downstream is lane-count agnostic.
     """
     n = ids.shape[0]
-    order = jnp.argsort(ids).astype(jnp.int32)
-    sorted_ids = ids[order]
-    is_new = jnp.concatenate(
-        [jnp.ones((1,), dtype=bool), sorted_ids[1:] != sorted_ids[:-1]])
+    if ids.ndim == 2:  # split-pair layout
+        iota = jnp.arange(n, dtype=jnp.int32)
+        s_hi, s_lo, order = jax.lax.sort(
+            (ids[:, 0], ids[:, 1], iota), num_keys=2)
+        sorted_ids = jnp.stack([s_hi, s_lo], axis=-1)
+        is_new = jnp.concatenate(
+            [jnp.ones((1,), dtype=bool),
+             (s_hi[1:] != s_hi[:-1]) | (s_lo[1:] != s_lo[:-1])])
+    else:
+        order = jnp.argsort(ids).astype(jnp.int32)
+        sorted_ids = ids[order]
+        is_new = jnp.concatenate(
+            [jnp.ones((1,), dtype=bool), sorted_ids[1:] != sorted_ids[:-1]])
     seg = (jnp.cumsum(is_new) - 1).astype(jnp.int32)  # ascending segment ids
     num_unique = seg[-1] + 1
     # duplicate writes to one segment all carry the same value, so .set is deterministic
-    unique_ids = jnp.zeros((n,), ids.dtype).at[seg].set(
+    unique_ids = jnp.zeros(sorted_ids.shape, ids.dtype).at[seg].set(
         sorted_ids, mode="drop", indices_are_sorted=True)
     counts = jax.ops.segment_sum(jnp.ones((n,), jnp.int32), seg, num_segments=n,
                                  indices_are_sorted=True)
     inverse = jnp.zeros((n,), jnp.int32).at[order].set(seg)
     return UniqueResult(unique_ids, inverse, counts.astype(jnp.int32),
-                        num_unique.astype(jnp.int32), order, seg)
+                        num_unique.astype(jnp.int32), order.astype(jnp.int32),
+                        seg)
 
 
 class BucketResult(NamedTuple):
@@ -83,7 +97,13 @@ def bucket_by_owner(ids: jax.Array, valid: jax.Array, num_shards: int,
     use capacity == n for exactness).
     """
     n = ids.shape[0]
-    owner = jnp.where(valid, (ids % num_shards).astype(jnp.int32), num_shards)
+    if ids.ndim == 2:  # split-pair layout: owner via modular pair arithmetic
+        from .id64 import pair_mod
+        owner = jnp.where(valid, pair_mod(ids, num_shards).astype(jnp.int32),
+                          num_shards)
+    else:
+        owner = jnp.where(valid, (ids % num_shards).astype(jnp.int32),
+                          num_shards)
     # stable sort by owner so each bucket preserves input order
     order = jnp.argsort(owner, stable=True)
     sorted_owner = owner[order]
@@ -96,8 +116,10 @@ def bucket_by_owner(ids: jax.Array, valid: jax.Array, num_shards: int,
     # scatter (owner, slot) -> id; out-of-capacity and invalid entries drop
     flat_pos = jnp.where(in_cap, sorted_owner * capacity + slot_sorted,
                          num_shards * capacity)
-    bucket_ids = jnp.zeros((num_shards * capacity,), ids.dtype).at[flat_pos].set(
-        ids[order], mode="drop").reshape(num_shards, capacity)
+    lanes = ids.shape[1:]  # () single-lane, (2,) split-pair
+    bucket_ids = jnp.zeros((num_shards * capacity,) + lanes,
+                           ids.dtype).at[flat_pos].set(
+        ids[order], mode="drop").reshape((num_shards, capacity) + lanes)
     bucket_valid = jnp.zeros((num_shards * capacity,), bool).at[flat_pos].set(
         True, mode="drop").reshape(num_shards, capacity)
     # per-input-element position (for unbucketing responses)

@@ -1,0 +1,124 @@
+"""Split 63-bit ids for the x64-off default config.
+
+XLA with `jax_enable_x64=False` (the JAX default, and the right setting for
+TPU compute) cannot represent int64 arrays — `jnp.asarray(np.int64)` silently
+truncates to int32, so ids congruent mod 2^32 collide and the reference's
+`input_dim=-1` -> 2^63 hashed id space (`variable/Meta.h:44-46`) is lost.
+
+The fix is a **split-pair id layout** that the whole id pipeline understands:
+
+    pair = uint32 array of shape (..., 2)
+    pair[..., 0] = hi = bits 62..32   (valid ids: hi < 2^31)
+    pair[..., 1] = lo = bits 31..0
+
+Padding / the EMPTY sentinel set hi's top bit (all-ones row), mirroring the
+single-lane convention of negative == invalid. Host code (numpy has real
+int64) converts at the boundary with `np_split_ids` / `np_join_ids`; device
+code dispatches on `is_pair(ids)`. Checkpoints always store plain int64 ids
+on disk, so the on-disk format is identical in both configs.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# hi lane values >= HI_INVALID mark padding/EMPTY (valid hi < 2^31: ids < 2^63)
+HI_INVALID = np.uint32(0x80000000)
+PAIR_EMPTY = np.uint32(0xFFFFFFFF)
+
+
+def is_pair(ids) -> bool:
+    """True when `ids` LOOKS like a split-pair id array (uint32, trailing dim
+    2). The shape alone is ambiguous — a two-field uint32 batch matches too —
+    so dispatch points must AND this with `spec.use_hash_table` (the pair
+    layout exists only for hash tables; array-table ids are plain ints)."""
+    return (getattr(ids, "dtype", None) == jnp.uint32
+            and ids.ndim >= 1 and ids.shape[-1] == 2)
+
+
+def np_resident_ids(keys: np.ndarray):
+    """(keys np array, either layout) -> (resident bool mask, int64 ids of the
+    resident slots). The one implementation of 'which slots hold real ids' for
+    checkpoint/export/offload writers."""
+    keys = np.asarray(keys)
+    if keys.ndim == 2:
+        sel = keys[:, 0] < HI_INVALID
+        return sel, np_join_ids(keys[sel])
+    sel = keys >= 0
+    return sel, keys[sel].astype(np.int64)
+
+
+def np_ids_as_int64(ids) -> np.ndarray:
+    """Flatten a HASH-TABLE id batch (either layout) to 1-D int64 — host-side
+    twin of the device dispatch (callers guarantee hash-table context)."""
+    ids = np.asarray(ids)
+    if ids.dtype == np.uint32 and ids.ndim >= 1 and ids.shape[-1] == 2:
+        return np_join_ids(ids).reshape(-1)
+    return ids.reshape(-1).astype(np.int64)
+
+
+def np_split_ids(ids64) -> np.ndarray:
+    """int64 (...,) -> uint32 (..., 2); negative ids become the EMPTY pair."""
+    ids = np.asarray(ids64, np.int64)
+    hi = (ids >> 32).astype(np.uint32)
+    lo = (ids & np.int64(0xFFFFFFFF)).astype(np.uint32)
+    neg = ids < 0
+    hi[neg] = PAIR_EMPTY
+    lo[neg] = PAIR_EMPTY
+    return np.stack([hi, lo], axis=-1)
+
+
+def np_join_ids(pair) -> np.ndarray:
+    """uint32 (..., 2) -> int64 (...,); EMPTY/padding rows become -1."""
+    pair = np.asarray(pair)
+    hi = pair[..., 0].astype(np.int64)
+    lo = pair[..., 1].astype(np.int64)
+    out = (hi << 32) | lo
+    out[pair[..., 0] >= HI_INVALID] = -1
+    return out
+
+
+def split_ids(ids: jax.Array) -> jax.Array:
+    """Device-side widen of single-lane ids to the pair layout (int64 inputs
+    keep all bits — x64-on only; int32 inputs get hi=0). Negative -> EMPTY."""
+    if is_pair(ids):
+        return ids
+    neg = ids < 0
+    if ids.dtype.itemsize >= 8:
+        hi = jnp.where(neg, PAIR_EMPTY, (ids >> 32).astype(jnp.uint32))
+        lo = jnp.where(neg, PAIR_EMPTY,
+                       (ids & 0xFFFFFFFF).astype(jnp.uint32))
+    else:
+        hi = jnp.where(neg, PAIR_EMPTY, jnp.zeros_like(ids, jnp.uint32))
+        lo = jnp.where(neg, PAIR_EMPTY, ids.astype(jnp.uint32))
+    return jnp.stack([hi, lo], axis=-1)
+
+
+def pair_valid(pair: jax.Array) -> jax.Array:
+    """(..., 2) -> (...,) bool: real id (not padding/EMPTY)."""
+    return pair[..., 0] < HI_INVALID
+
+
+def pair_mod(pair: jax.Array, m: int) -> jax.Array:
+    """(hi*2^32 + lo) % m in uint32 arithmetic (m <= 2^15 keeps the partial
+    products well inside uint32) — the owner-shard routing `id % S`
+    (`EmbeddingPullOperator.cpp:74-84`) for split ids."""
+    m_u = jnp.uint32(m)
+    two32_mod = jnp.uint32((1 << 32) % m)
+    hi = pair[..., 0] % m_u
+    lo = pair[..., 1] % m_u
+    return ((hi * two32_mod) % m_u + lo) % m_u
+
+
+def np_pair_mod(pair: np.ndarray, m: int) -> np.ndarray:
+    two32_mod = np.uint32((1 << 32) % m)
+    hi = pair[..., 0] % np.uint32(m)
+    lo = pair[..., 1] % np.uint32(m)
+    return ((hi * two32_mod) % np.uint32(m) + lo) % np.uint32(m)
+
+
+def pair_sort_key(pair: jax.Array) -> tuple:
+    """(hi, lo) operands for lexicographic `lax.sort(..., num_keys=2)`."""
+    return pair[..., 0], pair[..., 1]

@@ -230,13 +230,15 @@ def save_sharded(state, model, path: str, *, num_shards: int,
                 sdir = os.path.join(
                     vdir, f"shard_{ordinal:05d}_of_{num_shards:05d}")
                 os.makedirs(sdir, exist_ok=True)
-                # pass 1 (chunked): resident positions + ids
+                # pass 1 (chunked): resident positions + ids (disk format is
+                # ALWAYS plain int64, whatever the device key layout)
                 pos_parts, id_parts = [], []
+                from ..ops.id64 import np_resident_ids
                 for a in range(0, kr.nrows, chunk_rows):
                     kchunk = kr.rows(a, min(a + chunk_rows, kr.nrows))
-                    sel = kchunk >= 0
+                    sel, ids64 = np_resident_ids(kchunk)
+                    id_parts.append(ids64)
                     pos_parts.append(a + np.nonzero(sel)[0])
-                    id_parts.append(kchunk[sel])
                 pos = np.concatenate(pos_parts) if pos_parts else \
                     np.empty((0,), np.int64)
                 ids = np.concatenate(id_parts) if id_parts else \
@@ -452,11 +454,12 @@ def load_sharded(state, model, path: str, *, num_shards: int = 1,
             src_ids = {s: np.load(os.path.join(sdir, "ids.npy"))
                        for s, sdir in src.items()}
 
-            def build_target(t, rows_t, base_w, base_slots, key_dtype):
+            def build_target(t, rows_t, base_w, base_slots, key_like):
                 """-> (keys, weights, slots, dropped) np arrays for shard t."""
+                from ..tables.hash_table import np_fresh_keys
                 ids, pos_by_src = _hash_sources_for_target(t, T, src_ids)
-                keys_t = np.full((rows_t,), -1, key_dtype)
-                pos = np_hash_insert(keys_t, ids.astype(key_dtype), 1)
+                keys_t = np_fresh_keys(rows_t, like=key_like)
+                pos = np_hash_insert(keys_t, ids.astype(np.int64), 1)
                 placed = pos >= 0
                 w = base_w.copy()
                 slots_np = {k: base_slots[k].copy() for k in base_slots}
@@ -489,7 +492,7 @@ def load_sharded(state, model, path: str, *, num_shards: int = 1,
                                   for k in have_slots}
                     keys_t, w, slots_np, dropped = build_target(
                         t, wdata.shape[0], base_w, base_slots,
-                        np.dtype(tmap_k[t][1].dtype))
+                        tmap_k[t][1])
                     var_dropped += dropped
                     per_dev_w[dev] = w
                     per_dev_k[tmap_k[t][0]] = keys_t
@@ -508,8 +511,7 @@ def load_sharded(state, model, path: str, *, num_shards: int = 1,
                 base_w = np.asarray(ts.weights)
                 base_slots = {k: np.asarray(ts.slots[k]) for k in have_slots}
                 keys_t, w, slots_np, dropped = build_target(
-                    0, ts.keys.shape[0], base_w, base_slots,
-                    np.dtype(ts.keys.dtype))
+                    0, ts.keys.shape[0], base_w, base_slots, ts.keys)
                 slots = dict(ts.slots)
                 for k in have_slots:
                     slots[k] = _put_like(slots_np[k], ts.slots[k])

@@ -154,11 +154,11 @@ class ShardedModel:
                 rows = spec.rows_per_shard(T) * T
 
             def mk(spec=spec, rows=rows):
+                from ..tables.hash_table import fresh_keys
                 return EmbeddingTableState(
                     weights=jnp.zeros((rows, spec.output_dim), spec.dtype),
                     slots={},
-                    keys=(jnp.full((rows,), -1, jnp.int64)
-                          if spec.use_hash_table else None),
+                    keys=(fresh_keys(rows) if spec.use_hash_table else None),
                     overflow=(jnp.zeros((), jnp.int32)
                               if spec.use_hash_table else None),
                 )
@@ -235,9 +235,18 @@ class ShardedModel:
                                       axis=0),
                              0)
             return rows.reshape(jnp.asarray(ids).shape + (spec.output_dim,))
-        ids = jnp.asarray(ids)
-        if ids.dtype not in (jnp.int32, jnp.int64):
-            ids = ids.astype(jnp.int64)
+        if (spec.use_hash_table
+                and self.tables[name].keys.ndim == 2):
+            # split-pair table (x64 off): convert int64 request ids host-side
+            from ..ops.id64 import is_pair, np_split_ids
+            if not is_pair(ids):
+                ids = jnp.asarray(np_split_ids(np.asarray(ids, np.int64)))
+            else:
+                ids = jnp.asarray(ids)
+        else:
+            ids = jnp.asarray(ids)
+            if ids.dtype not in (jnp.int32, jnp.int64):
+                ids = ids.astype(jnp.int64)
         return self._lookup_fn(name)(self.tables[name], ids)
 
     def predict(self, batch: Dict[str, Any]) -> jax.Array:

@@ -66,16 +66,41 @@ def _bucket_capacity(n: int, num_shards: int, capacity_factor: float) -> int:
 def _id_valid(spec: EmbeddingSpec, ids: jax.Array) -> jax.Array:
     """In-vocab mask. Hash tables accept any non-negative id; array tables reject
     ids outside [0, input_dim) so padded shard rows are never read or trained."""
+    if ids.ndim == 2:  # split-pair 63-bit layout (hash tables only)
+        from ..ops.id64 import pair_valid
+        return pair_valid(ids)
     if spec.use_hash_table:
         return ids >= 0
     return (ids >= 0) & (ids < spec.input_dim)
+
+
+def _is_pair_batch(spec: EmbeddingSpec, ids: jax.Array) -> bool:
+    """Pair dispatch gated on use_hash_table: a uint32 two-field batch on an
+    array table is NOT a pair (`ops/id64.is_pair` docstring)."""
+    from ..ops.id64 import is_pair
+    return spec.use_hash_table and is_pair(ids)
+
+
+def flatten_ids(spec: EmbeddingSpec, ids: jax.Array) -> jax.Array:
+    """(... [, 2]) -> (n [, 2]): one row per id POSITION whatever the lane
+    count (split-pair ids keep their trailing lane dim)."""
+    return ids.reshape(-1, 2) if _is_pair_batch(spec, ids) else ids.reshape(-1)
+
+
+def ids_positions(spec: EmbeddingSpec, ids: jax.Array) -> int:
+    return ids.size // 2 if _is_pair_batch(spec, ids) else ids.size
+
+
+def _out_shape(spec: EmbeddingSpec, ids: jax.Array):
+    """Row-output shape for an id batch: pairs drop their lane dim."""
+    return ids.shape[:-1] if _is_pair_batch(spec, ids) else ids.shape
 
 
 def make_plan(spec: EmbeddingSpec, ids: jax.Array, *, axis: str = DATA_AXIS,
               capacity_factor: float = 0.0) -> ExchangePlan:
     """Dedup local ids, bucket by owner, exchange the id buckets (one all_to_all)."""
     S = jax.lax.axis_size(axis)
-    flat = ids.reshape(-1)
+    flat = flatten_ids(spec, ids)
     n = flat.shape[0]
     uniq = unique_with_counts(flat)
     valid = (uniq.counts > 0) & _id_valid(spec, uniq.unique_ids)
@@ -92,10 +117,16 @@ def _serve_rows(spec: EmbeddingSpec, state: EmbeddingTableState,
                 ) -> Tuple[EmbeddingTableState, jax.Array]:
     """Server side of a pull: gather this shard's rows for the received ids."""
     S = jax.lax.axis_size(axis)
-    flat_recv = plan.recv_ids.reshape(-1)
+    pair = plan.recv_ids.ndim == 3  # (S, cap, 2) split-pair buckets
+    flat_recv = (plan.recv_ids.reshape(-1, 2) if pair
+                 else plan.recv_ids.reshape(-1))
     flat_valid = plan.recv_valid.reshape(-1)
     if spec.use_hash_table:
-        probe = jnp.where(flat_valid, flat_recv, -1)
+        if pair:
+            from ..ops.id64 import PAIR_EMPTY
+            probe = jnp.where(flat_valid[:, None], flat_recv, PAIR_EMPTY)
+        else:
+            probe = jnp.where(flat_valid, flat_recv, -1)
         if train:
             from ..tables.hash_table import hash_lookup_train
             old_overflow = state.overflow
@@ -133,9 +164,10 @@ def sharded_lookup_train(
     feed the plan to `sharded_apply_gradients` for the same batch."""
     plan = make_plan(spec, ids, axis=axis, capacity_factor=capacity_factor)
     state, rows = _serve_rows(spec, state, plan, train=True, axis=axis)
-    out = _reassemble(plan, rows, ids.shape, spec.output_dim, axis)
+    out = _reassemble(plan, rows, _out_shape(spec, ids), spec.output_dim, axis)
     stats = {
-        "pull_indices": jnp.asarray(ids.size, jnp.int32),   # reference accumulator
+        # reference accumulator counts id POSITIONS (lane-count agnostic)
+        "pull_indices": jnp.asarray(ids_positions(spec, ids), jnp.int32),
         "pull_unique": plan.uniq.num_unique,                # `pull_unique` counter
         "pull_overflow": plan.buckets.overflow,
     }
@@ -154,7 +186,7 @@ def sharded_lookup(
     inserts, absent hash ids return zeros)."""
     plan = make_plan(spec, ids, axis=axis, capacity_factor=capacity_factor)
     _, rows = _serve_rows(spec, state, plan, train=False, axis=axis)
-    return _reassemble(plan, rows, ids.shape, spec.output_dim, axis)
+    return _reassemble(plan, rows, _out_shape(spec, ids), spec.output_dim, axis)
 
 
 def sharded_apply_gradients(
@@ -192,13 +224,19 @@ def sharded_apply_gradients(
     recv_c = jax.lax.all_to_all(c_buckets, axis, 0, 0)
 
     # server side: cross-source re-dedup + fused optimizer (MPSC reduce + update)
-    rids = plan.recv_ids.reshape(-1)
+    pair = plan.recv_ids.ndim == 3
+    rids = (plan.recv_ids.reshape(-1, 2) if pair
+            else plan.recv_ids.reshape(-1))
     rg = recv_g.reshape(-1, spec.output_dim)
     rc = recv_c.reshape(-1)
     if spec.use_hash_table:
         from ..tables.hash_table import hash_find
-        slot = hash_find(state.keys,
-                         jnp.where(rc > 0, rids, -1).astype(state.keys.dtype))
+        if pair:
+            from ..ops.id64 import PAIR_EMPTY
+            probe = jnp.where((rc > 0)[:, None], rids, PAIR_EMPTY)
+        else:
+            probe = jnp.where(rc > 0, rids, -1).astype(state.keys.dtype)
+        slot = hash_find(state.keys, probe)
         capacity = state.keys.shape[0]
         pre_counts = jnp.where((slot < capacity) & (rc > 0), rc, 0)
         weights, slots = sparse_apply_dense_table(

@@ -117,12 +117,12 @@ class MeshTrainer(Trainer):
             rows = spec.rows_per_shard(self.num_shards) * self.num_shards
 
             def mk(spec=spec, opt=opt, rows=rows):
+                from ..tables.hash_table import fresh_keys
                 key = jax.random.fold_in(jax.random.PRNGKey(self.seed),
                                          spec.variable_id * 131071)
                 weights = spec.initializer(key, (rows, spec.output_dim), spec.dtype)
                 slots = opt.init_slots(rows, spec.output_dim)
-                keys = (jnp.full((rows,), -1, jnp.int64)
-                        if spec.use_hash_table else None)
+                keys = fresh_keys(rows) if spec.use_hash_table else None
                 overflow = (jnp.zeros((), jnp.int32)
                             if spec.use_hash_table else None)
                 return EmbeddingTableState(weights=weights, slots=slots, keys=keys,
@@ -268,14 +268,19 @@ class SeqMeshTrainer(MeshTrainer):
     def _batch_pspec(self, batch):
         d, s = self.data_axis, self.seq_axis
 
-        def sparse_spec(x):
+        def sparse_spec(x, spec):
+            from ..ops.id64 import is_pair
             nd = jnp.ndim(x)
+            if spec is not None and spec.use_hash_table and is_pair(x):
+                # trailing dim is the id lane pair, not sequence positions
+                return P(d, *([None] * (nd - 3)), s, None)
             return P(d, *([None] * (nd - 2)), s)
 
         out = {}
         for key, value in batch.items():
             if key == "sparse":
-                out[key] = {k: sparse_spec(v) for k, v in value.items()}
+                out[key] = {k: sparse_spec(v, self.model.specs.get(k))
+                            for k, v in value.items()}
             elif key == "label" and jnp.ndim(value) >= 2:
                 out[key] = P(d, s)
             elif key == "dense":

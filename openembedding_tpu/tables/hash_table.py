@@ -18,6 +18,16 @@ handful of fused gathers/scatters:
   init-on-first-pull (`EmbeddingOptimizerVariable.h:242-266`).
 
 Ids must be non-negative (63-bit hash space); -1 is the EMPTY sentinel.
+
+**63-bit ids WITHOUT jax_enable_x64 (the default config):** XLA under x64-off
+cannot hold int64 arrays at all, so keys are stored as a **split pair of
+uint32 lanes** — shape (capacity, 2), `[:, 0]` = bits 62..32 (valid < 2^31),
+`[:, 1]` = bits 31..0 — and ids travel the id pipeline (dedup -> bucket ->
+all_to_all -> probe) in the same `uint32 (..., 2)` layout (`ops/id64.py`).
+Every kernel here dispatches on `keys.ndim`: 1 = int64 single-lane (x64 on),
+2 = split-pair. EMPTY/padding in pair form is hi >= 2^31 (all-ones row).
+The reference gets 2^63 keys for free from C++ `uint64_t`
+(`variable/Meta.h:44-46`); the pair layout is the TPU-native equivalent.
 """
 
 from __future__ import annotations
@@ -26,6 +36,9 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+
+from ..ops.id64 import (HI_INVALID, PAIR_EMPTY, is_pair, np_join_ids,
+                        np_split_ids, pair_valid)
 
 EMPTY = -1
 DEFAULT_NUM_PROBES = 64
@@ -57,6 +70,57 @@ def np_mix(ids):
     return u ^ (u >> np.uint32(16))
 
 
+def _mix_pair(hi: jax.Array, lo: jax.Array) -> jax.Array:
+    """Avalanche both uint32 lanes of a split 63-bit id into one uint32."""
+    u = lo.astype(jnp.uint32) * jnp.uint32(0x9E3779B1)
+    u = u ^ (hi.astype(jnp.uint32) * jnp.uint32(0x85EBCA77))
+    u = (u ^ (u >> 16)) * jnp.uint32(0x45D9F3B)
+    return u ^ (u >> 16)
+
+
+def np_mix_pair(hi, lo):
+    """Numpy mirror of `_mix_pair` — same sync contract as `np_mix`."""
+    import numpy as np
+    u = hi.astype(np.uint32), lo.astype(np.uint32)
+    v = u[1] * np.uint32(0x9E3779B1)
+    v = v ^ (u[0] * np.uint32(0x85EBCA77))
+    v = (v ^ (v >> np.uint32(16))) * np.uint32(0x45D9F3B)
+    return v ^ (v >> np.uint32(16))
+
+
+def fresh_keys(rows: int) -> jax.Array:
+    """An all-EMPTY key array in the layout the current config supports:
+    int64 single-lane under x64, the uint32 split pair otherwise — the
+    dispatch point that makes `input_dim=-1` mean 2^63 in BOTH configs."""
+    if jax.config.jax_enable_x64:
+        return jnp.full((rows,), EMPTY, jnp.int64)
+    return jnp.full((rows, 2), PAIR_EMPTY, jnp.uint32)
+
+
+def np_fresh_keys(rows: int, like=None):
+    """Host twin of `fresh_keys`; `like` (an existing keys array) pins the
+    layout explicitly (checkpoint loaders build for a given template)."""
+    import numpy as np
+    pair = (like.ndim == 2) if like is not None \
+        else not jax.config.jax_enable_x64
+    if pair:
+        return np.full((rows, 2), PAIR_EMPTY, np.uint32)
+    return np.full((rows,), EMPTY, np.int64)
+
+
+def adapt_ids(keys: jax.Array, ids: jax.Array) -> jax.Array:
+    """Convert flat ids to the key array's layout (pair <-> single), keeping
+    negatives/EMPTY invalid in either layout."""
+    from ..ops.id64 import split_ids
+    if keys.ndim == 2:
+        return ids if is_pair(ids) else split_ids(ids)
+    if is_pair(ids):
+        raise ValueError(
+            "split-pair ids need a pair-layout table (jax_enable_x64 is on; "
+            "pass plain int64 ids instead)")
+    return ids.astype(keys.dtype)
+
+
 def np_hash_insert(keys, ids, num_shards: int,
                    num_probes: int = DEFAULT_NUM_PROBES):
     """Vectorized host-side insertion of checkpointed keys into a (possibly
@@ -81,19 +145,25 @@ def np_hash_insert(keys, ids, num_shards: int,
     """
     import numpy as np
 
+    pair = keys.ndim == 2  # split-pair layout (see module docstring)
     rows_total = keys.shape[0]
     cps = rows_total // num_shards
     owner = (np.asarray(ids, np.int64) % num_shards) * cps
-    mixed = np_mix(ids)
-    base = (mixed % np.uint64(cps) if ids.dtype.itemsize >= 8
-            else mixed % np.uint32(cps)).astype(np.int64)
+    if pair:
+        ids_pair = np_split_ids(np.asarray(ids, np.int64))
+        base = (np_mix_pair(ids_pair[:, 0], ids_pair[:, 1])
+                % np.uint32(cps)).astype(np.int64)
+    else:
+        mixed = np_mix(ids)
+        base = (mixed % np.uint64(cps) if ids.dtype.itemsize >= 8
+                else mixed % np.uint32(cps)).astype(np.int64)
     pos_out = np.full(len(ids), -1, np.int64)
     max_d = min(num_probes, cps)
     active = np.arange(len(ids))
     dist = np.zeros(len(ids), np.int64)
     while active.size:
         p = owner[active] + (base[active] + dist[active]) % cps
-        empty = keys[p] == EMPTY
+        empty = keys[p, 0] >= HI_INVALID if pair else keys[p] == EMPTY
         cand, cp = active[empty], p[empty]
         order = np.argsort(cp, kind="stable")
         cp_s, cand_s = cp[order], cand[order]
@@ -101,7 +171,10 @@ def np_hash_insert(keys, ids, num_shards: int,
         if cp_s.size:
             first[1:] = cp_s[1:] != cp_s[:-1]
         win, wp = cand_s[first], cp_s[first]
-        keys[wp] = ids[win]
+        if pair:
+            keys[wp] = ids_pair[win]
+        else:
+            keys[wp] = ids[win]
         pos_out[win] = wp
         placed = np.zeros(len(ids), bool)
         placed[win] = True
@@ -111,15 +184,79 @@ def np_hash_insert(keys, ids, num_shards: int,
     return pos_out
 
 
+def _pair_find_or_insert(keys: jax.Array, ids: jax.Array,
+                         num_probes: int) -> Tuple[jax.Array, jax.Array,
+                                                   jax.Array]:
+    """Split-pair twin of the single-lane probe loop below. One extra care:
+    two contenders racing a scatter into one row could in principle tear the
+    two lanes; the read-back verifies BOTH lanes, so a torn row simply matches
+    neither contender (both keep probing) and the garbage slot is probed past
+    forever — a leaked slot, never a wrong answer."""
+    capacity = keys.shape[0]
+    valid = pair_valid(ids)
+    base = (_mix_pair(ids[:, 0], ids[:, 1])
+            % jnp.uint32(capacity)).astype(jnp.int32)
+    slot0 = jnp.full((ids.shape[0],), capacity, jnp.int32)
+    placed0 = ~valid
+
+    def probe(d, carry):
+        keys, slot, placed = carry
+        pos = (base + d) % capacity
+        cur = keys[pos]
+        match = (cur[:, 0] == ids[:, 0]) & (cur[:, 1] == ids[:, 1])
+        found = (~placed) & match
+        slot = jnp.where(found, pos, slot)
+        placed = placed | found
+        want = (~placed) & (cur[:, 0] >= HI_INVALID)
+        target = jnp.where(want, pos, capacity)
+        keys = keys.at[target].set(ids, mode="drop")
+        re = keys[pos]
+        got = want & (re[:, 0] == ids[:, 0]) & (re[:, 1] == ids[:, 1])
+        slot = jnp.where(got, pos, slot)
+        placed = placed | got
+        return keys, slot, placed
+
+    keys, slot, placed = jax.lax.fori_loop(
+        0, num_probes, probe, (keys, slot0, placed0))
+    overflow = jnp.sum(~placed).astype(jnp.int32)
+    return keys, slot, overflow
+
+
+def _pair_find(keys: jax.Array, ids: jax.Array, num_probes: int) -> jax.Array:
+    capacity = keys.shape[0]
+    base = (_mix_pair(ids[:, 0], ids[:, 1])
+            % jnp.uint32(capacity)).astype(jnp.int32)
+    slot0 = jnp.full((ids.shape[0],), capacity, jnp.int32)
+    done0 = ~pair_valid(ids)
+
+    def probe(d, carry):
+        slot, done = carry
+        pos = (base + d) % capacity
+        cur = keys[pos]
+        found = (~done) & (cur[:, 0] == ids[:, 0]) & (cur[:, 1] == ids[:, 1])
+        slot = jnp.where(found, pos, slot)
+        # an all-EMPTY row terminates the search; garbage (torn) rows do not
+        done = done | found | ((~done) & (cur[:, 0] == jnp.uint32(0xFFFFFFFF))
+                               & (cur[:, 1] == jnp.uint32(0xFFFFFFFF)))
+        return slot, done
+
+    slot, _ = jax.lax.fori_loop(0, num_probes, probe, (slot0, done0))
+    return slot
+
+
 def hash_find_or_insert(keys: jax.Array, ids: jax.Array,
                         num_probes: int = DEFAULT_NUM_PROBES
                         ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Find each id's slot, inserting missing ids into empty slots.
 
-    keys: (capacity,) int table; ids: (n,) unique non-negative ids (dedup first —
-    duplicate ids in one call may claim two slots). Returns (new_keys, slot (n,) int32
-    with `capacity` marking overflow, overflow_count).
+    keys: (capacity,) int table OR (capacity, 2) uint32 split-pair table;
+    ids in the matching layout ((n,) / (n, 2)), unique, non-negative (dedup
+    first — duplicate ids in one call may claim two slots). Returns
+    (new_keys, slot (n,) int32 with `capacity` marking overflow,
+    overflow_count).
     """
+    if keys.ndim == 2:
+        return _pair_find_or_insert(keys, ids, num_probes)
     capacity = keys.shape[0]
     valid = ids >= 0  # negative ids (padding like -1) must never match EMPTY slots
     base = (_mix(ids) % jnp.asarray(capacity).astype(_mix(ids).dtype)).astype(jnp.int32)
@@ -151,6 +288,8 @@ def hash_find(keys: jax.Array, ids: jax.Array,
               num_probes: int = DEFAULT_NUM_PROBES) -> jax.Array:
     """Read-only probe: slot index per id, `capacity` if absent (reference read-only
     serving pull `get_weights`, `EmbeddingPullOperator.cpp:149-205`)."""
+    if keys.ndim == 2:
+        return _pair_find(keys, ids, num_probes)
     capacity = keys.shape[0]
     base = (_mix(ids) % jnp.asarray(capacity).astype(_mix(ids).dtype)).astype(jnp.int32)
     slot0 = jnp.full(ids.shape, capacity, jnp.int32)
@@ -172,7 +311,7 @@ def hash_find(keys: jax.Array, ids: jax.Array,
 
 def hash_lookup(state, ids: jax.Array) -> jax.Array:
     """Read-only pull: absent ids return zero rows."""
-    ids = ids.astype(state.keys.dtype)
+    ids = adapt_ids(state.keys, ids)
     slot = hash_find(state.keys, ids)
     capacity, dim = state.weights.shape
     hit = slot < capacity
@@ -186,10 +325,14 @@ def hash_lookup_train(state, ids: jax.Array):
     (`EmbeddingOptimizerVariable.h:242-266`)."""
     from ..ops.dedup import unique_with_counts
 
-    ids = ids.astype(state.keys.dtype)
+    ids = adapt_ids(state.keys, ids)
     uniq = unique_with_counts(ids)
     # only insert real (count>0) unique ids; padding probes for EMPTY and is dropped
-    probe_ids = jnp.where(uniq.counts > 0, uniq.unique_ids, EMPTY)
+    if state.keys.ndim == 2:
+        probe_ids = jnp.where((uniq.counts > 0)[:, None], uniq.unique_ids,
+                              PAIR_EMPTY)
+    else:
+        probe_ids = jnp.where(uniq.counts > 0, uniq.unique_ids, EMPTY)
     new_keys, uslot, overflow = hash_find_or_insert(state.keys, probe_ids)
     slot = uslot[uniq.inverse]
     capacity = state.keys.shape[0]
@@ -206,7 +349,7 @@ def hash_apply_gradients(state, optimizer, ids: jax.Array, grads: jax.Array):
     then run the shared fused sparse apply over slot indices."""
     from ..ops.sparse import sparse_apply_dense_table
 
-    ids = ids.astype(state.keys.dtype)
+    ids = adapt_ids(state.keys, ids)
     slot = hash_find(state.keys, ids)
     capacity = state.keys.shape[0]
     # absent ids (overflowed at pull time) drop their gradients, like the reference

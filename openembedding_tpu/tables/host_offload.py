@@ -162,11 +162,17 @@ def _make_mesh_admit(mesh, axis, state_pspec, slot_names):
     from .hash_table import hash_find_or_insert
 
     def admit(state, ids, w_rows, s_rows, known):
+        from ..ops.id64 import PAIR_EMPTY, is_pair, pair_mod, pair_valid
         S = jax.lax.axis_size(axis)
         idx = jax.lax.axis_index(axis)
         keys = state.keys
-        mine = (ids >= 0) & ((ids % S).astype(jnp.int32) == idx)
-        probe = jnp.where(mine, ids, -1).astype(keys.dtype)
+        if is_pair(ids):
+            mine = pair_valid(ids) & (pair_mod(ids, S).astype(jnp.int32)
+                                      == idx)
+            probe = jnp.where(mine[:, None], ids, PAIR_EMPTY)
+        else:
+            mine = (ids >= 0) & ((ids % S).astype(jnp.int32) == idx)
+            probe = jnp.where(mine, ids, -1).astype(keys.dtype)
         new_keys, slot, oflow = hash_find_or_insert(keys, probe)
         cps = keys.shape[0]
         admitted_local = mine & (slot < cps)
@@ -270,7 +276,8 @@ class HostOffloadTable:
                                      spec.variable_id * 131071)
             weights = spec.initializer(key, (rows, spec.output_dim), spec.dtype)
             slots = opt.init_slots(rows, spec.output_dim)
-            keys = jnp.full((rows,), -1, jnp.int64)
+            from .hash_table import fresh_keys
+            keys = fresh_keys(rows)
             overflow = jnp.zeros((), jnp.int32)
             return EmbeddingTableState(weights=weights, slots=slots, keys=keys,
                                        overflow=overflow)
@@ -313,8 +320,11 @@ class HostOffloadTable:
 
     def prepare(self, ids) -> None:
         """Make the cache ready for a batch: flush if needed, re-admit evicted
-        ids. Call BEFORE the train step; rebind `self.state` after it."""
-        flat = np.unique(np.asarray(ids).reshape(-1).astype(np.int64))
+        ids (split-pair batches are joined to int64 host-side — the residency
+        set, the store, and the shard accounting all speak int64). Call
+        BEFORE the train step; rebind `self.state` after it."""
+        from ..ops.id64 import np_ids_as_int64
+        flat = np.unique(np_ids_as_int64(ids))
         flat = flat[flat >= 0]
         if self._resident_sorted.size:
             pos = np.searchsorted(self._resident_sorted, flat)
@@ -339,7 +349,12 @@ class HostOffloadTable:
                     "cannot hold one batch and some rows will overflow — "
                     "raise `capacity` or shrink the batch", RuntimeWarning)
         known_hit, w, s = self.store.lookup(new)
-        ids_dev = jnp.asarray(new)
+        # the host store is int64 numpy; the device cache may be split-pair
+        if self.state.keys.ndim == 2:
+            from ..ops.id64 import np_split_ids
+            ids_dev = jnp.asarray(np_split_ids(new))
+        else:
+            ids_dev = jnp.asarray(new)
         with metrics.vtimer("offload", "admit"):
             self.state, admitted = self._admit(
                 self.state, ids_dev, jnp.asarray(w),
@@ -360,10 +375,10 @@ class HostOffloadTable:
         resetting the cache — a consistent full snapshot for checkpoint/persist
         while training continues undisturbed."""
         with metrics.vtimer("offload", "sync"):
-            keys = np.asarray(self.state.keys)
-            sel = keys >= 0
+            from ..ops.id64 import np_resident_ids
+            sel, ids64 = np_resident_ids(np.asarray(self.state.keys))
             self.store.merge(
-                keys[sel].astype(np.int64),
+                ids64,
                 np.asarray(self.state.weights)[sel].astype(np.float32),
                 {k: np.asarray(v)[sel].astype(np.float32)
                  for k, v in self.state.slots.items()})
@@ -409,8 +424,12 @@ class HostOffloadTable:
         """Read rows wherever they live; absent ids -> zeros. Implemented as a
         store write-back + host read so it is correct for any mesh layout.
         For eval/export, not the hot path."""
+        from ..ops.id64 import np_ids_as_int64
         self.sync_to_store()
-        flat = np.asarray(ids).reshape(-1).astype(np.int64)
+        raw = np.asarray(ids)
+        flat = np_ids_as_int64(raw)
+        out_shape = (raw.shape[:-1]
+                     if raw.dtype == np.uint32 and raw.shape[-1] == 2
+                     else raw.shape)
         _, host_rows, _ = self.store.lookup(flat)
-        return host_rows.reshape(np.asarray(ids).shape
-                                 + (self.spec.output_dim,))
+        return host_rows.reshape(out_shape + (self.spec.output_dim,))

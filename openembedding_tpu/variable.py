@@ -45,7 +45,8 @@ class EmbeddingVariable:
 
     # -- reference `Variable.push_gradients`: queue grads; applied at update_weights
     def push_gradients(self, ids, grads) -> None:
-        ids = jnp.asarray(ids).reshape(-1)
+        from .embedding import _flat_ids
+        ids, _ = _flat_ids(self.spec, jnp.asarray(ids))  # pairs keep lanes
         grads = jnp.asarray(grads).reshape(-1, self.spec.output_dim)
         if self._pending_ids is None:
             self._pending_ids, self._pending_grads = ids, grads
