@@ -170,6 +170,22 @@ def _put_like(np_arr: np.ndarray, like) -> jax.Array:
     return jax.device_put(arr, sharding) if sharding is not None else arr
 
 
+def _migrate_dense_slots(target, loaded_flat: Dict[str, np.ndarray]):
+    """Optimizer-swap migration for the DENSE tower's slots: carry checkpoint
+    slot entries that exist in the target layout with the same shape, keep the
+    target's fresh init for the rest (the same name+shape rule as
+    `variable.set_optimizer` and the per-table slot loading; reference
+    hot-swaps layouts via `copy_from`, `EmbeddingVariable.cpp:29-60`).
+    Wholesale replacement would hand e.g. an Adadelta step an Adagrad-shaped
+    slot dict and KeyError inside jit."""
+    target_flat = _flatten_params(target)
+    out = dict(target_flat)
+    for k, v in loaded_flat.items():
+        if k in target_flat and target_flat[k].shape == v.shape:
+            out[k] = v
+    return _unflatten_params(out)
+
+
 def _check_meta(meta: ModelMeta, model) -> None:
     """Shared dump/load meta validation (reference: load_model rejects meta
     mismatches); used by this module and `parallel/checkpoint.py`."""
@@ -208,7 +224,8 @@ def load_server_model(state, model, path: str, *, num_shards: int = 1,
     dense_slots = state.dense_slots
     if os.path.exists(slots_path):
         z = np.load(slots_path)
-        dense_slots = _unflatten_params({k: z[k] for k in z.files})
+        dense_slots = _migrate_dense_slots(state.dense_slots,
+                                           {k: z[k] for k in z.files})
 
     new_tables = dict(state.tables)
     for name, spec in model.specs.items():

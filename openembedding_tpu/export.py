@@ -181,10 +181,11 @@ class StandaloneModel:
             ids_shape = flat_np.shape
             flat_np = flat_np.reshape(-1).astype(np.int64)
             n = t["ids"].shape[0]
+            if n == 0:  # empty table: every id is absent -> zero rows
+                return jnp.zeros(tuple(ids_shape) + (t["dim"],), w.dtype)
             pos = np.searchsorted(t["ids"], flat_np)
-            pos_c = np.minimum(pos, max(n - 1, 0))
-            hit = (t["ids"][pos_c] == flat_np) if n else \
-                np.zeros(flat_np.shape, bool)
+            pos_c = np.minimum(pos, n - 1)
+            hit = t["ids"][pos_c] == flat_np
             rows = jnp.where(jnp.asarray(hit)[:, None],
                              w[jnp.asarray(pos_c)], jnp.zeros_like(w[:1]))
             return rows.reshape(tuple(ids_shape) + (t["dim"],))

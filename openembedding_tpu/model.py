@@ -247,6 +247,23 @@ class Trainer:
     # -- init ---------------------------------------------------------------
 
     def init(self, sample_batch: Dict[str, Any]) -> TrainState:
+        # the one warning jit can't emit: int64 ids under x64-off silently
+        # truncate at the device boundary (hi lane lost) — the pair layout
+        # (`ops/id64.py`, `synthetic_criteo(ids_dtype='pair')`) is the fix
+        if not jax.config.jax_enable_x64:
+            import numpy as _np
+            for name, spec in self.model.ps_specs().items():
+                if not spec.use_hash_table:
+                    continue
+                ids = _np.asarray(sample_batch["sparse"][name])
+                if ids.dtype == _np.int64 and (ids >= (1 << 31)).any():
+                    import warnings
+                    warnings.warn(
+                        f"embedding {name!r}: int64 ids >= 2^31 with "
+                        "jax_enable_x64 off TRUNCATE to int32 on device "
+                        "(ids congruent mod 2^32 collide). Feed the split-"
+                        "pair layout instead (ops/id64.np_split_ids or "
+                        "ids_dtype='pair').", UserWarning)
         key = jax.random.PRNGKey(self.seed)
         embedded = self._fake_embedded(sample_batch)
         dense_inputs = sample_batch.get("dense")
@@ -270,9 +287,28 @@ class Trainer:
             model_version=jnp.zeros((), jnp.int32),
         )
 
+    def _check_num_shards(self) -> None:
+        """`EmbeddingSpec.num_shards` exists for reference API parity
+        (`exb.py:388-419`: rows spread over N PS processes, placement round-
+        robined in `WorkerContext.cpp:66-85`). Under SPMD there are no server
+        processes to place onto — every table shards over the WHOLE mesh, which
+        strictly dominates sub-mesh placement on TPU (the all_to_all spans all
+        ICI links either way; fewer shards would only idle devices). A value
+        other than -1/mesh-size is therefore NOT honored, and silence would be
+        a lying knob — say so loudly."""
+        for name, spec in self.model.ps_specs().items():
+            if spec.num_shards not in (-1, self.num_shards):
+                import warnings
+                warnings.warn(
+                    f"embedding {name!r}: num_shards={spec.num_shards} is not "
+                    f"honored — tables always shard over the whole mesh "
+                    f"({self.num_shards} device(s)) under SPMD; see "
+                    "PARITY.md 'num_shards'", UserWarning)
+
     def init_tables(self) -> Dict[str, EmbeddingTableState]:
         """Hook: single-device tables. MeshTrainer overrides to create the tables
         directly sharded (a huge table must never materialize on one device)."""
+        self._check_num_shards()
         tables = {}
         for name, spec in self.model.ps_specs().items():
             if spec.storage == "host_cached":

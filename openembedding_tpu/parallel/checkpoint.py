@@ -404,8 +404,10 @@ def load_sharded(state, model, path: str, *, num_shards: int = 1,
     slots_path = os.path.join(path, "dense_slots.npz")
     dense_slots = state.dense_slots
     if os.path.exists(slots_path):
+        from ..checkpoint import _migrate_dense_slots
         z = np.load(slots_path)
-        dense_slots = _unflatten_params({k: z[k] for k in z.files})
+        dense_slots = _migrate_dense_slots(state.dense_slots,
+                                           {k: z[k] for k in z.files})
 
     new_tables = dict(state.tables)
     for name, spec in model.specs.items():

@@ -27,6 +27,17 @@ but moves S*n ids; real workloads set a capacity_factor so capacity ~ factor * n
 and watch the overflow counters (dropped ids pull zeros / drop grads — divergence from
 the reference's unbounded buffers, surfaced in metrics).
 
+SIZING RULE for `capacity_factor` (f): bucket (src, dst) must hold the unique
+ids of src's batch slice owned by dst. With u unique ids per device batch of n
+and p_max = the hottest shard's share of them, zero-drop needs
+    f >= S * p_max * (u / n).
+Uniform ids: p_max ~ 1/S, so f >= u/n (<= 1). Zipfian CTR traffic concentrates
+2-4x on hot shards after hashing -> start at f in [1, 2], watch
+`pull_overflow`/`push_overflow` in the step stats (psum'd per batch) and the
+table-level `overflow` counter, raise f while they fire. f = 0 (exact mode,
+cap = n) can never drop but moves S*n ids per a2a. Tested in
+`tests/test_capacity_and_migration.py`.
+
 Out-of-vocab ids (array tables) are masked invalid end to end: they pull zeros and
 their gradients are dropped, identical to the single-device path (`ops/sparse.py`).
 """

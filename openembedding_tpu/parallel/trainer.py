@@ -103,6 +103,7 @@ class MeshTrainer(Trainer):
         )
 
     def init_tables(self):
+        self._check_num_shards()
         mesh = self.mesh
         tables = {}
         for name, spec in self.model.ps_specs().items():

@@ -424,12 +424,10 @@ class HostOffloadTable:
         """Read rows wherever they live; absent ids -> zeros. Implemented as a
         store write-back + host read so it is correct for any mesh layout.
         For eval/export, not the hot path."""
-        from ..ops.id64 import np_ids_as_int64
+        from ..ops.id64 import is_pair, np_ids_as_int64
         self.sync_to_store()
         raw = np.asarray(ids)
         flat = np_ids_as_int64(raw)
-        out_shape = (raw.shape[:-1]
-                     if raw.dtype == np.uint32 and raw.shape[-1] == 2
-                     else raw.shape)
+        out_shape = raw.shape[:-1] if is_pair(raw) else raw.shape
         _, host_rows, _ = self.store.lookup(flat)
         return host_rows.reshape(out_shape + (self.spec.output_dim,))
