@@ -64,7 +64,8 @@ def save_server_model(state, model, path: str, *, include_optimizer: bool = True
     triples sorted by id, so a load at ANY future mesh size is a pure relayout
     (reference: key remap `index*shard_num + shard_id` on load,
     `EmbeddingShardFile.h:23-25`). NOTE: this single-host path gathers each table to
-    host RAM; the streaming per-shard writer is future work (`parallel` checkpoint).
+    host RAM; the mesh-scale per-shard streaming writer is
+    `parallel/checkpoint.save_sharded` (bounded host memory, multi-host).
     """
     from .parallel.sharded import deinterleave_rows
 
