@@ -99,7 +99,13 @@ def read_criteo_tsv(paths, batch_size: int, *, id_space: int = 1 << 25,
         paths = [paths]
     if native not in ("auto", "on", "off"):
         raise ValueError(f"bad native mode {native!r}")
-    if native != "off" and not any(str(p).endswith(".gz") for p in paths):
+    from ..utils import fs as fsmod
+    any_remote = any(fsmod.is_remote(str(p)) for p in paths)
+    if any_remote and native == "on":
+        raise ValueError("native reader reads local files only; remote URIs "
+                         "stream through utils.fs (native='off'/'auto')")
+    if (native != "off" and not any_remote
+            and not any(str(p).endswith(".gz") for p in paths)):
         reader = None
         try:
             # only CONSTRUCTION falls back (no compiler / bad build); a failure
@@ -121,8 +127,25 @@ def read_criteo_tsv(paths, batch_size: int, *, id_space: int = 1 << 25,
     while True:
         pending = []
         for path in paths:
-            opener = gzip.open if str(path).endswith(".gz") else open
-            with opener(path, "rt") as f:
+            from contextlib import ExitStack
+            stack = ExitStack()
+            if fsmod.is_remote(str(path)):
+                # sequential stream through the URI's adapter (the reference's
+                # hadoop-pipe read, `EmbeddingShardFile.h`); .gz decodes on
+                # the fly. GzipFile does NOT close its fileobj, so the pipe
+                # reader (whose close() waits the subprocess and surfaces a
+                # nonzero exit) enters the stack explicitly — a mid-stream
+                # transport failure must propagate, same invariant as the
+                # native reader above.
+                import io
+                raw = stack.enter_context(fsmod.open_stream(str(path), "rb"))
+                f = stack.enter_context(io.TextIOWrapper(
+                    gzip.GzipFile(fileobj=raw) if str(path).endswith(".gz")
+                    else raw))
+            else:
+                opener = gzip.open if str(path).endswith(".gz") else open
+                f = stack.enter_context(opener(path, "rt"))
+            with stack:
                 for i, line in enumerate(f):
                     if i % num_hosts != host_id:
                         continue

@@ -139,6 +139,14 @@ class StandaloneModel:
     @classmethod
     def load(cls, path: str, model: Optional[EmbeddingModel] = None
              ) -> "StandaloneModel":
+        from .utils import fs as fsmod
+        if fsmod.is_remote(path):
+            import shutil
+            local = fsmod.stage_in(path)
+            try:
+                return cls.load(local, model=model)
+            finally:
+                shutil.rmtree(local, ignore_errors=True)
         with open(os.path.join(path, MODEL_META_FILE)) as f:
             meta = ModelMeta.from_json(f.read())
         if model is None:

@@ -149,26 +149,60 @@ class Trainer:
 
     # -- checkpointing (reference: model.save/save_weights/load_weights wiring,
     #    `exb.py:550-583`) -------------------------------------------------------
+    def _stage_save(self, write_fn, path: str):
+        """Remote-URI checkpoints write locally then push through the URI's
+        filesystem adapter (`utils/fs.py` — the reference's HDFS dump via
+        hadoop pipes, `EmbeddingShardFile.h`). Each process pushes only the
+        files it wrote, so multi-host uploads compose."""
+        from .utils import fs as fsmod
+        if not fsmod.is_remote(path):
+            return write_fn(path)
+        import shutil
+        import tempfile
+        local = tempfile.mkdtemp(prefix="oetpu_ckpt_out_")
+        try:
+            meta = write_fn(local)
+            fsmod.stage_out(local, path)
+            return meta
+        finally:
+            shutil.rmtree(local, ignore_errors=True)
+
+    def _stage_load(self, read_fn, path: str):
+        from .utils import fs as fsmod
+        if not fsmod.is_remote(path):
+            return read_fn(path)
+        import shutil
+        local = fsmod.stage_in(path)
+        try:
+            return read_fn(local)
+        finally:
+            shutil.rmtree(local, ignore_errors=True)
+
     def save(self, state: "TrainState", path: str, **kw):
         from .checkpoint import save_server_model
-        return save_server_model(state, self.model, path,
-                                 num_shards=self.num_shards,
-                                 offload_stores=self.offload_store_snapshots(state),
-                                 **kw)
+        return self._stage_save(
+            lambda p: save_server_model(
+                state, self.model, p, num_shards=self.num_shards,
+                offload_stores=self.offload_store_snapshots(state), **kw),
+            path)
 
     def load(self, state: "TrainState", path: str):
         """Dispatches on the checkpoint layout: single-file (this class's save)
         or per-shard streaming (`MeshTrainer.save` / `parallel/checkpoint.py`) —
-        either loads at any target mesh size."""
-        from .parallel.checkpoint import checkpoint_layout, load_sharded
-        if checkpoint_layout(path) == "sharded":
-            return load_sharded(state, self.model, path,
-                                num_shards=self.num_shards,
-                                offload=self.offload)
-        from .checkpoint import load_server_model
-        return load_server_model(state, self.model, path,
-                                 num_shards=self.num_shards,
-                                 offload=self.offload)
+        either loads at any target mesh size. Remote URIs stage to local disk
+        first (the loaders are random-access/memmap'd)."""
+        def read(p):
+            from .parallel.checkpoint import checkpoint_layout, load_sharded
+            if checkpoint_layout(p) == "sharded":
+                return load_sharded(state, self.model, p,
+                                    num_shards=self.num_shards,
+                                    offload=self.offload)
+            from .checkpoint import load_server_model
+            return load_server_model(state, self.model, p,
+                                     num_shards=self.num_shards,
+                                     offload=self.offload)
+
+        return self._stage_load(read, path)
 
     # -- host offload drivers (storage="host_cached" variables) ---------------
     #

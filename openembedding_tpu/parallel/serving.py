@@ -124,6 +124,17 @@ class ShardedModel:
              model=None) -> "ShardedModel":
         from .checkpoint import checkpoint_layout, load_sharded
         from ..checkpoint import load_server_model
+        from ..utils import fs as fsmod
+
+        if fsmod.is_remote(path):
+            # the loaders are random-access (memmap'd shard assembly): remote
+            # checkpoints stage through local disk, like Trainer.load
+            import shutil
+            local = fsmod.stage_in(path)
+            try:
+                return cls.load(local, mesh=mesh, model=model)
+            finally:
+                shutil.rmtree(local, ignore_errors=True)
 
         mesh = mesh if mesh is not None else make_mesh()
         axis = mesh.axis_names[0]

@@ -52,10 +52,11 @@ class MeshTrainer(Trainer):
         reference's server-side per-shard dump, `EmbeddingDumpOperator.cpp:36-96`.
         `Trainer.load` / `MeshTrainer.load` restore it at any mesh size."""
         from .checkpoint import save_sharded
-        return save_sharded(state, self.model, path,
-                            num_shards=self.num_shards,
-                            offload_stores=self.offload_store_snapshots(state),
-                            **kw)
+        return self._stage_save(
+            lambda p: save_sharded(
+                state, self.model, p, num_shards=self.num_shards,
+                offload_stores=self.offload_store_snapshots(state), **kw),
+            path)
 
     # -- sharding specs ------------------------------------------------------
 
