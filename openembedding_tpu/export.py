@@ -141,12 +141,8 @@ class StandaloneModel:
              ) -> "StandaloneModel":
         from .utils import fs as fsmod
         if fsmod.is_remote(path):
-            import shutil
-            local = fsmod.stage_in(path)
-            try:
+            with fsmod.staged(path) as local:
                 return cls.load(local, model=model)
-            finally:
-                shutil.rmtree(local, ignore_errors=True)
         with open(os.path.join(path, MODEL_META_FILE)) as f:
             meta = ModelMeta.from_json(f.read())
         if model is None:

@@ -169,14 +169,8 @@ class Trainer:
 
     def _stage_load(self, read_fn, path: str):
         from .utils import fs as fsmod
-        if not fsmod.is_remote(path):
-            return read_fn(path)
-        import shutil
-        local = fsmod.stage_in(path)
-        try:
+        with fsmod.staged(path) as local:
             return read_fn(local)
-        finally:
-            shutil.rmtree(local, ignore_errors=True)
 
     def save(self, state: "TrainState", path: str, **kw):
         from .checkpoint import save_server_model

@@ -129,12 +129,8 @@ class ShardedModel:
         if fsmod.is_remote(path):
             # the loaders are random-access (memmap'd shard assembly): remote
             # checkpoints stage through local disk, like Trainer.load
-            import shutil
-            local = fsmod.stage_in(path)
-            try:
+            with fsmod.staged(path) as local:
                 return cls.load(local, mesh=mesh, model=model)
-            finally:
-                shutil.rmtree(local, ignore_errors=True)
 
         mesh = mesh if mesh is not None else make_mesh()
         axis = mesh.axis_names[0]

@@ -147,8 +147,10 @@ class ShellPipeFS(FileSystemBase):
 
     def __init__(self, *, cat, put, test, ls, mkdir, testdir=None,
                  puttree=None):
+        # NO testdir fallback to `test`: an exists-check would call files
+        # directories and send stage_in's walk recursing into them
         self.templates = {"cat": cat, "put": put, "test": test, "ls": ls,
-                          "mkdir": mkdir, "testdir": testdir or test,
+                          "mkdir": mkdir, "testdir": testdir,
                           "puttree": puttree}
 
     def _cmd(self, name: str, uri: str) -> List[str]:
@@ -182,14 +184,26 @@ class ShellPipeFS(FileSystemBase):
                        capture_output=True)
 
     def isdir(self, uri):
+        if not self.templates.get("testdir"):
+            raise NotImplementedError(
+                "ShellPipeFS needs an explicit `testdir` template for "
+                "directory walks (stage_in); an exists-test cannot "
+                "distinguish files from directories")
         return subprocess.run(self._cmd("testdir", uri),
                               capture_output=True).returncode == 0
 
     def put_tree(self, local_dir, uri):
         """One recursive upload command when a `puttree` template exists
-        (hadoop's `-put <dir> <uri>` — avoids a JVM per checkpoint file);
-        per-file walk otherwise."""
+        (avoids a subprocess per checkpoint file); per-file walk otherwise.
+        A puttree template receives the paths as {local}/{path}; prefer
+        positional-argument `sh -c '... "$0" "$1"' {local} {path}` forms so
+        URIs never word-split or execute. NOTE a whole-directory upload does
+        NOT compose across hosts writing disjoint shards of one checkpoint —
+        multi-host savers must use the per-file walk (the hdfs registration
+        therefore ships without puttree)."""
         if self.templates.get("puttree"):
+            if not os.listdir(local_dir):
+                return  # nothing to push; a shell glob would stay literal
             cmd = [part.format(path=uri, local=local_dir)
                    for part in self.templates["puttree"]]
             subprocess.run(cmd, check=True, capture_output=True)
@@ -198,10 +212,39 @@ class ShellPipeFS(FileSystemBase):
 
 
 class _PipeReader:
+    """Read side of a shell pipe. EOF is TRACKED (the read methods below are
+    found before __getattr__, so wrapped callers like TextIOWrapper/GzipFile
+    go through them): a consumer that read to EOF gets the producer's REAL
+    exit code at close() — a `hadoop fs -cat` that died mid-file after
+    closing stdout must fail the load, not truncate it silently."""
+
     def __init__(self, proc):
         self._proc = proc
         self._stream = proc.stdout
         self._closed = False
+        self._eof = False
+
+    def _track(self, out):
+        if not out:
+            self._eof = True
+        return out
+
+    def read(self, *a):
+        return self._track(self._stream.read(*a))
+
+    def read1(self, *a):
+        return self._track(self._stream.read1(*a))
+
+    def readline(self, *a):
+        return self._track(self._stream.readline(*a))
+
+    def readinto(self, b):
+        n = self._stream.readinto(b)
+        if not n:
+            self._eof = True
+        return n
+
+    readinto1 = readinto
 
     def __getattr__(self, name):
         return getattr(self._stream, name)
@@ -213,18 +256,26 @@ class _PipeReader:
         self.close()
 
     def __iter__(self):
-        return iter(self._stream)
+        line = self.readline()
+        while line:
+            yield line
+            line = self.readline()
 
     def close(self):
-        """Idempotent. An ABANDONED stream (caller stopped reading early —
-        islice'd training loops) terminates the producer quietly; SIGPIPE
-        exits count as that same intentional teardown. Any other nonzero exit
-        is a real transport failure and MUST raise (a silently-truncated
-        Criteo day would train on partial data)."""
+        """Idempotent. After EOF: wait for the producer and surface any
+        nonzero exit (truncated stream). Before EOF (caller abandoned the
+        stream — islice'd loops): terminate quietly; SIGPIPE from our own
+        close also counts as intentional teardown."""
         if self._closed:
             return
         self._closed = True
         self._stream.close()
+        if self._eof:
+            rc = self._proc.wait()
+            if rc != 0:
+                raise IOError(f"pipe reader exited rc={rc} after EOF "
+                              "(truncated stream?)")
+            return
         rc = self._proc.poll()
         if rc is None:  # still producing: we abandoned it
             self._proc.terminate()
@@ -259,27 +310,37 @@ class _PipeWriter:
             raise IOError(f"pipe writer exited rc={rc}")
 
 
-def _hadoop_fs() -> ShellPipeFS:
-    """The reference's exact transport: `hadoop fs` subcommands
-    (`documents/en/benchmark.md` Criteo-1TB flow dumps to HDFS)."""
-    hadoop = os.environ.get("OETPU_HADOOP_BIN", "hadoop")
-    return ShellPipeFS(
-        cat=[hadoop, "fs", "-cat", "{path}"],
-        put=[hadoop, "fs", "-put", "-f", "-", "{path}"],
-        test=[hadoop, "fs", "-test", "-e", "{path}"],
-        ls=[hadoop, "fs", "-ls", "-C", "{path}"],
-        mkdir=[hadoop, "fs", "-mkdir", "-p", "{path}"],
-        testdir=[hadoop, "fs", "-test", "-d", "{path}"],
-        # one JVM for the whole checkpoint tree, not one per file; `dir/*`
-        # (shell glob) lands the CONTENTS at {path}, not a nested child dir
-        puttree=["sh", "-c",
-                 hadoop + " fs -mkdir -p {path} && "
-                 + hadoop + " fs -put -f {local}/* {path}/"],
-    )
+class _HadoopFS(ShellPipeFS):
+    """`hadoop fs` transport — the reference's exact one
+    (`documents/en/benchmark.md` Criteo-1TB flow dumps to HDFS). The binary
+    resolves from $OETPU_HADOOP_BIN at CALL time, not import time, so setting
+    the env var after importing the package works. No puttree template: the
+    per-file walk is the only upload that composes across hosts writing
+    disjoint shards of one checkpoint."""
+
+    def __init__(self):
+        super().__init__(cat=[], put=[], test=[], ls=[], mkdir=[])
+
+    def _cmd(self, name, uri):
+        hadoop = os.environ.get("OETPU_HADOOP_BIN", "hadoop")
+        args = {"cat": ["-cat", uri],
+                "put": ["-put", "-f", "-", uri],
+                "test": ["-test", "-e", uri],
+                "ls": ["-ls", "-C", uri],
+                "mkdir": ["-mkdir", "-p", uri],
+                "testdir": ["-test", "-d", uri]}[name]
+        return [hadoop, "fs"] + args
+
+    def isdir(self, uri):
+        return subprocess.run(self._cmd("testdir", uri),
+                              capture_output=True).returncode == 0
+
+    def put_tree(self, local_dir, uri):
+        FileSystemBase.put_tree(self, local_dir, uri)
 
 
-register_filesystem("hdfs", _hadoop_fs())
-register_filesystem("viewfs", _hadoop_fs())
+register_filesystem("hdfs", _HadoopFS())
+register_filesystem("viewfs", _HadoopFS())
 
 
 # ---------------------------------------------------------------------------
@@ -294,6 +355,25 @@ def open_stream(uri: str, mode: str = "rb"):
     if fs is None:
         return open(path, mode)
     return fs.open(path, mode)
+
+
+from contextlib import contextmanager
+
+
+@contextmanager
+def staged(uri: str):
+    """Yield a LOCAL directory holding `uri`'s contents; staged copies are
+    removed on exit, local paths pass through untouched. The one staging
+    lifecycle for every random-access loader (Trainer.load, StandaloneModel,
+    ShardedModel)."""
+    if not is_remote(uri):
+        yield uri
+        return
+    local = stage_in(uri)
+    try:
+        yield local
+    finally:
+        shutil.rmtree(local, ignore_errors=True)
 
 
 def stage_in(uri: str, local_dir: Optional[str] = None) -> str:

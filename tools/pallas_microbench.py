@@ -67,6 +67,28 @@ def bench_dim(dim, vocab, n, opt, interp, try_pallas):
     except Exception:
         print(f"[dim {dim:4d}] gather Pallas: FAILED")
         traceback.print_exc(limit=2)
+    # window-batched gather (PERF lever #1): sorted rows, two densities —
+    # uniform (worst case, sigma~1) and frequency-clustered (the reference's
+    # relabel-by-frequency data shape, where windows amortize)
+    for label, rows_w in (
+        ("uniform", jnp.sort(rows)),
+        ("hot10%", jnp.sort(jnp.asarray(
+            rng.integers(0, max(vocab // 10, 1), size=n), jnp.int32))),
+    ):
+        for window in (16, 64):
+            try:
+                pwin = jax.jit(lambda w, r, win=window:
+                               pallas_sparse.gather_rows_windows(
+                                   w, r, window=win, interpret=interp))
+                np.testing.assert_array_equal(
+                    np.asarray(xla_gather(w, rows_w)),
+                    np.asarray(pwin(w, rows_w)))
+                t = timeit(pwin, w, rows_w)
+                print(f"[dim {dim:4d}] gather win{window:3d} {label}: "
+                      f"{t*1e3:8.3f} ms ({n/t/1e6:7.1f} M rows/s)")
+            except Exception:
+                print(f"[dim {dim:4d}] gather win{window} {label}: FAILED")
+                traceback.print_exc(limit=2)
     try:
         pallas_sparse.set_mode("interpret" if interp else "on")
         papply = jax.jit(
