@@ -33,6 +33,47 @@ from .model import EmbeddingModel
 MODEL_CONFIG_FILE = "model_config.json"
 
 
+def bucket_size(n: int, floor: int = 8) -> int:
+    """Serving batch bucket: next power of two >= n (min `floor`). Requests
+    pad up to a bucket so the jit cache holds O(log max_batch) programs
+    instead of one per distinct request size — the batching/padding policy
+    the reference delegates to TF-Serving's batcher."""
+    b = floor
+    while b < n:
+        b <<= 1
+    return b
+
+
+class RaggedBatchError(ValueError):
+    """A serving request whose features disagree on the batch size — the
+    CALLER's error; the REST layer maps this to 400."""
+
+
+def pad_serving_batch(batch, n: int, bucket: int):
+    """Pad every leading batch dim n -> bucket (sparse ids with -1 = invalid
+    -> zero rows; dense/float with zeros). Callers slice outputs [:n].
+    Features that disagree on n are REJECTED — silently padding a short
+    feature would return fabricated logits with HTTP 200."""
+    import numpy as np
+
+    def pad(x, fill, what):
+        x = np.asarray(x)
+        if x.shape[0] != n:
+            raise RaggedBatchError(
+                f"ragged serving batch: {what} has {x.shape[0]} rows, "
+                f"expected {n}")
+        if x.shape[0] == bucket:
+            return x
+        widths = [(0, bucket - x.shape[0])] + [(0, 0)] * (x.ndim - 1)
+        return np.pad(x, widths, constant_values=fill)
+
+    out = {"sparse": {k: pad(v, -1, f"sparse[{k!r}]")
+                      for k, v in batch["sparse"].items()}}
+    if batch.get("dense") is not None:
+        out["dense"] = pad(batch["dense"], 0, "dense")
+    return out
+
+
 def load_model_config(path: str, **overrides) -> Optional[EmbeddingModel]:
     """Rebuild the EmbeddingModel from a directory's model_config.json recipe
     (None when absent). Shared by StandaloneModel and parallel.ShardedModel so
@@ -216,8 +257,16 @@ class StandaloneModel:
                 return module.apply({"params": params}, embedded, dense)
 
             self._predict_fn = jax.jit(fwd)
+        # bucketed padding bounds the compile cache (one program per power-of-
+        # two batch size, not per request size); probing via a REQUIRED
+        # feature raises KeyError(name) -> 400 at the REST layer
+        first = next(iter(self._tables))
+        n = np.asarray(batch["sparse"][first]).shape[0]
+        padded = pad_serving_batch(batch, n, bucket_size(n))
         # sparse_as_dense variables were exported as plain array tables, so every
         # spec (PS or sad) resolves through the same lookup here
-        embedded = {name: self.lookup(name, batch["sparse"][name])
+        embedded = {name: self.lookup(name, padded["sparse"][name])
                     for name in self._tables}
-        return self._predict_fn(self.dense_params, embedded, batch.get("dense"))
+        out = self._predict_fn(self.dense_params, embedded,
+                               padded.get("dense"))
+        return out[:n]

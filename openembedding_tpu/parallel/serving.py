@@ -230,18 +230,38 @@ class ShardedModel:
 
     def lookup(self, name: str, ids) -> jax.Array:
         """Read-only sharded pull (absent/out-of-range ids -> zero rows),
-        reference `read_only_pull` (`EmbeddingPullOperator.cpp:149-205`)."""
+        reference `read_only_pull` (`EmbeddingPullOperator.cpp:149-205`).
+        The flat id count pads to a power-of-two bucket so the shard_map'd
+        pull compiles O(log max_batch) programs, not one per request size."""
+        from ..export import bucket_size
+        from ..ops.id64 import is_pair
+        spec = self.specs[name]
+        raw = np.asarray(ids)
+        pair = spec.use_hash_table and is_pair(raw)
+        ids_shape = raw.shape[:-1] if pair else raw.shape
+        flat = raw.reshape((-1, 2) if pair else (-1,))
+        n = flat.shape[0]
+        if not spec.sparse_as_dense and n:
+            b = bucket_size(n)
+            if b != n:
+                widths = [(0, b - n)] + [(0, 0)] * (flat.ndim - 1)
+                flat = np.pad(flat, widths, constant_values=-1)
+        rows = self._lookup_raw(name, flat)[:n]
+        return rows.reshape(tuple(ids_shape) + (spec.output_dim,))
+
+    def _lookup_raw(self, name: str, ids) -> jax.Array:
+        """FLAT ids ((n,) int or (n, 2) pair) -> (n, dim) rows; the public
+        `lookup` above owns padding/bucketing and the final reshape."""
         spec = self.specs[name]
         if spec.sparse_as_dense:
             table = self.dense_params["__embeddings__"][name]
-            flat = jnp.asarray(ids).reshape(-1)
+            flat = jnp.asarray(ids)
             ok = (flat >= 0) & (flat < table.shape[0])
-            rows = jnp.where(ok[:, None],
+            return jnp.where(ok[:, None],
                              jnp.take(table, jnp.clip(flat, 0,
                                                       table.shape[0] - 1),
                                       axis=0),
                              0)
-            return rows.reshape(jnp.asarray(ids).shape + (spec.output_dim,))
         if (spec.use_hash_table
                 and self.tables[name].keys.ndim == 2):
             # split-pair table (x64 off): convert int64 request ids host-side
@@ -264,7 +284,13 @@ class ShardedModel:
             raise ValueError(
                 "checkpoint has no model_config recipe; pass the "
                 "EmbeddingModel to ShardedModel.load(path, model=...)")
-        embedded = {name: self.lookup(name, batch["sparse"][name])
+        from ..export import bucket_size, pad_serving_batch
+        # probe the batch size via a REQUIRED feature: a missing one raises
+        # KeyError(name), which the REST layer maps to 400
+        first = next(iter(self.specs))
+        n = np.asarray(batch["sparse"][first]).shape[0]
+        padded = pad_serving_batch(batch, n, bucket_size(n))
+        embedded = {name: self.lookup(name, padded["sparse"][name])
                     for name in self.specs}
         if self._predict_fn is None:
             module = self.model.module
@@ -274,4 +300,4 @@ class ShardedModel:
 
             self._predict_fn = jax.jit(fwd)
         return self._predict_fn(self.dense_params, embedded,
-                                batch.get("dense"))
+                                padded.get("dense"))[:n]

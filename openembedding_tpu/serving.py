@@ -339,6 +339,7 @@ class ServingHandler(BaseHTTPRequestHandler):
                     batch["dense"] = self._coerce(
                         lambda v: np.asarray(v, dtype=np.float32),
                         body["dense"], "dense")
+                from .export import RaggedBatchError
                 try:
                     logits = model.predict(batch)
                 except KeyError as e:
@@ -347,6 +348,8 @@ class ServingHandler(BaseHTTPRequestHandler):
                     raise _BadRequest(
                         f"predict request is missing sparse feature {e}"
                     ) from e
+                except RaggedBatchError as e:
+                    raise _BadRequest(str(e)) from e
                 return self._json(200, {"logits": np.asarray(logits).tolist()})
             return self._json(404, {"error": "not found"})
         except _BadRequest as e:

@@ -233,3 +233,39 @@ def test_rest_sharded_serving(mesh_trained, tmp_path, server):
     assert status == 500
     status, entry = _req(f"{base}/models/toobig-0")
     assert status == 200 and entry["status"] == "ERROR"
+
+
+def test_request_padding_bounds_compile_cache(mesh_trained, tmp_path):
+    """Varying request sizes reuse O(log n) compiled programs (bucketed
+    padding) and answers stay correct at every size — the batching/padding
+    policy the reference delegates to TF-Serving's batcher."""
+    model, trainer, state, batch = mesh_trained
+    path = str(tmp_path / "ck_pad")
+    trainer.save(state, path)
+    sm = ShardedModel.load(path)
+
+    from openembedding_tpu.parallel.sharded import deinterleave_rows
+    table = np.asarray(deinterleave_rows(
+        np.asarray(state.tables["categorical"].weights), 8, VOCAB))
+    for n in (1, 2, 3, 5, 7, 8, 11, 13):
+        ids = np.arange(n, dtype=np.int64)
+        got = np.asarray(sm.lookup("categorical", ids))
+        np.testing.assert_allclose(got, table[:n], rtol=0, atol=0)
+    # every size <= 8 shares the 8-bucket, 11/13 share the 16-bucket: the
+    # jitted pull compiled at most TWO shapes for eight request sizes
+    assert sm._lookup_fns["categorical"]._cache_size() <= 2
+
+    # ragged requests are rejected, never silently padded (wrong logits)
+    from openembedding_tpu.export import RaggedBatchError
+    bad = {"sparse": {"categorical": batch["sparse"]["categorical"][:6]},
+           "dense": np.asarray(batch["dense"])[:3]}
+    with pytest.raises(RaggedBatchError, match="ragged"):
+        sm.predict(bad)
+
+    logits = {}
+    for n in (1, 3, 4, 6):
+        b = {"sparse": {"categorical": batch["sparse"]["categorical"][:n]},
+             "dense": np.asarray(batch["dense"])[:n]}
+        logits[n] = np.asarray(sm.predict(b)).reshape(-1)
+        assert logits[n].shape[0] == n
+    np.testing.assert_allclose(logits[3], logits[6][:3], rtol=1e-5, atol=1e-6)

@@ -1,0 +1,126 @@
+"""Benchmark sweep harness over {model} x {dim} x {mode} — the counterpart of
+the reference's `laboratory/benchmark/benchmark.py` matrix
+({data} x {WDL,DeepFM,xDeepFM} x {9,64} x {none,server,cache,prefetch} x np).
+
+Each cell shells out to `examples/criteo_deepctr.py` (the same workload the
+reference sweeps via its own benchmark CLI), parses the throughput/AUC lines,
+and appends a CSV row — partial results survive an aborted sweep.
+
+    python tools/sweep.py --out sweep.csv                         # full matrix
+    python tools/sweep.py --models lr deepfm --dims 9 --steps 40  # subset
+    JAX_PLATFORMS=cpu python tools/sweep.py --smoke               # CI-sized
+
+Modes: plain (single device), mesh (all local devices, sharded tables),
+cache (sparse_as_dense dense mirror), prefetch (device-staged input).
+"""
+
+import argparse
+import csv
+import itertools
+import os
+import re
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLE = os.path.join(REPO, "examples", "criteo_deepctr.py")
+
+MODE_FLAGS = {
+    "plain": [],
+    "mesh": ["--mesh"],
+    "cache": None,     # filled per-run: --cache <vocabulary>
+    "prefetch": ["--prefetch"],
+}
+
+THROUGHPUT_RE = re.compile(r"([\d,]+) examples/s \(([\d,]+)/chip\)")
+AUC_RE = re.compile(r"train AUC ([\d.]+)")
+LOSS_RE = re.compile(r"trained \d+ steps, loss ([\d.]+)")
+
+
+def run_cell(model, dim, mode, args):
+    cmd = [sys.executable, EXAMPLE, "--model", model,
+           "--batch-size", str(args.batch_size), "--steps", str(args.steps),
+           "--vocabulary", str(args.vocabulary), "--synthetic"]
+    if model != "lr":
+        cmd += ["--dim", str(dim)]
+    if mode == "cache":
+        cmd += ["--cache", str(args.vocabulary)]
+    else:
+        cmd += MODE_FLAGS[mode]
+    env = dict(os.environ, PYTHONPATH=REPO)
+    t0 = time.time()
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, env=env,
+                              timeout=args.cell_timeout)
+        rc, out = proc.returncode, proc.stdout + proc.stderr
+    except subprocess.TimeoutExpired as e:
+        # a hung cell becomes a failed ROW; the rest of the matrix still runs
+        rc = "timeout"
+        out = ((e.stdout or b"").decode(errors="replace")
+               if isinstance(e.stdout, bytes) else (e.stdout or ""))
+    wall = time.time() - t0
+    row = {"model": model, "dim": dim if model != "lr" else "-", "mode": mode,
+           "rc": rc, "wall_s": round(wall, 1),
+           "examples_per_s": "", "per_chip": "", "loss": "", "auc": ""}
+    m = THROUGHPUT_RE.search(out)
+    if m:
+        row["examples_per_s"] = m.group(1).replace(",", "")
+        row["per_chip"] = m.group(2).replace(",", "")
+    m = LOSS_RE.search(out)
+    if m:
+        row["loss"] = m.group(1)
+    m = AUC_RE.search(out)
+    if m:
+        row["auc"] = m.group(1)
+    if rc != 0:
+        row["auc"] = (out.strip().splitlines() or ["?"])[-1][:120]
+    return row
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--models", nargs="*",
+                    default=["lr", "wdl", "deepfm", "xdeepfm"])
+    ap.add_argument("--dims", nargs="*", type=int, default=[9, 64])
+    ap.add_argument("--modes", nargs="*",
+                    default=["plain", "mesh", "cache", "prefetch"])
+    ap.add_argument("--batch-size", type=int, default=4096)
+    ap.add_argument("--steps", type=int, default=100)
+    ap.add_argument("--vocabulary", type=int, default=1 << 22)
+    ap.add_argument("--cell-timeout", type=int, default=900)
+    ap.add_argument("--out", default="sweep.csv")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny CI-sized matrix (seconds per cell)")
+    args = ap.parse_args()
+    if args.smoke:
+        args.models = ["lr", "deepfm"]
+        args.dims = [4]
+        args.modes = ["plain", "mesh"]
+        args.batch_size = 64
+        args.steps = 6
+        args.vocabulary = 1 << 14
+
+    fields = ["model", "dim", "mode", "rc", "wall_s", "examples_per_s",
+              "per_chip", "loss", "auc"]
+    fresh = not os.path.exists(args.out)
+    with open(args.out, "a", newline="") as f:
+        writer = csv.DictWriter(f, fieldnames=fields)
+        if fresh:
+            writer.writeheader()
+        for model, dim, mode in itertools.product(args.models, args.dims,
+                                                  args.modes):
+            if model == "lr" and dim != args.dims[0]:
+                continue  # LR has no dim axis; run it once
+            row = run_cell(model, dim, mode, args)
+            writer.writerow(row)
+            f.flush()
+            print(f"{model:8s} dim={row['dim']:>3} {mode:9s} rc={row['rc']} "
+                  f"{row['examples_per_s'] or '-':>9} ex/s  "
+                  f"auc={row['auc'] or '-'}")
+    print(f"sweep -> {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
