@@ -183,11 +183,13 @@ def run_case(name, fn, attempts=2, cooldown_s=20):
                 return None
 
 
-def _stacked_batches(dim_unused, steps, ids_dtype=np.int32, seed=7):
+def _stacked_batches(dim_unused, steps, ids_dtype=np.int32, seed=7,
+                     id_space=None):
     import jax
     from openembedding_tpu.data import synthetic_criteo
-    batches = list(synthetic_criteo(BATCH, id_space=VOCAB, steps=steps,
-                                    seed=seed, ids_dtype=ids_dtype))
+    batches = list(synthetic_criteo(BATCH, id_space=id_space or VOCAB,
+                                    steps=steps, seed=seed,
+                                    ids_dtype=ids_dtype))
     stacked = jax.device_put(jax.tree_util.tree_map(
         lambda *xs: np.stack(xs), *batches))
     return batches, stacked
@@ -217,14 +219,23 @@ def case_trainer(dim):
 
     name = f"dim{dim}"
     WD.stage(f"{name}:init", 240)
-    model = make_deepfm(vocabulary=VOCAB, dim=dim)
+    # dim 64 runs a 2^23-row table on one chip: at 2^24 the program needs
+    # ~17.1 G HBM (> 15.75 G v5e) — weights+accum are 2 x 4.06 G and XLA's
+    # gather lowering for 32 < width < 128 materializes a 128-lane-padded
+    # temp copy of the table (2.0x, measured via compiled.memory_analysis();
+    # PERF.md "dim-64 single-chip HBM budget"). The reference never fits
+    # this table on one device either (it lives on a 175 GB remote PS,
+    # documents/en/benchmark.md:41-56); multi-chip meshes shard it 1/S.
+    vocab = min(VOCAB, 1 << 23) if dim >= 64 else VOCAB
+    model = make_deepfm(vocabulary=vocab, dim=dim)
     trainer = Trainer(model, embed.Adagrad(learning_rate=0.05))
     # int32 ids: keep x64 off on TPU (VOCAB < 2^31)
-    batches, stacked = _stacked_batches(dim, SCAN_STEPS)
+    batches, stacked = _stacked_batches(dim, SCAN_STEPS, id_space=vocab)
     state = trainer.init(batches[0])
     eps = _measure_many(name, trainer.jit_train_many(), state, stacked)
     return {"examples_per_sec_per_chip": round(eps, 1),
-            "vs_baseline_dim9": round(eps / BASELINE_PER_CHIP, 3)}
+            "vs_baseline_dim9": round(eps / BASELINE_PER_CHIP, 3),
+            "vocab": vocab}
 
 
 def case_mesh1():

@@ -212,7 +212,9 @@ class StandaloneModel:
 
     def lookup(self, name: str, ids) -> jax.Array:
         """Read-only pull: absent/out-of-range ids -> zero rows (reference
-        `get_weights` serving semantics)."""
+        `get_weights` serving semantics). The flat id count pads to a
+        power-of-two bucket (padding id -1 = absent) so direct REST pulls
+        compile O(log max_batch) gather programs, not one per request size."""
         t = self._tables[name]
         w = t["weights"]
         if t["kind"] == "hash":
@@ -228,19 +230,28 @@ class StandaloneModel:
             n = t["ids"].shape[0]
             if n == 0:  # empty table: every id is absent -> zero rows
                 return jnp.zeros(tuple(ids_shape) + (t["dim"],), w.dtype)
+            k = flat_np.shape[0]
+            if k:
+                flat_np = np.pad(flat_np, (0, bucket_size(k) - k),
+                                 constant_values=-1)
             pos = np.searchsorted(t["ids"], flat_np)
             pos_c = np.minimum(pos, n - 1)
             hit = t["ids"][pos_c] == flat_np
             rows = jnp.where(jnp.asarray(hit)[:, None],
                              w[jnp.asarray(pos_c)], jnp.zeros_like(w[:1]))
-            return rows.reshape(tuple(ids_shape) + (t["dim"],))
-        ids = jnp.asarray(ids)
-        flat = ids.reshape(-1)
+            return rows[:k].reshape(tuple(ids_shape) + (t["dim"],))
+        ids_shape = np.shape(ids)
+        flat_np = np.asarray(ids).reshape(-1)
+        k = flat_np.shape[0]
+        if k:
+            flat_np = np.pad(flat_np, (0, bucket_size(k) - k),
+                             constant_values=-1)
+        flat = jnp.asarray(flat_np)
         in_range = (flat >= 0) & (flat < w.shape[0])
         rows = jnp.where(in_range[:, None],
                          w[jnp.clip(flat, 0, w.shape[0] - 1)],
                          jnp.zeros((1, w.shape[1]), w.dtype))
-        return rows.reshape(ids.shape + (t["dim"],))
+        return rows[:k].reshape(tuple(ids_shape) + (t["dim"],))
 
     def predict(self, batch: Dict[str, Any]) -> jax.Array:
         """Full forward pass -> logits. Needs the dense module (from the export's

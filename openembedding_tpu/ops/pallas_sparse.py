@@ -200,6 +200,13 @@ def maybe_gather_rows(weights, rows, valid=None):
 # hashed ids over 2^24 rows) degenerates to one window per row = per-row DMA
 # of W rows: bandwidth still fine (W*row_bytes per descriptor), issue count no
 # worse than the per-row kernel. Extra HBM traffic is bounded by W * n rows.
+#
+# MEASURED 2026-07-30 (v5e, scan-fenced, dim 128, 2^21 rows, 106k pulls —
+# PERF.md "On-chip verdict"): REFUTED. XLA gather 2.5-5.0 ms; this kernel
+# 18-20 ms at W in {16, 64}, both densities. The DMA amortization works but
+# the per-row VMEM emit loop below is a serial scalar-core fori_loop at
+# ~170 ns/row — more than the entire XLA gather. Kept as a documented
+# negative result; default-off like the rest of the module.
 
 
 def _window_gather_kernel(bases, nw_arr, slotoff, w_hbm, out_ref, scratch,

@@ -241,7 +241,9 @@ class ShardedModel:
         ids_shape = raw.shape[:-1] if pair else raw.shape
         flat = raw.reshape((-1, 2) if pair else (-1,))
         n = flat.shape[0]
-        if not spec.sparse_as_dense and n:
+        # sparse_as_dense included: its jnp.take branch masks `flat >= 0`, so
+        # -1 padding is absent-safe there too
+        if n:
             b = bucket_size(n)
             if b != n:
                 widths = [(0, b - n)] + [(0, 0)] * (flat.ndim - 1)

@@ -111,7 +111,7 @@ def scenario_persist_ok(pid, n, tmp):
     root = os.path.join(tmp, "persists")
     with embed.AsyncPersister(trainer, trainer.model, root,
                               policy=embed.PersistPolicy(every_steps=1),
-                              commit_timeout=60.0) as p:
+                              commit_timeout=300.0) as p:
         p.persist(state)
         p.wait()
     multihost_utils.sync_global_devices("persist_done")

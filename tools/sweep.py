@@ -48,8 +48,15 @@ def run_cell(model, dim, mode, args):
         cmd += ["--cache", str(args.vocabulary)]
     else:
         cmd += MODE_FLAGS[mode]
-    env = dict(os.environ, PYTHONPATH=REPO)
+    existing = os.environ.get("PYTHONPATH")
+    env = dict(os.environ, PYTHONPATH=(
+        REPO + os.pathsep + existing if existing else REPO))
     t0 = time.time()
+
+    def _text(chunk):
+        return (chunk or b"").decode(errors="replace") \
+            if isinstance(chunk, bytes) else (chunk or "")
+
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True, env=env,
                               timeout=args.cell_timeout)
@@ -57,12 +64,11 @@ def run_cell(model, dim, mode, args):
     except subprocess.TimeoutExpired as e:
         # a hung cell becomes a failed ROW; the rest of the matrix still runs
         rc = "timeout"
-        out = ((e.stdout or b"").decode(errors="replace")
-               if isinstance(e.stdout, bytes) else (e.stdout or ""))
+        out = _text(e.stdout) + _text(e.stderr)
     wall = time.time() - t0
     row = {"model": model, "dim": dim if model != "lr" else "-", "mode": mode,
-           "rc": rc, "wall_s": round(wall, 1),
-           "examples_per_s": "", "per_chip": "", "loss": "", "auc": ""}
+           "rc": rc, "wall_s": round(wall, 1), "examples_per_s": "",
+           "per_chip": "", "loss": "", "auc": "", "error": ""}
     m = THROUGHPUT_RE.search(out)
     if m:
         row["examples_per_s"] = m.group(1).replace(",", "")
@@ -74,7 +80,7 @@ def run_cell(model, dim, mode, args):
     if m:
         row["auc"] = m.group(1)
     if rc != 0:
-        row["auc"] = (out.strip().splitlines() or ["?"])[-1][:120]
+        row["error"] = (out.strip().splitlines() or ["?"])[-1][:120]
     return row
 
 
@@ -102,7 +108,7 @@ def main():
         args.vocabulary = 1 << 14
 
     fields = ["model", "dim", "mode", "rc", "wall_s", "examples_per_s",
-              "per_chip", "loss", "auc"]
+              "per_chip", "loss", "auc", "error"]
     fresh = not os.path.exists(args.out)
     with open(args.out, "a", newline="") as f:
         writer = csv.DictWriter(f, fieldnames=fields)
@@ -117,7 +123,8 @@ def main():
             f.flush()
             print(f"{model:8s} dim={row['dim']:>3} {mode:9s} rc={row['rc']} "
                   f"{row['examples_per_s'] or '-':>9} ex/s  "
-                  f"auc={row['auc'] or '-'}")
+                  f"auc={row['auc'] or '-'}"
+                  + (f"  error={row['error']}" if row["error"] else ""))
     print(f"sweep -> {args.out}")
     return 0
 
