@@ -49,6 +49,10 @@ class RaggedBatchError(ValueError):
     CALLER's error; the REST layer maps this to 400."""
 
 
+class _BadRange(ValueError):
+    """A row-iteration request outside the table — the CALLER's error (400)."""
+
+
 def pad_serving_batch(batch, n: int, bucket: int):
     """Pad every leading batch dim n -> bucket (sparse ids with -1 = invalid
     -> zero rows; dense/float with zeros). Callers slice outputs [:n].
@@ -209,6 +213,46 @@ class StandaloneModel:
     @property
     def variable_names(self):
         return list(self._tables)
+
+    # -- live-replica export surface (restore_from_peer, serving.py) ---------
+    # The reference restores a dead node by iterating a LIVE replica's shard
+    # through (iterator_id, offset) cursors and shipping batched
+    # indices+weights (`server/EmbeddingRestoreOperator.cpp:19-106`). Here the
+    # same capability is three read-only methods the REST layer exposes, so a
+    # new serving node can rebuild a standalone export over the wire with no
+    # shared filesystem.
+
+    def export_manifest(self) -> dict:
+        """Row-iteration manifest: per variable, its kind, resident row count
+        and row width; plus the model_meta JSON needed to rewrite the export."""
+        variables = []
+        for v in self.meta.variables:
+            t = self._tables[v.storage_name]
+            rows = (t["ids"].shape[0] if t["kind"] == "hash"
+                    else int(np.shape(t["weights"])[0]))
+            variables.append({"storage_name": v.storage_name,
+                              "variable_id": v.variable_id,
+                              "kind": t["kind"], "rows": rows,
+                              "dim": int(t["dim"])})
+        cfg = self.model.config if self.model is not None else None
+        return {"variables": variables, "meta": json.loads(self.meta.to_json()),
+                "model_config": cfg}
+
+    def export_rows(self, name: str, start: int, count: int) -> Dict[str, np.ndarray]:
+        """Rows [start, start+count) of one variable, in the export's own
+        order (hash: id-sorted resident pairs; array: global row order)."""
+        t = self._tables[name]
+        if start < 0 or count < 0:
+            raise _BadRange(f"bad row range [{start}, {start}+{count})")
+        out = {"weights": np.asarray(t["weights"][start:start + count])}
+        if t["kind"] == "hash":
+            out["ids"] = np.asarray(t["ids"][start:start + count])
+        return out
+
+    def export_dense(self) -> Dict[str, np.ndarray]:
+        """Flat dense-tower params (the export's dense_params.npz content)."""
+        return {k: np.asarray(v)
+                for k, v in _flatten_params(self.dense_params).items()}
 
     def lookup(self, name: str, ids) -> jax.Array:
         """Read-only pull: absent/out-of-range ids -> zero rows (reference

@@ -207,6 +207,59 @@ class ShardedModel:
     def variable_names(self) -> List[str]:
         return [n for n, s in self.specs.items()]
 
+    # -- live-replica export surface (restore_from_peer, ../serving.py) -------
+    # Same contract as `StandaloneModel.export_manifest/export_rows/
+    # export_dense` (the reference's replica-iteration restore,
+    # `server/EmbeddingRestoreOperator.cpp:19-106`): rows stream out through
+    # the read-only sharded pull, so the model is never materialized here —
+    # only the requesting peer assembles a full standalone export.
+
+    def _resident_ids(self, name: str) -> np.ndarray:
+        """Sorted int64 ids resident in a hash table (host-side, cached)."""
+        if not hasattr(self, "_resident_cache"):
+            self._resident_cache: Dict[str, np.ndarray] = {}
+        if name not in self._resident_cache:
+            from ..ops.id64 import np_resident_ids
+            _, ids64 = np_resident_ids(np.asarray(self.tables[name].keys))
+            self._resident_cache[name] = np.sort(ids64)
+        return self._resident_cache[name]
+
+    def export_manifest(self) -> dict:
+        variables = []
+        for v in self.meta.variables:
+            spec = self.specs[v.storage_name]
+            if spec.use_hash_table:
+                kind, rows = "hash", int(self._resident_ids(v.storage_name).shape[0])
+            else:
+                kind, rows = "array", int(spec.input_dim)
+            variables.append({"storage_name": v.storage_name,
+                              "variable_id": v.variable_id,
+                              "kind": kind, "rows": rows,
+                              "dim": int(spec.output_dim)})
+        cfg = self.model.config if self.model is not None else None
+        return {"variables": variables,
+                "meta": json.loads(self.meta.to_json()),
+                "model_config": cfg}
+
+    def export_rows(self, name: str, start: int, count: int) -> Dict[str, np.ndarray]:
+        from ..export import _BadRange
+        spec = self.specs[name]
+        if start < 0 or count < 0:
+            raise _BadRange(f"bad row range [{start}, {start}+{count})")
+        if spec.use_hash_table:
+            ids = self._resident_ids(name)[start:start + count]
+            return {"ids": ids,
+                    "weights": np.asarray(self.lookup(name, ids))}
+        stop = min(start + count, spec.input_dim)
+        ids = np.arange(start, max(start, stop), dtype=np.int64)
+        return {"weights": np.asarray(self.lookup(name, ids))}
+
+    def export_dense(self) -> Dict[str, np.ndarray]:
+        from ..checkpoint import _flatten_params
+        return {k: np.asarray(v)
+                for k, v in _flatten_params(self.dense_params).items()
+                if not k.startswith("__embeddings__/")}
+
     def _table_pspec(self, spec: EmbeddingSpec):
         return EmbeddingTableState(
             weights=P(self.axis, None), slots={},

@@ -237,7 +237,10 @@ class ServingHandler(BaseHTTPRequestHandler):
         return data
 
     def _route(self):
-        path = self.path.rstrip("/")
+        from urllib.parse import parse_qs, urlsplit
+        parts = urlsplit(self.path)
+        self.query = {k: v[-1] for k, v in parse_qs(parts.query).items()}
+        path = parts.path.rstrip("/")
         m = re.fullmatch(r"/models/([A-Za-z0-9._-]+)(?::(\w+)|/(pull|predict))?",
                          path)
         if m:
@@ -257,11 +260,48 @@ class ServingHandler(BaseHTTPRequestHandler):
 
     # -- verbs --------------------------------------------------------------
 
+    def _npz(self, arrays: dict) -> None:
+        """Stream a dict of numpy arrays as an uncompressed .npz body."""
+        import io
+        buf = io.BytesIO()
+        np.savez(buf, **arrays)
+        body = buf.getvalue()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/octet-stream")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def do_GET(self):  # noqa: N802 (http.server API)
-        kind, sign, _ = self._route()
+        kind, sign, action = self._route()
         try:
             if kind == "models":
                 return self._json(200, self.manager.registry.show_models())
+            if kind == "model" and action in ("exportmeta", "rows", "dense"):
+                # live-replica restore surface (reference
+                # `EmbeddingRestoreOperator.cpp:19-106`: iterate a live
+                # replica's rows through cursors): a peer pages these three
+                # endpoints to rebuild a standalone export with no shared
+                # filesystem — see `restore_from_peer`.
+                model = self.manager.find_model(sign)
+                if action == "exportmeta":
+                    return self._json(200, model.export_manifest())
+                if action == "dense":
+                    return self._npz(model.export_dense())
+                var = self.query.get("var")
+                if var is None:
+                    raise _BadRequest("rows: missing ?var=")
+                if var not in model.variable_names:
+                    return self._json(
+                        404, {"error": f"model {sign!r} has no variable {var!r}"})
+                start = self._coerce(int, self.query.get("start", 0), "start")
+                count = self._coerce(int, self.query.get("count", 1 << 16),
+                                     "count")
+                from .export import _BadRange
+                try:
+                    return self._npz(model.export_rows(var, start, count))
+                except _BadRange as e:
+                    raise _BadRequest(str(e)) from e
             if kind == "model":
                 entry = self.manager.registry.get(sign)
                 if entry is None:
@@ -281,6 +321,10 @@ class ServingHandler(BaseHTTPRequestHandler):
                 self.wfile.write(body)
                 return None
             return self._json(404, {"error": "not found"})
+        except _BadRequest as e:
+            return self._json(400, {"error": str(e)})
+        except KeyError as e:
+            return self._json(404, {"error": str(e)})
         except Exception as e:  # noqa: BLE001 - every handler error becomes a 500
             return self._json(500, {"error": str(e)})
 
@@ -379,6 +423,87 @@ class ServingHandler(BaseHTTPRequestHandler):
             return self._json(404, {"error": str(e)})
         except Exception as e:  # noqa: BLE001
             return self._json(500, {"error": str(e)})
+
+
+def restore_from_peer(peer: str, model_sign: str, dest: str, *,
+                      page: int = 1 << 16, timeout: float = 60.0) -> str:
+    """Rebuild a model's standalone export from a LIVE serving peer over REST.
+
+    The reference replaces a dead serving node by iterating another replica's
+    shard via (iterator_id, offset) cursors and shipping batched
+    indices+weights (`server/EmbeddingRestoreOperator.cpp:19-106`,
+    `entry/server.cc:52-55` `--restore`). Here the new node pages the peer's
+    `:exportmeta` / `:rows` / `:dense` endpoints and writes a standard
+    standalone export under `dest` — no shared filesystem required. Register
+    `dest` with the local node (POST /models) to finish the restore.
+
+    Returns `dest`. Raises on a peer error or a non-NORMAL model.
+    """
+    import io
+    import urllib.request
+    from urllib.parse import quote
+
+    def get(path: str) -> bytes:
+        with urllib.request.urlopen(f"{peer}{path}", timeout=timeout) as r:
+            return r.read()
+
+    entry = json.loads(get(f"/models/{model_sign}"))
+    if entry.get("status") != "NORMAL":
+        raise RuntimeError(
+            f"peer model {model_sign!r} is {entry.get('status')!r}, "
+            "not restorable")
+    manifest = json.loads(get(f"/models/{model_sign}:exportmeta"))
+
+    os.makedirs(dest, exist_ok=True)
+    for v in manifest["variables"]:
+        vdir = os.path.join(dest, f"variable_{v['variable_id']}")
+        os.makedirs(vdir, exist_ok=True)
+        chunks: Dict[str, list] = {"weights": [], "ids": []}
+        for start in range(0, max(v["rows"], 1), page):
+            if start >= v["rows"]:
+                break  # zero-row table: write empty payloads below
+            data = np.load(io.BytesIO(get(
+                f"/models/{model_sign}:rows"
+                f"?var={quote(v['storage_name'], safe='')}"
+                f"&start={start}&count={page}")))
+            chunks["weights"].append(data["weights"])
+            if "ids" in data:
+                chunks["ids"].append(data["ids"])
+        w = (np.concatenate(chunks["weights"]) if chunks["weights"]
+             else np.zeros((0, v["dim"]), np.float32))
+        if w.shape[0] != v["rows"]:
+            raise RuntimeError(
+                f"peer returned {w.shape[0]} rows for {v['storage_name']!r}, "
+                f"manifest says {v['rows']} (model changed mid-restore?)")
+        np.save(os.path.join(vdir, "weights.npy"), w)
+        if v["kind"] == "hash":
+            ids = (np.concatenate(chunks["ids"]) if chunks["ids"]
+                   else np.zeros((0,), np.int64))
+            np.save(os.path.join(vdir, "ids.npy"), ids)
+
+    dense = np.load(io.BytesIO(get(f"/models/{model_sign}:dense")))
+    np.savez(os.path.join(dest, "dense_params.npz"),
+             **{k: dense[k] for k in dense.files})
+
+    meta = dict(manifest["meta"])
+    meta["uri"] = dest
+    meta["num_shards"] = 1  # the restored artifact is a standalone export
+    # keep the written meta consistent with the written files: the peer's meta
+    # may describe a sharded checkpoint (dense_manifest incl. __embeddings__/
+    # entries that export_dense filters out, no `extra` block)
+    meta["dense_manifest"] = {
+        k: {"shape": list(dense[k].shape), "dtype": str(dense[k].dtype)}
+        for k in dense.files}
+    meta["extra"] = {"standalone": True,
+                     "restored_from": f"{peer}/models/{model_sign}"}
+    from .checkpoint import MODEL_META_FILE
+    with open(os.path.join(dest, MODEL_META_FILE), "w") as f:
+        json.dump(meta, f, indent=2, sort_keys=True)
+    if manifest.get("model_config") is not None:
+        from .export import MODEL_CONFIG_FILE
+        with open(os.path.join(dest, MODEL_CONFIG_FILE), "w") as f:
+            json.dump(manifest["model_config"], f, indent=2, sort_keys=True)
+    return dest
 
 
 def make_server(registry_root: str, host: str = "127.0.0.1", port: int = 0
