@@ -69,6 +69,125 @@ def scatter_rows(weights: jax.Array, rows: jax.Array, values: jax.Array,
                                   unique_indices=sorted_unique)
 
 
+# ---------------------------------------------------------------------------
+# packed table layout (weights + optimizer slots in ONE array)
+# ---------------------------------------------------------------------------
+#
+# The fused apply is HBM-LATENCY-bound: each gather/scatter pair over k unique
+# rows costs ~147 ns/row regardless of row width (PERF.md). Storing weights
+# and slots separately pays one pair PER ARRAY (Adagrad: 2 pairs = ~27 ms for
+# 106k rows on v5e); concatenating them column-wise into one (rows, dim+Σslot)
+# array pays ONE pair (~19 ms measured, 1.44x). The packed form only exists
+# inside `Trainer.train_many`'s scan (pack at entry, unpack at exit, amortized
+# over K steps) so checkpoints, serving, offload and the sharded protocol all
+# keep the split layout.
+#
+# Width gate: XLA's gather for 32 < width < 128 materializes a 128-lane-padded
+# 2.0x temp copy of the WHOLE table every scan iteration (measured via
+# compiled.memory_analysis(); PERF.md "dim-64 single-chip HBM budget"), so
+# packing only engages when the packed width stays in the sublane-packed
+# regime (<= 32) or is lane-exact (% 128 == 0).
+
+PACKED_MAX_SUBLANE_WIDTH = 32
+
+
+def packed_layout(dim: int, slots: Dict[str, jax.Array],
+                  weights_dtype=jnp.float32):
+    """Static column layout ((name, width), ...) for a packable table, or None
+    when packing is unsafe/unprofitable (no slots; non-f32 weights or slots; a
+    packed width in XLA's padded-copy regime).
+
+    Non-f32 weights are refused, not upcast: a bf16 table packed as f32 would
+    (a) double its HBM footprint for the whole scan and (b) skip the
+    round-to-storage-dtype that the split path applies on every scatter,
+    breaking bit-parity between train_many and K train_step calls."""
+    if not slots:
+        return None  # SGD-like: weights alone are already one array
+    if jnp.dtype(weights_dtype) != jnp.float32:
+        return None
+    names = sorted(slots)
+    widths = [int(slots[n].shape[1]) for n in names]
+    total = dim + sum(widths)
+    if not (total <= PACKED_MAX_SUBLANE_WIDTH or total % 128 == 0):
+        return None
+    if any(slots[n].dtype != jnp.float32 for n in names):
+        return None
+    return tuple(zip(names, widths))
+
+
+def pack_table(weights: jax.Array, slots: Dict[str, jax.Array],
+               layout) -> jax.Array:
+    """-> (rows, dim+Σwidths) f32; column order: weights, then layout order."""
+    return jnp.concatenate(
+        [weights.astype(jnp.float32)] + [slots[name] for name, _ in layout],
+        axis=1)
+
+
+def unpack_table(packed: jax.Array, layout, dim: int, weights_dtype
+                 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    weights = packed[:, :dim].astype(weights_dtype)
+    slots = {}
+    off = dim
+    for name, w in layout:
+        slots[name] = packed[:, off:off + w]
+        off += w
+    return weights, slots
+
+
+def _dedup_routed(n_rows: int, row_ids: jax.Array, grads: jax.Array,
+                  pre_counts: jax.Array):
+    """Shared dedup/sentinel prologue of both fused applies -> (g, counts, idx).
+
+    Routing invariants (load-bearing — both apply paths depend on them):
+    - padding (count==0) AND negative ids route to the out-of-range sort key
+      `n_rows` BEFORE dedup: jax wraps negative scatter indices, so id -1
+      would otherwise silently train the LAST row and break the sorted/unique
+      promises below (mode='drop' only drops the high side);
+    - sentinel slots get counts 0 after the segment sums;
+    - every invalid unique slot i maps to the DISTINCT out-of-bounds row
+      n_rows + i, so `idx` is genuinely ascending and duplicate-free — the
+      indices_are_sorted/unique_indices promises hold exactly and XLA emits
+      the vectorized gather/scatter instead of a serialized row loop (the
+      difference between 25 ms and sub-ms on v5e; tools/step_bisect.py)."""
+    n = row_ids.shape[0]
+    if pre_counts is None:
+        pre_counts = jnp.ones((n,), jnp.int32)
+    uniq = unique_with_counts(jnp.where((pre_counts > 0) & (row_ids >= 0),
+                                        row_ids, n_rows))
+    g = uniq.segment_reduce(grads)
+    counts = uniq.segment_reduce(pre_counts)
+    counts = jnp.where(uniq.unique_ids < n_rows, counts, 0)
+    idx = jnp.where(counts > 0, uniq.unique_ids,
+                    n_rows + jnp.arange(n, dtype=uniq.unique_ids.dtype))
+    return g, counts, idx
+
+
+def sparse_apply_packed_table(
+    optimizer,
+    packed: jax.Array,
+    layout,
+    dim: int,
+    row_ids: jax.Array,
+    grads: jax.Array,
+    pre_counts: jax.Array = None,
+) -> jax.Array:
+    """`sparse_apply_dense_table` over the packed layout: identical dedup and
+    optimizer math, ONE gather + ONE scatter instead of one pair per array."""
+    g, counts, idx = _dedup_routed(packed.shape[0], row_ids, grads, pre_counts)
+    rows = lookup_rows(packed, idx, sorted_unique=True)  # (n, W) f32
+    s_rows = {}
+    off = dim
+    for name, w in layout:
+        s_rows[name] = rows[:, off:off + w]
+        off += w
+    new_w, new_s = optimizer.apply(rows[:, :dim], s_rows,
+                                   g.astype(jnp.float32), counts)
+    new_rows = jnp.concatenate(
+        [new_w] + [new_s[name] for name, _ in layout], axis=1)
+    return scatter_rows(packed, idx, new_rows.astype(packed.dtype),
+                        sorted_unique=True)
+
+
 def sparse_apply_dense_table(
     optimizer,
     weights: jax.Array,
@@ -87,23 +206,10 @@ def sparse_apply_dense_table(
     dedup -> sum gradients/counts over duplicates -> gather rows+slots -> fused
     optimizer apply -> scatter back. Rows not touched stay bit-identical.
     """
-    n = row_ids.shape[0]
-    if pre_counts is None:
-        pre_counts = jnp.ones((n,), jnp.int32)
-    # Route padding (count==0) to an out-of-range sort key so dedup's padding slots
-    # coincide with count-0 slots after the segment sums.
-    # negative ids route to the sentinel too: jax wraps negative scatter indices
-    # (id -1 would silently train the LAST row and break the sorted/unique
-    # promises below — mode='drop' only drops the high side)
-    uniq = unique_with_counts(jnp.where((pre_counts > 0) & (row_ids >= 0),
-                                        row_ids, weights.shape[0]))
-    g = uniq.segment_reduce(grads)
-    counts = uniq.segment_reduce(pre_counts)
-    # padding slots (id == n_rows sentinel) get counts 0:
-    counts = jnp.where(uniq.unique_ids < weights.shape[0], counts, 0)
+    g, counts, idx = _dedup_routed(weights.shape[0], row_ids, grads, pre_counts)
 
     from .pallas_sparse import maybe_fused_apply
-    fused = maybe_fused_apply(optimizer, weights, slots, uniq.unique_ids, g, counts)
+    fused = maybe_fused_apply(optimizer, weights, slots, idx, g, counts)
     if fused is not None:
         return fused
 
@@ -111,17 +217,6 @@ def sparse_apply_dense_table(
     # beta_2^t rounds to 1.0 (killing Adam's lr_t) and g^2 accumulators lose most of
     # their mantissa. Slots are stored f32 (`SparseOptimizer.init_slots`); weights are
     # upcast for the update and cast back on scatter (TPU-idiomatic mixed precision).
-    #
-    # Index vector: valid unique ids are ascending (sort-based dedup); every invalid
-    # slot i (padding / sentinel) maps to the DISTINCT out-of-bounds row n_rows + i,
-    # so the whole vector is genuinely ascending and duplicate-free — the
-    # indices_are_sorted/unique_indices promises hold exactly, and XLA emits the
-    # vectorized gather/scatter instead of a serialized row loop (the difference
-    # between 25 ms and sub-ms on v5e; tools/step_bisect.py).
-    valid = counts > 0
-    n_rows_t = weights.shape[0]
-    idx = jnp.where(valid, uniq.unique_ids,
-                    n_rows_t + jnp.arange(n, dtype=uniq.unique_ids.dtype))
     w_rows = lookup_rows(weights, idx, sorted_unique=True).astype(jnp.float32)
     s_rows = {k: lookup_rows(v, idx, sorted_unique=True)
               for k, v in slots.items()}

@@ -116,6 +116,7 @@ class ShardedModel:
         self.model = model
         self._lookup_fns: Dict[str, Any] = {}
         self._predict_fn = None
+        self._resident_cache: Dict[str, np.ndarray] = {}
 
     # -- loading -------------------------------------------------------------
 
@@ -215,14 +216,15 @@ class ShardedModel:
     # only the requesting peer assembles a full standalone export.
 
     def _resident_ids(self, name: str) -> np.ndarray:
-        """Sorted int64 ids resident in a hash table (host-side, cached)."""
-        if not hasattr(self, "_resident_cache"):
-            self._resident_cache: Dict[str, np.ndarray] = {}
-        if name not in self._resident_cache:
+        """Sorted int64 ids resident in a hash table (host-side, cached).
+        `_resident_cache` is created in __init__ and never rebound, so
+        concurrent REST threads at worst duplicate the one-time compute."""
+        cache = self._resident_cache
+        if name not in cache:
             from ..ops.id64 import np_resident_ids
             _, ids64 = np_resident_ids(np.asarray(self.tables[name].keys))
-            self._resident_cache[name] = np.sort(ids64)
-        return self._resident_cache[name]
+            cache[name] = np.sort(ids64)
+        return cache[name]
 
     def export_manifest(self) -> dict:
         variables = []

@@ -154,6 +154,12 @@ class MeshTrainer(Trainer):
                         for k, v in metrics.get("stats", {}).items()}
         return out
 
+    def _packed_layouts(self, state):
+        # the sharded exchange protocol (parallel/sharded.py) owns the
+        # per-shard apply and keeps the split weights/slots layout; in-scan
+        # packing (Trainer.train_many) is a single-device-path optimization
+        return {}
+
     def table_pull(self, spec, table, ids):
         return sharded_lookup_train(
             spec, table, ids, axis=self.axis,
