@@ -496,8 +496,8 @@ class Trainer:
         from .ops.sparse import packed_layout
         out = {}
         for name, spec in self.model.ps_specs().items():
-            if spec.use_hash_table or spec.storage == "host_cached":
-                continue
+            if spec.storage == "host_cached":
+                continue  # offload drives prepare/flush around single steps
             ts = state.tables[name]
             lay = packed_layout(spec.output_dim, ts.slots, ts.weights.dtype)
             if lay is not None:
@@ -505,13 +505,19 @@ class Trainer:
         return out
 
     def _packed_pull(self, spec, table, ids):
-        """Array-table pull from the packed layout: gather full packed rows
-        (the gather is latency-bound, the extra slot bytes ride free) and
-        slice the weight columns."""
+        """Pull from the packed layout: gather full packed rows (the gather is
+        latency-bound, the extra slot bytes ride free) and slice the weight
+        columns. Hash tables keep their normal probe/insert (keys are a
+        separate array either way)."""
         from .embedding import _flat_ids
         from .ops.sparse import lookup_rows
         flat, out_shape = _flat_ids(spec, ids)
-        rows = lookup_rows(table.weights, flat)[:, :spec.output_dim]
+        if spec.use_hash_table:
+            from .tables.hash_table import hash_lookup_train
+            table, rows = hash_lookup_train(table, flat,
+                                            out_dim=spec.output_dim)
+        else:
+            rows = lookup_rows(table.weights, flat)[:, :spec.output_dim]
         rows = rows.astype(spec.dtype).reshape(out_shape + (spec.output_dim,))
         return table, rows, {}, None
 
@@ -520,6 +526,11 @@ class Trainer:
         from .ops.sparse import sparse_apply_packed_table
         flat_ids, _ = _flat_ids(spec, ids)
         flat_grads = grads.reshape(-1, spec.output_dim)
+        if spec.use_hash_table:
+            from .tables.hash_table import hash_apply_gradients_packed
+            return hash_apply_gradients_packed(
+                table, self.opt_for(spec), flat_ids, flat_grads, layout,
+                spec.output_dim), {}
         packed = sparse_apply_packed_table(
             self.opt_for(spec), table.weights, layout, spec.output_dim,
             flat_ids, flat_grads)

@@ -319,10 +319,15 @@ def hash_lookup(state, ids: jax.Array) -> jax.Array:
     return jnp.where(hit[:, None], rows, jnp.zeros_like(rows))
 
 
-def hash_lookup_train(state, ids: jax.Array):
+def hash_lookup_train(state, ids: jax.Array, out_dim: int = None):
     """Training pull: inserts unseen ids (their slots already carry initializer values)
     and returns (new_state, rows). Mirrors the reference's lazy-init pull
-    (`EmbeddingOptimizerVariable.h:242-266`)."""
+    (`EmbeddingOptimizerVariable.h:242-266`).
+
+    `out_dim`: when the state holds the PACKED weights+slots layout
+    (`ops/sparse.packed_layout`, inside `Trainer.train_many`'s scan), slice
+    the weight columns out of the gathered packed rows — the gather is
+    latency-bound, the slot bytes ride free."""
     from ..ops.dedup import unique_with_counts
 
     ids = adapt_ids(state.keys, ids)
@@ -338,10 +343,23 @@ def hash_lookup_train(state, ids: jax.Array):
     capacity = state.keys.shape[0]
     hit = slot < capacity
     rows = jnp.take(state.weights, jnp.clip(slot, 0, capacity - 1), axis=0)
+    if out_dim is not None and rows.shape[1] != out_dim:
+        rows = rows[:, :out_dim]
     rows = jnp.where(hit[:, None], rows, jnp.zeros_like(rows))
     new_overflow = (state.overflow + overflow if state.overflow is not None
                     else overflow)
     return state.replace(keys=new_keys, overflow=new_overflow), rows
+
+
+def _grad_slots_and_counts(state, ids: jax.Array):
+    """ids -> (clipped slot indices, pre_counts) for the push+update: absent
+    ids (overflowed at pull time) drop their gradients via count 0, like the
+    reference dropping pushes for ids a dead shard lost."""
+    ids = adapt_ids(state.keys, ids)
+    slot = hash_find(state.keys, ids)
+    capacity = state.keys.shape[0]
+    pre_counts = jnp.where(slot < capacity, 1, 0).astype(jnp.int32)
+    return jnp.clip(slot, 0, capacity), pre_counts
 
 
 def hash_apply_gradients(state, optimizer, ids: jax.Array, grads: jax.Array):
@@ -349,13 +367,21 @@ def hash_apply_gradients(state, optimizer, ids: jax.Array, grads: jax.Array):
     then run the shared fused sparse apply over slot indices."""
     from ..ops.sparse import sparse_apply_dense_table
 
-    ids = adapt_ids(state.keys, ids)
-    slot = hash_find(state.keys, ids)
-    capacity = state.keys.shape[0]
-    # absent ids (overflowed at pull time) drop their gradients, like the reference
-    # dropping pushes for ids a dead shard lost; mark them as padding via count 0
-    pre_counts = jnp.where(slot < capacity, 1, 0).astype(jnp.int32)
+    slot, pre_counts = _grad_slots_and_counts(state, ids)
     weights, slots = sparse_apply_dense_table(
-        optimizer, state.weights, state.slots,
-        jnp.clip(slot, 0, capacity), grads, pre_counts=pre_counts)
+        optimizer, state.weights, state.slots, slot, grads,
+        pre_counts=pre_counts)
     return state.replace(weights=weights, slots=slots)
+
+
+def hash_apply_gradients_packed(state, optimizer, ids: jax.Array,
+                                grads: jax.Array, layout, dim: int):
+    """`hash_apply_gradients` over the packed weights+slots layout: same probe
+    and drop semantics, one gather/scatter pair (`sparse_apply_packed_table`)."""
+    from ..ops.sparse import sparse_apply_packed_table
+
+    slot, pre_counts = _grad_slots_and_counts(state, ids)
+    packed = sparse_apply_packed_table(
+        optimizer, state.weights, layout, dim, slot, grads,
+        pre_counts=pre_counts)
+    return state.replace(weights=packed)

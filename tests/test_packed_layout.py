@@ -111,6 +111,53 @@ def test_train_many_packed_matches_step_loop():
                                       np.asarray(v))
 
 
+def test_train_many_packed_hash_table_matches_step_loop():
+    """Hash-table (input_dim=-1) variables pack too: same probe/insert/
+    overflow semantics, one gather/scatter pair. Exact parity vs the split
+    step path, including the keys array and overflow counter."""
+    from openembedding_tpu.embedding import Embedding
+    from openembedding_tpu.model import EmbeddingModel
+    from openembedding_tpu.models.ctr import LogisticRegression
+
+    steps = 5
+    model = EmbeddingModel(
+        module=LogisticRegression(),
+        embeddings=[Embedding(input_dim=-1, output_dim=8, name="categorical",
+                              capacity=512)])
+    trainer = Trainer(model, embed.Adagrad(learning_rate=0.1))
+    rng = np.random.default_rng(11)
+    batches = [{"sparse": {"categorical": rng.integers(0, 10_000, (32, 4))
+                           .astype(np.int64)},
+                "dense": None,
+                "label": rng.integers(0, 2, (32,)).astype(np.float32)}
+               for _ in range(steps)]
+    stacked = jax.tree_util.tree_map(
+        lambda *xs: np.stack(xs) if xs[0] is not None else None, *batches,
+        is_leaf=lambda x: x is None)
+
+    state = trainer.init(batches[0])
+    assert "categorical" in trainer._packed_layouts(state)
+    sm, metrics = trainer.jit_train_many()(state, stacked)
+
+    state2 = trainer.init(batches[0])
+    step = trainer.jit_train_step()
+    losses = []
+    for b in batches:
+        state2, m = step(state2, b)
+        losses.append(float(m["loss"]))
+
+    np.testing.assert_allclose(np.asarray(metrics["loss"]), losses,
+                               rtol=0, atol=0)
+    np.testing.assert_array_equal(np.asarray(sm.tables["categorical"].keys),
+                                  np.asarray(state2.tables["categorical"].keys))
+    assert int(sm.tables["categorical"].overflow) == int(state2.tables["categorical"].overflow)
+    np.testing.assert_array_equal(np.asarray(sm.tables["categorical"].weights),
+                                  np.asarray(state2.tables["categorical"].weights))
+    for k, v in state2.tables["categorical"].slots.items():
+        np.testing.assert_array_equal(np.asarray(sm.tables["categorical"].slots[k]),
+                                      np.asarray(v))
+
+
 def test_train_many_unpackable_still_works():
     """A packed width in XLA's padded-copy regime (32 < W < 128) bypasses
     packing; train_many still runs on the split layout."""
