@@ -426,7 +426,7 @@ class Trainer:
                 new_tables[name], push_stats = self._packed_apply(
                     spec, pulled_tables[name],
                     jnp.asarray(batch["sparse"][name]), row_grads[name],
-                    packed[name])
+                    packed[name], pull_plans[name])
             else:
                 new_tables[name], push_stats = self.table_apply(
                     spec, pulled_tables[name],
@@ -521,7 +521,7 @@ class Trainer:
         rows = rows.astype(spec.dtype).reshape(out_shape + (spec.output_dim,))
         return table, rows, {}, None
 
-    def _packed_apply(self, spec, table, ids, grads, layout):
+    def _packed_apply(self, spec, table, ids, grads, layout, plan=None):
         from .embedding import _flat_ids
         from .ops.sparse import sparse_apply_packed_table
         flat_ids, _ = _flat_ids(spec, ids)

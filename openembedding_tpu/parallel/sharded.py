@@ -141,7 +141,8 @@ def _serve_rows(spec: EmbeddingSpec, state: EmbeddingTableState,
         if train:
             from ..tables.hash_table import hash_lookup_train
             old_overflow = state.overflow
-            state, rows = hash_lookup_train(state, probe)
+            state, rows = hash_lookup_train(state, probe,
+                                            out_dim=spec.output_dim)
             # overflow is replicated table-level state: psum the per-shard increment
             delta = jax.lax.psum(state.overflow - old_overflow, axis)
             state = state.replace(overflow=old_overflow + delta)
@@ -151,6 +152,12 @@ def _serve_rows(spec: EmbeddingSpec, state: EmbeddingTableState,
     else:
         local_rows = jnp.where(flat_valid, flat_recv // S, -1)
         rows = lookup_rows(state.weights, local_rows)
+        if rows.shape[1] != spec.output_dim:
+            # packed weights+slots layout inside train_many's scan
+            # (`ops/sparse.packed_layout`): slice the weight columns out of
+            # the gathered packed rows — the gather is latency-bound, the
+            # slot bytes ride free
+            rows = rows[:, :spec.output_dim]
     return state, rows.reshape(S, plan.cap, spec.output_dim)
 
 
@@ -210,9 +217,15 @@ def sharded_apply_gradients(
     axis: str = DATA_AXIS,
     capacity_factor: float = 0.0,
     plan: Optional[ExchangePlan] = None,
+    packed=None,
 ) -> Tuple[EmbeddingTableState, Dict[str, jax.Array]]:
     """Push + fused update inside shard_map. Pass the pull's `plan` to skip the
-    duplicate dedup/bucketing and id exchange."""
+    duplicate dedup/bucketing and id exchange.
+
+    `packed`: the column layout when the shard state holds the packed
+    weights+slots array (`ops/sparse.packed_layout`, inside
+    `Trainer.train_many`'s scan) — the update then pays one gather/scatter
+    pair per shard instead of one per array."""
     S = jax.lax.axis_size(axis)
     if plan is None:
         plan = make_plan(spec, ids, axis=axis, capacity_factor=capacity_factor)
@@ -250,14 +263,19 @@ def sharded_apply_gradients(
         slot = hash_find(state.keys, probe)
         capacity = state.keys.shape[0]
         pre_counts = jnp.where((slot < capacity) & (rc > 0), rc, 0)
-        weights, slots = sparse_apply_dense_table(
-            optimizer, state.weights, state.slots,
-            jnp.clip(slot, 0, capacity), rg, pre_counts=pre_counts)
+        rows, counts = jnp.clip(slot, 0, capacity), pre_counts
     else:
-        local_rows = jnp.where(rc > 0, rids // S, state.weights.shape[0])
-        weights, slots = sparse_apply_dense_table(
-            optimizer, state.weights, state.slots, local_rows, rg, pre_counts=rc)
+        rows = jnp.where(rc > 0, rids // S, state.weights.shape[0])
+        counts = rc
     stats = {"push_overflow": buckets.overflow}
+    if packed is not None:
+        from ..ops.sparse import sparse_apply_packed_table
+        new_packed = sparse_apply_packed_table(
+            optimizer, state.weights, packed, spec.output_dim, rows, rg,
+            pre_counts=counts)
+        return state.replace(weights=new_packed), stats
+    weights, slots = sparse_apply_dense_table(
+        optimizer, state.weights, state.slots, rows, rg, pre_counts=counts)
     return state.replace(weights=weights, slots=slots), stats
 
 

@@ -154,11 +154,19 @@ class MeshTrainer(Trainer):
                         for k, v in metrics.get("stats", {}).items()}
         return out
 
-    def _packed_layouts(self, state):
-        # the sharded exchange protocol (parallel/sharded.py) owns the
-        # per-shard apply and keeps the split weights/slots layout; in-scan
-        # packing (Trainer.train_many) is a single-device-path optimization
-        return {}
+    # packed scan layout: the base `_packed_layouts` gate applies per shard
+    # (widths are shard-invariant); the sharded pull auto-slices packed rows
+    # and the apply takes the layout, so only the two hooks below differ.
+
+    def _packed_pull(self, spec, table, ids):
+        return sharded_lookup_train(
+            spec, table, ids, axis=self.axis,
+            capacity_factor=self.capacity_factor)
+
+    def _packed_apply(self, spec, table, ids, grads, layout, plan=None):
+        return sharded_apply_gradients(
+            spec, table, self.opt_for(spec), ids, grads, axis=self.axis,
+            capacity_factor=self.capacity_factor, plan=plan, packed=layout)
 
     def table_pull(self, spec, table, ids):
         return sharded_lookup_train(
