@@ -67,6 +67,12 @@ class EmbeddingSpec:
     # variable PMem table selection (`EmbeddingInitOperator.cpp:146-168`).
     storage: str = "hbm"
     variable_id: int = -1
+    # batch feature this variable reads its ids from; "" = the variable's own
+    # name. Lets two variables share one id stream (e.g. a CTR model's
+    # first-order dim-1 table beside the latent table — the reference's
+    # DeepCTR linear feature columns likewise re-read the same input,
+    # `test/benchmark/criteo_deepctr.py`).
+    feature: str = ""
 
     def __post_init__(self):
         if self.input_dim == 0 or self.input_dim < -1:
@@ -94,6 +100,11 @@ class EmbeddingSpec:
     @property
     def vocabulary_size(self) -> int:
         return HASH_VOCABULARY_THRESHOLD if self.use_hash_table else self.input_dim
+
+    @property
+    def feature_name(self) -> str:
+        """The batch["sparse"] key this variable's ids come from."""
+        return self.feature or self.name
 
     @property
     def meta(self) -> EmbeddingVariableMeta:
@@ -130,6 +141,7 @@ class EmbeddingSpec:
             "capacity": self.capacity,
             "storage": self.storage,
             "variable_id": self.variable_id,
+            "feature": self.feature,
         }
 
     @classmethod
@@ -235,7 +247,8 @@ class Embedding:
                  num_shards: int = -1,
                  sparse_as_dense: bool = False,
                  capacity: int = 0,
-                 storage: str = "hbm"):
+                 storage: str = "hbm",
+                 feature: str = ""):
         self.spec = EmbeddingSpec(
             name=name,
             input_dim=input_dim,
@@ -247,6 +260,7 @@ class Embedding:
             sparse_as_dense=sparse_as_dense,
             capacity=capacity,
             storage=storage,
+            feature=feature,
         )
 
     def __repr__(self):

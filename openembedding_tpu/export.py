@@ -315,12 +315,17 @@ class StandaloneModel:
         # bucketed padding bounds the compile cache (one program per power-of-
         # two batch size, not per request size); probing via a REQUIRED
         # feature raises KeyError(name) -> 400 at the REST layer
-        first = next(iter(self._tables))
+        specs = self.model.specs
+
+        def feat(name):
+            return specs[name].feature_name if name in specs else name
+
+        first = feat(next(iter(self._tables)))
         n = np.asarray(batch["sparse"][first]).shape[0]
         padded = pad_serving_batch(batch, n, bucket_size(n))
         # sparse_as_dense variables were exported as plain array tables, so every
         # spec (PS or sad) resolves through the same lookup here
-        embedded = {name: self.lookup(name, padded["sparse"][name])
+        embedded = {name: self.lookup(name, padded["sparse"][feat(name)])
                     for name in self._tables}
         out = self._predict_fn(self.dense_params, embedded,
                                padded.get("dense"))

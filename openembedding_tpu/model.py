@@ -221,7 +221,7 @@ class Trainer:
         new_tables = dict(state.tables)
         for name, ot in self.offload.items():
             ot.adopt(state.tables[name])
-            ot.prepare(batch["sparse"][name])
+            ot.prepare(batch["sparse"][self.model.specs[name].feature_name])
             new_tables[name] = ot.state
         return state.replace(tables=new_tables)
 
@@ -283,7 +283,7 @@ class Trainer:
             for name, spec in self.model.ps_specs().items():
                 if not spec.use_hash_table:
                     continue
-                ids = _np.asarray(sample_batch["sparse"][name])
+                ids = _np.asarray(sample_batch["sparse"][spec.feature_name])
                 if ids.dtype == _np.int64 and (ids >= (1 << 31)).any():
                     import warnings
                     warnings.warn(
@@ -356,7 +356,7 @@ class Trainer:
         from .ops.id64 import is_pair
         out = {}
         for name, spec in self.model.specs.items():
-            ids = jnp.asarray(batch["sparse"][name])
+            ids = jnp.asarray(batch["sparse"][spec.feature_name])
             shape = (ids.shape[:-1] if spec.use_hash_table and is_pair(ids)
                      else ids.shape)
             out[name] = jnp.zeros(shape + (spec.output_dim,), spec.dtype)
@@ -389,14 +389,13 @@ class Trainer:
         pull_plans = {}
         stats = {}
         for name, spec in ps_specs.items():
+            ids = jnp.asarray(batch["sparse"][spec.feature_name])
             if name in packed:
                 pulled_tables[name], pulled[name], pull_stats, pull_plans[name] = \
-                    self._packed_pull(spec, state.tables[name],
-                                      jnp.asarray(batch["sparse"][name]))
+                    self._packed_pull(spec, state.tables[name], ids)
             else:
                 pulled_tables[name], pulled[name], pull_stats, pull_plans[name] = \
-                    self.table_pull(spec, state.tables[name],
-                                    jnp.asarray(batch["sparse"][name]))
+                    self.table_pull(spec, state.tables[name], ids)
             for k, v in pull_stats.items():
                 stats[f"{name}/{k}"] = v
 
@@ -404,7 +403,7 @@ class Trainer:
             embedded = dict(pulled_rows)
             for name, spec in sad_specs.items():
                 table = dense_params["__embeddings__"][name]
-                ids = jnp.asarray(batch["sparse"][name])
+                ids = jnp.asarray(batch["sparse"][spec.feature_name])
                 embedded[name] = jnp.take(table, ids, axis=0)
             logits = model.module.apply({"params": dense_params}, embedded,
                                         batch.get("dense"))
@@ -422,15 +421,14 @@ class Trainer:
         # SPARSE push+update (reference: PushGradients + UpdateWeights store op)
         new_tables = dict(state.tables)
         for name, spec in ps_specs.items():
+            ids = jnp.asarray(batch["sparse"][spec.feature_name])
             if name in packed:
                 new_tables[name], push_stats = self._packed_apply(
-                    spec, pulled_tables[name],
-                    jnp.asarray(batch["sparse"][name]), row_grads[name],
+                    spec, pulled_tables[name], ids, row_grads[name],
                     packed[name], pull_plans[name])
             else:
                 new_tables[name], push_stats = self.table_apply(
-                    spec, pulled_tables[name],
-                    jnp.asarray(batch["sparse"][name]),
+                    spec, pulled_tables[name], ids,
                     row_grads[name], pull_plans[name])
             for k, v in push_stats.items():
                 stats[f"{name}/{k}"] = v
@@ -470,12 +468,13 @@ class Trainer:
         model = self.model
         embedded = {
             name: self.table_lookup(spec, state.tables[name],
-                                    jnp.asarray(batch["sparse"][name]))
+                                    jnp.asarray(batch["sparse"][spec.feature_name]))
             for name, spec in model.ps_specs().items()
         }
         for name, spec in model.sad_specs().items():
             table = state.dense_params["__embeddings__"][name]
-            embedded[name] = jnp.take(table, jnp.asarray(batch["sparse"][name]), axis=0)
+            embedded[name] = jnp.take(
+                table, jnp.asarray(batch["sparse"][spec.feature_name]), axis=0)
         logits = model.module.apply({"params": state.dense_params}, embedded,
                                     batch.get("dense"))
         return {"logits": logits, "loss": self._loss(logits, batch)}
@@ -489,10 +488,10 @@ class Trainer:
         return jax.jit(self.train_step, donate_argnums=(0,))
 
     def _packed_layouts(self, state: TrainState):
-        """{name: column layout} for array tables worth packing inside the
-        scan (see `ops/sparse.packed_layout`). MeshTrainer returns {} — its
-        apply runs inside the shard_map'd exchange protocol (parallel/sharded.py),
-        which keeps the split layout."""
+        """{name: column layout} for tables worth packing inside the scan
+        (see `ops/sparse.packed_layout`). Applies per shard under MeshTrainer
+        too — its `_packed_pull`/`_packed_apply` hooks route through the
+        packed-aware sharded protocol (parallel/sharded.py)."""
         from .ops.sparse import packed_layout
         out = {}
         for name, spec in self.model.ps_specs().items():

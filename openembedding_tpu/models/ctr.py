@@ -29,6 +29,9 @@ CRITEO_NUM_SPARSE = 26   # C1..C26
 CRITEO_NUM_DENSE = 13    # I1..I13
 
 CATEGORICAL = "categorical"
+# split first-order layout: the dim-1 linear-term table beside the latent
+# table, both reading the CATEGORICAL id feature (EmbeddingSpec.feature)
+FIRST_ORDER = "first_order"
 
 
 class MLP(nn.Module):
@@ -49,8 +52,20 @@ class MLP(nn.Module):
         return x
 
 
-def _split_first_order(e):
-    """A combined table row is [w, v_1..v_d]: first-order weight + latent vector."""
+def _split_first_order(embedded):
+    """-> (first-order weights (B, F), latent vectors (B, F, d)).
+
+    Folded layout (default for small dims): one combined table whose row is
+    [w, v_1..v_d]. Split layout (`first_order="split"`): the first-order
+    weight lives in its own dim-1 variable sharing the CATEGORICAL id
+    feature — the reference's DeepCTR builds separate linear feature columns
+    the same way (`test/benchmark/criteo_deepctr.py`), and at lane-straddling
+    widths (e.g. dim 64 -> folded width 65) splitting keeps the latent table
+    lane-exact, which is what the packed scan layout and XLA's copy-free
+    gather need (PERF.md "dim-64 single-chip HBM budget")."""
+    if FIRST_ORDER in embedded:
+        return embedded[FIRST_ORDER][..., 0], embedded[CATEGORICAL]
+    e = embedded[CATEGORICAL]
     return e[..., 0], e[..., 1:]
 
 
@@ -62,7 +77,7 @@ class LogisticRegression(nn.Module):
 
     @nn.compact
     def __call__(self, embedded, dense):
-        w, _ = _split_first_order(embedded[CATEGORICAL])
+        w, _ = _split_first_order(embedded)
         logit = jnp.sum(w.astype(jnp.float32), axis=-1)
         if dense is not None:
             logit += nn.Dense(1, dtype=self.compute_dtype,
@@ -81,7 +96,7 @@ class WideDeep(nn.Module):
 
     @nn.compact
     def __call__(self, embedded, dense):
-        w, v = _split_first_order(embedded[CATEGORICAL])   # (B,F), (B,F,d)
+        w, v = _split_first_order(embedded)   # (B,F), (B,F,d)
         wide = jnp.sum(w.astype(jnp.float32), axis=-1)
         feats = v.reshape(v.shape[0], -1)
         if dense is not None:
@@ -103,7 +118,7 @@ class DeepFM(nn.Module):
 
     @nn.compact
     def __call__(self, embedded, dense):
-        w, v = _split_first_order(embedded[CATEGORICAL])   # (B,F), (B,F,d)
+        w, v = _split_first_order(embedded)   # (B,F), (B,F,d)
         first = jnp.sum(w.astype(jnp.float32), axis=-1)
         vb = v.astype(self.compute_dtype)
         # FM second order: 0.5 * sum_d [(sum_f v)^2 - sum_f v^2]
@@ -135,7 +150,7 @@ class XDeepFM(nn.Module):
 
     @nn.compact
     def __call__(self, embedded, dense):
-        w, v = _split_first_order(embedded[CATEGORICAL])
+        w, v = _split_first_order(embedded)
         linear = jnp.sum(w.astype(jnp.float32), axis=-1)
         x0 = v.astype(self.compute_dtype)               # (B, F, d)
         xk = x0
@@ -175,7 +190,7 @@ class DLRM(nn.Module):
 
     @nn.compact
     def __call__(self, embedded, dense):
-        _, v = _split_first_order(embedded[CATEGORICAL])   # (B, F, d)
+        _, v = _split_first_order(embedded)   # (B, F, d)
         d = v.shape[-1]
         vb = v.astype(self.compute_dtype)
         if dense is not None:
@@ -203,30 +218,58 @@ class DLRM(nn.Module):
 
 def _categorical_embedding(vocabulary: int, dim: int, *, hashed: bool,
                            capacity: int, num_shards: int,
-                           optimizer=None) -> Embedding:
-    """The shared combined table: dim+1 columns (col 0 = first-order weight).
+                           optimizer=None, split: bool = False):
+    """The categorical table(s) as a list.
 
-    Initialization matches the reference's defaults: latent vectors ~ N(0, 1e-4)
-    (DeepCTR's embeddings_initializer=RandomNormal(stddev=1e-4)); a uniform init
-    would swamp the FM term. First-order column starts at 0 like a Zeros linear."""
-    return Embedding(
-        input_dim=-1 if hashed else vocabulary,
-        output_dim=dim + 1,
-        name=CATEGORICAL,
-        embeddings_initializer=CombinedFirstOrder(stddev=1e-4),
-        optimizer=optimizer,
-        num_shards=num_shards,
-        capacity=capacity,
-    )
+    Folded (default): ONE combined table of dim+1 columns (col 0 =
+    first-order weight). Split: latent table (dim) + a dim-1 FIRST_ORDER
+    table aliased to the same id feature (see `_split_first_order`).
+
+    Initialization matches the reference's defaults either way: latent
+    vectors ~ N(0, 1e-4) (DeepCTR's RandomNormal(stddev=1e-4)); a uniform
+    init would swamp the FM term. First-order weights start at 0 like a
+    Zeros linear."""
+    from ..initializers import Normal, Zeros
+    kw = dict(input_dim=-1 if hashed else vocabulary, optimizer=optimizer,
+              num_shards=num_shards, capacity=capacity)
+    if not split:
+        return [Embedding(output_dim=dim + 1, name=CATEGORICAL,
+                          embeddings_initializer=CombinedFirstOrder(stddev=1e-4),
+                          **kw)]
+    return [Embedding(output_dim=dim, name=CATEGORICAL,
+                      embeddings_initializer=Normal(stddev=1e-4), **kw),
+            Embedding(output_dim=1, name=FIRST_ORDER,
+                      embeddings_initializer=Zeros(),
+                      feature=CATEGORICAL, **kw)]
+
+
+def _first_order_mode(mode: str, dim: int) -> str:
+    """Resolve first_order="auto": fold when the folded width packs in the
+    sublane regime for 1-slot optimizers (2*(dim+1) <= 32, e.g. the dim-9
+    benchmark); split when the latent dim is a half/full lane multiple so the
+    split table is copy-free and lane-exact for the packed layout (dim 64:
+    folded 65 triggers XLA's 2x padded-copy gather AND cannot pack); fold
+    otherwise (neither layout packs; folded does one pull, not two)."""
+    if mode != "auto":
+        if mode not in ("fold", "split"):
+            raise ValueError(f"first_order={mode!r}: expected fold/split/auto")
+        return mode
+    if 2 * (dim + 1) <= 32:
+        return "fold"
+    if dim % 64 == 0:
+        return "split"
+    return "fold"
 
 
 def _make(module, *, vocabulary: int, dim: int, hashed: bool = False,
           capacity: int = 0, num_shards: int = -1, optimizer=None,
-          loss_fn=binary_logloss, config: dict = None) -> EmbeddingModel:
-    emb = _categorical_embedding(vocabulary, dim, hashed=hashed,
-                                 capacity=capacity, num_shards=num_shards,
-                                 optimizer=optimizer)
-    return EmbeddingModel(module, [emb], loss_fn=loss_fn, config=config)
+          loss_fn=binary_logloss, config: dict = None,
+          first_order: str = "fold") -> EmbeddingModel:
+    embs = _categorical_embedding(vocabulary, dim, hashed=hashed,
+                                  capacity=capacity, num_shards=num_shards,
+                                  optimizer=optimizer,
+                                  split=first_order == "split")
+    return EmbeddingModel(module, embs, loss_fn=loss_fn, config=config)
 
 
 def _config(family: str, compute_dtype, **kwargs) -> dict:
@@ -251,38 +294,50 @@ def make_lr(vocabulary: int, *, hashed: bool = False, capacity: int = 0,
 
 def make_wdl(vocabulary: int, dim: int = 9, *, hidden=(256, 128),
              hashed: bool = False, capacity: int = 0, num_shards: int = -1,
-             optimizer=None, compute_dtype=jnp.bfloat16) -> EmbeddingModel:
+             optimizer=None, compute_dtype=jnp.bfloat16,
+             first_order: str = "auto") -> EmbeddingModel:
+    fo = _first_order_mode(first_order, dim)
     return _make(WideDeep(hidden=hidden, compute_dtype=compute_dtype),
                  vocabulary=vocabulary, dim=dim, hashed=hashed,
                  capacity=capacity, num_shards=num_shards, optimizer=optimizer,
+                 first_order=fo,
                  config=_config("wdl", compute_dtype, vocabulary=vocabulary,
                                 dim=dim, hidden=list(hidden), hashed=hashed,
-                                capacity=capacity, num_shards=num_shards))
+                                capacity=capacity, num_shards=num_shards,
+                                first_order=fo))
 
 
 def make_deepfm(vocabulary: int, dim: int = 9, *, hidden=(400, 400, 400),
                 hashed: bool = False, capacity: int = 0, num_shards: int = -1,
-                optimizer=None, compute_dtype=jnp.bfloat16) -> EmbeddingModel:
+                optimizer=None, compute_dtype=jnp.bfloat16,
+                first_order: str = "auto") -> EmbeddingModel:
+    fo = _first_order_mode(first_order, dim)
     return _make(DeepFM(hidden=hidden, compute_dtype=compute_dtype),
                  vocabulary=vocabulary, dim=dim, hashed=hashed,
                  capacity=capacity, num_shards=num_shards, optimizer=optimizer,
+                 first_order=fo,
                  config=_config("deepfm", compute_dtype, vocabulary=vocabulary,
                                 dim=dim, hidden=list(hidden), hashed=hashed,
-                                capacity=capacity, num_shards=num_shards))
+                                capacity=capacity, num_shards=num_shards,
+                                first_order=fo))
 
 
 def make_xdeepfm(vocabulary: int, dim: int = 9, *, hidden=(400, 400),
                  cin_layers=(128, 128), hashed: bool = False, capacity: int = 0,
                  num_shards: int = -1, optimizer=None,
-                 compute_dtype=jnp.bfloat16) -> EmbeddingModel:
+                 compute_dtype=jnp.bfloat16,
+                 first_order: str = "auto") -> EmbeddingModel:
+    fo = _first_order_mode(first_order, dim)
     return _make(XDeepFM(hidden=hidden, cin_layers=cin_layers,
                          compute_dtype=compute_dtype),
                  vocabulary=vocabulary, dim=dim, hashed=hashed,
                  capacity=capacity, num_shards=num_shards, optimizer=optimizer,
+                 first_order=fo,
                  config=_config("xdeepfm", compute_dtype, vocabulary=vocabulary,
                                 dim=dim, hidden=list(hidden),
                                 cin_layers=list(cin_layers), hashed=hashed,
-                                capacity=capacity, num_shards=num_shards))
+                                capacity=capacity, num_shards=num_shards,
+                                first_order=fo))
 
 
 def make_dlrm(vocabulary: int, dim: int = 16, *, bottom=(512, 256),

@@ -344,11 +344,12 @@ class ShardedModel:
         from ..export import bucket_size, pad_serving_batch
         # probe the batch size via a REQUIRED feature: a missing one raises
         # KeyError(name), which the REST layer maps to 400
-        first = next(iter(self.specs))
+        first = self.specs[next(iter(self.specs))].feature_name
         n = np.asarray(batch["sparse"][first]).shape[0]
         padded = pad_serving_batch(batch, n, bucket_size(n))
-        embedded = {name: self.lookup(name, padded["sparse"][name])
-                    for name in self.specs}
+        embedded = {name: self.lookup(
+            name, padded["sparse"][self.specs[name].feature_name])
+            for name in self.specs}
         if self._predict_fn is None:
             module = self.model.module
 

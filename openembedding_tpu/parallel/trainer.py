@@ -159,9 +159,8 @@ class MeshTrainer(Trainer):
     # and the apply takes the layout, so only the two hooks below differ.
 
     def _packed_pull(self, spec, table, ids):
-        return sharded_lookup_train(
-            spec, table, ids, axis=self.axis,
-            capacity_factor=self.capacity_factor)
+        # the sharded pull self-detects packed rows by width (_serve_rows)
+        return self.table_pull(spec, table, ids)
 
     def _packed_apply(self, spec, table, ids, grads, layout, plan=None):
         return sharded_apply_gradients(
@@ -292,10 +291,11 @@ class SeqMeshTrainer(MeshTrainer):
                 return P(d, *([None] * (nd - 3)), s, None)
             return P(d, *([None] * (nd - 2)), s)
 
+        by_feat = {s.feature_name: s for s in self.model.specs.values()}
         out = {}
         for key, value in batch.items():
             if key == "sparse":
-                out[key] = {k: sparse_spec(v, self.model.specs.get(k))
+                out[key] = {k: sparse_spec(v, by_feat.get(k))
                             for k, v in value.items()}
             elif key == "label" and jnp.ndim(value) >= 2:
                 out[key] = P(d, s)
