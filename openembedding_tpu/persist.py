@@ -111,6 +111,17 @@ class AsyncPersister:
         self.commit_timeout = commit_timeout
         self.policy = policy or PersistPolicy(every_steps=1000)
         os.makedirs(root, exist_ok=True)
+        # Clear stale `.writing` dirs (partial attempts of a CRASHED prior
+        # run) NOW, at construction: no writer of THIS run can be active yet
+        # — training steps are collectives, so no peer can outrun this
+        # constructor into its first persist(). Cleaning any later (the
+        # writer thread used to rmtree at write time) races a faster peer's
+        # already-finished shard + done marker out of existence, and the
+        # commit wait then times out (observed under full-suite contention).
+        if jax.process_index() == 0:
+            import glob as _glob
+            for d in _glob.glob(os.path.join(root, "persist_*.writing")):
+                shutil.rmtree(d, ignore_errors=True)
         self._q: "queue.Queue" = queue.Queue(maxsize=window)
         self._error: Optional[BaseException] = None
         self._thread = threading.Thread(target=self._writer, daemon=True)
@@ -199,8 +210,9 @@ class AsyncPersister:
 
         tmp = f"{path}.writing"
         pidx, pcount = jax.process_index(), jax.process_count()
-        if pidx == 0 and os.path.exists(tmp):
-            shutil.rmtree(tmp)
+        # NOTE: stale-dir cleanup happens in persist() (main thread,
+        # barrier-fenced); an rmtree here would race a faster peer's
+        # already-finished write out of existence — see persist().
         if self.trainer.num_shards > 1:
             from .parallel.checkpoint import save_sharded
             save_sharded(snapshot, self.model, tmp,
