@@ -12,6 +12,7 @@ scattered with out-of-bounds indices and `mode='drop'`, so they can never corrup
 
 from __future__ import annotations
 
+import os
 from typing import Dict, Tuple
 
 import jax
@@ -89,13 +90,20 @@ def scatter_rows(weights: jax.Array, rows: jax.Array, values: jax.Array,
 # regime (<= 32) or is lane-exact (% 128 == 0).
 
 PACKED_MAX_SUBLANE_WIDTH = 32
+# pack/unpack at the scan boundary transiently holds BOTH layouts (~2x the
+# packed bytes); tables whose packed form exceeds this skip packing so the
+# boundary cannot OOM a chip whose steady state fits. Override (bytes, per
+# shard) via OETPU_PACKED_MAX_BYTES for bigger-HBM parts.
+PACKED_MAX_BYTES = int(os.environ.get("OETPU_PACKED_MAX_BYTES",
+                                      str(4 << 30)))
 
 
 def packed_layout(dim: int, slots: Dict[str, jax.Array],
                   weights_dtype=jnp.float32):
     """Static column layout ((name, width), ...) for a packable table, or None
     when packing is unsafe/unprofitable (no slots; non-f32 weights or slots; a
-    packed width in XLA's padded-copy regime).
+    packed width in XLA's padded-copy regime; a packed size whose scan-entry
+    boundary would risk OOM — see PACKED_MAX_BYTES).
 
     Non-f32 weights are refused, not upcast: a bf16 table packed as f32 would
     (a) double its HBM footprint for the whole scan and (b) skip the
@@ -111,6 +119,9 @@ def packed_layout(dim: int, slots: Dict[str, jax.Array],
     if not (total <= PACKED_MAX_SUBLANE_WIDTH or total % 128 == 0):
         return None
     if any(slots[n].dtype != jnp.float32 for n in names):
+        return None
+    rows = int(next(iter(slots.values())).shape[0])
+    if rows * total * 4 > PACKED_MAX_BYTES:
         return None
     return tuple(zip(names, widths))
 
