@@ -311,3 +311,47 @@ def test_restore_from_sharded_peer(mesh_trained, tmp_path, server):
         {"sparse": batch["sparse"], "dense": batch["dense"]})).reshape(-1)
     np.testing.assert_allclose(mine, np.asarray(peer_out["logits"]).reshape(-1),
                                rtol=1e-3, atol=1e-4)
+
+
+def test_export_rows_pair_layout_hash(tmp_path, server):
+    """The live-replica export surface over a 63-bit split-pair hash table:
+    resident-id enumeration from (capacity, 2) uint32 keys, paged rows, and a
+    restored export answering identically (int64 ids in, pair probe inside)."""
+    from openembedding_tpu.export import StandaloneModel
+    from openembedding_tpu.serving import restore_from_peer
+
+    mesh = make_mesh()
+    with jax.enable_x64(False):  # pin the split-pair key layout
+        model = make_deepfm(vocabulary=-1, dim=4, hidden=(16,), hashed=True,
+                            capacity=2048)
+        trainer = MeshTrainer(model, embed.Adagrad(learning_rate=0.05),
+                              mesh=mesh)
+        batches = list(synthetic_criteo(32, id_space=1 << 40, steps=2, seed=9,
+                                        ids_dtype="pair"))
+        state = trainer.init(batches[0])
+        assert state.tables["categorical"].keys.ndim == 2  # pair layout
+        step = trainer.jit_train_step(batches[0], state)
+        for b in batches:
+            state, _ = step(state, b)
+        path = str(tmp_path / "ck_pair")
+        trainer.save(state, path)
+
+    base, httpd = server
+    status, entry = _req(f"{base}/models", "POST",
+                         {"model_sign": "pair-0", "model_uri": path,
+                          "shard_num": 8})
+    assert status == 200 and entry["status"] == "NORMAL"
+    peer_model = httpd.manager._cache["pair-0"]
+    man = peer_model.export_manifest()
+    (v,) = [x for x in man["variables"] if x["storage_name"] == "categorical"]
+    assert v["kind"] == "hash" and v["rows"] > 0
+
+    dest = restore_from_peer(base, "pair-0", str(tmp_path / "restored_pair"),
+                             page=7)  # multi-page over the resident ids
+    restored = StandaloneModel.load(dest)
+    from openembedding_tpu.ops.id64 import np_join_ids
+    probe = np_join_ids(batches[0]["sparse"]["categorical"].reshape(-1, 2))[:16]
+    want = np.asarray(peer_model.lookup("categorical",
+                                        probe.astype(np.int64)))
+    got = np.asarray(restored.lookup("categorical", probe.astype(np.int64)))
+    np.testing.assert_allclose(got, want, rtol=0, atol=0)
