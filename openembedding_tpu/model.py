@@ -547,6 +547,13 @@ class Trainer:
         array — 1.44x on the fused apply, PERF.md): pack once at entry, unpack
         once at exit, amortized over K steps. State layout outside this
         function is unchanged."""
+        if getattr(self, "offload", None):
+            raise ValueError(
+                "train_many cannot drive storage='host_cached' tables: the "
+                "host-side offload_prepare/flush must run between steps, and "
+                "a scan fuses the steps into one device program. Drive "
+                "host-cached models with jit_train_step + offload_prepare "
+                "(examples/criteo_deepctr.py --offload shows the loop).")
         from .ops.sparse import pack_table, unpack_table
         layouts = self._packed_layouts(state)
         if layouts:

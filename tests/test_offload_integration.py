@@ -231,3 +231,29 @@ def test_offload_persist_roundtrip(tmp_path):
     got = _rows(fresh, fstate, ids)
     assert np.isfinite(got).all()
     assert (np.abs(got).sum(axis=1) > 0).any()  # trained rows actually restored
+
+
+def test_train_many_rejects_offload():
+    """A scan cannot interleave host-side prepare/flush: explicit error, not
+    silent stale-cache training."""
+    import pytest as _pytest
+    from openembedding_tpu.model import Trainer as _Trainer
+    from openembedding_tpu.models import make_deepfm as _mk
+    import openembedding_tpu as _embed
+    import dataclasses as _dc
+    import numpy as _np
+    from openembedding_tpu.data import synthetic_criteo as _syn
+
+    model = _mk(vocabulary=256, dim=4)
+    spec = model.specs["categorical"]
+    model.specs["categorical"] = _dc.replace(
+        spec, input_dim=-1, capacity=64, storage="host_cached")
+    tr = _Trainer(model, _embed.Adagrad(learning_rate=0.05))
+    b = next(_syn(16, id_space=256, steps=1, seed=0))
+    state = tr.init(b)
+    state = tr.offload_prepare(state, b)
+    stacked = {"sparse": {"categorical": _np.stack([b["sparse"]["categorical"]])},
+               "dense": _np.stack([b["dense"]]),
+               "label": _np.stack([b["label"]])}
+    with _pytest.raises(ValueError, match="host_cached"):
+        tr.train_many(state, stacked)
