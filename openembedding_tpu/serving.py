@@ -210,6 +210,7 @@ class ModelManager:
 
 class ServingHandler(BaseHTTPRequestHandler):
     manager: ModelManager = None  # set by make_server
+    batcher: "Optional[MicroBatcher]" = None  # set when batching is enabled
     node_info: dict = {}
     quiet = True
 
@@ -385,7 +386,10 @@ class ServingHandler(BaseHTTPRequestHandler):
                         body["dense"], "dense")
                 from .export import RaggedBatchError
                 try:
-                    logits = model.predict(batch)
+                    if self.batcher is not None:
+                        logits = self.batcher.predict(model, sign, batch)
+                    else:
+                        logits = model.predict(batch)
                 except KeyError as e:
                     # a feature the model needs is absent from the request
                     # body — the CALLER's error (400), not an unknown sign
@@ -423,6 +427,125 @@ class ServingHandler(BaseHTTPRequestHandler):
             return self._json(404, {"error": str(e)})
         except Exception as e:  # noqa: BLE001
             return self._json(500, {"error": str(e)})
+
+
+class MicroBatcher:
+    """Aggregate concurrent /predict requests into one padded device batch.
+
+    The reference delegates serving-side batching to TF-Serving's batcher
+    (SavedModel + `documents/en/serving.md`); this is the same role for the
+    REST node: a request parks up to `window_ms` waiting for companions, then
+    one worker runs the whole group as a single `model.predict` (which pads to
+    a power-of-two bucket, so grouped requests also share compiled programs).
+    Groups are keyed by (model, feature-key set, id rank) — only structurally
+    identical requests merge. Failures propagate to every member of the group.
+    """
+
+    def __init__(self, manager: "ModelManager", window_ms: float = 2.0,
+                 max_batch: int = 4096):
+        self.manager = manager
+        self.window_s = window_ms / 1e3
+        self.max_batch = max_batch
+        self._lock = threading.Lock()
+        self._full = threading.Condition(self._lock)
+        self._groups: Dict[tuple, list] = {}
+
+    @staticmethod
+    def _group_key(sign: str, batch: dict) -> tuple:
+        """Only structurally identical requests merge: same feature set AND
+        same trailing shapes per feature (np.concatenate needs them), same
+        dense width."""
+        sparse = batch["sparse"]
+        dense = batch.get("dense")
+        return (sign,
+                tuple((k, np.asarray(v).shape[1:])
+                      for k, v in sorted(sparse.items())),
+                None if dense is None else np.asarray(dense).shape[1:])
+
+    @staticmethod
+    def _request_rows(batch: dict) -> int:
+        """Leading-dim row count; an INTERNALLY ragged request fails alone at
+        enqueue (never poisoning its groupmates), and an empty request is the
+        caller's error (KeyError -> the handler's 400)."""
+        from .export import RaggedBatchError
+        if not batch["sparse"]:
+            raise KeyError("predict request has no sparse features")
+        ns = {k: int(np.asarray(v).shape[0])
+              for k, v in batch["sparse"].items()}
+        if batch.get("dense") is not None:
+            ns["dense"] = int(np.asarray(batch["dense"]).shape[0])
+        if len(set(ns.values())) != 1:
+            raise RaggedBatchError(
+                f"ragged serving batch: row counts {ns}")
+        return next(iter(ns.values()))
+
+    def predict(self, model, sign: str, batch: dict) -> np.ndarray:
+        """Blocking: returns this request's logits slice. `model` is the
+        handler's already-resolved servable (resolving again inside the
+        window would turn a mid-window DELETE into the wrong error class)."""
+        n = self._request_rows(batch)
+        entry = {"batch": batch, "n": n, "done": threading.Event(),
+                 "out": None, "err": None}
+        key = self._group_key(sign, batch)
+        with self._lock:
+            group = self._groups.setdefault(key, [])
+            group.append(entry)
+            leader = len(group) == 1
+            if not leader and sum(e["n"] for e in group) >= self.max_batch:
+                self._full.notify_all()  # wake the leader early
+        if leader:
+            # the first arrival owns the window + the device call; a full
+            # group releases it before the window expires
+            deadline = time.monotonic() + self.window_s
+            with self._lock:
+                while (time.monotonic() < deadline
+                       and sum(e["n"] for e in self._groups.get(key, ()))
+                       < self.max_batch):
+                    self._full.wait(timeout=max(
+                        0.0, deadline - time.monotonic()))
+                group = self._groups.pop(key, [])
+            self._run(model, group)
+        entry["done"].wait()
+        if entry["err"] is not None:
+            raise entry["err"]
+        return entry["out"]
+
+    def _run(self, model, group: list) -> None:
+        # chunk so one merged call never exceeds max_batch rows
+        chunk, rows = [], 0
+        for e in group:
+            if chunk and rows + e["n"] > self.max_batch:
+                self._run_chunk(model, chunk)
+                chunk, rows = [], 0
+            chunk.append(e)
+            rows += e["n"]
+        if chunk:
+            self._run_chunk(model, chunk)
+
+    def _run_chunk(self, model, group: list) -> None:
+        from .utils import metrics
+        try:
+            batches = [e["batch"] for e in group]
+            merged = {"sparse": {
+                k: np.concatenate([np.asarray(b["sparse"][k])
+                                   for b in batches])
+                for k in batches[0]["sparse"]}}
+            if batches[0].get("dense") is not None:
+                merged["dense"] = np.concatenate(
+                    [np.asarray(b["dense"]) for b in batches])
+            logits = np.asarray(model.predict(merged))
+            metrics.observe("serving.predict_batches", 1)
+            metrics.observe("serving.predict_requests", len(group))
+            off = 0
+            for e in group:
+                e["out"] = logits[off:off + e["n"]]
+                off += e["n"]
+        except Exception as err:  # noqa: BLE001 — delivered to every waiter
+            for e in group:
+                e["err"] = err
+        finally:
+            for e in group:
+                e["done"].set()
 
 
 def restore_from_peer(peer: str, model_sign: str, dest: str, *,
@@ -506,9 +629,11 @@ def restore_from_peer(peer: str, model_sign: str, dest: str, *,
     return dest
 
 
-def make_server(registry_root: str, host: str = "127.0.0.1", port: int = 0
+def make_server(registry_root: str, host: str = "127.0.0.1", port: int = 0, *,
+                batch_window_ms: float = 0.0, max_batch: int = 4096
                 ) -> ThreadingHTTPServer:
-    """Build (not start) the serving HTTP server; port 0 picks a free port."""
+    """Build (not start) the serving HTTP server; port 0 picks a free port.
+    `batch_window_ms > 0` turns on predict micro-batching (`MicroBatcher`)."""
     registry = ModelRegistry(registry_root)
     manager = ModelManager(registry)
 
@@ -516,8 +641,12 @@ def make_server(registry_root: str, host: str = "127.0.0.1", port: int = 0
         pass
 
     Handler.manager = manager
+    Handler.batcher = (MicroBatcher(manager, window_ms=batch_window_ms,
+                                    max_batch=max_batch)
+                       if batch_window_ms > 0 else None)
     Handler.node_info = {"node_id": f"{os.uname().nodename}:{os.getpid()}",
-                         "registry": registry_root}
+                         "registry": registry_root,
+                         "batch_window_ms": batch_window_ms}
     httpd = ThreadingHTTPServer((host, port), Handler)
     httpd.manager = manager
     return httpd
@@ -529,8 +658,16 @@ def main(argv=None) -> int:
     ap.add_argument("--registry", required=True, help="registry root directory")
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=8501)
+    ap.add_argument("--batch-window-ms", type=float, default=0.0,
+                    help="micro-batch concurrent /predict requests inside this "
+                         "window (0 = off; the reference's TF-Serving batcher "
+                         "role)")
+    ap.add_argument("--max-batch", type=int, default=4096,
+                    help="largest merged predict batch (rows)")
     args = ap.parse_args(argv)
-    httpd = make_server(args.registry, args.host, args.port)
+    httpd = make_server(args.registry, args.host, args.port,
+                        batch_window_ms=args.batch_window_ms,
+                        max_batch=args.max_batch)
     print(f"serving on http://{args.host}:{httpd.server_address[1]} "
           f"(registry: {args.registry})")
     try:
