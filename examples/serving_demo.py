@@ -59,23 +59,23 @@ def main():
         base = f"http://127.0.0.1:{httpd.server_address[1]}"
         print(f"serving node at {base}")
 
-        entry = rest(f"{base}/models", "POST",
-                     {"model_sign": sign, "model_uri": export_dir})
+        # the shipped client handles JSON + replica failover (serving.py
+        # ServingClient; pass several node URLs for HA)
+        from openembedding_tpu.serving import ServingClient
+        client = ServingClient([base])
+        entry = client.create_model(sign, export_dir)
         print(f"registered: {entry['model_sign']} status={entry['status']}")
 
-        out = rest(f"{base}/models/{sign}/pull", "POST",
-                   {"variable": "categorical", "ids": [0, 1, 2]})
-        print(f"pull rows shape: "
-              f"{np.asarray(out['weights']).shape}")
+        rows = client.pull(sign, "categorical", [0, 1, 2])
+        print(f"pull rows shape: {rows.shape}")
 
-        out = rest(f"{base}/models/{sign}/predict", "POST",
-                   {"sparse": {"categorical":
-                               np.asarray(first["sparse"]["categorical"])[:4]
-                               .tolist()},
-                    "dense": np.asarray(first["dense"])[:4].tolist()})
-        print(f"predict logits: {np.round(out['logits'], 4).tolist()}")
+        logits = client.predict(
+            sign,
+            {"categorical": np.asarray(first["sparse"]["categorical"])[:4]},
+            dense=np.asarray(first["dense"])[:4])
+        print(f"predict logits: {np.round(logits, 4).tolist()}")
 
-        print("models:", list(rest(f"{base}/models")))
+        print("models:", list(client.show_models()))
         httpd.shutdown()
     print("serving demo OK")
 

@@ -27,7 +27,7 @@ import re
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict, Optional
+from typing import Any, Dict, Optional
 
 import numpy as np
 
@@ -427,6 +427,74 @@ class ServingHandler(BaseHTTPRequestHandler):
             return self._json(404, {"error": str(e)})
         except Exception as e:  # noqa: BLE001
             return self._json(500, {"error": str(e)})
+
+
+class ServingClient:
+    """REST client with replica failover — the caller-side half of serving HA.
+
+    The reference picks one replica per pull and retries on `NoReplica`
+    (`pick_one_replica`, `EmbeddingPullOperator.cpp:50-58`,
+    `c_api_test.h:117-121`); here the client walks its replica list starting
+    from a rotating offset (spreads load) and fails over to the next node on
+    connection errors. Server-side (HTTP) errors are NOT retried — a 400/404
+    is the same answer everywhere, and a 500 on one replica is surfaced, not
+    masked by silently asking another."""
+
+    def __init__(self, nodes, timeout: float = 30.0):
+        if isinstance(nodes, str):
+            nodes = [nodes]
+        if not nodes:
+            raise ValueError("need at least one serving node URL")
+        self.nodes = [n.rstrip("/") for n in nodes]
+        self.timeout = timeout
+        self._next = 0
+
+    def _request(self, method: str, path: str, body=None):
+        import urllib.error
+        import urllib.request
+        start, last = self._next, None
+        self._next = (self._next + 1) % len(self.nodes)
+        for i in range(len(self.nodes)):
+            node = self.nodes[(start + i) % len(self.nodes)]
+            data = json.dumps(body).encode() if body is not None else None
+            req = urllib.request.Request(f"{node}{path}", data=data,
+                                         method=method)
+            if data:
+                req.add_header("Content-Type", "application/json")
+            try:
+                with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                    return json.loads(r.read())
+            except urllib.error.HTTPError:
+                raise  # a server ANSWERED; its answer stands (see class doc)
+            except (urllib.error.URLError, ConnectionError, OSError) as e:
+                last = e  # dead/unreachable replica: try the next
+        raise ConnectionError(
+            f"no live replica among {self.nodes}: {last}") from last
+
+    def pull(self, model_sign: str, variable: str, ids) -> np.ndarray:
+        out = self._request("POST", f"/models/{model_sign}/pull",
+                            {"variable": variable,
+                             "ids": np.asarray(ids).tolist()})
+        return np.asarray(out["weights"], np.float32)
+
+    def predict(self, model_sign: str, sparse: Dict[str, Any],
+                dense=None) -> np.ndarray:
+        body = {"sparse": {k: np.asarray(v).tolist()
+                           for k, v in sparse.items()}}
+        if dense is not None:
+            body["dense"] = np.asarray(dense).tolist()
+        out = self._request("POST", f"/models/{model_sign}/predict", body)
+        return np.asarray(out["logits"], np.float32)
+
+    def create_model(self, model_sign: str, uri: str, *, replica_num: int = 1,
+                     shard_num: int = 1) -> dict:
+        return self._request("POST", "/models",
+                             {"model_sign": model_sign, "model_uri": uri,
+                              "replica_num": replica_num,
+                              "shard_num": shard_num})
+
+    def show_models(self) -> dict:
+        return self._request("GET", "/models")
 
 
 class MicroBatcher:

@@ -34,7 +34,8 @@ from openembedding_tpu.data import synthetic_criteo
 from openembedding_tpu.export import StandaloneModel, export_standalone
 from openembedding_tpu.model import Trainer
 from openembedding_tpu.models import make_deepfm
-from openembedding_tpu.serving import make_server, restore_from_peer
+from openembedding_tpu.serving import (ServingClient, make_server,
+                                        restore_from_peer)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SIGN = "ha-model-1"
@@ -65,16 +66,9 @@ def _http(method, url, body=None, timeout=30):
 
 
 def _pull_failover(nodes, sign, variable, ids):
-    """Try each replica in order; the first live one answers (reference
+    """Replica failover through the shipped client (reference
     `pick_one_replica` + NoReplica-retry semantics, client-side)."""
-    last = None
-    for url in nodes:
-        try:
-            return _http("POST", f"{url}/models/{sign}/pull",
-                         {"variable": variable, "ids": ids})
-        except (urllib.error.URLError, ConnectionError, OSError) as e:
-            last = e
-    raise AssertionError(f"no live replica answered: {last}")
+    return {"weights": ServingClient(nodes).pull(sign, variable, ids).tolist()}
 
 
 # ---------------------------------------------------------------------------
