@@ -88,6 +88,34 @@ def main():
         return (w, s)
     timeit_scan(apply_fn, (table.weights, table.slots), "sparse apply")
 
+    # 3b. PACKED sparse apply (the train_many scan layout): one gather/scatter
+    # pair over the concatenated weights+slots array (ops/sparse.packed_layout)
+    from openembedding_tpu.ops.sparse import (pack_table, packed_layout,
+                                              sparse_apply_packed_table)
+    lay = packed_layout(DIM + 1, table.slots, table.weights.dtype)
+    if lay is not None:
+        packed = pack_table(table.weights, table.slots, lay)
+
+        def papply(carry):
+            return sparse_apply_packed_table(opt, carry, lay, DIM + 1, ids,
+                                             grads)
+        timeit_scan(papply, packed, "sparse apply PACKED")
+
+        # 0b. whole train step on the packed state (what train_many scans)
+        layouts = trainer._packed_layouts(state)
+        ptables = dict(state.tables)
+        for name, l in layouts.items():
+            ts = ptables[name]
+            ptables[name] = ts.replace(
+                weights=pack_table(ts.weights, ts.slots, l), slots={})
+        pstate = state.replace(tables=ptables)
+
+        def full_packed(carry):
+            st, b = carry
+            st, _ = trainer.train_step(st, b, packed=layouts)
+            return (st, b)
+        timeit_scan(full_packed, (pstate, batch), "full train_step PACKED")
+
     # 4. dense fwd+bwd only
     rows = jnp.ones((BATCH, 26, DIM + 1), jnp.float32)
 
