@@ -49,6 +49,17 @@ class RaggedBatchError(ValueError):
     CALLER's error; the REST layer maps this to 400."""
 
 
+def pad_ids_to_bucket(flat: np.ndarray) -> np.ndarray:
+    """Pad a flat id vector (trailing dims preserved) to its power-of-two
+    bucket with -1 (= absent/invalid in every lookup path), so serving pulls
+    compile O(log max_batch) programs instead of one per request size."""
+    k = flat.shape[0]
+    if k == 0:
+        return flat
+    widths = [(0, bucket_size(k) - k)] + [(0, 0)] * (flat.ndim - 1)
+    return np.pad(flat, widths, constant_values=-1)
+
+
 class _BadRange(ValueError):
     """A row-iteration request outside the table — the CALLER's error (400)."""
 
@@ -275,9 +286,7 @@ class StandaloneModel:
             if n == 0:  # empty table: every id is absent -> zero rows
                 return jnp.zeros(tuple(ids_shape) + (t["dim"],), w.dtype)
             k = flat_np.shape[0]
-            if k:
-                flat_np = np.pad(flat_np, (0, bucket_size(k) - k),
-                                 constant_values=-1)
+            flat_np = pad_ids_to_bucket(flat_np)
             pos = np.searchsorted(t["ids"], flat_np)
             pos_c = np.minimum(pos, n - 1)
             hit = t["ids"][pos_c] == flat_np
@@ -287,10 +296,7 @@ class StandaloneModel:
         ids_shape = np.shape(ids)
         flat_np = np.asarray(ids).reshape(-1)
         k = flat_np.shape[0]
-        if k:
-            flat_np = np.pad(flat_np, (0, bucket_size(k) - k),
-                             constant_values=-1)
-        flat = jnp.asarray(flat_np)
+        flat = jnp.asarray(pad_ids_to_bucket(flat_np))
         in_range = (flat >= 0) & (flat < w.shape[0])
         rows = jnp.where(in_range[:, None],
                          w[jnp.clip(flat, 0, w.shape[0] - 1)],

@@ -288,7 +288,7 @@ class ShardedModel:
         reference `read_only_pull` (`EmbeddingPullOperator.cpp:149-205`).
         The flat id count pads to a power-of-two bucket so the shard_map'd
         pull compiles O(log max_batch) programs, not one per request size."""
-        from ..export import bucket_size
+        from ..export import pad_ids_to_bucket
         from ..ops.id64 import is_pair
         spec = self.specs[name]
         raw = np.asarray(ids)
@@ -297,13 +297,9 @@ class ShardedModel:
         flat = raw.reshape((-1, 2) if pair else (-1,))
         n = flat.shape[0]
         # sparse_as_dense included: its jnp.take branch masks `flat >= 0`, so
-        # -1 padding is absent-safe there too
-        if n:
-            b = bucket_size(n)
-            if b != n:
-                widths = [(0, b - n)] + [(0, 0)] * (flat.ndim - 1)
-                flat = np.pad(flat, widths, constant_values=-1)
-        rows = self._lookup_raw(name, flat)[:n]
+        # -1 padding is absent-safe there too (pair ids: -1 wraps to the
+        # all-ones PAIR_EMPTY row, also absent)
+        rows = self._lookup_raw(name, pad_ids_to_bucket(flat))[:n]
         return rows.reshape(tuple(ids_shape) + (spec.output_dim,))
 
     def _lookup_raw(self, name: str, ids) -> jax.Array:
