@@ -39,7 +39,7 @@ throughput, not host dispatch latency — the same way production input pipeline
 drive TPUs (the axon tunnel adds ~40 ms per dispatch that would otherwise swamp
 the measurement; see PERF.md "Measurement hygiene").
 
-Env knobs: OETPU_BENCH_CASES=dim9[,dim64][,mesh1][,pull] (default: all),
+Env knobs: OETPU_BENCH_CASES=dim9[,dim64][,mesh1][,mesh1f][,pull] (default: all),
 OETPU_BENCH_BUDGET_S (default 540), OETPU_BENCH_SCAN_STEPS / _REPEATS (smoke runs).
 """
 
@@ -238,26 +238,30 @@ def case_trainer(dim):
             "vocab": vocab}
 
 
-def case_mesh1():
+def case_mesh1(capacity_factor=0.0, name="mesh1"):
     """MeshTrainer on a 1-device mesh: same workload as dim9, but through the full
     sharded pull/push protocol (dedup -> owner bucketing -> all_to_all -> fused
     apply, `parallel/sharded.py`) — the honest number for the multi-chip path's
-    per-chip overhead."""
+    per-chip overhead. `capacity_factor > 0` is the production configuration
+    (bounded buckets, sized per the rule in `parallel/sharded.py`); 0 = exact
+    mode (cap = n, can never drop)."""
     import jax
     import openembedding_tpu as embed
     from openembedding_tpu.models import make_deepfm
     from openembedding_tpu.parallel import MeshTrainer, make_mesh
 
-    WD.stage("mesh1:init", 240)
+    WD.stage(f"{name}:init", 240)
     model = make_deepfm(vocabulary=VOCAB, dim=9)
     mesh = make_mesh(jax.devices()[:1])
-    trainer = MeshTrainer(model, embed.Adagrad(learning_rate=0.05), mesh=mesh)
+    trainer = MeshTrainer(model, embed.Adagrad(learning_rate=0.05), mesh=mesh,
+                          capacity_factor=capacity_factor)
     batches, stacked = _stacked_batches(9, SCAN_STEPS)
     state = trainer.init(batches[0])
     many = trainer.jit_train_many(stacked, state)
-    eps = _measure_many("mesh1", many, state, stacked)
+    eps = _measure_many(name, many, state, stacked)
     return {"examples_per_sec_per_chip": round(eps, 1),
-            "vs_baseline_dim9": round(eps / BASELINE_PER_CHIP, 3)}
+            "vs_baseline_dim9": round(eps / BASELINE_PER_CHIP, 3),
+            "capacity_factor": capacity_factor}
 
 
 def case_pull():
@@ -316,7 +320,8 @@ def main():
     log(f"devices: {devs}")
     EXTRA["platform"] = devs[0].platform
 
-    cases = os.environ.get("OETPU_BENCH_CASES", "dim9,dim64,mesh1,pull").split(",")
+    cases = os.environ.get("OETPU_BENCH_CASES",
+                           "dim9,dim64,mesh1,mesh1f,pull").split(",")
 
     # PRIMARY first: whatever happens later, this number is in the artifact.
     if "dim9" in cases:
@@ -327,6 +332,8 @@ def main():
 
     secondary = [("dim64", lambda: case_trainer(64)),
                  ("mesh1", case_mesh1),
+                 ("mesh1f", lambda: case_mesh1(capacity_factor=1.0,
+                                               name="mesh1f")),
                  ("pull", case_pull)]
     for name, fn in secondary:
         if name not in cases:
