@@ -14,16 +14,17 @@ same table as column 0 (tables store dim+1 columns), so WDL/DeepFM need no secon
 exchange for their linear term.
 """
 
-from .ctr import (MLP, LogisticRegression, WideDeep, DeepFM, XDeepFM, DLRM,
-                  make_lr, make_wdl, make_deepfm, make_xdeepfm, make_dlrm,
-                  CRITEO_NUM_SPARSE, CRITEO_NUM_DENSE)
+from .ctr import (MLP, LogisticRegression, WideDeep, DeepFM, XDeepFM, DCN,
+                  DLRM, make_lr, make_wdl, make_deepfm, make_xdeepfm,
+                  make_dcn, make_dlrm, CRITEO_NUM_SPARSE, CRITEO_NUM_DENSE)
 from .two_tower import TwoTower, make_two_tower, in_batch_softmax_loss
 from .sequential import (SASRec, make_sasrec, sasrec_bce_loss,
                          synthetic_sequences)
 
 _FAMILIES = {
     "lr": make_lr, "wdl": make_wdl, "deepfm": make_deepfm,
-    "xdeepfm": make_xdeepfm, "dlrm": make_dlrm, "two_tower": make_two_tower,
+    "xdeepfm": make_xdeepfm, "dcn": make_dcn, "dlrm": make_dlrm,
+    "two_tower": make_two_tower,
     "sasrec": make_sasrec,
 }
 
@@ -49,8 +50,9 @@ def from_config(config: dict, **overrides):
 
 
 __all__ = [
-    "MLP", "LogisticRegression", "WideDeep", "DeepFM", "XDeepFM", "DLRM",
-    "make_lr", "make_wdl", "make_deepfm", "make_xdeepfm", "make_dlrm",
+    "MLP", "LogisticRegression", "WideDeep", "DeepFM", "XDeepFM", "DCN",
+    "DLRM", "make_lr", "make_wdl", "make_deepfm", "make_xdeepfm", "make_dcn",
+    "make_dlrm",
     "from_config",
     "TwoTower", "make_two_tower", "in_batch_softmax_loss",
     "SASRec", "make_sasrec", "sasrec_bce_loss", "synthetic_sequences",

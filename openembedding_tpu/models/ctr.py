@@ -1,4 +1,4 @@
-"""CTR model families (LR, Wide&Deep, DeepFM, xDeepFM, DLRM) as flax modules.
+"""CTR model families (LR, Wide&Deep, DeepFM, xDeepFM, DCN, DLRM) as flax modules.
 
 Each module's `__call__(embedded, dense)` matches the Trainer contract
 (`model.py`): `embedded` maps variable name -> pulled rows, `dense` is the
@@ -179,6 +179,42 @@ class XDeepFM(nn.Module):
                 + deep[..., 0].astype(jnp.float32))
 
 
+class DCN(nn.Module):
+    """DCNv2 (Deep & Cross Network): explicit feature crosses
+    x_{l+1} = x0 * (W x_l + b) + x_l, in parallel with a DNN; beyond the
+    reference's zoo (its benchmark covers WDL/DeepFM/xDeepFM) but a staple of
+    the same DeepCTR library it builds on. Linear term from the first-order
+    weights like the other CTR families."""
+
+    hidden: Sequence[int] = (256, 128)
+    num_cross: int = 3
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, embedded, dense):
+        w, v = _split_first_order(embedded)                 # (B,F), (B,F,d)
+        linear = jnp.sum(w.astype(jnp.float32), axis=-1)
+        x0 = v.reshape(v.shape[0], -1).astype(self.compute_dtype)
+        if dense is not None:
+            x0 = jnp.concatenate([dense.astype(self.compute_dtype), x0],
+                                 axis=-1)
+            linear += nn.Dense(1, dtype=self.compute_dtype,
+                               param_dtype=jnp.float32)(
+                dense.astype(self.compute_dtype))[..., 0].astype(jnp.float32)
+        xk = x0
+        for li in range(self.num_cross):
+            # full-matrix DCNv2 cross (an MXU matmul per layer)
+            wx = nn.Dense(x0.shape[-1], dtype=self.compute_dtype,
+                          param_dtype=jnp.float32, name=f"cross_{li}")(xk)
+            xk = x0 * wx + xk
+        deep = MLP(tuple(self.hidden), activate_last=True,
+                   compute_dtype=self.compute_dtype)(x0)
+        both = jnp.concatenate([xk, deep], axis=-1)
+        out = nn.Dense(1, dtype=self.compute_dtype,
+                       param_dtype=jnp.float32)(both)[..., 0]
+        return linear + out.astype(jnp.float32)
+
+
 class DLRM(nn.Module):
     """DLRM: bottom MLP on dense -> pairwise dot interactions with the field
     embeddings -> top MLP. The reference's 500 GB PMem workload
@@ -336,6 +372,23 @@ def make_xdeepfm(vocabulary: int, dim: int = 9, *, hidden=(400, 400),
                  config=_config("xdeepfm", compute_dtype, vocabulary=vocabulary,
                                 dim=dim, hidden=list(hidden),
                                 cin_layers=list(cin_layers), hashed=hashed,
+                                capacity=capacity, num_shards=num_shards,
+                                first_order=fo))
+
+
+def make_dcn(vocabulary: int, dim: int = 9, *, hidden=(256, 128),
+             num_cross: int = 3, hashed: bool = False, capacity: int = 0,
+             num_shards: int = -1, optimizer=None, compute_dtype=jnp.bfloat16,
+             first_order: str = "auto") -> EmbeddingModel:
+    fo = _first_order_mode(first_order, dim)
+    return _make(DCN(hidden=hidden, num_cross=num_cross,
+                     compute_dtype=compute_dtype),
+                 vocabulary=vocabulary, dim=dim, hashed=hashed,
+                 capacity=capacity, num_shards=num_shards, optimizer=optimizer,
+                 first_order=fo,
+                 config=_config("dcn", compute_dtype, vocabulary=vocabulary,
+                                dim=dim, hidden=list(hidden),
+                                num_cross=num_cross, hashed=hashed,
                                 capacity=capacity, num_shards=num_shards,
                                 first_order=fo))
 

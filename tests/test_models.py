@@ -12,8 +12,9 @@ import openembedding_tpu as embed
 from openembedding_tpu.data import (CriteoBatcher, hash_category,
                                     read_criteo_tsv, synthetic_criteo)
 from openembedding_tpu.model import Trainer
-from openembedding_tpu.models import (make_deepfm, make_dlrm, make_lr,
-                                      make_two_tower, make_wdl, make_xdeepfm)
+from openembedding_tpu.models import (make_dcn, make_deepfm, make_dlrm,
+                                      make_lr, make_two_tower, make_wdl,
+                                      make_xdeepfm)
 from openembedding_tpu.parallel import MeshTrainer, make_mesh
 
 VOCAB = 512
@@ -38,6 +39,7 @@ def _ctr_batch(B=32, F=26, dense=13, seed=0):
 
 
 @pytest.mark.parametrize("maker,kw", [
+    (make_dcn, {"dim": 8, "num_cross": 2}),
     (make_lr, {}),
     (make_wdl, {"dim": 4, "hidden": (16, 8)}),
     (make_deepfm, {"dim": 4, "hidden": (16, 8)}),

@@ -87,7 +87,7 @@ def run_cell(model, dim, mode, args):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--models", nargs="*",
-                    default=["lr", "wdl", "deepfm", "xdeepfm"])
+                    default=["lr", "wdl", "deepfm", "xdeepfm", "dcn"])
     ap.add_argument("--dims", nargs="*", type=int, default=[9, 64])
     ap.add_argument("--modes", nargs="*",
                     default=["plain", "mesh", "cache", "prefetch"])
