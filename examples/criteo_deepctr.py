@@ -44,16 +44,7 @@ OPTIMIZERS = {
 }
 
 
-def auc(labels: np.ndarray, scores: np.ndarray) -> float:
-    """Rank-based AUC (the reference prints keras AUC per epoch)."""
-    order = np.argsort(scores, kind="stable")
-    ranks = np.empty_like(order, dtype=np.float64)
-    ranks[order] = np.arange(1, len(scores) + 1)
-    pos = labels > 0.5
-    n_pos, n_neg = int(pos.sum()), int((~pos).sum())
-    if n_pos == 0 or n_neg == 0:
-        return float("nan")
-    return (ranks[pos].sum() - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg)
+from openembedding_tpu.utils.metrics import auc  # noqa: E402
 
 
 def main():

@@ -101,3 +101,26 @@ def test_serving_metrics_endpoint(tmp_path):
         assert "oetpu_serving_requests 3.0" in body
     finally:
         httpd.shutdown()
+
+
+def test_auc():
+    from openembedding_tpu.utils.metrics import auc
+    import numpy as np
+    # perfect separation
+    assert auc([0, 0, 1, 1], [0.1, 0.2, 0.8, 0.9]) == 1.0
+    # perfect inversion
+    assert auc([1, 1, 0, 0], [0.1, 0.2, 0.8, 0.9]) == 0.0
+    # random-ish mid value
+    v = auc([0, 1, 0, 1], [0.4, 0.3, 0.6, 0.7])
+    assert 0.0 < v < 1.0
+    # one-class degenerate -> nan
+    assert np.isnan(auc([1, 1], [0.5, 0.6]))
+    # matches sklearn on random data when available
+    try:
+        from sklearn.metrics import roc_auc_score
+    except Exception:
+        return
+    rng = np.random.default_rng(0)
+    y = rng.integers(0, 2, 500)
+    s = rng.random(500)
+    np.testing.assert_allclose(auc(y, s), roc_auc_score(y, s), atol=1e-12)
