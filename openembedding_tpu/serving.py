@@ -74,7 +74,16 @@ class ModelRegistry:
                      shard_num: int = 1) -> dict:
         """Register CREATING -> caller loads/validates -> mark NORMAL.
         An existing CREATING entry is overwritten (the reference handles interrupted
-        CREATING the same way, `ModelController.cpp:47-85`); NORMAL entries refuse."""
+        CREATING the same way, `ModelController.cpp:47-85`); NORMAL entries refuse.
+
+        `shard_num` selects the servable kind (1 = materialized StandaloneModel,
+        >1 = ShardedModel over that many devices — `ModelManager._load_entry`).
+        `replica_num` is DECLARATIVE here: replicas are serving processes the
+        operator runs (each node that loads this entry is one replica;
+        `ServingClient` fails over between them), unlike the reference where
+        the PS itself places replica_num copies of each shard
+        (`Model.cpp:153-186`). The field records intent for operators/tooling;
+        this registry does not spawn processes."""
         with self._lock:
             cur = self.get(model_sign)
             if cur is not None and cur.get("status") == "NORMAL":
