@@ -278,3 +278,40 @@ def test_predict_micro_batching(trained, tmp_path):
 
 def _batches_counter(metrics_mod):
     return metrics_mod.Accumulator.get("serving.predict_batches").value()
+
+
+def test_serving_client_failover_semantics(trained, tmp_path):
+    """ServingClient: dead replicas are skipped; an ANSWERED HTTP error is
+    surfaced immediately (never retried on another replica); the starting
+    replica rotates per call."""
+    import urllib.error
+
+    from openembedding_tpu.export import export_standalone as _export
+    from openembedding_tpu.serving import ServingClient, make_server as _mk
+
+    model, trainer, state, batch = trained
+    path = str(tmp_path / "sc_export")
+    _export(state, model, path, model_sign="sc-0")
+    srv = _mk(str(tmp_path / "sc_reg"))
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        live = f"http://127.0.0.1:{srv.server_address[1]}"
+        dead = "http://127.0.0.1:9"  # discard port: connection refused
+
+        client = ServingClient([dead, live])
+        client.create_model("sc-0", path)
+
+        # dead first in rotation: the call still lands on the live node
+        rows = client.pull("sc-0", "categorical", [1, 2, 3])
+        assert rows.shape == (3, model.specs["categorical"].output_dim)
+
+        # an answered 404 surfaces as HTTPError, not a silent failover loop
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            client.pull("sc-0", "no_such_variable", [1])
+        assert ei.value.code == 404
+
+        # all replicas dead -> ConnectionError naming the nodes
+        with pytest.raises(ConnectionError, match="no live replica"):
+            ServingClient([dead]).pull("sc-0", "categorical", [1])
+    finally:
+        srv.shutdown()
