@@ -261,3 +261,36 @@ def test_train_many_unpackable_still_works():
     assert trainer._packed_layouts(state) == {}
     sm, metrics = trainer.jit_train_many()(state, stacked)
     assert np.isfinite(np.asarray(metrics["loss"])).all()
+
+
+def test_packed_scan_compiles_one_scatter_per_table():
+    """Structural pin on the packed win: the compiled train_many updates the
+    table through ONE scatter into the packed (V, 20) array — never the two
+    split-layout scatters ((V, 10) weights + (V, 10) accum) — and temps stay
+    far below a second table copy. HLO-shape matching is deliberately narrow;
+    if an XLA upgrade reshuffles instruction names, update the patterns, but
+    a reappearing split-shape scatter or a table-sized temp is a real
+    regression."""
+    import re
+
+    V = 1 << 18
+    model = make_deepfm(vocabulary=V, dim=9)
+    tr = Trainer(model, embed.Adagrad(learning_rate=0.05))
+    batches = list(synthetic_criteo(256, id_space=V, steps=2, seed=1))
+    stacked = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *batches)
+    state = tr.init(batches[0])
+    compiled = jax.jit(tr.train_many, donate_argnums=(0,)).lower(
+        state, stacked).compile()
+
+    txt = compiled.as_text()
+    packed = len(re.findall(rf"= f32\[{V},20\]\S* scatter\(", txt))
+    split = len(re.findall(rf"= f32\[{V},10\]\S* scatter\(", txt))
+    assert packed == 1, f"expected 1 packed-table scatter, found {packed}"
+    assert split == 0, f"split-layout scatters reappeared: {split}"
+
+    ma = compiled.memory_analysis()
+    if ma is not None:  # backend-dependent
+        packed_bytes = V * 20 * 4
+        assert ma.temp_size_in_bytes < 3 * packed_bytes, (
+            f"temps {ma.temp_size_in_bytes} suggest an extra table copy "
+            f"inside the scan (packed table is {packed_bytes})")
