@@ -130,6 +130,7 @@ def main():
     from openembedding_tpu.models import make_deepfm
     from openembedding_tpu.data import synthetic_criteo
 
+    # "auto" resolves to the XLA path (kernels stay off until they win)
     for mode in ("off", "interpret" if args.interpret else "auto"):
         pallas_sparse.set_mode(mode)
         model = make_deepfm(vocabulary=1 << (14 if small else 22), dim=9)
