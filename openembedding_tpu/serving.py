@@ -380,6 +380,11 @@ class ServingHandler(BaseHTTPRequestHandler):
                     lambda v: np.asarray(v, dtype=np.int64),
                     self._field(body, "ids"), "ids")
                 rows = model.lookup(variable, ids)
+                # content negotiation: `Accept: application/octet-stream`
+                # streams the rows as npz — JSON-encoding a big pull is pure
+                # overhead for programmatic clients (ServingClient binary=True)
+                if "application/octet-stream" in self.headers.get("Accept", ""):
+                    return self._npz({"weights": np.asarray(rows)})
                 return self._json(200, {"weights": np.asarray(rows).tolist()})
             if kind == "model" and action == "predict":
                 model = self.manager.find_model(sign)
@@ -458,7 +463,9 @@ class ServingClient:
         self.timeout = timeout
         self._next = 0
 
-    def _request(self, method: str, path: str, body=None):
+    def _request(self, method: str, path: str, body=None, *,
+                 binary: bool = False):
+        import io
         import urllib.error
         import urllib.request
         start, last = self._next, None
@@ -470,9 +477,15 @@ class ServingClient:
                                          method=method)
             if data:
                 req.add_header("Content-Type", "application/json")
+            if binary:
+                req.add_header("Accept", "application/octet-stream")
             try:
                 with urllib.request.urlopen(req, timeout=self.timeout) as r:
-                    return json.loads(r.read())
+                    raw = r.read()
+                    if binary and "octet-stream" in r.headers.get(
+                            "Content-Type", ""):
+                        return dict(np.load(io.BytesIO(raw)))
+                    return json.loads(raw)
             except urllib.error.HTTPError:
                 raise  # a server ANSWERED; its answer stands (see class doc)
             except (urllib.error.URLError, ConnectionError, OSError) as e:
@@ -480,10 +493,16 @@ class ServingClient:
         raise ConnectionError(
             f"no live replica among {self.nodes}: {last}") from last
 
-    def pull(self, model_sign: str, variable: str, ids) -> np.ndarray:
+    def pull(self, model_sign: str, variable: str, ids, *,
+             binary: bool = False) -> np.ndarray:
+        """`binary=True` asks for the npz wire format (Accept negotiation) —
+        no JSON float round-trip, the right mode for large/hot pulls."""
         out = self._request("POST", f"/models/{model_sign}/pull",
                             {"variable": variable,
-                             "ids": np.asarray(ids).tolist()})
+                             "ids": np.asarray(ids).tolist()},
+                            binary=binary)
+        if binary:
+            return out["weights"]
         return np.asarray(out["weights"], np.float32)
 
     def predict(self, model_sign: str, sparse: Dict[str, Any],

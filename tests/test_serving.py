@@ -315,3 +315,26 @@ def test_serving_client_failover_semantics(trained, tmp_path):
             ServingClient([dead]).pull("sc-0", "categorical", [1])
     finally:
         srv.shutdown()
+
+
+def test_binary_pull_negotiation(trained, tmp_path):
+    """Accept: application/octet-stream returns npz rows identical to the
+    JSON answer (ServingClient binary=True)."""
+    from openembedding_tpu.export import export_standalone as _export
+    from openembedding_tpu.serving import ServingClient, make_server as _mk
+
+    model, trainer, state, batch = trained
+    path = str(tmp_path / "bin_export")
+    _export(state, model, path, model_sign="bin-0")
+    srv = _mk(str(tmp_path / "bin_reg"))
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        client = ServingClient(f"http://127.0.0.1:{srv.server_address[1]}")
+        client.create_model("bin-0", path)
+        ids = [1, 2, 3, 500]
+        js = client.pull("bin-0", "categorical", ids)
+        bn = client.pull("bin-0", "categorical", ids, binary=True)
+        assert bn.dtype == np.float32
+        np.testing.assert_allclose(bn, js, rtol=1e-6, atol=1e-7)
+    finally:
+        srv.shutdown()
