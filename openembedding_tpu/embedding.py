@@ -165,7 +165,13 @@ def init_table_state(spec: EmbeddingSpec, optimizer: SparseOptimizer,
     `EmbeddingOptimizerVariable.h:242-266`; we init rows eagerly — deterministic per
     (seed, shard), documented divergence: RNG stream differs from lazy order)."""
     rows = spec.rows_per_shard(num_shards)
-    key = jax.random.fold_in(jax.random.PRNGKey(seed), spec.variable_id * 131071 + shard_id)
+    # fold_in needs uint32 data; the unassigned sentinel (-1, specs built
+    # outside an EmbeddingModel, e.g. a bare EmbeddingVariable) maps to a slot
+    # no real variable_id reaches (2^15: 131071 * 2^15 still fits uint32)
+    # instead of raising OverflowError. Streams of assigned ids are unchanged.
+    vid = spec.variable_id if spec.variable_id >= 0 else (1 << 15)
+    key = jax.random.fold_in(jax.random.PRNGKey(seed),
+                             vid * 131071 + shard_id)
     weights = spec.initializer(key, (rows, spec.output_dim), spec.dtype)
     slots = optimizer.init_slots(rows, spec.output_dim, spec.dtype)
     keys = None

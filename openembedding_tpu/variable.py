@@ -43,6 +43,17 @@ class EmbeddingVariable:
     def read_only_pull(self, ids) -> jax.Array:
         return lookup(self.spec, self.state, jnp.asarray(ids))
 
+    # -- reference `Variable.prefetch` (`exb.py`, `PrefetchPullWeights` op):
+    #    issue the pull EARLY so the rows are ready when the step runs. Under
+    #    SPMD the transfer overlap comes from the input pipeline
+    #    (`data.prefetch_to_device`) and XLA async scheduling, so the useful
+    #    remnant here is the SIDE EFFECT: hash tables insert unseen ids now
+    #    (warm keys), array tables no-op.
+    def prefetch(self, ids) -> None:
+        if self.spec.use_hash_table:
+            self.state, _ = lookup_train(self.spec, self.state,
+                                         jnp.asarray(ids))
+
     # -- reference `Variable.push_gradients`: queue grads; applied at update_weights
     def push_gradients(self, ids, grads) -> None:
         from .embedding import _flat_ids

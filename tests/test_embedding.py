@@ -196,3 +196,33 @@ def test_negative_ids_never_train_any_row():
         jnp.asarray([n_rows - 1], jnp.int32), grads[4:5])
     np.testing.assert_allclose(np.asarray(new_w[-1]), np.asarray(ref_w[-1]),
                                rtol=1e-6)
+
+
+def test_variable_prefetch_warms_hash_keys():
+    """`EmbeddingVariable.prefetch` (reference `Variable.prefetch` /
+    PrefetchPullWeights): hash tables insert unseen ids early, so the later
+    sparse_read finds them resident; array tables no-op."""
+    import numpy as np
+    import openembedding_tpu as embed
+    from openembedding_tpu.embedding import EmbeddingSpec
+    from openembedding_tpu.tables.hash_table import hash_find
+    from openembedding_tpu.ops.id64 import np_resident_ids
+
+    spec = EmbeddingSpec(name="v", input_dim=-1, output_dim=4, capacity=64,
+                         variable_id=0)
+    var = embed.EmbeddingVariable(spec, embed.Adagrad(learning_rate=0.1))
+    ids = np.asarray([3, 99, 12345], np.int64)
+    before = np_resident_ids(np.asarray(var.state.keys))[1].size
+    var.prefetch(ids)
+    after = np_resident_ids(np.asarray(var.state.keys))[1].size
+    assert after == before + 3
+    # the later training pull reads the SAME rows it would have inserted
+    rows = np.asarray(var.sparse_read(ids))
+    assert rows.shape == (3, 4) and np.isfinite(rows).all()
+
+    # array tables: prefetch is a no-op (rows are resident by construction)
+    aspec = EmbeddingSpec(name="a", input_dim=32, output_dim=4, variable_id=1)
+    avar = embed.EmbeddingVariable(aspec, embed.Adagrad(learning_rate=0.1))
+    w0 = np.asarray(avar.state.weights)
+    avar.prefetch(np.asarray([1, 2]))
+    np.testing.assert_array_equal(w0, np.asarray(avar.state.weights))
