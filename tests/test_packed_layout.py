@@ -294,3 +294,46 @@ def test_packed_scan_compiles_one_scatter_per_table():
         assert ma.temp_size_in_bytes < 3 * packed_bytes, (
             f"temps {ma.temp_size_in_bytes} suggest an extra table copy "
             f"inside the scan (packed table is {packed_bytes})")
+
+
+def test_seq_mesh_train_many_packed_matches_step_loop():
+    """SeqMeshTrainer (context parallelism) inherits the packed scan hooks:
+    a SASRec with a packable item table (dim 16 + Adagrad accum = 32) runs
+    jit_train_many on the packed per-shard layout and matches the per-step
+    split path exactly on the same (data, seq) mesh."""
+    from jax.sharding import Mesh
+    from openembedding_tpu.models import make_sasrec, synthetic_sequences
+    from openembedding_tpu.parallel import SeqMeshTrainer
+
+    devices = np.array(jax.devices()[:8]).reshape(4, 2)
+    mesh = Mesh(devices, ("data", "seq"))
+    steps = 3
+
+    def build():
+        model = make_sasrec(512, 16, attention="ring")
+        return model, SeqMeshTrainer(model, embed.Adagrad(learning_rate=0.1),
+                                     mesh=mesh)
+
+    batches = list(synthetic_sequences(8, 16, 512, steps=steps, seed=21))
+    stacked = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *batches)
+
+    model, tr = build()
+    state = tr.init(batches[0])
+    assert tr._packed_layouts(state), "expected the item table to pack"
+    many = tr.jit_train_many(stacked, state)
+    sm, metrics = many(state, stacked)
+
+    model2, tr2 = build()
+    state2 = tr2.init(batches[0])
+    step = tr2.jit_train_step(batches[0], state2)
+    losses = []
+    for b in batches:
+        state2, m = step(state2, b)
+        losses.append(float(m["loss"]))
+
+    np.testing.assert_allclose(np.asarray(metrics["loss"]), losses,
+                               rtol=0, atol=0)
+    for name in model.ps_specs():
+        np.testing.assert_array_equal(
+            np.asarray(sm.tables[name].weights),
+            np.asarray(state2.tables[name].weights))
