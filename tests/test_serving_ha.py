@@ -206,3 +206,26 @@ def test_ha_kill_restart_and_peer_restore(exported, tmp_path):
         for p in procs:
             if p.poll() is None:
                 p.kill()
+
+
+def test_restore_refuses_non_normal(exported, tmp_path):
+    """restore_from_peer must refuse a model that isn't NORMAL (a CREATING/
+    ERROR source would yield a partial or wrong artifact) and surface an
+    unknown sign as the peer's 404."""
+    import urllib.error
+
+    path, _ = exported
+    srv = make_server(str(tmp_path / "regnn"))
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        peer = f"http://127.0.0.1:{srv.server_address[1]}"
+        # register as CREATING (never promoted): restore must refuse
+        srv.manager.registry.create_model("half-0", path)
+        with pytest.raises(RuntimeError, match="CREATING"):
+            restore_from_peer(peer, "half-0", str(tmp_path / "d1"))
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            restore_from_peer(peer, "nope-0", str(tmp_path / "d2"))
+        assert ei.value.code == 404
+    finally:
+        srv.shutdown()
