@@ -15,23 +15,32 @@ Prints ONE JSON line on stdout:
    "errors": {case: "..."},                             # failed/skipped secondaries
    "stage": "...", "error": "..."}                      # only when the primary failed
 
-Robustness (the round-2 artifact was an undiagnosable rc=1 with no output):
-- per-stage progress lines on stderr with elapsed time, flushed immediately;
-- every TPU stage runs under a watchdog deadline — on expiry the partial result JSON
-  is printed and the process force-exits (rc 1 only if the primary case is missing);
-- each case retries once on jax UNAVAILABLE/INTERNAL runtime errors (transient axon
-  relay flakes) with a cool-down in between;
-- SIGTERM/SIGINT print the partial JSON before dying, so an external `timeout`
-  still yields a diagnosable artifact;
-- a wall-clock budget (OETPU_BENCH_BUDGET_S, default 540s) skips remaining
-  SECONDARY cases so the primary result always gets flushed well inside any
-  reasonable driver timeout.
+Robustness (the round-2 artifact was an undiagnosable rc=1 with no output; the
+round-3 artifact died at boot after 2x240s because the axon relay was down for
+hours and the old retry logic gave up after one fresh-process attempt):
+- the process the driver invokes is a pure-Python ORCHESTRATOR that never touches
+  jax in-process (a hung backend claim blocks the thread in C++, uninterruptible),
+  so it stays signal-responsive for its entire life. It probes relay health with a
+  cheap subprocess (`python -c "import jax; jax.devices()"` under a 75s timeout)
+  and only spawns the real measurement child once a probe succeeds — then keeps
+  probing + retrying until OETPU_BENCH_TOTAL_BUDGET_S (default 2700s) is truly
+  exhausted, because observed outages last hours and any up-window inside the
+  budget should be caught;
+- the measurement child's stdout is piped: its JSON only reaches the driver when
+  it is the final answer (green, or the best partial at budget end), preserving
+  the ONE-JSON-line contract across arbitrarily many retries;
+- inside the child: per-stage progress lines on stderr with elapsed time; every
+  TPU stage runs under a watchdog deadline that flushes the partial JSON and
+  force-exits; each case retries once on jax UNAVAILABLE/INTERNAL errors;
+- SIGTERM/SIGINT at either level print the partial JSON before dying (the
+  orchestrator's partial includes the probe history — proof the loop ran), so an
+  external `timeout` still yields a diagnosable artifact;
+- a per-run wall-clock budget (OETPU_BENCH_BUDGET_S, default 540s) skips remaining
+  SECONDARY cases so the primary result always gets flushed.
 
-Known failure mode OUTSIDE this script's control: every Python interpreter in this
-image performs an axon TPU handshake at startup (`/root/.axon_site/sitecustomize.py`,
-before any bench.py line runs). When the relay is unhealthy that handshake hangs
-pre-main — the symptom is rc 124/143 with NO output at all, not even the boot line.
-That is an environment outage, not a repo defect; re-run when the relay recovers.
+When the relay is down pre-main the probe subprocess (not the orchestrator) eats
+the hang: the symptom in the artifact is `boot.probe_attempts` climbing with
+`last_probe_error: "probe timeout ..."` — an environment outage, not a repo defect.
 
 Measurement: K train steps are fused into one compiled program with lax.scan
 (`Trainer.jit_train_many`) over device-staged batches, so the number is device
@@ -40,7 +49,8 @@ drive TPUs (the axon tunnel adds ~40 ms per dispatch that would otherwise swamp
 the measurement; see PERF.md "Measurement hygiene").
 
 Env knobs: OETPU_BENCH_CASES=dim9[,dim64][,mesh1][,mesh1f][,pull] (default: all),
-OETPU_BENCH_BUDGET_S (default 540), OETPU_BENCH_SCAN_STEPS / _REPEATS (smoke runs).
+OETPU_BENCH_BUDGET_S (default 540), OETPU_BENCH_SCAN_STEPS / _REPEATS (smoke runs),
+OETPU_BENCH_TOTAL_BUDGET_S / _PROBE_TIMEOUT_S / _PROBE_INTERVAL_S (orchestrator).
 """
 
 import json
@@ -116,27 +126,10 @@ class Watchdog:
             with self._lock:
                 d = self._deadline
             if d is not None and time.time() > d:
+                # A hung backend claim sits in C++ and cannot be recovered
+                # in-process; flush the partial JSON and die. Retries are the
+                # orchestrator's job (see orchestrate()).
                 log(f"WATCHDOG: stage {_STAGE[0]!r} exceeded its deadline")
-                if (_STAGE[0] == "boot"
-                        and not os.environ.get("OETPU_BENCH_RETRIED")):
-                    # A hung backend claim sits in C++ and cannot be recovered
-                    # in-process; one fresh-process retry often succeeds on a
-                    # flaky relay. A CHILD process (not execve: de_thread would
-                    # block on the stuck thread) inherits stdout and owns the
-                    # ONE-JSON-line contract; this parent emits nothing on
-                    # success and falls through to the partial-result emit if
-                    # the retry cannot even be spawned.
-                    log("boot hang: spawning one fresh-process retry")
-                    sys.stderr.flush()
-                    try:
-                        import subprocess
-                        rc = subprocess.call(
-                            [sys.executable] + list(sys.argv),
-                            env=dict(os.environ, OETPU_BENCH_RETRIED="1"),
-                            timeout=1500)
-                        os._exit(rc)
-                    except Exception as e:  # noqa: BLE001 — emit still owed
-                        log(f"retry spawn failed ({e}); emitting partial")
                 ERRORS.setdefault(_STAGE[0].split(":")[0],
                                   f"watchdog timeout in {_STAGE[0]}")
                 rc = emit()
@@ -348,5 +341,180 @@ def main():
     return emit()
 
 
+TOTAL_BUDGET_S = float(os.environ.get("OETPU_BENCH_TOTAL_BUDGET_S", "2700"))
+PROBE_TIMEOUT_S = float(os.environ.get("OETPU_BENCH_PROBE_TIMEOUT_S", "75"))
+PROBE_INTERVAL_S = float(os.environ.get("OETPU_BENCH_PROBE_INTERVAL_S", "30"))
+
+
+def orchestrate():
+    """Relay-outage-proof driver loop (see module docstring). Pure Python — never
+    imports jax in-process, so it cannot hang in the C++ backend claim and always
+    answers signals. Loops probe -> measure-child until green or budget end."""
+    import subprocess
+
+    t0 = time.time()
+    probes = {"attempts": 0, "ok": 0, "last_error": None}
+    last_child = [None]  # best partial JSON from a red child attempt
+    phase = ["probe"]
+    live = [None]  # currently-running subprocess, killed on our own death
+
+    def remaining():
+        return TOTAL_BUDGET_S - (time.time() - t0)
+
+    def boot_info():
+        return {"probe_attempts": probes["attempts"], "probe_ok": probes["ok"],
+                "waited_s": round(time.time() - t0, 1),
+                "budget_s": TOTAL_BUDGET_S,
+                "last_probe_error": probes["last_error"]}
+
+    emitted = [False]
+
+    def emit_partial(reason, rc=1):
+        if emitted[0]:
+            return rc
+        emitted[0] = True
+        out = last_child[0] if last_child[0] else dict(RESULT)
+        out.setdefault("errors", {})["boot"] = reason
+        out.setdefault("stage", "boot")
+        out.setdefault("error", reason)
+        out["boot"] = boot_info()
+        print(json.dumps(out), flush=True)
+        return rc
+
+    def remember_child(child):
+        """Keep the most informative red-child JSON: one with measurement data
+        beats a boot-stage stub from a later attempt."""
+        prev = last_child[0]
+        if prev is None or len(child.get("extra") or {}) >= len(prev.get("extra")
+                                                                or {}):
+            last_child[0] = child
+
+    def on_sig(signum, frame):
+        log(f"orchestrator: signal {signum} during {phase[0]}")
+        proc = live[0]
+        if proc is not None:
+            if phase[0] == "measure":
+                # Give the child its own SIGTERM so it emits a partial with
+                # whatever cases already finished, and harvest it.
+                try:
+                    proc.terminate()
+                    out, _ = proc.communicate(timeout=15)
+                    for line in reversed((out or "").splitlines()):
+                        if line.strip().startswith("{"):
+                            remember_child(json.loads(line))
+                            break
+                except Exception:  # noqa: BLE001 — partial emit still owed
+                    pass
+            # An orphaned probe/child would keep contending the single-claimant
+            # relay slot after we die; take it with us.
+            try:
+                proc.kill()
+            except OSError:
+                pass
+        sys.stderr.flush()
+        os._exit(emit_partial(f"killed by signal {signum} during {phase[0]}"))
+
+    signal.signal(signal.SIGTERM, on_sig)
+    signal.signal(signal.SIGINT, on_sig)
+
+    def probe():
+        probes["attempts"] += 1
+        phase[0] = f"probe#{probes['attempts']}"
+        log(f"{phase[0]}: claiming backend in a throwaway subprocess "
+            f"(timeout {PROBE_TIMEOUT_S:.0f}s)")
+        p = subprocess.Popen(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        live[0] = p
+        try:
+            out, err = p.communicate(timeout=PROBE_TIMEOUT_S)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.communicate()
+            live[0] = None
+            probes["last_error"] = (f"probe timeout after {PROBE_TIMEOUT_S:.0f}s "
+                                    "(backend claim hang = relay down)")
+            log(f"{phase[0]}: {probes['last_error']}")
+            return False
+        live[0] = None
+        platform = (out or "").strip()
+        if p.returncode == 0 and platform:
+            if platform == "cpu" and not cpu_mode:
+                # Silent CPU fallback (axon backend failed to register): a
+                # "green" run here would publish CPU throughput against the
+                # TPU baseline. Treat as relay-down.
+                probes["last_error"] = "probe fell back to CPU (axon backend absent)"
+                log(f"{phase[0]}: {probes['last_error']}")
+                return False
+            probes["ok"] += 1
+            log(f"{phase[0]}: relay UP (platform={platform})")
+            return True
+        probes["last_error"] = ((err or "").strip()[-300:]
+                                or f"probe rc={p.returncode}")
+        log(f"{phase[0]}: probe failed: {probes['last_error']}")
+        return False
+
+    def run_child():
+        phase[0] = "measure"
+        deadline = max(90.0, remaining())
+        log(f"spawning measurement child (deadline {deadline:.0f}s)")
+        # OETPU_BENCH_RETRIED=1 disables the child's own fresh-process respawn:
+        # this loop owns retries now.
+        proc = subprocess.Popen(
+            [sys.executable] + list(sys.argv),
+            env=dict(os.environ, OETPU_BENCH_CHILD="1", OETPU_BENCH_RETRIED="1"),
+            stdout=subprocess.PIPE, text=True)
+        live[0] = proc
+        try:
+            out, _ = proc.communicate(timeout=deadline)
+        except subprocess.TimeoutExpired:
+            proc.terminate()  # child's SIGTERM handler emits its partial JSON
+            try:
+                out, _ = proc.communicate(timeout=20)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                out, _ = proc.communicate()
+        live[0] = None
+        for line in reversed((out or "").splitlines()):
+            if line.strip().startswith("{"):
+                try:
+                    return json.loads(line)
+                except ValueError:
+                    return {"value": None, "raw": line.strip()[:500]}
+        return None
+
+    # CPU smoke runs (CI, tests) have no relay to probe or wait for.
+    cpu_mode = "cpu" in (os.environ.get("JAX_PLATFORMS") or "").lower()
+    while True:
+        # A child spawned with < ~2.5 min left cannot finish even the primary
+        # case; stop here so total runtime stays near the budget instead of
+        # overshooting into an external SIGKILL (which would lose the JSON).
+        if remaining() <= max(PROBE_TIMEOUT_S + 90, 150):
+            return emit_partial(
+                f"budget exhausted: {probes['attempts']} probes "
+                f"({probes['ok']} ok) over {time.time() - t0:.0f}s, no green run")
+        if cpu_mode or probe():
+            child = run_child()
+            if child is not None:
+                if child.get("value") is not None:
+                    emitted[0] = True
+                    child.setdefault("extra", {})["boot"] = boot_info()
+                    print(json.dumps(child), flush=True)
+                    return 0
+                remember_child(child)
+                log(f"child red (stage={child.get('stage')}, "
+                    f"error={str(child.get('error'))[:120]}); "
+                    f"{remaining():.0f}s of budget left")
+            else:
+                log("child produced no JSON; retrying within budget")
+            if cpu_mode:  # no relay outage to wait out — a red run is a real bug
+                return emit_partial("cpu-mode child run red (not a relay issue)")
+        phase[0] = "sleep"
+        time.sleep(max(1.0, min(PROBE_INTERVAL_S, remaining())))
+
+
 if __name__ == "__main__":
-    sys.exit(main())
+    if os.environ.get("OETPU_BENCH_CHILD"):
+        sys.exit(main())
+    sys.exit(orchestrate())
