@@ -129,6 +129,78 @@ def bucket_by_owner(ids: jax.Array, valid: jax.Array, num_shards: int,
     return BucketResult(bucket_ids, bucket_valid, owner_out, slot_out, overflow)
 
 
+def unique_and_route(ids: jax.Array, valid: jax.Array, num_shards: int,
+                     capacity: int) -> tuple:
+    """Fused dedup + owner routing: ONE multi-key sort where
+    `unique_with_counts` + `bucket_by_owner` pay two argsorts plus a
+    searchsorted (the S-invariant protocol compute the mesh1 bench surfaces —
+    the reference does this client-side work on CPU off the device critical
+    path, `EmbeddingPullOperator.cpp:60-112`; on TPU it rides the step).
+
+    Sorting by (owner, id, iota) yields uniques in OWNER-MAJOR id order, so a
+    unique's bucket slot is just its unique-rank minus its owner group's
+    start — no second sort, no searchsorted. Returns (UniqueResult,
+    BucketResult) with the same field contracts (only the order of
+    `unique_ids` differs: owner-major instead of plain id-sorted; all
+    consumers are order-agnostic — `inverse`, `counts`, `seg` stay mutually
+    consistent).
+
+    `valid` masks per-INPUT-id (invalid ids sort into a trailing pseudo-owner
+    `num_shards` and never reach a bucket). `owner = id % num_shards` exactly
+    like the split implementation."""
+    n = ids.shape[0]
+    S = num_shards
+    iota = jnp.arange(n, dtype=jnp.int32)
+    if ids.ndim == 2:  # split-pair layout
+        from .id64 import pair_mod
+        owner_in = jnp.where(valid, pair_mod(ids, S).astype(jnp.int32), S)
+        so, s_hi, s_lo, order = jax.lax.sort(
+            (owner_in, ids[:, 0], ids[:, 1], iota), num_keys=3)
+        sorted_ids = jnp.stack([s_hi, s_lo], axis=-1)
+        id_change = (s_hi[1:] != s_hi[:-1]) | (s_lo[1:] != s_lo[:-1])
+    else:
+        owner_in = jnp.where(valid, (ids % S).astype(jnp.int32), S)
+        so, sorted_ids, order = jax.lax.sort((owner_in, ids, iota), num_keys=2)
+        id_change = sorted_ids[1:] != sorted_ids[:-1]
+    is_new = jnp.concatenate(
+        [jnp.ones((1,), bool), (so[1:] != so[:-1]) | id_change])
+    seg = (jnp.cumsum(is_new) - 1).astype(jnp.int32)
+    num_unique = seg[-1] + 1
+    unique_ids = jnp.zeros(sorted_ids.shape, ids.dtype).at[seg].set(
+        sorted_ids, mode="drop", indices_are_sorted=True)
+    counts = jax.ops.segment_sum(jnp.ones((n,), jnp.int32), seg, num_segments=n,
+                                 indices_are_sorted=True)
+    inverse = jnp.zeros((n,), jnp.int32).at[order].set(seg)
+    uniq = UniqueResult(unique_ids, inverse, counts.astype(jnp.int32),
+                        num_unique.astype(jnp.int32), order.astype(jnp.int32),
+                        seg)
+
+    # owner per UNIQUE slot: scatter the sorted owners through seg (padding
+    # slots >= num_unique keep the invalid pseudo-owner S)
+    u_owner = jnp.full((n,), S, jnp.int32).at[seg].set(
+        so, mode="drop", indices_are_sorted=True)
+    # bucket slot = unique rank within the owner group (seg is owner-major)
+    per_owner = jax.ops.segment_sum(is_new.astype(jnp.int32), so,
+                                    num_segments=S + 1)
+    start = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32),
+         jnp.cumsum(per_owner)[:-1].astype(jnp.int32)])
+    slot_u = jnp.where(u_owner < S,
+                       iota - start[jnp.clip(u_owner, 0, S - 1)], capacity)
+    in_cap = (u_owner < S) & (slot_u < capacity)
+    overflow = jnp.sum((u_owner < S) & (slot_u >= capacity)).astype(jnp.int32)
+    flat_pos = jnp.where(in_cap, u_owner * capacity + slot_u, S * capacity)
+    lanes = ids.shape[1:]
+    bucket_ids = jnp.zeros((S * capacity,) + lanes, ids.dtype).at[flat_pos].set(
+        unique_ids, mode="drop").reshape((S, capacity) + lanes)
+    bucket_valid = jnp.zeros((S * capacity,), bool).at[flat_pos].set(
+        True, mode="drop").reshape(S, capacity)
+    slot_out = jnp.where(in_cap, slot_u, capacity)
+    buckets = BucketResult(bucket_ids, bucket_valid, u_owner, slot_out,
+                           overflow)
+    return uniq, buckets
+
+
 def unbucket(bucket_rows: jax.Array, owner: jax.Array, slot: jax.Array) -> jax.Array:
     """Inverse of bucket_by_owner for per-id payloads: read back each input element's
     row from its (owner, slot) position. bucket_rows: (num_shards, capacity, ...)."""

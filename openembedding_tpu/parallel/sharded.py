@@ -50,8 +50,8 @@ import jax
 import jax.numpy as jnp
 
 from ..embedding import EmbeddingSpec, EmbeddingTableState
-from ..ops.dedup import BucketResult, UniqueResult, bucket_by_owner, unbucket, \
-    unique_with_counts
+from ..ops.dedup import (BucketResult, UniqueResult, bucket_by_owner,
+                         unbucket, unique_and_route, unique_with_counts)
 from ..ops.sparse import lookup_rows, sparse_apply_dense_table
 from .mesh import DATA_AXIS
 
@@ -92,6 +92,20 @@ def _is_pair_batch(spec: EmbeddingSpec, ids: jax.Array) -> bool:
     return spec.use_hash_table and is_pair(ids)
 
 
+def adapt_batch_ids(spec: EmbeddingSpec, state: EmbeddingTableState,
+                    ids: jax.Array) -> jax.Array:
+    """Route ids in the TABLE's key layout. Under x64-off every hash table keys
+    in the split-pair layout (`tables/hash_table.fresh_keys`), so a single-lane
+    int batch must widen BEFORE dedup/routing or the server-side probe indexes
+    pair keys with flat ids (the single-device paths adapt inside
+    `hash_lookup*`; the sharded protocol adapts here, at its entry, so plan
+    and probe agree — `adapt_ids` is shape-agnostic, the batch dims ride)."""
+    if not spec.use_hash_table or state.keys is None:
+        return ids
+    from ..tables.hash_table import adapt_ids
+    return adapt_ids(state.keys, ids)
+
+
 def flatten_ids(spec: EmbeddingSpec, ids: jax.Array) -> jax.Array:
     """(... [, 2]) -> (n [, 2]): one row per id POSITION whatever the lane
     count (split-pair ids keep their trailing lane dim)."""
@@ -109,14 +123,30 @@ def _out_shape(spec: EmbeddingSpec, ids: jax.Array):
 
 def make_plan(spec: EmbeddingSpec, ids: jax.Array, *, axis: str = DATA_AXIS,
               capacity_factor: float = 0.0) -> ExchangePlan:
-    """Dedup local ids, bucket by owner, exchange the id buckets (one all_to_all)."""
+    """Dedup local ids, bucket by owner, exchange the id buckets (one all_to_all).
+
+    Dedup and routing come out of ONE fused sort (`ops/dedup.unique_and_route`).
+    `S == 1` is specialized at trace time: every id is local, so the bucket
+    scatter and the id all_to_all vanish — the plan serves the unique ids
+    directly (the protocol's compute overhead at S=1 is the floor every
+    multi-chip projection sits on; see PERF.md mesh1)."""
     S = jax.lax.axis_size(axis)
     flat = flatten_ids(spec, ids)
     n = flat.shape[0]
-    uniq = unique_with_counts(flat)
-    valid = (uniq.counts > 0) & _id_valid(spec, uniq.unique_ids)
+    if S == 1:
+        uniq = unique_with_counts(flat)
+        valid = (uniq.counts > 0) & _id_valid(spec, uniq.unique_ids)
+        recv_ids = uniq.unique_ids[None]
+        recv_valid = valid[None]
+        buckets = BucketResult(
+            bucket_ids=recv_ids, bucket_valid=recv_valid,
+            owner=jnp.zeros((n,), jnp.int32),
+            slot=jnp.arange(n, dtype=jnp.int32),
+            overflow=jnp.zeros((), jnp.int32))
+        return ExchangePlan(uniq, buckets, recv_ids, recv_valid, n)
+    valid = _id_valid(spec, flat)
     cap = _bucket_capacity(n, S, capacity_factor)
-    buckets = bucket_by_owner(uniq.unique_ids, valid, S, cap)
+    uniq, buckets = unique_and_route(flat, valid, S, cap)
     # [BOUNDARY: was one RPC per owning server; now one ICI all_to_all]
     recv_ids = jax.lax.all_to_all(buckets.bucket_ids, axis, 0, 0)
     recv_valid = jax.lax.all_to_all(buckets.bucket_valid, axis, 0, 0)
@@ -163,9 +193,14 @@ def _serve_rows(spec: EmbeddingSpec, state: EmbeddingTableState,
 
 def _reassemble(plan: ExchangePlan, rows: jax.Array, out_shape,
                 dim: int, axis: str) -> jax.Array:
-    """Client side: rows back over the a2a, un-bucket, expand duplicates."""
-    back = jax.lax.all_to_all(rows, axis, 0, 0)
-    uniq_rows = unbucket(back, plan.buckets.owner, plan.buckets.slot)
+    """Client side: rows back over the a2a, un-bucket, expand duplicates.
+    At S=1 the served rows ARE the unique rows (make_plan's identity plan) —
+    no a2a, no unbucket gather."""
+    if jax.lax.axis_size(axis) == 1:
+        uniq_rows = rows[0]
+    else:
+        back = jax.lax.all_to_all(rows, axis, 0, 0)
+        uniq_rows = unbucket(back, plan.buckets.owner, plan.buckets.slot)
     out = jnp.take(uniq_rows, plan.uniq.inverse, axis=0)
     return out.reshape(out_shape + (dim,))
 
@@ -180,6 +215,7 @@ def sharded_lookup_train(
 ) -> Tuple[EmbeddingTableState, jax.Array, Dict[str, jax.Array], ExchangePlan]:
     """Training pull inside shard_map. Returns (new_shard_state, rows, stats, plan);
     feed the plan to `sharded_apply_gradients` for the same batch."""
+    ids = adapt_batch_ids(spec, state, ids)
     plan = make_plan(spec, ids, axis=axis, capacity_factor=capacity_factor)
     state, rows = _serve_rows(spec, state, plan, train=True, axis=axis)
     out = _reassemble(plan, rows, _out_shape(spec, ids), spec.output_dim, axis)
@@ -202,6 +238,7 @@ def sharded_lookup(
 ) -> jax.Array:
     """Read-only pull (serving/eval; reference `read_only_pull` handler — never
     inserts, absent hash ids return zeros)."""
+    ids = adapt_batch_ids(spec, state, ids)
     plan = make_plan(spec, ids, axis=axis, capacity_factor=capacity_factor)
     _, rows = _serve_rows(spec, state, plan, train=False, axis=axis)
     return _reassemble(plan, rows, _out_shape(spec, ids), spec.output_dim, axis)
@@ -228,6 +265,7 @@ def sharded_apply_gradients(
     pair per shard instead of one per array."""
     S = jax.lax.axis_size(axis)
     if plan is None:
+        ids = adapt_batch_ids(spec, state, ids)
         plan = make_plan(spec, ids, axis=axis, capacity_factor=capacity_factor)
     gflat = grads.reshape(-1, spec.output_dim)
     n = gflat.shape[0]
@@ -236,23 +274,33 @@ def sharded_apply_gradients(
     # sorted-segment path (see UniqueResult.segment_reduce)
     g = uniq.segment_reduce(gflat)
     valid = (uniq.counts > 0) & _id_valid(spec, uniq.unique_ids)
-    # scatter grads/counts into the plan's bucket positions (payload follows its id)
-    flat_pos = jnp.where((buckets.owner < S) & (buckets.slot < cap),
-                         buckets.owner * cap + buckets.slot, S * cap)
-    g_buckets = jnp.zeros((S * cap, spec.output_dim), g.dtype).at[flat_pos].set(
-        g, mode="drop").reshape(S, cap, spec.output_dim)
-    c_buckets = jnp.zeros((S * cap,), jnp.int32).at[flat_pos].set(
-        jnp.where(valid, uniq.counts, 0), mode="drop").reshape(S, cap)
-
-    recv_g = jax.lax.all_to_all(g_buckets, axis, 0, 0)
-    recv_c = jax.lax.all_to_all(c_buckets, axis, 0, 0)
-
-    # server side: cross-source re-dedup + fused optimizer (MPSC reduce + update)
     pair = plan.recv_ids.ndim == 3
-    rids = (plan.recv_ids.reshape(-1, 2) if pair
-            else plan.recv_ids.reshape(-1))
-    rg = recv_g.reshape(-1, spec.output_dim)
-    rc = recv_c.reshape(-1)
+    if S == 1:
+        # identity routing (see make_plan): the local unique slots ARE the
+        # server's receive buffer — no bucket scatter, no grad/count a2a
+        rids = uniq.unique_ids
+        rg = g
+        rc = jnp.where(valid, uniq.counts, 0)
+    else:
+        # scatter grads/counts into the plan's bucket positions (payload
+        # follows its id)
+        flat_pos = jnp.where((buckets.owner < S) & (buckets.slot < cap),
+                             buckets.owner * cap + buckets.slot, S * cap)
+        g_buckets = jnp.zeros((S * cap, spec.output_dim),
+                              g.dtype).at[flat_pos].set(
+            g, mode="drop").reshape(S, cap, spec.output_dim)
+        c_buckets = jnp.zeros((S * cap,), jnp.int32).at[flat_pos].set(
+            jnp.where(valid, uniq.counts, 0), mode="drop").reshape(S, cap)
+
+        recv_g = jax.lax.all_to_all(g_buckets, axis, 0, 0)
+        recv_c = jax.lax.all_to_all(c_buckets, axis, 0, 0)
+
+        # server side: cross-source re-dedup + fused optimizer (MPSC reduce
+        # + update)
+        rids = (plan.recv_ids.reshape(-1, 2) if pair
+                else plan.recv_ids.reshape(-1))
+        rg = recv_g.reshape(-1, spec.output_dim)
+        rc = recv_c.reshape(-1)
     if spec.use_hash_table:
         from ..tables.hash_table import hash_find
         if pair:

@@ -64,3 +64,82 @@ def test_bucket_overflow_counted():
     res = bucket_by_owner(ids, valid, num_shards=4, capacity=4)
     assert int(res.overflow) == 12
     assert int(res.bucket_valid.sum()) == 4
+
+
+@pytest.mark.parametrize("pair", [False, True])
+@pytest.mark.parametrize("cap_frac", [1.0, 0.3])
+def test_unique_and_route_matches_split_pipeline(pair, cap_frac):
+    """The fused single-sort plan must agree with unique_with_counts +
+    bucket_by_owner on everything order-independent: the unique id SET, the
+    inverse mapping contract (unique_ids[inverse[i]] == ids[i]), counts per
+    id, per-owner bucket CONTENT, and the overflow count."""
+    from openembedding_tpu.ops.dedup import unique_and_route
+
+    rng = np.random.default_rng(0)
+    n, S = 257, 4
+    raw = rng.integers(0, 64, size=n)
+    # validity is a function of the id VALUE (negative = padding), exactly
+    # like `_id_valid` in the protocol — never a per-occurrence coin flip
+    invalid_values = {3, 17, 42}
+    raw = np.where(np.isin(raw, list(invalid_values)), -1, raw)
+    mask = raw < 0
+    if pair:
+        from openembedding_tpu.ops.id64 import np_split_ids
+        ids64 = np.where(raw < 0, -1, raw.astype(np.int64) + (1 << 40))
+        ids = jnp.asarray(np_split_ids(ids64))
+    else:
+        ids = jnp.asarray(raw.astype(np.int32))
+        ids64 = raw.astype(np.int64)
+    valid = jnp.asarray(~mask)
+    cap = max(1, int(cap_frac * n / S))
+
+    uniq, buckets = jax.jit(
+        lambda i, v: unique_and_route(i, v, S, cap))(ids, valid)
+
+    # oracle: the split pipeline (validity recomputed on the unique ids, the
+    # way make_plan's old path did)
+    o_uniq = unique_with_counts(ids)
+    if pair:
+        from openembedding_tpu.ops.id64 import pair_valid
+        o_valid_u = (o_uniq.counts > 0) & pair_valid(o_uniq.unique_ids)
+    else:
+        o_valid_u = (o_uniq.counts > 0) & (o_uniq.unique_ids >= 0)
+    o_buckets = bucket_by_owner(o_uniq.unique_ids, o_valid_u, S, cap)
+
+    # inverse contract on the fused result
+    u = np.asarray(uniq.unique_ids)
+    inv = np.asarray(uniq.inverse)
+    got_back = u[inv]
+    np.testing.assert_array_equal(got_back, np.asarray(ids))
+
+    # counts per id agree (compare as {id: count} dicts over valid slots)
+    def count_map(uq, cnts):
+        uq, cnts = np.asarray(uq), np.asarray(cnts)
+        out = {}
+        for i in range(len(cnts)):
+            if cnts[i] > 0:
+                key = tuple(uq[i]) if uq.ndim == 2 else int(uq[i])
+                out[key] = int(cnts[i])
+        return out
+
+    assert count_map(uniq.unique_ids, uniq.counts) == \
+        count_map(o_uniq.unique_ids, o_uniq.counts)
+
+    # bucket content per owner agrees as SETS (order within a bucket differs)
+    def bucket_sets(b):
+        ids_np, valid_np = np.asarray(b.bucket_ids), np.asarray(b.bucket_valid)
+        out = []
+        for s in range(S):
+            rows = ids_np[s][valid_np[s]]
+            out.append({tuple(r) if rows.ndim == 2 else int(r) for r in rows})
+        return out
+
+    g, o = bucket_sets(buckets), bucket_sets(o_buckets)
+    if cap_frac >= 1.0:
+        assert g == o
+        assert int(buckets.overflow) == int(o_buckets.overflow) == 0
+    else:
+        # under capacity pressure both drop the same NUMBER of ids per owner
+        # (which ids differ by intra-bucket order)
+        assert [len(x) for x in g] == [len(x) for x in o]
+        assert int(buckets.overflow) == int(o_buckets.overflow)

@@ -230,3 +230,51 @@ def test_pair_checkpoint_roundtrip_and_cross_config(tmp_path):
     got = np.asarray(lookup(t3.model.specs["categorical"],
                             s3.tables["categorical"], jnp.asarray(ids64)))
     np.testing.assert_allclose(got, want, rtol=0, atol=0)
+
+
+def test_mesh_single_lane_ids_on_pair_table_x64_off():
+    """REGRESSION: under x64-off every hash table keys in the pair layout, but
+    a user feeding plain int32 ids (id space < 2^31) went through the sharded
+    protocol with single-lane routing and crashed in the server-side pair
+    probe. `parallel/sharded.adapt_batch_ids` now widens at the protocol
+    entry; training must match the same stream fed as explicit pairs."""
+    from openembedding_tpu.data import synthetic_criteo
+    from openembedding_tpu.models import make_deepfm
+    from openembedding_tpu.parallel import MeshTrainer, make_mesh
+
+    def build():
+        import dataclasses
+        m = make_deepfm(vocabulary=-1, dim=DIM, hidden=(16,), hashed=True,
+                        capacity=4096)
+        m.specs["categorical"] = dataclasses.replace(
+            m.specs["categorical"], initializer=Constant(0.0))
+        return MeshTrainer(m, embed.Adagrad(learning_rate=0.1),
+                           mesh=make_mesh())
+
+    with jax.enable_x64(False):
+        i32 = list(synthetic_criteo(16, id_space=1 << 20, steps=3, seed=6,
+                                    ids_dtype=np.int32))
+        pair = [dict(b, sparse={"categorical": np_split_ids(
+            b["sparse"]["categorical"].astype(np.int64))}) for b in i32]
+
+        ta = build()
+        sa = ta.init(i32[0])
+        assert sa.tables["categorical"].keys.ndim == 2  # pair-keyed cache
+        step_a = ta.jit_train_step(i32[0], sa)
+        la = []
+        for b in i32:
+            sa, m = step_a(sa, b)
+            la.append(float(m["loss"]))
+
+        tb = build()
+        sb = tb.init(pair[0])
+        step_b = tb.jit_train_step(pair[0], sb)
+        lb = []
+        for b in pair:
+            sb, m = step_b(sb, b)
+            lb.append(float(m["loss"]))
+
+        np.testing.assert_allclose(la, lb, rtol=1e-6, atol=1e-7)
+        np.testing.assert_array_equal(
+            np.asarray(sa.tables["categorical"].weights),
+            np.asarray(sb.tables["categorical"].weights))
