@@ -67,6 +67,11 @@ def main():
                          "(storage='host_cached', tables/host_offload.py)")
     ap.add_argument("--cache", type=int, default=0,
                     help="sparse_as_dense for vocab <= N (reference --cache)")
+    ap.add_argument("--scan", type=int, default=0, metavar="K",
+                    help="fuse K steps per dispatch (jit_train_many / "
+                         "offload_train_many): one union admission per window "
+                         "for --offload tables; per-step logits (and so the "
+                         "train AUC) are not collected in this mode")
     ap.add_argument("--prefetch", action="store_true")
     ap.add_argument("--persist", default="", help="async persist root dir")
     ap.add_argument("--persist-steps", type=int, default=50)
@@ -140,39 +145,69 @@ def main():
 
     reporter = M.PeriodicReporter(args.report_interval).start()
     all_labels, all_scores = [], []
+
+    def report_overflow():
+        # the static-capacity divergence must be *managed*, not just
+        # counted: surface dropped ids as they happen (see also the
+        # pull/push_overflow step stats on the mesh path).
+        # table_overflow includes counts banked across offload flushes.
+        for name in state.tables:
+            ov = trainer.table_overflow(state, name)
+            if ov > 0:
+                print(f"  WARNING: {name}: {ov} ids have overflowed the "
+                      "hash capacity (rows dropped) — raise capacity or "
+                      "capacity_factor")
+
     t0 = time.perf_counter()
-    state = trainer.offload_prepare(state, first)
-    state, m = step(state, first)
-    for i in range(1, args.steps):
-        batch = next(batches)
-        with M.vtimer("train", "step"):
-            state = trainer.offload_prepare(state, batch)
-            state, m = step(state, batch)
-        all_labels.append(np.asarray(batch["label"]))
-        all_scores.append(np.asarray(m["logits"]).reshape(-1))
-        M.record_step_stats({k: v for k, v in m.get("stats", {}).items()})
-        if persister is not None:
-            persister.maybe_persist(state)
-        if i % 20 == 0:
-            print(f"step {i}: loss {float(m['loss']):.4f}")
-            # the static-capacity divergence must be *managed*, not just
-            # counted: surface dropped ids as they happen (see also the
-            # pull/push_overflow step stats on the mesh path).
-            # table_overflow includes counts banked across offload flushes.
-            for name in state.tables:
-                ov = trainer.table_overflow(state, name)
-                if ov > 0:
-                    print(f"  WARNING: {name}: {ov} ids have overflowed the "
-                          "hash capacity (rows dropped) — raise capacity or "
-                          "capacity_factor")
+    if args.scan > 1:
+        # scan-fused windows: K steps per device dispatch; host_cached tables
+        # get one union-of-K admission per window (model.offload_train_many).
+        # Per-step logits are not collected in this mode (no train AUC).
+        import jax as _jax
+        done = 0
+        window = [first]
+        while done < args.steps:
+            while len(window) < min(args.scan, args.steps - done):
+                window.append(next(batches))
+            stacked = _jax.tree_util.tree_map(
+                lambda *xs: np.stack(xs), *window)
+            with M.vtimer("train", "window"):
+                state, m = trainer.offload_train_many(state, stacked)
+            done += len(window)
+            window = []
+            m = dict(m, loss=np.asarray(m["loss"])[-1])
+            if persister is not None:
+                persister.maybe_persist(state)
+            print(f"step {done}: loss {float(m['loss']):.4f}")
+            report_overflow()
+        trained = done
+        mode = f" (scan K={args.scan})"
+    else:
+        state = trainer.offload_prepare(state, first)
+        state, m = step(state, first)
+        for i in range(1, args.steps):
+            batch = next(batches)
+            with M.vtimer("train", "step"):
+                state = trainer.offload_prepare(state, batch)
+                state, m = step(state, batch)
+            all_labels.append(np.asarray(batch["label"]))
+            all_scores.append(np.asarray(m["logits"]).reshape(-1))
+            M.record_step_stats({k: v for k, v in m.get("stats", {}).items()})
+            if persister is not None:
+                persister.maybe_persist(state)
+            if i % 20 == 0:
+                print(f"step {i}: loss {float(m['loss']):.4f}")
+                report_overflow()
+        trained = args.steps
+        mode = ""
     loss = float(m["loss"])  # fences the device work
     dt = time.perf_counter() - t0
     reporter.stop()
     if persister is not None:
         persister.close()
 
-    examples = args.steps * args.batch_size
-    print(f"trained {args.steps} steps, loss {loss:.4f}, "
+    examples = trained * args.batch_size
+    print(f"trained {trained} steps{mode}, loss {loss:.4f}, "
           f"{examples / dt:,.0f} examples/s "
           f"({examples / dt / max(1, getattr(trainer, 'num_shards', 1)):,.0f}"
           f"/chip)")

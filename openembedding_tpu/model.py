@@ -223,6 +223,7 @@ class Trainer:
             ot.adopt(state.tables[name])
             ot.prepare(batch["sparse"][self.model.specs[name].feature_name])
             new_tables[name] = ot.state
+        self._offload_prepared = True  # train_many's trace-time guard
         return state.replace(tables=new_tables)
 
     def offload_flush(self, state: "TrainState") -> "TrainState":
@@ -495,8 +496,6 @@ class Trainer:
         from .ops.sparse import packed_layout
         out = {}
         for name, spec in self.model.ps_specs().items():
-            if spec.storage == "host_cached":
-                continue  # offload drives prepare/flush around single steps
             ts = state.tables[name]
             lay = packed_layout(spec.output_dim, ts.slots, ts.weights.dtype)
             if lay is not None:
@@ -546,14 +545,24 @@ class Trainer:
         (one latency-bound gather/scatter pair per step instead of one per
         array — 1.44x on the fused apply, PERF.md): pack once at entry, unpack
         once at exit, amortized over K steps. State layout outside this
-        function is unchanged."""
-        if getattr(self, "offload", None):
+        function is unchanged.
+
+        storage="host_cached" tables work too, but the caller MUST admit the
+        union of the K batches' ids first — `offload_prepare(state, batches)`
+        does it in one jitted admission (a scan cannot interleave host-side
+        admission, so an unprepared cache would silently train initializer
+        rows where the host store holds trained ones). Use
+        `offload_train_many`, which drives prepare -> scan -> adopt."""
+        if self.offload and not getattr(self, "_offload_prepared", False):
+            # trace-time fail-fast for the old misuse (an unprepared cache
+            # trains initializer rows over the store's trained ones); repeat
+            # calls bypass Python, so the per-window prepare contract itself
+            # is enforced by convention — offload_train_many does it right
             raise ValueError(
-                "train_many cannot drive storage='host_cached' tables: the "
-                "host-side offload_prepare/flush must run between steps, and "
-                "a scan fuses the steps into one device program. Drive "
-                "host-cached models with jit_train_step + offload_prepare "
-                "(examples/criteo_deepctr.py --offload shows the loop).")
+                "train_many on storage='host_cached' tables needs the union "
+                "of the K batches' ids admitted first: use "
+                "trainer.offload_train_many(state, batches) (or call "
+                "offload_prepare(state, batches) before every window).")
         from .ops.sparse import pack_table, unpack_table
         layouts = self._packed_layouts(state)
         if layouts:
@@ -584,6 +593,34 @@ class Trainer:
     def jit_train_many(self):
         """Scan-fused multi-step driver (state DONATED, like jit_train_step)."""
         return jax.jit(self.train_many, donate_argnums=(0,))
+
+    def _many_fn(self, batches, state):
+        """Cached jitted train_many (MeshTrainer overrides: its jit_train_many
+        needs the samples to derive partition specs and caches internally)."""
+        if getattr(self, "_cached_many_fn", None) is None:
+            self._cached_many_fn = self.jit_train_many()
+        return self._cached_many_fn
+
+    def offload_train_many(self, state: TrainState, batches
+                           ) -> Tuple[TrainState, Dict]:
+        """Scan-fused driving of host-cached models: ONE jitted admission of the
+        union of the K batches' ids (flushing first if over high-water), then
+        the fused K-step scan — the 2x scan-fusion lever and the >HBM capacity
+        story compose instead of excluding each other. The reference serves any
+        table through the same hot path regardless of backing store
+        (`PmemEmbeddingOptimizerVariable.h:88-198` folds its DRAM cache into
+        pull/update); this is the scan-era equivalent.
+
+        The cache must be able to hold the K-batch union: size `capacity` (and
+        pick K) so `union_unique_ids <= high_water * capacity`, or admission
+        warns and overflowed rows fall back to insert-on-pull semantics.
+        Works (as a plain fused scan) for models with no offloaded tables."""
+        state = self.offload_prepare(state, batches)
+        many = self._many_fn(batches, state)
+        state, m = many(state, batches)
+        for name, ot in self.offload.items():
+            ot.adopt(state.tables[name])
+        return state, m
 
     def jit_eval_step(self):
         return jax.jit(self.eval_step)

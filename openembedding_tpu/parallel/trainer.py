@@ -226,6 +226,9 @@ class MeshTrainer(Trainer):
         self._train_many_fn = jax.jit(many, donate_argnums=(0,))
         return self._train_many_fn
 
+    def _many_fn(self, batches, state):
+        return self.jit_train_many(batches, state)
+
     def jit_eval_step(self, sample_batch=None, sample_state=None):
         if self._eval_step_fn is not None:
             return self._eval_step_fn
