@@ -14,13 +14,19 @@ input pipeline, like the reference's client-side request assembly):
    device cache (one jitted scatter: rows + optimizer slots restored exactly);
    brand-new ids are left to the device table's insert-on-pull (their slots carry
    initializer values). If admission would push occupancy over the high-water
-   mark, the cache is FLUSHED first.
+   mark, COLD residents are evicted first (see 3).
 2. the train step runs entirely on device against the cache (normal hash path).
-3. `flush()`: every resident (id, row, slots) is pulled host-side, merged into
-   the host store (id-sorted arrays + searchsorted, same layout as checkpoint and
-   standalone export), and the cache resets. Coarse whole-cache eviction — the
-   reference evicts per-item LRU; a slot-granular policy is a later refinement
-   (PERF.md lists it).
+3. eviction under pressure is clock/second-chance (`eviction="clock"`,
+   default, the TPU equivalent of the reference's per-item LRU,
+   `PmemEmbeddingTable.h:143-163`): every resident id carries a referenced
+   bit, set when a prepare() touches it; `evict_cold()` moves only the
+   UNreferenced rows to the host store and rebuilds the cache keeping hot
+   rows on device (host<->device traffic O(cold), a stable hot set stops
+   round-tripping). The whole-cache `flush()` remains as the fallback when
+   the hot set leaves no room (and as `eviction="flush"`, the coarse policy):
+   every resident (id, row, slots) pulled host-side, merged into the host
+   store (id-sorted arrays + searchsorted, same layout as checkpoint and
+   standalone export), cache reset.
 
 Exactness: a row's weights AND optimizer state round-trip bit-identically through
 evict/admit, so training with a small cache equals training with an infinite table
@@ -152,6 +158,118 @@ def _admit_fn(state: EmbeddingTableState, ids, w_rows, s_rows, known):
     return new_state, admitted
 
 
+def _evict_fn(state, cold_ids, hot_ids, fresh):
+    """Jitted clock eviction (single device): gather the COLD rows out for the
+    host store, then rebuild the cache from a fresh template keeping the HOT
+    rows entirely on device — the host<->device traffic is O(cold), not
+    O(cache) (the whole-cache flush's cost). The reference's per-item LRU
+    achieves the same end inside its DRAM cache (`PmemEmbeddingTable.h:143-163`).
+
+    Open-addressed probe chains cannot delete in place (a vacated slot would
+    terminate later probes early), hence the rebuild: fresh keys, hot ids
+    re-inserted, their rows copied old-slot -> new-slot on device."""
+    from .hash_table import hash_find, hash_find_or_insert
+
+    cap = state.keys.shape[0]
+    cslot = hash_find(state.keys, cold_ids)
+    cfound = cslot < cap
+    cidx = jnp.clip(cslot, 0, cap - 1)
+    cold_w = jnp.take(state.weights, cidx, axis=0)
+    cold_s = {k: jnp.take(v, cidx, axis=0) for k, v in state.slots.items()}
+
+    hslot = hash_find(state.keys, hot_ids)
+    hfound = hslot < cap
+    hidx = jnp.clip(hslot, 0, cap - 1)
+    hot_w = jnp.take(state.weights, hidx, axis=0)
+    hot_s = {k: jnp.take(v, hidx, axis=0) for k, v in state.slots.items()}
+
+    keys, slot, overflow = hash_find_or_insert(fresh.keys, hot_ids)
+    ok = hfound & (slot < cap)
+    target = jnp.where(ok, slot, cap)
+    weights = fresh.weights.at[target].set(hot_w, mode="drop")
+    slots = {k: fresh.slots[k].at[target].set(hot_s[k], mode="drop")
+             for k in fresh.slots}
+    # a hot row whose re-insert overflowed the probe chain (rare) must reach
+    # the store, not vanish: hand its data back with the lost mask
+    lost = hfound & (slot >= cap)
+    lost_w = jnp.where(lost[:, None], hot_w, 0.0)
+    lost_s = {k: jnp.where(lost[:, None], v, 0.0) for k, v in hot_s.items()}
+    new_state = state.replace(keys=keys, weights=weights, slots=slots,
+                              overflow=state.overflow + overflow)
+    return new_state, cfound, cold_w, cold_s, ok, lost, lost_w, lost_s
+
+
+def _make_mesh_evict(mesh, axis, state_pspec, slot_names):
+    """shard_map'd clock eviction for the row-sharded cache: each shard serves
+    its own cold rows and rebuilds its local key range with its local hot
+    ids (same ownership rule as `_make_mesh_admit`)."""
+    from jax.sharding import PartitionSpec as P
+    from .hash_table import hash_find, hash_find_or_insert
+
+    def evict(state, cold_ids, hot_ids, fresh):
+        from ..ops.id64 import PAIR_EMPTY, is_pair, pair_mod, pair_valid
+        S = jax.lax.axis_size(axis)
+        idx = jax.lax.axis_index(axis)
+        keys = state.keys
+        cap = keys.shape[0]
+
+        def probe_of(ids):
+            if is_pair(ids):
+                mine = pair_valid(ids) & (pair_mod(ids, S).astype(jnp.int32)
+                                          == idx)
+                return mine, jnp.where(mine[:, None], ids, PAIR_EMPTY)
+            mine = (ids >= 0) & ((ids % S).astype(jnp.int32) == idx)
+            return mine, jnp.where(mine, ids, -1).astype(keys.dtype)
+
+        cmine, cprobe = probe_of(cold_ids)
+        cslot = hash_find(keys, cprobe)
+        cfound_l = cmine & (cslot < cap)
+        cidx = jnp.clip(cslot, 0, cap - 1)
+        cold_w = jnp.where(cfound_l[:, None],
+                           jnp.take(state.weights, cidx, axis=0), 0.0)
+        cold_s = {k: jnp.where(cfound_l[:, None],
+                               jnp.take(v, cidx, axis=0), 0.0)
+                  for k, v in state.slots.items()}
+
+        hmine, hprobe = probe_of(hot_ids)
+        hslot = hash_find(keys, hprobe)
+        hfound_l = hmine & (hslot < cap)
+        hidx = jnp.clip(hslot, 0, cap - 1)
+        hot_w = jnp.take(state.weights, hidx, axis=0)
+        hot_s = {k: jnp.take(v, hidx, axis=0) for k, v in state.slots.items()}
+
+        new_keys, slot, oflow = hash_find_or_insert(fresh.keys, hprobe)
+        ok = hfound_l & (slot < cap)
+        target = jnp.where(ok, slot, cap)
+        weights = fresh.weights.at[target].set(hot_w, mode="drop")
+        slots = {k: fresh.slots[k].at[target].set(hot_s[k], mode="drop")
+                 for k in fresh.slots}
+        lost_l = hfound_l & (slot >= cap)
+        lost_w = jnp.where(lost_l[:, None], hot_w, 0.0)
+        lost_s = {k: jnp.where(lost_l[:, None], v, 0.0)
+                  for k, v in hot_s.items()}
+        # each row lives on exactly one shard: psum assembles the global masks
+        # and the cold/lost payloads (zeros elsewhere)
+        cfound = jax.lax.psum(cfound_l.astype(jnp.int32), axis) > 0
+        kept = jax.lax.psum(ok.astype(jnp.int32), axis) > 0
+        lost = jax.lax.psum(lost_l.astype(jnp.int32), axis) > 0
+        cold_w = jax.lax.psum(cold_w, axis)
+        cold_s = {k: jax.lax.psum(v, axis) for k, v in cold_s.items()}
+        lost_w = jax.lax.psum(lost_w, axis)
+        lost_s = {k: jax.lax.psum(v, axis) for k, v in lost_s.items()}
+        overflow = state.overflow + jax.lax.psum(oflow, axis)
+        new_state = state.replace(keys=new_keys, weights=weights, slots=slots,
+                                  overflow=overflow)
+        return new_state, cfound, cold_w, cold_s, kept, lost, lost_w, lost_s
+
+    slot_specs = {k: P() for k in slot_names}
+    in_specs = (state_pspec, P(), P(), state_pspec)
+    out_specs = (state_pspec, P(), P(), slot_specs, P(), P(), P(), slot_specs)
+    return jax.jit(jax.shard_map(evict, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_vma=False),
+                   donate_argnums=(0,))
+
+
 def _make_mesh_admit(mesh, axis, state_pspec, slot_names):
     """shard_map'd admission for a row-sharded cache: each device claims only
     the ids it owns (`id % S == shard_index`, the layout `parallel/sharded.py`
@@ -213,16 +331,19 @@ class HostOffloadTable:
 
     def __init__(self, spec: EmbeddingSpec, optimizer: SparseOptimizer, *,
                  seed: int = 0, high_water: float = 0.6,
-                 mesh=None, axis=None):
+                 mesh=None, axis=None, eviction: str = "clock"):
         if not spec.use_hash_table:
             raise ValueError("host offload needs a hash-table spec "
                              "(input_dim=-1 + capacity)")
         if not 0 < high_water <= 1:
             raise ValueError("high_water in (0, 1]")
+        if eviction not in ("clock", "flush"):
+            raise ValueError("eviction must be 'clock' or 'flush'")
         self.spec = spec
         self.optimizer = optimizer
         self.seed = seed
         self.high_water = high_water
+        self.eviction = eviction
         self.mesh = mesh
         self.axis = axis
         self.num_shards = int(mesh.devices.size) if mesh is not None else 1
@@ -236,12 +357,14 @@ class HostOffloadTable:
                 slots={k: P(axis, None)
                        for k in optimizer.slot_shapes(spec.output_dim)},
                 keys=P(axis), overflow=P())
-            self.state = self._init_sharded_state()
+            self._mk_fresh = self._compile_sharded_fresh()
         else:
-            self.state = init_table_state(spec, optimizer, seed=seed)
-        self._fresh = jax.device_get(self.state)  # template for cache resets
-        self._shardings = jax.tree_util.tree_map(
-            lambda x: x.sharding, self.state)
+            self._mk_fresh = jax.jit(
+                lambda: init_table_state(spec, optimizer, seed=seed))
+        # fresh state regenerated ON DEVICE (same seed -> bit-identical every
+        # time): resets and eviction rebuilds never move a full cache of bytes
+        # over the host boundary
+        self.state = self._mk_fresh()
         self.capacity = self.state.keys.shape[0]
         self.rows_per_shard = self.capacity // self.num_shards
         self.store = HostStore(spec.output_dim,
@@ -250,6 +373,9 @@ class HostOffloadTable:
         # per-id Python boxing (a set would cost O(occupancy) host work right
         # when the cache is large — the feature's point)
         self._resident_sorted = np.empty((0,), np.int64)
+        # second-chance bit per resident id (clock eviction): set when a
+        # prepare() touches the id, cleared for survivors at each eviction
+        self._ref = np.empty((0,), bool)
         self._shard_counts = np.zeros((self.num_shards,), np.int64)
         # cumulative overflow carried across cache resets: the device counter
         # restarts at 0 every flush, but dropped ids must stay observable
@@ -258,13 +384,16 @@ class HostOffloadTable:
         if mesh is not None:
             self._admit = _make_mesh_admit(mesh, axis, self._pspec,
                                            list(self.state.slots))
+            self._evict = _make_mesh_evict(mesh, axis, self._pspec,
+                                           list(self.state.slots))
         else:
             self._admit = jax.jit(_admit_fn, donate_argnums=(0,))
+            self._evict = jax.jit(_evict_fn, donate_argnums=(0,))
 
-    def _init_sharded_state(self) -> EmbeddingTableState:
-        """Create the cache directly sharded (same recipe as
+    def _compile_sharded_fresh(self):
+        """Compiled fresh-state builder for the sharded cache (same recipe as
         `MeshTrainer.init_tables`: jit + out_shardings, never materialized on
-        one device — though an offload cache is small by design)."""
+        one device)."""
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         spec, opt = self.spec, self.optimizer
@@ -285,7 +414,7 @@ class HostOffloadTable:
         shardings = jax.tree_util.tree_map(
             lambda p: NamedSharding(self.mesh, p), self._pspec,
             is_leaf=lambda x: isinstance(x, P))
-        return jax.jit(mk, out_shardings=shardings)()
+        return jax.jit(mk, out_shardings=shardings)
 
     @property
     def resident_count(self) -> int:
@@ -319,29 +448,41 @@ class HostOffloadTable:
         return bool((counts > self.high_water * self.rows_per_shard).any())
 
     def prepare(self, ids) -> None:
-        """Make the cache ready for a batch: flush if needed, re-admit evicted
-        ids (split-pair batches are joined to int64 host-side — the residency
-        set, the store, and the shard accounting all speak int64). Call
-        BEFORE the train step; rebind `self.state` after it."""
+        """Make the cache ready for a batch: evict/flush if needed, re-admit
+        evicted ids (split-pair batches are joined to int64 host-side — the
+        residency set, the store, and the shard accounting all speak int64).
+        Call BEFORE the train step; rebind `self.state` after it.
+
+        Over high-water with `eviction="clock"` (default): cold residents
+        (untouched since the last eviction round) move to the store, hot rows
+        stay ON DEVICE (`evict_cold`) — falling back to the whole-cache flush
+        only when the hot set itself leaves no room."""
         from ..ops.id64 import np_ids_as_int64
         flat = np.unique(np_ids_as_int64(ids))
         flat = flat[flat >= 0]
         if self._resident_sorted.size:
             pos = np.searchsorted(self._resident_sorted, flat)
             pos_c = np.minimum(pos, self._resident_sorted.size - 1)
-            new = flat[self._resident_sorted[pos_c] != flat]
+            hit = self._resident_sorted[pos_c] == flat
+            # second-chance bit: this batch's residents are HOT
+            self._ref[pos_c[hit]] = True
+            new = flat[~hit]
         else:
             new = flat
         if new.size == 0:
             return
         if self._would_exceed(new):
-            self.flush()
-            # The flush just evicted the batch's previously-resident ids too;
-            # admit the WHOLE batch back or the train step would reinsert those
-            # ids with initializer values, losing their weights/slots.
-            new = flat
-            per_shard = np.bincount(new % self.num_shards,
-                                    minlength=self.num_shards)
+            if self.eviction == "clock":
+                self.evict_cold()
+            if self.eviction != "clock" or self._would_exceed(new):
+                self.flush()
+                # The flush just evicted the batch's previously-resident ids
+                # too; admit the WHOLE batch back or the train step would
+                # reinsert those ids with initializer values, losing their
+                # weights/slots.
+                new = flat
+            per_shard = self._shard_counts + np.bincount(
+                new % self.num_shards, minlength=self.num_shards)
             if per_shard.max(initial=0) > self.rows_per_shard:
                 warnings.warn(
                     f"batch puts {int(per_shard.max())} unique ids on one "
@@ -363,12 +504,74 @@ class HostOffloadTable:
         admitted = np.asarray(admitted)
         got = new[admitted]
         # O(n+m) sorted merge (got is sorted: a subset of np.unique output)
-        self._resident_sorted = np.insert(
-            self._resident_sorted,
-            np.searchsorted(self._resident_sorted, got), got)
+        at = np.searchsorted(self._resident_sorted, got)
+        self._resident_sorted = np.insert(self._resident_sorted, at, got)
+        # fresh admits enter UNreferenced: a one-shot id is evictable at the
+        # next pressure round, while a recurring id gets its bit set by the
+        # mark-on-touch at the top of the next prepare() — which runs BEFORE
+        # eviction, so the current batch is always protected
+        self._ref = np.insert(self._ref, at, False)
         self._shard_counts += np.bincount(got % self.num_shards,
                                           minlength=self.num_shards)
         metrics.observe("offload.admitted", int(admitted.sum()))
+
+    def _ids_to_device(self, ids64: np.ndarray):
+        from ..ops.id64 import np_split_ids
+        if self.state.keys.ndim == 2:
+            return jnp.asarray(np_split_ids(ids64))
+        return jnp.asarray(ids64.astype(self.state.keys.dtype))
+
+    def evict_cold(self) -> int:
+        """Clock/second-chance eviction: move residents whose referenced bit is
+        clear to the host store and rebuild the cache keeping the hot rows on
+        device; survivors' bits are cleared (they must be touched again to
+        survive the next round). Host<->device traffic is O(cold rows) — the
+        whole-cache flush's O(cache) cost only happens via the explicit
+        fallback in prepare(). Returns the number of rows evicted."""
+        cold = self._resident_sorted[~self._ref]
+        hot = self._resident_sorted[self._ref]
+        if cold.size == 0:
+            return 0
+
+        # pad each list to a power of two: stable compile cache across rounds
+        def pad(a):
+            n = 1 << max(0, (a.size - 1).bit_length())
+            return np.concatenate([a, np.full((n - a.size,), -1, np.int64)])
+
+        cold_p = pad(cold)
+        hot_p = pad(hot) if hot.size else np.full((1,), -1, np.int64)
+        with metrics.vtimer("offload", "evict"):
+            fresh = self._mk_fresh()
+            (self.state, cfound, cw, cs, kept, lost,
+             lost_w, lost_s) = self._evict(
+                self.state, self._ids_to_device(cold_p),
+                self._ids_to_device(hot_p), fresh)
+            cfound = np.asarray(cfound)[:cold.size]
+            self.store.merge(
+                cold[cfound],
+                np.asarray(cw)[:cold.size][cfound].astype(np.float32),
+                {k: np.asarray(v)[:cold.size][cfound].astype(np.float32)
+                 for k, v in cs.items()})
+        nh = hot.size
+        kept = np.asarray(kept)[:nh] if nh else np.zeros((0,), bool)
+        lost = np.asarray(lost)[:nh] if nh else np.zeros((0,), bool)
+        if lost.any():
+            # hot rows whose re-insert overflowed (rare): bank them in the
+            # store — they re-admit on their next appearance
+            self.store.merge(
+                hot[lost],
+                np.asarray(lost_w)[:nh][lost].astype(np.float32),
+                {k: np.asarray(v)[:nh][lost].astype(np.float32)
+                 for k, v in lost_s.items()})
+        survivors = np.sort(hot[kept])
+        self._resident_sorted = survivors
+        self._ref = np.zeros((survivors.size,), bool)  # second chance expired
+        self._shard_counts = np.bincount(
+            survivors % self.num_shards, minlength=self.num_shards
+        ).astype(np.int64)
+        metrics.observe("offload.evicted_cold", int(cfound.sum()))
+        metrics.observe("offload.kept_hot", int(survivors.size))
+        return int(cfound.sum())
 
     def sync_to_store(self) -> None:
         """Write every resident (id, row, slots) back to the host store WITHOUT
@@ -396,9 +599,9 @@ class HostOffloadTable:
         contents are stale). The device overflow counter restarts at 0, so its
         current value is banked first (`total_overflow` stays monotonic)."""
         self._overflow_flushed += int(np.asarray(self.state.overflow))
-        self.state = jax.tree_util.tree_map(
-            jax.device_put, self._fresh, self._shardings)
+        self.state = self._mk_fresh()
         self._resident_sorted = np.empty((0,), np.int64)
+        self._ref = np.empty((0,), bool)
         self._shard_counts[:] = 0
 
     def load_store(self, ids: np.ndarray, weights: np.ndarray,

@@ -195,3 +195,83 @@ def test_offload_rejects_array_table():
     with pytest.raises(ValueError, match="hash-table"):
         HostOffloadTable(EmbeddingSpec(name="a", input_dim=100, output_dim=DIM,
                                        variable_id=0), embed.Adagrad())
+
+
+# -- clock / second-chance eviction ------------------------------------------
+
+
+def test_clock_eviction_keeps_hot_resident():
+    """A stable hot set must survive evictions ON DEVICE: after pressure
+    forces evictions, every hot id is still resident, cold one-shot ids went
+    to the store, and no whole-cache flush happened."""
+    from openembedding_tpu.utils import metrics as M
+
+    opt = embed.Adagrad(learning_rate=0.1)
+    # capacity 32, high_water 0.6 -> ~19 slots; hot set of 8 + 6 fresh ids
+    # per round overflows after ~2 rounds, forcing eviction rounds
+    ot = HostOffloadTable(_spec(32), opt, high_water=0.6)
+    rng = np.random.default_rng(3)
+    hot = rng.integers(0, 1 << 19, size=8).astype(np.int64)
+    hot = np.unique(hot)
+    flushes_before = M.report().get("offload.flushes", 0)
+    evictions = 0
+    for r in range(12):
+        cold = (np.arange(6, dtype=np.int64) + (1 << 20) + 100 * r)
+        ids = jnp.asarray(np.concatenate([hot, cold]))
+        ot.prepare(ids)
+        state, _ = lookup_train(_spec(32), ot.state, ids)
+        ot.state = apply_gradients(_spec(32), state, opt, ids,
+                                   jnp.ones((ids.shape[0], DIM), jnp.float32))
+    # hot ids never left the device
+    for h in hot:
+        assert ot.is_resident(int(h)), f"hot id {h} was evicted"
+    # cold ids from earlier rounds reached the store
+    assert ot.store.ids.size > 0
+    assert (ot.store.ids >= (1 << 20)).any()
+    # and the hot set never round-tripped through the store
+    store_ids = set(ot.store.ids.tolist())
+    assert sum(1 for h in hot if int(h) in store_ids) == 0
+    flushes_after = M.report().get("offload.flushes", 0)
+    assert flushes_after == flushes_before  # clock eviction, never full flush
+
+
+def test_clock_eviction_matches_infinite_table():
+    """Training through eviction rounds stays lossless (Constant init):
+    equal to one big in-HBM table on the same stream."""
+    opt_a = embed.Adagrad(learning_rate=0.1)
+    opt_b = embed.Adagrad(learning_rate=0.1)
+    spec_small, spec_big = _spec(32), _spec(1 << 13)
+    ot = HostOffloadTable(spec_small, opt_a, high_water=0.6)
+    big = init_table_state(spec_big, opt_b, seed=0)
+    rng = np.random.default_rng(5)
+    hot = np.unique(rng.integers(0, 1 << 19, size=8).astype(np.int64))
+    all_ids = []
+    for r in range(10):
+        cold = (np.arange(5, dtype=np.int64) + (1 << 20) + 64 * r)
+        ids_np = np.concatenate([hot, cold])
+        all_ids.append(ids_np)
+        ids = jnp.asarray(ids_np)
+        grads = jnp.asarray(rng.standard_normal((ids_np.size, DIM)),
+                            jnp.float32)
+        ot.prepare(ids)
+        state, _ = lookup_train(spec_small, ot.state, ids)
+        ot.state = apply_gradients(spec_small, state, opt_a, ids, grads)
+        bstate, _ = lookup_train(spec_big, big, ids)
+        big = apply_gradients(spec_big, bstate, opt_b, ids, grads)
+    ids = np.unique(np.concatenate(all_ids))
+    got = ot.lookup_anywhere(jnp.asarray(ids))
+    want = np.asarray(lookup(spec_big, big, jnp.asarray(ids)))
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+
+def test_flush_policy_still_available():
+    """eviction='flush' preserves the coarse whole-cache behavior."""
+    from openembedding_tpu.utils import metrics as M
+    opt = embed.Adagrad(learning_rate=0.1)
+    ot = HostOffloadTable(_spec(32), opt, high_water=0.6, eviction="flush")
+    before = M.report().get("offload.flushes", 0)
+    rng = np.random.default_rng(9)
+    for r in range(6):
+        ids = jnp.asarray(rng.integers(0, 1 << 30, size=12).astype(np.int64))
+        ot.prepare(ids)
+    assert M.report().get("offload.flushes", 0) > before

@@ -134,8 +134,11 @@ def test_sharded_model_serves_host_cached_checkpoint(tmp_path):
     import dataclasses
     from openembedding_tpu.model import EmbeddingModel
 
+    # cache holds ONE batch's uniques (~723 < 0.6 * 2048, no overflow warning)
+    # while the 6-batch stream's cumulative uniques far exceed it — the store,
+    # not the cache, is the authoritative row set
     base = make_deepfm(vocabulary=-1, dim=4, hidden=(16,), hashed=True,
-                       capacity=64)
+                       capacity=2048)
     spec = dataclasses.replace(base.specs["categorical"],
                                storage="host_cached")
     model = EmbeddingModel(base.module, [], loss_fn=base.loss_fn,
@@ -145,13 +148,17 @@ def test_sharded_model_serves_host_cached_checkpoint(tmp_path):
     batches = list(synthetic_criteo(32, id_space=1 << 40, steps=6, seed=2))
     state = trainer.init(batches[0])
     step = trainer.jit_train_step()
-    for b in batches:
-        state = trainer.offload_prepare(state, b)
-        state, _ = step(state, b)
+    import warnings as _warnings
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("error", RuntimeWarning)  # no capacity warnings
+        for b in batches:
+            state = trainer.offload_prepare(state, b)
+            state, _ = step(state, b)
     ot = trainer.offload["categorical"]
     ot.adopt(state.tables["categorical"])
     ot.sync_to_store()
-    assert ot.store.ids.size > 64  # the store really exceeds the cache
+    assert ot.total_overflow == 0  # every trained row reached the store
+    assert ot.store.ids.size > ot.capacity  # the store really exceeds the cache
 
     path = str(tmp_path / "ck_off")
     trainer.save(state, path)
