@@ -59,5 +59,5 @@ from .model import EmbeddingModel, Trainer, TrainState
 from . import checkpoint
 from .checkpoint import save_server_model, load_server_model
 from . import persist
-from .persist import (AsyncPersister, PersistPolicy, persist_server_model,
-                      restore_server_model)
+from .persist import (AsyncPersister, IncrementalPersister, PersistPolicy,
+                      persist_server_model, restore_server_model)

@@ -33,6 +33,7 @@ import time
 from typing import List, Optional, Tuple
 
 import jax
+import numpy as np
 
 from .utils import metrics
 
@@ -120,8 +121,9 @@ class AsyncPersister:
         # commit wait then times out (observed under full-suite contention).
         if jax.process_index() == 0:
             import glob as _glob
-            for d in _glob.glob(os.path.join(root, "persist_*.writing")):
-                shutil.rmtree(d, ignore_errors=True)
+            for pat in ("persist_*.writing", "delta_*.writing"):
+                for d in _glob.glob(os.path.join(root, pat)):
+                    shutil.rmtree(d, ignore_errors=True)
         self._q: "queue.Queue" = queue.Queue(maxsize=window)
         self._error: Optional[BaseException] = None
         self._thread = threading.Thread(target=self._writer, daemon=True)
@@ -170,7 +172,8 @@ class AsyncPersister:
             stores = self.trainer.offload_store_snapshots(state) \
                 if getattr(self.trainer, "offload", None) else {}
         path = os.path.join(self.root, f"persist_{step:012d}")
-        self._q.put((snapshot, stores, step, path))  # backpressure when full
+        write_cb = lambda tmp: self._write_full_payload(snapshot, stores, tmp)  # noqa: E731
+        self._q.put((write_cb, step, path))  # backpressure when full
         self.policy.mark(step)
         metrics.observe("persist.submitted", 1)
         return path
@@ -178,16 +181,14 @@ class AsyncPersister:
     # -- writer thread ------------------------------------------------------
 
     def _writer(self) -> None:
-        from .checkpoint import save_server_model
-
         while True:
             item = self._q.get()
             if item is None:
                 return
-            snapshot, stores, step, path = item
+            write_cb, step, path = item
             try:
                 with metrics.vtimer("persist", "write"):
-                    self._write_one(snapshot, stores, step, path)
+                    self._write_one(write_cb, step, path)
                 metrics.observe("persist.committed", 1)
                 if jax.process_index() == 0:
                     self._gc()
@@ -196,23 +197,9 @@ class AsyncPersister:
             finally:
                 self._q.task_done()
 
-    def _write_one(self, snapshot, stores, step: int, path: str) -> None:
-        """Write this process's shards into `<path>.writing`, then commit.
-
-        Multi-host commit protocol (the reference's work-id commit,
-        `PmemEmbeddingTable.h:236-300`, re-expressed over a shared FS): every
-        process streams its own shards into the SAME `.writing` dir and drops a
-        `done.<process_index>` marker; only process 0 — after ALL markers are
-        present — renames the dir into place and writes COMMIT. A fast process
-        can therefore never commit (or garbage-collect) a checkpoint another
-        host is still writing, and restore never sees a partial dump."""
+    def _write_full_payload(self, snapshot, stores, tmp: str) -> None:
         from .checkpoint import save_server_model
 
-        tmp = f"{path}.writing"
-        pidx, pcount = jax.process_index(), jax.process_count()
-        # NOTE: stale-dir cleanup happens in persist() (main thread,
-        # barrier-fenced); an rmtree here would race a faster peer's
-        # already-finished write out of existence — see persist().
         if self.trainer.num_shards > 1:
             from .parallel.checkpoint import save_sharded
             save_sharded(snapshot, self.model, tmp,
@@ -224,6 +211,23 @@ class AsyncPersister:
                               include_optimizer=self.include_optimizer,
                               num_shards=self.trainer.num_shards,
                               offload_stores=stores)
+
+    def _write_one(self, write_cb, step: int, path: str) -> None:
+        """Write this process's payload into `<path>.writing`, then commit.
+
+        Multi-host commit protocol (the reference's work-id commit,
+        `PmemEmbeddingTable.h:236-300`, re-expressed over a shared FS): every
+        process streams its own shards into the SAME `.writing` dir and drops a
+        `done.<process_index>` marker; only process 0 — after ALL markers are
+        present — renames the dir into place and writes COMMIT. A fast process
+        can therefore never commit (or garbage-collect) a checkpoint another
+        host is still writing, and restore never sees a partial dump."""
+        tmp = f"{path}.writing"
+        pidx, pcount = jax.process_index(), jax.process_count()
+        # NOTE: stale-dir cleanup happens in persist() (main thread,
+        # barrier-fenced); an rmtree here would race a faster peer's
+        # already-finished write out of existence — see persist().
+        write_cb(tmp)
         with open(os.path.join(tmp, f"done.{pidx}"), "w") as f:
             f.write(str(step))
         if pidx != 0:
@@ -287,6 +291,360 @@ class AsyncPersister:
                                     trainer=self.trainer)
 
 
+# -- incremental (dirty-window) persistence ----------------------------------
+#
+# The reference's PMem tables make a persist near-instant because the rows are
+# ALREADY persistent — committing a checkpoint only flushes the pending window
+# and writes a work-id marker (`PmemEmbeddingTable.h:236-300`, "lightweight
+# checkpoints", `documents/en/pmem.md`). A TPU table lives in HBM, so rows must
+# cross device->host->disk — but only the rows TOUCHED since the last persist
+# changed. The incremental pipeline makes persist cost O(touched), not
+# O(model): a full base persist, then `delta_<step>` directories holding the
+# touched rows (+ the small dense tree), chained by parent pointers under the
+# same COMMIT protocol; restore = base + replay.
+
+_DELTA_RE = re.compile(r"delta_(\d+)$")
+DELTA_FORMAT = "oetpu-delta-v1"
+
+
+def list_deltas(root: str) -> List[Tuple[int, str]]:
+    """(step, path) of committed deltas, oldest first."""
+    if not os.path.isdir(root):
+        return []
+    out = []
+    for name in os.listdir(root):
+        m = _DELTA_RE.match(name)
+        path = os.path.join(root, name)
+        if m and os.path.exists(os.path.join(path, COMMIT_FILE)):
+            out.append((int(m.group(1)), path))
+    return sorted(out)
+
+
+def delta_chain(root: str) -> Tuple[Optional[str], List[str]]:
+    """-> (base_persist_path, [delta paths to replay in order]).
+
+    The newest committed FULL persist anchors the chain; committed deltas
+    newer than it are walked by parent pointer and the chain stops at the
+    first break (a missing/uncommitted link) — replaying a consistent prefix
+    restores the state at that link's step, never a torn mix."""
+    import json
+
+    base = latest_persist(root)
+    if base is None:
+        return None, []
+    base_step = list_persists(root)[-1][0]
+    chain = []
+    parent = base_step
+    remaining = {s: p for s, p in list_deltas(root) if s > base_step}
+    for step in sorted(remaining):
+        path = remaining[step]
+        try:
+            with open(os.path.join(path, "meta.json")) as f:
+                meta = json.load(f)
+        except (OSError, ValueError):
+            break
+        if meta.get("parent") != parent or meta.get("format") != DELTA_FORMAT:
+            break
+        chain.append(path)
+        parent = step
+    return base, chain
+
+
+class DirtyTracker:
+    """Host-side touched-id accumulation per embedding table, fed from the
+    input stream (the same place the reference's client knows its pull ids,
+    `EmbeddingPullOperator.cpp:60-112`). observe() only uniques the BATCH
+    (O(batch log batch)) and appends; the cross-batch union is deferred to
+    take(), once per persist — re-sorting the whole window every step would
+    put O(window log window) host work on the training hot loop."""
+
+    def __init__(self, model):
+        self._feats = {name: spec.feature_name
+                       for name, spec in model.ps_specs().items()
+                       if spec.storage != "host_cached"}
+        self._chunks = {name: [] for name in self._feats}
+        self.observed = 0
+
+    def observe(self, batch) -> None:
+        from .ops.id64 import np_ids_as_int64
+        for name, feat in self._feats.items():
+            ids = np.unique(np_ids_as_int64(batch["sparse"][feat]))
+            ids = ids[ids >= 0]
+            if ids.size:
+                self._chunks[name].append(ids)
+        self.observed += 1
+
+    def take(self):
+        """-> {name: sorted unique ids}; resets the window."""
+        out = {name: (np.unique(np.concatenate(chunks)) if chunks
+                      else np.empty((0,), np.int64))
+               for name, chunks in self._chunks.items()}
+        self._chunks = {name: [] for name in self._feats}
+        self.observed = 0
+        return out
+
+
+def _ceil_pow2(n: int) -> int:
+    return 1 << max(0, (n - 1).bit_length())
+
+
+class IncrementalPersister(AsyncPersister):
+    """AsyncPersister whose steady-state persists are O(touched rows).
+
+    Drive it like AsyncPersister but hand it the batches too:
+
+        p = IncrementalPersister(trainer, model, root,
+                                 policy=PersistPolicy(every_steps=50))
+        for batch in data:
+            state, m = step(state, batch)
+            p.maybe_persist(state, batch=batch)   # observes + maybe persists
+
+    (or call `p.observe(batch)` per step and `maybe_persist(state)` as before —
+    EVERY trained batch must be observed, else its rows go stale in the deltas;
+    an unobserved window falls back to a full persist with a warning.)
+
+    Persist schedule: a full base every `full_every` persists (bounds the
+    restore replay chain), deltas in between. Single-process only (the
+    multi-host sharded dump streams per-shard and stays full); host-cached
+    tables also fall back to full persists — their store already lives
+    host-side and the admission bookkeeping, not the snapshot, is their cost."""
+
+    def __init__(self, trainer, model, root: str, *, full_every: int = 8,
+                 **kw):
+        if jax.process_count() > 1 or trainer.num_shards > 1:
+            raise ValueError(
+                "IncrementalPersister is single-process/single-shard; "
+                "multi-host training persists full per-shard dumps "
+                "(AsyncPersister)")
+        if full_every < 1:
+            raise ValueError("full_every must be >= 1")
+        super().__init__(trainer, model, root, **kw)
+        self.full_every = full_every
+        self.tracker = DirtyTracker(model)
+        self._since_full = 0
+        self._last_persist_step: Optional[int] = None
+        self._readers = {}
+
+    def observe(self, batch) -> None:
+        self.tracker.observe(batch)
+
+    def maybe_persist(self, state, batch=None) -> bool:
+        if batch is not None:
+            self.observe(batch)
+        return super().maybe_persist(state)
+
+    # -- touched-row device read (the O(touched) snapshot) -------------------
+
+    def _reader(self, name, spec, padded_n: int):
+        key = (name, padded_n)
+        if key not in self._readers:
+            import jax.numpy as jnp
+
+            def read(ts, ids):
+                if spec.use_hash_table:
+                    from .tables.hash_table import hash_find
+                    slot = hash_find(ts.keys, ids)
+                    cap = ts.keys.shape[0]
+                    found = slot < cap
+                    idx = jnp.clip(slot, 0, cap - 1)
+                else:
+                    n = ts.weights.shape[0]
+                    found = (ids >= 0) & (ids < n)
+                    idx = jnp.clip(ids, 0, n - 1)
+                w = jnp.take(ts.weights, idx, axis=0)
+                s = {k: jnp.take(v, idx, axis=0) for k, v in ts.slots.items()}
+                return found, w, s
+
+            self._readers[key] = jax.jit(read)
+        return self._readers[key]
+
+    def _read_touched(self, state, name, ids64: np.ndarray):
+        """-> host dict {ids, weights, slot_<k>...} for the touched rows that
+        exist in the table (overflow-dropped ids have no row to persist)."""
+        from .ops.id64 import np_split_ids
+        spec = self.model.specs[name]
+        ts = state.tables[name]
+        n = ids64.size
+        padded = _ceil_pow2(max(1, n))
+        pad = np.full((padded - n,), -1, np.int64)
+        ids_h = np.concatenate([ids64, pad])
+        pair = spec.use_hash_table and ts.keys is not None and ts.keys.ndim == 2
+        if pair:
+            ids_dev = np_split_ids(ids_h)
+        elif spec.use_hash_table:
+            ids_dev = ids_h.astype(ts.keys.dtype)  # x64-on single lane
+        else:
+            ids_dev = ids_h.astype(np.int32)  # array vocab always < 2^31
+        found, w, s = self._reader(name, spec, padded)(ts, ids_dev)
+        found = np.asarray(found)[:n] if n else np.zeros((0,), bool)
+        keep = found
+        out = {"ids": ids64[keep],
+               "weights": np.asarray(w)[:n][keep].astype(np.float32)}
+        for k, v in s.items():
+            out[f"slot_{k}"] = np.asarray(v)[:n][keep].astype(np.float32)
+        return out
+
+    # -- persist dispatch ----------------------------------------------------
+
+    def persist(self, state) -> str:
+        self._raise_pending_error()
+        step = int(state.step)
+        touched = self.tracker.take()
+        unobserved = (not any(v.size for v in touched.values())
+                      and self._last_persist_step is not None
+                      and step > self._last_persist_step)
+        full = (self._last_persist_step is None
+                or self._since_full >= self.full_every
+                or bool(getattr(self.trainer, "offload", None))
+                or unobserved)
+        if unobserved and self._since_full < self.full_every \
+                and not getattr(self.trainer, "offload", None):
+            import warnings
+            warnings.warn(
+                "IncrementalPersister: steps advanced but no batches were "
+                "observed since the last persist — falling back to a FULL "
+                "persist. Call observe(batch) (or maybe_persist(state, "
+                "batch=batch)) for every trained batch.", RuntimeWarning)
+        if full:
+            path = super().persist(state)
+            self._since_full = 0
+            self._last_persist_step = step
+            return path
+
+        with metrics.vtimer("persist", "snapshot_delta"):
+            parent = self._last_persist_step
+            tables = {name: self._read_touched(state, name, ids)
+                      for name, ids in touched.items() if ids.size}
+            from .checkpoint import _flatten_params
+            dense = {
+                "params": _flatten_params(jax.device_get(state.dense_params)),
+                "slots": _flatten_params(jax.device_get(state.dense_slots)),
+            }
+            scalars = {"step": step,
+                       "model_version": int(state.model_version)}
+        path = os.path.join(self.root, f"delta_{step:012d}")
+        write_cb = lambda tmp: self._write_delta_payload(  # noqa: E731
+            tables, dense, scalars, parent, tmp)
+        self._q.put((write_cb, step, path))
+        self.policy.mark(step)
+        self._since_full += 1
+        self._last_persist_step = step
+        metrics.observe("persist.submitted_delta", 1)
+        return path
+
+    def _write_delta_payload(self, tables, dense, scalars, parent: int,
+                             tmp: str) -> None:
+        import json
+        os.makedirs(tmp, exist_ok=True)
+        for name, payload in tables.items():
+            np.savez(os.path.join(tmp, f"table_{name}.npz"), **payload)
+        np.savez(os.path.join(tmp, "dense.npz"),
+                 **{f"params/{k}": v for k, v in dense["params"].items()},
+                 **{f"slots/{k}": v for k, v in dense["slots"].items()})
+        meta = {"format": DELTA_FORMAT, "parent": parent,
+                "tables": sorted(tables), **scalars}
+        with open(os.path.join(tmp, "meta.json"), "w") as f:
+            json.dump(meta, f)
+
+    def _gc(self) -> None:
+        """Chain-aware GC: a newly committed full supersedes all older deltas;
+        fulls keep the AsyncPersister policy."""
+        persists = list_persists(self.root)
+        if persists:
+            newest_full = persists[-1][0]
+            for step, path in list_deltas(self.root):
+                if step <= newest_full:
+                    shutil.rmtree(path, ignore_errors=True)
+        super()._gc()
+
+
+def _apply_delta(state, model, path: str):
+    """Replay one committed delta onto a (single-shard) state: jitted row
+    scatter per table — hash ids re-found-or-inserted with the live probe
+    kernel, array ids written in place."""
+    import json
+
+    import jax.numpy as jnp
+
+    from .ops.id64 import np_split_ids
+
+    with open(os.path.join(path, "meta.json")) as f:
+        meta = json.load(f)
+    new_tables = dict(state.tables)
+    for name in meta["tables"]:
+        spec = model.specs[name]
+        ts = new_tables[name]
+        with np.load(os.path.join(path, f"table_{name}.npz")) as z:
+            ids64 = z["ids"]
+            w = z["weights"]
+            slots = {k[len("slot_"):]: z[k] for k in z.files
+                     if k.startswith("slot_")}
+        if ids64.size == 0:
+            continue
+        if spec.use_hash_table:
+            pair = ts.keys.ndim == 2
+            ids_dev = jnp.asarray(np_split_ids(ids64) if pair
+                                  else ids64.astype(ts.keys.dtype))
+
+            def write(ts, ids, w, s):
+                from .tables.hash_table import hash_find_or_insert
+                keys, slot, overflow = hash_find_or_insert(ts.keys, ids)
+                cap = keys.shape[0]
+                target = jnp.where(slot < cap, slot, cap)
+                weights = ts.weights.at[target].set(
+                    w.astype(ts.weights.dtype), mode="drop")
+                new_slots = {k: ts.slots[k].at[target].set(
+                    s[k].astype(ts.slots[k].dtype), mode="drop")
+                    for k in ts.slots}
+                return ts.replace(keys=keys, weights=weights, slots=new_slots,
+                                  overflow=ts.overflow + overflow)
+
+            new_tables[name] = jax.jit(write, donate_argnums=(0,))(
+                ts, ids_dev, jnp.asarray(w),
+                {k: jnp.asarray(v) for k, v in slots.items()})
+        else:
+
+            def write(ts, ids, w, s):
+                n = ts.weights.shape[0]
+                tgt = jnp.where((ids >= 0) & (ids < n), ids, n)
+                weights = ts.weights.at[tgt].set(
+                    w.astype(ts.weights.dtype), mode="drop")
+                new_slots = {k: ts.slots[k].at[tgt].set(
+                    s[k].astype(ts.slots[k].dtype), mode="drop")
+                    for k in ts.slots}
+                return ts.replace(weights=weights, slots=new_slots)
+
+            new_tables[name] = jax.jit(write, donate_argnums=(0,))(
+                ts, jnp.asarray(ids64.astype(np.int32)), jnp.asarray(w),
+                {k: jnp.asarray(v) for k, v in slots.items()})
+
+    with np.load(os.path.join(path, "dense.npz")) as z:
+        from .checkpoint import _unflatten_params
+        params = _unflatten_params(
+            {k[len("params/"):]: z[k] for k in z.files
+             if k.startswith("params/")})
+        dslots = _unflatten_params(
+            {k[len("slots/"):]: z[k] for k in z.files
+             if k.startswith("slots/")})
+
+    def _match(template, loaded):
+        """Rebuild the template's pytree with loaded leaves (dtypes pinned)."""
+        leaves, treedef = jax.tree_util.tree_flatten(template)
+        new_leaves = treedef.flatten_up_to(loaded)
+        return jax.tree_util.tree_unflatten(
+            treedef, [jnp.asarray(nl).astype(l.dtype).reshape(l.shape)
+                      for l, nl in zip(leaves, new_leaves)])
+
+    return state.replace(
+        tables=new_tables,
+        dense_params=_match(state.dense_params, params),
+        dense_slots=_match(state.dense_slots, dslots),
+        step=jnp.asarray(meta["step"], state.step.dtype),
+        model_version=jnp.asarray(meta["model_version"],
+                                  state.model_version.dtype),
+    )
+
+
 # -- module-level API parity with `exb.py:697-705` ---------------------------
 
 
@@ -298,18 +656,27 @@ def persist_server_model(trainer, model, state, root: str, window: int = 2) -> s
 
 
 def restore_server_model(state, model, root: str, *, trainer=None):
-    """Restore the newest COMMITTED persist under `root` (crash-consistent:
-    uncommitted directories are ignored; reference `restore_server_model`,
-    `exb.py:703-705`)."""
-    path = latest_persist(root)
+    """Restore the newest COMMITTED persist under `root`, then replay any
+    committed delta chain on top (crash-consistent at every level: uncommitted
+    directories are ignored, a broken chain replays only its consistent
+    prefix; reference `restore_server_model`, `exb.py:703-705`)."""
+    path, deltas = delta_chain(root)
     if path is None:
         raise FileNotFoundError(f"no committed persist under {root!r}")
     num_shards = trainer.num_shards if trainer is not None else 1
     offload = getattr(trainer, "offload", None) or None
     from .parallel.checkpoint import checkpoint_layout, load_sharded
     if checkpoint_layout(path) == "sharded":
-        return load_sharded(state, model, path, num_shards=num_shards,
-                            offload=offload)
-    from .checkpoint import load_server_model
-    return load_server_model(state, model, path, num_shards=num_shards,
+        state = load_sharded(state, model, path, num_shards=num_shards,
                              offload=offload)
+    else:
+        from .checkpoint import load_server_model
+        state = load_server_model(state, model, path, num_shards=num_shards,
+                                  offload=offload)
+    if deltas and num_shards > 1:
+        raise ValueError("delta replay is single-shard (see "
+                         "IncrementalPersister); restore with a single-device "
+                         "trainer or from a full persist")
+    for d in deltas:
+        state = _apply_delta(state, model, d)
+    return state

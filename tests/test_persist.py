@@ -6,6 +6,7 @@ import os
 import shutil
 import time
 
+import jax
 import numpy as np
 import pytest
 
@@ -169,3 +170,213 @@ def test_snapshot_isolated_from_donation(setup, tmp_path):
     assert int(restored.step) == 1
     np.testing.assert_array_equal(
         np.asarray(restored.tables["categorical"].weights), want)
+
+
+# -- incremental (dirty-window) persistence ----------------------------------
+
+
+def _state_equal(a, b):
+    import jax
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def _dir_bytes(path):
+    total = 0
+    for dirpath, _, files in os.walk(path):
+        for f in files:
+            total += os.path.getsize(os.path.join(dirpath, f))
+    return total
+
+
+def test_incremental_restore_equals_live_state(setup, tmp_path):
+    """base + delta replay == the live state, bit for bit (rows, slots, dense
+    params, dense optimizer slots, step, model_version)."""
+    from openembedding_tpu.persist import IncrementalPersister, list_deltas
+
+    model, trainer, state, batches = setup
+    step = trainer.jit_train_step()
+    root = str(tmp_path / "persist")
+    with IncrementalPersister(trainer, model, root, window=2, keep=10,
+                              policy=PersistPolicy(every_steps=2),
+                              full_every=100) as p:
+        for b in batches:
+            state, _ = step(state, b)
+            p.maybe_persist(state, batch=b)
+        p.wait()
+    # first persist is the full base; the rest are deltas
+    assert [s for s, _ in list_persists(root)] == [2]
+    assert [s for s, _ in list_deltas(root)] == [4, 6]
+
+    fresh = Trainer(model, embed.Adagrad(learning_rate=0.05), seed=0)
+    fstate = fresh.init(batches[0])
+    fstate = restore_server_model(fstate, model, root, trainer=fresh)
+    _state_equal(fstate, state)
+
+
+def test_incremental_bytes_proportional_to_touched(tmp_path):
+    """The VERDICT's acceptance: delta bytes scale with TOUCHED rows, not the
+    table. A 2^16-row table trained on batches touching ~64 ids must produce
+    deltas orders of magnitude smaller than the full base persist."""
+    from openembedding_tpu.persist import IncrementalPersister, list_deltas
+
+    big_vocab = 1 << 16
+    model = make_deepfm(vocabulary=big_vocab, dim=4, hidden=(8,))
+    trainer = Trainer(model, embed.Adagrad(learning_rate=0.05), seed=0)
+    # every batch draws from a 64-id hot set: the dirty window stays tiny
+    rng = np.random.default_rng(7)
+    hot = rng.integers(0, big_vocab, size=64)
+    batches = []
+    for i in range(4):
+        ids = hot[rng.integers(0, 64, size=(16, 26))].astype(np.int32)
+        batches.append({"sparse": {"categorical": ids},
+                        "label": rng.random(16).astype(np.float32)})
+    state = trainer.init(batches[0])
+    step = trainer.jit_train_step()
+    root = str(tmp_path / "persist")
+    with IncrementalPersister(trainer, model, root, window=2, keep=10,
+                              policy=PersistPolicy(every_steps=1),
+                              full_every=100) as p:
+        for b in batches:
+            state, _ = step(state, b)
+            p.maybe_persist(state, batch=b)
+        p.wait()
+
+    fulls = list_persists(root)
+    deltas = list_deltas(root)
+    assert len(fulls) == 1 and len(deltas) == 3
+    full_bytes = _dir_bytes(fulls[0][1])
+    for _, dpath in deltas:
+        dbytes = _dir_bytes(dpath)
+        # 64 rows x (4 weights + 4 slots + id) vs 2^16 rows: >100x smaller
+        assert dbytes * 100 < full_bytes, (dbytes, full_bytes)
+
+    fresh = Trainer(model, embed.Adagrad(learning_rate=0.05), seed=0)
+    fstate = fresh.init(batches[0])
+    fstate = restore_server_model(fstate, model, root, trainer=fresh)
+    _state_equal(fstate, state)
+
+
+def test_incremental_uncommitted_delta_ignored(setup, tmp_path):
+    """Crash consistency down the chain: a delta without COMMIT (and anything
+    after it) is not replayed — restore lands on the last consistent prefix."""
+    from openembedding_tpu.persist import IncrementalPersister, list_deltas
+
+    model, trainer, state, batches = setup
+    step = trainer.jit_train_step()
+    root = str(tmp_path / "persist")
+    states = {}
+    with IncrementalPersister(trainer, model, root, window=2, keep=10,
+                              policy=PersistPolicy(every_steps=2),
+                              full_every=100) as p:
+        for b in batches:
+            state, _ = step(state, b)
+            if p.maybe_persist(state, batch=b):
+                p.wait()
+                states[int(state.step)] = jax.device_get(state)
+    # simulate a crash mid-write of the last delta: drop its COMMIT
+    last_step, last_path = list_deltas(root)[-1]
+    os.remove(os.path.join(last_path, "COMMIT"))
+
+    fresh = Trainer(model, embed.Adagrad(learning_rate=0.05), seed=0)
+    fstate = fresh.init(batches[0])
+    fstate = restore_server_model(fstate, model, root, trainer=fresh)
+    assert int(fstate.step) == 4  # the consistent prefix: base(2) + delta(4)
+    _state_equal(fstate, states[4])
+
+
+def test_incremental_full_every_and_gc(setup, tmp_path):
+    """A scheduled full persist supersedes the chain: older deltas are GC'd,
+    restore uses the new base alone."""
+    from openembedding_tpu.persist import IncrementalPersister, list_deltas
+
+    model, trainer, state, batches = setup
+    step = trainer.jit_train_step()
+    root = str(tmp_path / "persist")
+    with IncrementalPersister(trainer, model, root, window=2, keep=10,
+                              policy=PersistPolicy(every_steps=1),
+                              full_every=2) as p:
+        for b in batches:  # persists at steps 1..6; fulls at 1, 4 (2 deltas each)
+            state, _ = step(state, b)
+            p.maybe_persist(state, batch=b)
+        p.wait()
+    full_steps = [s for s, _ in list_persists(root)]
+    delta_steps = [s for s, _ in list_deltas(root)]
+    assert full_steps[-1] == 4
+    assert all(d > 4 for d in delta_steps), (full_steps, delta_steps)
+
+    fresh = Trainer(model, embed.Adagrad(learning_rate=0.05), seed=0)
+    fstate = fresh.init(batches[0])
+    fstate = restore_server_model(fstate, model, root, trainer=fresh)
+    assert int(fstate.step) == 6
+    _state_equal(fstate, jax.device_get(state))
+
+
+def test_incremental_unobserved_window_falls_back_to_full(setup, tmp_path):
+    """Steps advancing without observe() must NOT silently persist stale
+    deltas: warn + full persist."""
+    from openembedding_tpu.persist import IncrementalPersister, list_deltas
+
+    model, trainer, state, batches = setup
+    step = trainer.jit_train_step()
+    root = str(tmp_path / "persist")
+    with IncrementalPersister(trainer, model, root, window=2,
+                              policy=PersistPolicy(every_steps=1),
+                              full_every=100) as p:
+        state, _ = step(state, batches[0])
+        p.maybe_persist(state, batch=batches[0])  # full base
+        state, _ = step(state, batches[1])
+        with pytest.warns(RuntimeWarning, match="observed"):
+            p.maybe_persist(state)  # no batch, no observe -> full + warning
+        p.wait()
+    assert [s for s, _ in list_persists(root)] == [1, 2]
+    assert list_deltas(root) == []
+
+
+def test_incremental_pair_keys_x64_off(tmp_path):
+    """The dirty window under the default config (x64 off, split-pair hash
+    keys): tracker ids are int64 host-side, the row reader/writer speak the
+    pair layout."""
+    from openembedding_tpu.persist import IncrementalPersister, list_deltas
+    from openembedding_tpu.initializers import Constant
+    import dataclasses
+
+    with jax.enable_x64(False):
+        model = make_deepfm(vocabulary=-1, dim=4, hidden=(8,), hashed=True,
+                            capacity=4096)
+        model.specs["categorical"] = dataclasses.replace(
+            model.specs["categorical"], initializer=Constant(0.0))
+        trainer = Trainer(model, embed.Adagrad(learning_rate=0.05), seed=0)
+        batches = list(synthetic_criteo(16, id_space=1 << 62, steps=4, seed=2,
+                                        ids_dtype="pair"))
+        state = trainer.init(batches[0])
+        assert state.tables["categorical"].keys.ndim == 2
+        step = trainer.jit_train_step()
+        root = str(tmp_path / "persist")
+        with IncrementalPersister(trainer, model, root, window=2,
+                                  policy=PersistPolicy(every_steps=1),
+                                  full_every=100) as p:
+            for b in batches:
+                state, _ = step(state, b)
+                p.maybe_persist(state, batch=b)
+            p.wait()
+        assert len(list_deltas(root)) == 3
+
+        fresh = Trainer(model, embed.Adagrad(learning_rate=0.05), seed=0)
+        fstate = fresh.init(batches[0])
+        fstate = restore_server_model(fstate, model, root, trainer=fresh)
+        assert int(fstate.step) == 4
+        # rows must match by id (slot layouts may differ between the restored
+        # insert order and the live table's) — read through the model's pull
+        from openembedding_tpu.embedding import lookup
+        from openembedding_tpu.ops.id64 import np_ids_as_int64, np_split_ids
+        ids = np.unique(np.concatenate(
+            [np_ids_as_int64(b["sparse"]["categorical"]) for b in batches]))
+        pair = jax.numpy.asarray(np_split_ids(ids))
+        spec = model.specs["categorical"]
+        np.testing.assert_array_equal(
+            np.asarray(lookup(spec, fstate.tables["categorical"], pair)),
+            np.asarray(lookup(spec, state.tables["categorical"], pair)))
