@@ -6,7 +6,9 @@ reference: the benchmark readers in `test/benchmark/criteo_deepctr.py:168-240`
 """
 
 from .criteo import (CriteoBatcher, criteo_fold_offsets, hash_category,
-                     read_criteo_tsv, synthetic_criteo, prefetch_to_device)
+                     planted_criteo, planted_logit, read_criteo_tsv,
+                     synthetic_criteo, prefetch_to_device)
 
 __all__ = ["CriteoBatcher", "criteo_fold_offsets", "hash_category",
-           "read_criteo_tsv", "synthetic_criteo", "prefetch_to_device"]
+           "planted_criteo", "planted_logit", "read_criteo_tsv",
+           "synthetic_criteo", "prefetch_to_device"]

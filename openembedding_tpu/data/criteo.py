@@ -302,6 +302,60 @@ def synthetic_criteo(batch_size: int, *, id_space: int = 1 << 25,
         yield {"sparse": {"categorical": ids}, "dense": dense, "label": labels}
 
 
+def _splitmix64(x: np.ndarray) -> np.ndarray:
+    """Deterministic 64-bit mix (SplitMix64) — the per-id weight hash for the
+    planted-signal generator; vectorized, no Python loops."""
+    x = (x + np.uint64(0x9E3779B97F4A7C15)).astype(np.uint64)
+    x = ((x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)).astype(np.uint64)
+    x = ((x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)).astype(np.uint64)
+    return x ^ (x >> np.uint64(31))
+
+
+def planted_logit(ids64: np.ndarray, *, seed: int = 0,
+                  scale: float = 8.0) -> np.ndarray:
+    """The TRUE logit of a planted-signal batch: each id contributes a fixed
+    hash-derived weight in (-1, 1); the logit is `scale * mean_over_fields`.
+    Deterministic in (id, seed) — this is the generative model's own scoring
+    function, so its held-out AUC is the Bayes-optimal target a trained model
+    is graded against."""
+    h = _splitmix64(ids64.astype(np.uint64) ^ np.uint64(0xA5A5_0000 + seed))
+    w = (h >> np.uint64(11)).astype(np.float64) / float(1 << 53) * 2.0 - 1.0
+    return (scale * w.mean(axis=-1)).astype(np.float32)
+
+
+def planted_criteo(batch_size: int, *, id_space: int = 1 << 15,
+                   num_fields: int = 8, seed: int = 0, alpha: float = 1.05,
+                   steps: Optional[int] = None, scale: float = 8.0,
+                   label_seed: int = 1, ids_dtype=np.int32) -> Iterator[Dict]:
+    """Criteo-like stream with a PLANTED id-conditional signal (the reference
+    validates its benchmark models by AUC on real Criteo,
+    `test/benchmark/criteo_deepctr.py`; real terabytes don't fit a test
+    battery, so this generator makes held-out AUC a regression metric with a
+    KNOWN optimum): ids are Zipfian like `synthetic_criteo`, labels are
+    Bernoulli(sigmoid(planted_logit(ids))). Any model containing a per-id
+    linear term (LR, W&D, DeepFM first order) can represent the true scorer
+    exactly, so its held-out AUC must approach `planted_logit`'s own — see
+    `tests/test_planted_auc.py`."""
+    rng = np.random.default_rng(seed)
+    it = itertools.count() if steps is None else range(steps)
+    for _ in it:
+        u = rng.random((batch_size, num_fields))
+        raw = np.floor(np.clip(u ** (-1.0 / (alpha - 1.0)), 1.0, 2.0 ** 62)
+                       ).astype(np.int64)
+        fields = np.broadcast_to(np.arange(num_fields, dtype=np.uint64),
+                                 (batch_size, num_fields))
+        ids64 = hash_category(raw.astype(np.uint64), fields, id_space)
+        logit = planted_logit(ids64, seed=label_seed, scale=scale)
+        labels = (rng.random(batch_size) < 1.0 / (1.0 + np.exp(-logit))
+                  ).astype(np.float32)
+        if ids_dtype == "pair":
+            from ..ops.id64 import np_split_ids
+            ids = np_split_ids(ids64)
+        else:
+            ids = ids64.astype(ids_dtype)
+        yield {"sparse": {"categorical": ids}, "dense": None, "label": labels}
+
+
 def _rows_concat(a: Dict, b: Dict) -> Dict:
     out = {"sparse": {k: np.concatenate([a["sparse"][k], b["sparse"][k]])
                       for k in a["sparse"]},

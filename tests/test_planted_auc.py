@@ -1,0 +1,108 @@
+"""Statistical correctness at scale: held-out AUC against a KNOWN optimum.
+
+The reference validates its benchmark models by AUC on real Criteo
+(`test/benchmark/criteo_deepctr.py`, `documents/en/benchmark.md:41-56`); a test
+battery cannot ship terabytes, so `data.planted_criteo` plants a deterministic
+id-conditional signal and `data.planted_logit` IS the generative model's own
+scorer — its held-out AUC is the Bayes-optimal target. Any model with a per-id
+linear term (LR, W&D, DeepFM's first order) can represent the true scorer, so
+after ~10^6 training rows its held-out AUC must land within tolerance of the
+oracle's. This replaces eyeballing loss curves with a regression metric: a
+sparse-path bug (dropped gradients, mis-routed rows, broken dedup) shows up as
+an AUC gap long before it breaks shape checks."""
+
+import numpy as np
+import pytest
+
+import jax
+
+import openembedding_tpu as embed
+from openembedding_tpu.data import planted_criteo, planted_logit
+from openembedding_tpu.model import Trainer
+from openembedding_tpu.models import make_deepfm, make_lr, make_wdl
+from openembedding_tpu.utils.metrics import auc
+
+VOCAB = 1 << 15
+BATCH = 512
+STEPS_PER_EPOCH = 200
+EPOCHS = 10  # ~1.02M training rows
+
+
+@pytest.fixture(scope="module")
+def heldout():
+    batches = list(planted_criteo(BATCH, steps=20, seed=999))
+    labels = np.concatenate([b["label"] for b in batches])
+    true_logits = np.concatenate(
+        [planted_logit(b["sparse"]["categorical"].astype(np.int64), seed=1)
+         for b in batches])
+    oracle = auc(labels, true_logits)
+    # the planted signal itself must be strong and deterministic
+    assert 0.82 < oracle < 0.84, oracle
+    return batches, labels, oracle
+
+
+def _train_and_score(model, heldout, epochs=EPOCHS):
+    batches_h, labels, _ = heldout
+    trainer = Trainer(model, embed.Adam(learning_rate=0.02))
+    state = None
+    many = trainer.jit_train_many()
+    for epoch in range(epochs):
+        batches = list(planted_criteo(BATCH, steps=STEPS_PER_EPOCH,
+                                      seed=epoch))
+        stacked = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *batches)
+        if state is None:
+            state = trainer.init(batches[0])
+        state, m = many(state, stacked)
+    assert np.isfinite(np.asarray(m["loss"])).all()
+    ev = trainer.jit_eval_step()
+    scores = np.concatenate(
+        [np.asarray(ev(state, b)["logits"]).reshape(-1) for b in batches_h])
+    return auc(labels, scores)
+
+
+def test_lr_reaches_planted_optimum(heldout):
+    _, _, oracle = heldout
+    got = _train_and_score(make_lr(vocabulary=VOCAB), heldout)
+    assert got > oracle - 0.03, (got, oracle)
+
+
+def test_wdl_reaches_planted_optimum(heldout):
+    _, _, oracle = heldout
+    got = _train_and_score(
+        make_wdl(vocabulary=VOCAB, dim=8, hidden=(64, 32)), heldout)
+    assert got > oracle - 0.03, (got, oracle)
+
+
+def test_deepfm_reaches_planted_optimum(heldout):
+    _, _, oracle = heldout
+    got = _train_and_score(
+        make_deepfm(vocabulary=VOCAB, dim=8, hidden=(64, 32)), heldout)
+    # the FM/deep tower takes longer to stop fighting the linear term;
+    # measured 0.802 vs oracle 0.830 at 1M rows (PERF.md round 4)
+    assert got > oracle - 0.035, (got, oracle)
+
+
+def test_mesh_trainer_reaches_planted_optimum(heldout):
+    """The sharded exchange protocol trains to the same statistical quality:
+    8-device mesh, fused dedup+routing, all_to_all pull/push."""
+    from openembedding_tpu.parallel import MeshTrainer, make_mesh
+
+    batches_h, labels, oracle = heldout
+    trainer = MeshTrainer(make_lr(vocabulary=VOCAB),
+                          embed.Adam(learning_rate=0.02), mesh=make_mesh())
+    state = None
+    many = None
+    for epoch in range(EPOCHS):
+        batches = list(planted_criteo(BATCH, steps=STEPS_PER_EPOCH,
+                                      seed=epoch))
+        stacked = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *batches)
+        if state is None:
+            state = trainer.init(batches[0])
+            many = trainer.jit_train_many(stacked, state)
+        state, m = many(state, stacked)
+    assert np.isfinite(np.asarray(m["loss"])).all()
+    ev = trainer.jit_eval_step(batches_h[0], state)
+    scores = np.concatenate(
+        [np.asarray(ev(state, b)["logits"]).reshape(-1) for b in batches_h])
+    got = auc(labels, scores)
+    assert got > oracle - 0.03, (got, oracle)
