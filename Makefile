@@ -1,0 +1,43 @@
+# CI entry points (reference ships build+test automation,
+# /root/reference/.github/workflows/build.yml; this is the TPU-native repo's
+# equivalent — `.github/workflows/ci.yml` calls these same targets).
+#
+# Everything runs on an 8-virtual-device CPU mesh: the root conftest.py flips
+# JAX to the cpu backend before it initializes, so no TPU (or axon relay) is
+# needed. `make ci` is the one command that must stay green.
+
+PY ?= python
+# `-u PALLAS_AXON_POOL_IPS`: on hosts with a tunneled TPU (this image), every
+# interpreter otherwise performs the accelerator handshake at startup — CPU
+# targets must never touch it (tests/conftest.py does the same for pytest).
+CPU_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+	XLA_FLAGS=--xla_force_host_platform_device_count=8
+
+.PHONY: ci test dryrun bench-smoke native
+
+ci: test dryrun bench-smoke
+
+# the full battery (mesh collectives, serving HA processes, persist crash
+# consistency, planted-signal AUC regression, keras parity, ...)
+test:
+	$(PY) -m pytest tests/ -q
+
+# the driver's multi-chip validation: jit + execute full train steps (DP +
+# row-sharded tables + all_to_all, packed scan, 63-bit ids, host-cached scan,
+# ring-attention CP) over an 8-device mesh
+dryrun:
+	$(CPU_ENV) $(PY) -c "import __graft_entry__ as g; \
+	fn, args = g.entry(); import jax; out = jax.jit(fn)(*args); \
+	print('entry OK, loss', float(out['loss'])); g.dryrun_multichip(8)"
+
+# the benchmark harness end to end on tiny shapes (measures nothing — proves
+# the suite runs and emits its one-line JSON contract)
+bench-smoke:
+	$(CPU_ENV) OETPU_BENCH_SCAN_STEPS=3 OETPU_BENCH_REPEATS=1 \
+	OETPU_BENCH_VOCAB=65536 OETPU_BENCH_BUDGET_S=480 $(PY) bench.py
+
+# build the native data-path extension explicitly (the package also builds it
+# on demand at import; this target surfaces compiler errors directly)
+native:
+	$(CPU_ENV) $(PY) -c "from openembedding_tpu import native; \
+	native.build(); print('native extension OK')"

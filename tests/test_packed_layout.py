@@ -296,6 +296,36 @@ def test_packed_scan_compiles_one_scatter_per_table():
             f"inside the scan (packed table is {packed_bytes})")
 
 
+def test_packed_scan_dim64_split_first_order_one_scatter_each():
+    """The dim-64 benchmark configuration (VERDICT r3 weak #4): split
+    first-order auto-engages at lane-multiple dims, so train_many packs BOTH
+    tables — categorical 64+64 -> (V, 128) lane-exact, first_order 1+1 ->
+    (V, 2) sublane — and each updates through ONE packed scatter with no
+    split-shape scatters left. The on-chip HBM claim (no 128-lane-padded temp
+    copy of the table at width 128) is probed by `tools/dim64_probe.py` on
+    real TPU; this pins the program STRUCTURE on any backend."""
+    import re
+
+    V = 1 << 14
+    model = make_deepfm(vocabulary=V, dim=64)
+    assert set(model.specs) == {"categorical", "first_order"}
+    tr = Trainer(model, embed.Adagrad(learning_rate=0.05))
+    batches = list(synthetic_criteo(256, id_space=V, steps=2, seed=1))
+    stacked = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *batches)
+    state = tr.init(batches[0])
+    assert set(tr._packed_layouts(state)) == {"categorical", "first_order"}
+    compiled = jax.jit(tr.train_many, donate_argnums=(0,)).lower(
+        state, stacked).compile()
+
+    txt = compiled.as_text()
+    cat = len(re.findall(rf"= f32\[{V},128\]\S* scatter\(", txt))
+    fo = len(re.findall(rf"= f32\[{V},2\]\S* scatter\(", txt))
+    split = len(re.findall(rf"= f32\[{V},(?:64|65|1)\]\S* scatter\(", txt))
+    assert cat == 1, f"expected 1 packed categorical scatter, found {cat}"
+    assert fo == 1, f"expected 1 packed first-order scatter, found {fo}"
+    assert split == 0, f"split-layout scatters reappeared: {split}"
+
+
 def test_seq_mesh_train_many_packed_matches_step_loop():
     """SeqMeshTrainer (context parallelism) inherits the packed scan hooks:
     a SASRec with a packable item table (dim 16 + Adagrad accum = 32) runs

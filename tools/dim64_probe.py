@@ -1,0 +1,74 @@
+"""On-chip HBM probe for the dim-64 packed benchmark configuration.
+
+Round-3 finding (PERF.md "dim-64 single-chip HBM budget"): XLA's TPU gather
+lowering for row widths in (32, 128) materializes a 128-lane-padded 2.0x temp
+copy of the whole table, which is why the dim64 bench case runs 2^23 rows.
+The split first-order layout makes the packed categorical table exactly
+(V, 64+64=128) — lane-exact, so the padded copy should vanish. This probe
+compiles the REAL bench program (train_many on make_deepfm(dim=64)) for the
+attached TPU and prints `memory_analysis()`: run it in a relay up-window and
+record temp_size vs table size in PERF.md.
+
+Usage (needs the real chip):  python tools/dim64_probe.py [--vocab LOG2]
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--vocab", type=int, default=23,
+                    help="log2 table rows (default 23 = the bench case)")
+    ap.add_argument("--batch", type=int, default=4096)
+    ap.add_argument("--steps", type=int, default=8)
+    args = ap.parse_args()
+
+    import jax
+
+    import openembedding_tpu as embed
+    from openembedding_tpu.data import synthetic_criteo
+    from openembedding_tpu.model import Trainer
+    from openembedding_tpu.models import make_deepfm
+
+    V = 1 << args.vocab
+    print(f"platform={jax.devices()[0].platform} vocab=2^{args.vocab}",
+          flush=True)
+    model = make_deepfm(vocabulary=V, dim=64)
+    tr = Trainer(model, embed.Adagrad(learning_rate=0.05))
+    batches = list(synthetic_criteo(args.batch, id_space=V, steps=args.steps,
+                                    seed=1, ids_dtype=np.int32))
+    stacked = jax.device_put(jax.tree_util.tree_map(
+        lambda *xs: np.stack(xs), *batches))
+    state = tr.init(batches[0])
+    layouts = tr._packed_layouts(state)
+    print(f"packed layouts: { {k: v for k, v in layouts.items()} }", flush=True)
+    compiled = jax.jit(tr.train_many, donate_argnums=(0,)).lower(
+        state, stacked).compile()
+    ma = compiled.memory_analysis()
+    table_bytes = V * 128 * 4
+    print(f"table (packed, V x 128 f32): {table_bytes / 2**30:.2f} GiB")
+    if ma is None:
+        print("memory_analysis() unavailable on this backend", flush=True)
+        return 1
+    for f in ("temp_size_in_bytes", "argument_size_in_bytes",
+              "output_size_in_bytes", "alias_size_in_bytes"):
+        v = getattr(ma, f, None)
+        if v is not None:
+            print(f"{f}: {v / 2**30:.3f} GiB")
+    ratio = ma.temp_size_in_bytes / table_bytes
+    print(f"temp/table ratio: {ratio:.2f} "
+          f"({'NO padded table copy' if ratio < 1.0 else 'TABLE-SIZED TEMP PRESENT'})")
+    # run one dispatch so the number is a real program, not just a compile
+    state, m = compiled(state, stacked)
+    print(f"executed: loss={float(np.asarray(m['loss'])[-1]):.4f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
