@@ -61,3 +61,6 @@ from .checkpoint import save_server_model, load_server_model
 from . import persist
 from .persist import (AsyncPersister, IncrementalPersister, PersistPolicy,
                       persist_server_model, restore_server_model)
+# keras_compat (from_keras_model / import-hook inject) is imported lazily:
+# it needs keras, whose backend is fixed at first import — see
+# openembedding_tpu/keras_compat.py and openembedding_tpu/inject.py

@@ -1,0 +1,228 @@
+"""Import-hook auto-injection: run an UNMODIFIED Keras training script on this
+framework's sharded TPU tables.
+
+    python -m openembedding_tpu.inject your_keras_script.py [args...]
+
+The reference ships this as an interpreter-startup monkeypatch
+(`laboratory/inject/openembedding_inject_tensorflow.py:11-40` swaps
+`tf.keras.layers.Embedding`/`Model`/every optimizer class inside
+`sitecustomize.py`, gated by an env var) so that scripts written against plain
+Keras train their embeddings on the parameter servers. The TPU-native
+equivalent needs no class swaps: Keras 3 on the JAX backend already traces
+into XLA, so this runner only (a) forces `KERAS_BACKEND=jax` before the user
+script imports keras and (b) wraps `keras.Model.fit` — when the compiled model
+contains Embedding layers, fit converts it with `keras_compat.from_keras_model`
+(tables become shardable/hashable framework tables, the dense remainder stays
+the user's own Keras graph) and drives the jitted Trainer; trained weights are
+written back into the live Keras variables so `predict()`/`save()` behave as
+the script expects. Models without Embedding layers fall through to native
+Keras fit untouched.
+
+Scope (documented, like the reference's laboratory status): numpy/array `x`
+(dict keyed by input name, single array, or list in `model.inputs` order),
+array `y`, `batch_size`/`epochs`/`shuffle`; `OETPU_INJECT_MESH=1` trains
+data-parallel + row-sharded over every visible device (MeshTrainer) instead
+of single-device.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Any, Dict
+
+
+def _as_input_dict(model, x) -> Dict[str, Any]:
+    import numpy as np
+    names = [t.name for t in model.inputs]
+    if isinstance(x, dict):
+        missing = [n for n in names if n not in x]
+        if missing:
+            raise ValueError(f"fit(x=dict) is missing inputs {missing}")
+        return {n: np.asarray(x[n]) for n in names}
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    if len(xs) != len(names):
+        raise ValueError(
+            f"fit got {len(xs)} input arrays for {len(names)} model inputs")
+    return {n: np.asarray(v) for n, v in zip(names, xs)}
+
+
+_SUPPORTED_DEFAULTS = {"callbacks": None, "validation_split": 0.0,
+                       "validation_data": None, "class_weight": None,
+                       "sample_weight": None, "initial_epoch": 0,
+                       "steps_per_epoch": None, "validation_steps": None,
+                       "validation_batch_size": None, "validation_freq": 1}
+
+
+def _fit_via_framework(model, x, y, *, batch_size=32, epochs=1, shuffle=True,
+                       verbose="auto", **unsupported):
+    import numpy as np
+
+    import openembedding_tpu as embed
+    from .keras_compat import (KerasDenseModule, export_keras_rows,
+                               from_keras_model, import_keras_rows)
+    from .model import Trainer
+
+    # reject ANY fit option this path cannot honor — silently ignoring
+    # class_weight / validation_split / ... would change results vs Keras
+    for key, value in unsupported.items():
+        default = _SUPPORTED_DEFAULTS.get(key, object())
+        harmless = (value == default
+                    or (not value and default in (None, 0.0, 0)))
+        if not harmless:
+            raise ValueError(
+                f"inject fit does not support {key}={value!r}; call keras "
+                "fit directly (model without Embedding layers) or use the "
+                "Trainer API")
+    if batch_size is None:
+        batch_size = 32  # the keras default
+
+    emodel, opt = from_keras_model(model)
+    if opt is None:
+        raise ValueError("model.compile(optimizer=...) before fit")
+    if os.environ.get("OETPU_INJECT_DEBUG"):
+        print(f"[inject] routing fit through the framework trainer "
+              f"(tables: {sorted(emodel.ps_specs())})", file=sys.stderr,
+              flush=True)
+    use_mesh = os.environ.get("OETPU_INJECT_MESH") == "1"
+    if use_mesh:
+        from .parallel import MeshTrainer
+        trainer = MeshTrainer(emodel, opt)
+    else:
+        trainer = Trainer(emodel, opt)
+
+    inputs = _as_input_dict(model, x)
+    y = np.asarray(y).reshape(-1).astype(np.float32)
+    n = y.shape[0]
+    sparse_feats = {s.feature_name for s in emodel.ps_specs().values()} | \
+                   {s.feature_name for s in emodel.sad_specs().values()}
+    dense_names = [k for k in inputs if k not in sparse_feats]
+
+    def batch_of(idx):
+        """Fixed-size batch: a trailing partial batch pads to batch_size with
+        weight-0 rows (Keras trains the tail too; padding keeps ONE compiled
+        step and the weighted loss matches Keras's mean over the real rows)."""
+        pad = batch_size - idx.size
+        if pad:
+            idx = np.concatenate([idx, np.zeros((pad,), idx.dtype)])
+        weight = np.ones((batch_size,), np.float32)
+        if pad:
+            weight[-pad:] = 0.0
+        sparse = {f: inputs[f][idx].astype(np.int32) for f in sparse_feats}
+        if not dense_names:
+            dense = None
+        elif len(dense_names) == 1:
+            dense = inputs[dense_names[0]][idx].astype(np.float32)
+        else:
+            dense = {k: inputs[k][idx].astype(np.float32)
+                     for k in dense_names}
+        return {"sparse": sparse, "dense": dense, "label": y[idx],
+                "weight": weight}, batch_size - pad
+
+    if use_mesh:
+        import warnings
+        warnings.warn(
+            "OETPU_INJECT_MESH=1: pre-set Keras embedding rows are NOT "
+            "imported into the sharded tables (training starts from fresh "
+            "init); warm starts need the Trainer/checkpoint API",
+            RuntimeWarning)
+
+    state = None
+    step = None
+    rng = np.random.default_rng(0)
+    history = {"loss": []}
+    for epoch in range(epochs):
+        order = rng.permutation(n) if shuffle else np.arange(n)
+        losses, counts = [], []
+        for start in range(0, n, batch_size):
+            b, real = batch_of(order[start:start + batch_size])
+            if state is None:
+                state = trainer.init(b)
+                state = import_keras_rows(trainer, state, model) \
+                    if not use_mesh else state
+                step = (trainer.jit_train_step(b, state) if use_mesh
+                        else trainer.jit_train_step())
+            state, m = step(state, b)
+            losses.append(float(m["loss"]))
+            counts.append(real)
+        history["loss"].append(float(np.average(losses, weights=counts)))
+        if verbose:
+            print(f"[inject] epoch {epoch + 1}/{epochs} "
+                  f"loss {history['loss'][-1]:.4f}", flush=True)
+
+    if state is not None:
+        # make the user's Keras object serve what was trained
+        module = emodel.module
+        assert isinstance(module, KerasDenseModule)
+        module.write_back(state.dense_params)
+        if not use_mesh:
+            export_keras_rows(trainer, state, model)
+        else:
+            import warnings
+            warnings.warn(
+                "OETPU_INJECT_MESH=1: sharded table rows are not written "
+                "back into the Keras Embedding variables; save them with "
+                "the Trainer/checkpoint API", RuntimeWarning)
+
+    class _History:
+        pass
+
+    h = _History()
+    h.history = history
+    h.model = model
+    return h
+
+
+def install() -> None:
+    """Wrap keras.Model.fit: embedding-bearing models train through this
+    framework, everything else falls through to native Keras."""
+    import keras
+
+    from .keras_compat import _require_jax_backend
+
+    _require_jax_backend(keras)
+    native_fit = keras.Model.fit
+    # Keras 3 fit's positional parameter order after (x, y) — bound here so
+    # scripts calling fit positionally (m.fit(x, y, 64)) keep working
+    fit_pos = ("batch_size", "epochs", "verbose", "callbacks",
+               "validation_split", "validation_data", "shuffle",
+               "class_weight", "sample_weight", "initial_epoch",
+               "steps_per_epoch")
+
+    def fit(self, x=None, y=None, *args, **kw):
+        for name, value in zip(fit_pos, args):
+            if name in kw:
+                raise TypeError(f"fit() got multiple values for {name!r}")
+            kw[name] = value
+        has_embedding = any(isinstance(l, keras.layers.Embedding)
+                            for l in getattr(self, "layers", []))
+        if not has_embedding or not getattr(self, "inputs", None):
+            return native_fit(self, x=x, y=y, **kw)
+        return _fit_via_framework(self, x, y, **kw)
+
+    keras.Model.fit = fit
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv:
+        print("usage: python -m openembedding_tpu.inject script.py [args...]",
+              file=sys.stderr)
+        return 2
+    if "keras" in sys.modules:
+        import keras as _k
+        if _k.config.backend() != "jax":
+            print("inject: keras was already imported with the "
+                  f"{_k.config.backend()!r} backend; start a fresh "
+                  "interpreter", file=sys.stderr)
+            return 2
+    os.environ["KERAS_BACKEND"] = "jax"
+    install()
+    import runpy
+    sys.argv = argv
+    runpy.run_path(argv[0], run_name="__main__")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

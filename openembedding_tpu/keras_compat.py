@@ -1,0 +1,263 @@
+"""Whole-model Keras conversion: an unmodified Keras CTR model trains on the
+sharded TPU tables.
+
+The reference's `distributed_model()` clone-replaces `tf.keras.layers.Embedding`
+with its PS-backed layer inside a live Keras graph (`tensorflow/exb.py:593-642`)
+so existing Keras scripts gain distributed embeddings without a rewrite; its
+laboratory goes one further and monkeypatches the Keras classes at interpreter
+startup (`laboratory/inject/openembedding_inject_tensorflow.py:11-40`). The
+TPU-native equivalent uses Keras 3's JAX backend: the functional graph is
+SLICED at every Embedding output, the dense remainder becomes its own Keras
+model whose `stateless_call` is pure and traces straight into our jitted train
+step, and the Embedding layers become `EmbeddingSpec`s backed by this
+framework's (shardable, hashable, offloadable) tables.
+
+    model = keras.Model(...)            # plain Keras, Embedding layers inside
+    emodel, opt = from_keras_model(model, keras_optimizer)
+    trainer = Trainer(emodel, opt)      # or MeshTrainer: same object
+
+Constraints (explicit, checked):
+- `keras.config.backend() == "jax"` (set KERAS_BACKEND=jax before importing
+  keras; the TF/torch backends cannot trace into an XLA train step);
+- each Embedding layer is fed DIRECTLY by a model `Input` (id preprocessing
+  belongs in the input pipeline — the reference's layer has the same shape:
+  ids in, rows out);
+- the dense remainder has no non-trainable variables (BatchNorm-style state
+  does not fit the stateless dense path yet);
+- each Embedding layer is applied once (no shared-layer call sites).
+
+Batch convention after conversion: sparse ids keyed by the FEEDING INPUT's
+name, one "dense" entry (array for a single non-embedding input, dict of
+arrays keyed by input name for several).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from .embedding import Embedding as OEmbedding
+from .model import EmbeddingModel, binary_logloss
+from .optimizers import SparseOptimizer, from_keras as optimizer_from_keras
+from .initializers import from_keras as initializer_from_keras
+
+
+def _require_jax_backend(keras):
+    if keras.config.backend() != "jax":
+        raise RuntimeError(
+            "from_keras_model needs the Keras JAX backend: set "
+            "KERAS_BACKEND=jax in the environment BEFORE importing keras "
+            f"(current backend: {keras.config.backend()!r})")
+
+
+def prob_logloss(probs, labels, weight=None):
+    """Binary cross-entropy on PROBABILITIES (a Keras tower usually ends in
+    `Dense(1, activation='sigmoid')`; our native models emit logits)."""
+    p = jnp.clip(probs.reshape(-1), 1e-7, 1 - 1e-7)
+    y = labels.reshape(-1).astype(p.dtype)
+    per = -(y * jnp.log(p) + (1 - y) * jnp.log1p(-p))
+    if weight is None:
+        return jnp.mean(per)
+    w = weight.reshape(-1).astype(per.dtype)
+    return jnp.sum(per * w) / jnp.maximum(jnp.sum(w), 1.0)
+
+
+def mse_loss(pred, labels, weight=None):
+    """Mean squared error (regression heads compiled with loss='mse')."""
+    d = pred.reshape(-1) - labels.reshape(-1).astype(pred.dtype)
+    per = d * d
+    if weight is None:
+        return jnp.mean(per)
+    w = weight.reshape(-1).astype(per.dtype)
+    return jnp.sum(per * w) / jnp.maximum(jnp.sum(w), 1.0)
+
+
+def loss_from_keras(loss) -> Any:
+    """Translate a compiled Keras loss (string or instance) to a framework
+    loss fn; raises on losses the converter cannot honor — silently training
+    a DIFFERENT objective than the user compiled would be worse than failing."""
+    name = loss if isinstance(loss, str) else type(loss).__name__
+    canon = str(name).lower()
+    from_logits = bool(getattr(loss, "from_logits", False))
+    if "binary" in canon and ("crossentropy" in canon or "cross_entropy"
+                              in canon):
+        return binary_logloss if from_logits else prob_logloss
+    if canon in ("mse", "mean_squared_error", "meansquarederror"):
+        return mse_loss
+    raise ValueError(
+        f"compiled loss {loss!r} is not supported by the Keras converter "
+        "(supported: binary_crossentropy with or without from_logits, mse); "
+        "pass loss_fn= to from_keras_model explicitly")
+
+
+class KerasDenseModule:
+    """Adapter giving the sliced dense Keras model the flax-module surface the
+    Trainer drives (`init(key, embedded, dense)` / `apply({'params': ...})`).
+    Params are a dict {v<i>: array} in the model's trainable-variable order —
+    a plain pytree, so the Trainer's dense optimizer path and checkpointing
+    treat it like any flax tree."""
+
+    def __init__(self, dense_model, input_kinds: List[Tuple[str, str]]):
+        # input_kinds: [(kind, name)] in dense_model.inputs order, where kind
+        # is "emb" (name = embedding layer name) or "dense" (name = input name)
+        self.dense_model = dense_model
+        self.input_kinds = input_kinds
+
+    def _params_now(self) -> Dict[str, Any]:
+        # COPIES, not the live buffers: the Trainer's jitted step donates its
+        # state, and donating the Keras variables' own arrays would delete
+        # them out from under the user's model ("Array has been deleted")
+        return {f"v{i}": jnp.array(v.value, copy=True)
+                for i, v in enumerate(self.dense_model.trainable_variables)}
+
+    def init(self, key, embedded, dense_inputs):
+        del key, embedded, dense_inputs  # the Keras model is already built
+        return {"params": self._params_now()}
+
+    def _assemble(self, embedded, dense_inputs):
+        args = []
+        for kind, name in self.input_kinds:
+            if kind == "emb":
+                args.append(embedded[name])
+            elif isinstance(dense_inputs, dict):
+                args.append(jnp.asarray(dense_inputs[name]))
+            else:
+                args.append(jnp.asarray(dense_inputs))
+        return args
+
+    def apply(self, variables, embedded, dense_inputs):
+        params = variables["params"]
+        tv = [params[f"v{i}"] for i in range(len(params))]
+        outs, _ = self.dense_model.stateless_call(
+            tv, [], self._assemble(embedded, dense_inputs))
+        out = outs[0] if isinstance(outs, (list, tuple)) else outs
+        return out.reshape(out.shape[0])
+
+    def write_back(self, params: Dict[str, Any]) -> None:
+        """Push trained values into the live Keras variables (so the user's
+        model.predict()/save() reflect the training — the reference's
+        converted model stays a usable Keras model the same way)."""
+        for i, v in enumerate(self.dense_model.trainable_variables):
+            v.assign(np.asarray(params[f"v{i}"]))
+
+
+def from_keras_model(model, optimizer=None, *,
+                     loss_fn=None) -> Tuple[EmbeddingModel,
+                                            Optional[SparseOptimizer]]:
+    """Convert a built Keras model with Embedding layers into an
+    `EmbeddingModel` (+ translated optimizer when one is given — a Keras
+    optimizer instance or the model's compiled one).
+
+    The embedding tables start from each layer's own initializer; use
+    `import_keras_rows` to carry over already-trained rows."""
+    import keras
+
+    _require_jax_backend(keras)
+    if not getattr(model, "inputs", None):
+        raise ValueError("the Keras model must be built/functional "
+                         "(Sequential models: call it once or pass an Input)")
+
+    emb_layers = [l for l in model.layers
+                  if isinstance(l, keras.layers.Embedding)]
+    if not emb_layers:
+        raise ValueError("no keras.layers.Embedding layers to convert")
+
+    input_by_tensor = {id(t): t for t in model.inputs}
+    embeddings = []
+    emb_outputs = []
+    emb_input_names = set()
+    for layer in emb_layers:
+        nodes = getattr(layer, "_inbound_nodes", [])
+        if len(nodes) != 1:
+            raise ValueError(
+                f"Embedding layer {layer.name!r} has {len(nodes)} call "
+                "sites; shared embedding layers are not convertible")
+        (src,) = nodes[0].input_tensors
+        if id(src) not in input_by_tensor:
+            raise ValueError(
+                f"Embedding layer {layer.name!r} must be fed directly by a "
+                "model Input (found an intermediate tensor); move id "
+                "preprocessing into the input pipeline")
+        feature = src.name
+        emb_input_names.add(feature)
+        embeddings.append(OEmbedding(
+            input_dim=layer.input_dim, output_dim=layer.output_dim,
+            name=layer.name, feature=feature,
+            embeddings_initializer=initializer_from_keras(
+                layer.embeddings_initializer)))
+        emb_outputs.append(nodes[0].output_tensors[0])
+
+    dense_inputs = [t for t in model.inputs
+                    if t.name not in emb_input_names]
+    dense_model = keras.Model(emb_outputs + dense_inputs, model.outputs)
+    if dense_model.non_trainable_variables:
+        raise ValueError(
+            "the dense remainder has non-trainable variables (e.g. "
+            "BatchNorm); the stateless dense path cannot carry them yet")
+    input_kinds = ([("emb", l.name) for l in emb_layers]
+                   + [("dense", t.name) for t in dense_inputs])
+
+    if loss_fn is None:
+        compiled = getattr(model, "loss", None)
+        if compiled is not None:
+            loss_fn = loss_from_keras(compiled)
+        else:
+            # uncompiled model: infer from the output head's activation
+            last = model.layers[-1]
+            act = getattr(last, "activation", None)
+            sigmoid = getattr(keras.activations, "sigmoid", None)
+            loss_fn = prob_logloss if act is sigmoid else binary_logloss
+
+    emodel = EmbeddingModel(
+        KerasDenseModule(dense_model, input_kinds), embeddings,
+        loss_fn=loss_fn)
+
+    opt = None
+    if optimizer is not None:
+        opt = optimizer_from_keras(optimizer)
+    elif getattr(model, "optimizer", None) is not None:
+        opt = optimizer_from_keras(model.optimizer)
+    return emodel, opt
+
+
+def import_keras_rows(trainer, state, keras_model):
+    """Carry a built Keras model's embedding tables into the converted
+    trainer's table state (single-device trainers; sharded imports go through
+    a checkpoint). Returns the updated TrainState."""
+    import keras
+
+    if trainer.num_shards != 1:
+        raise ValueError("import_keras_rows is single-device; save/load a "
+                         "checkpoint to import into a mesh")
+    new_tables = dict(state.tables)
+    by_name = {l.name: l for l in keras_model.layers
+               if isinstance(l, keras.layers.Embedding)}
+    for name, spec in trainer.model.ps_specs().items():
+        layer = by_name.get(name)
+        if layer is None:
+            continue
+        rows = jnp.asarray(np.asarray(layer.embeddings), spec.dtype)
+        ts = new_tables[name]
+        if spec.use_hash_table:
+            raise ValueError(f"{name}: hash-table import not supported here")
+        new_tables[name] = ts.replace(weights=rows.astype(ts.weights.dtype))
+    return state.replace(tables=new_tables)
+
+
+def export_keras_rows(trainer, state, keras_model) -> None:
+    """The reverse: write the trained table rows back into the Keras model's
+    Embedding variables (with `KerasDenseModule.write_back` this makes the
+    original Keras object serve the trained model natively)."""
+    import keras
+
+    by_name = {l.name: l for l in keras_model.layers
+               if isinstance(l, keras.layers.Embedding)}
+    for name, spec in trainer.model.ps_specs().items():
+        layer = by_name.get(name)
+        if layer is None or spec.use_hash_table:
+            continue
+        ids = jnp.arange(spec.input_dim, dtype=jnp.int32)
+        rows = trainer.table_lookup(spec, state.tables[name], ids)
+        layer.embeddings.assign(np.asarray(rows, np.float32))

@@ -61,9 +61,13 @@ def main():
         v = getattr(ma, f, None)
         if v is not None:
             print(f"{f}: {v / 2**30:.3f} GiB")
-    ratio = ma.temp_size_in_bytes / table_bytes
-    print(f"temp/table ratio: {ratio:.2f} "
-          f"({'NO padded table copy' if ratio < 1.0 else 'TABLE-SIZED TEMP PRESENT'})")
+    temp = getattr(ma, "temp_size_in_bytes", None)
+    if temp is None:
+        print("temp_size_in_bytes unavailable on this backend", flush=True)
+    else:
+        ratio = temp / table_bytes
+        print(f"temp/table ratio: {ratio:.2f} "
+              f"({'NO padded table copy' if ratio < 1.0 else 'TABLE-SIZED TEMP PRESENT'})")
     # run one dispatch so the number is a real program, not just a compile
     state, m = compiled(state, stacked)
     print(f"executed: loss={float(np.asarray(m['loss'])[-1]):.4f}")
