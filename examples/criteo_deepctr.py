@@ -75,10 +75,17 @@ def main():
     ap.add_argument("--prefetch", action="store_true")
     ap.add_argument("--persist", default="", help="async persist root dir")
     ap.add_argument("--persist-steps", type=int, default=50)
+    ap.add_argument("--persist-incremental", action="store_true",
+                    help="dirty-window persistence: deltas proportional to "
+                         "touched rows between full bases "
+                         "(persist.IncrementalPersister; single-device)")
     ap.add_argument("--save", default="")
     ap.add_argument("--load", default="")
     ap.add_argument("--export", default="", help="standalone serving export dir")
     ap.add_argument("--report-interval", type=float, default=0.0)
+    ap.add_argument("--profile", default="", metavar="DIR",
+                    help="capture a jax.profiler trace of the train loop "
+                         "into DIR (view with xprof/tensorboard)")
     args = ap.parse_args()
 
     if args.model == "two_tower":
@@ -139,7 +146,9 @@ def main():
 
     persister = None
     if args.persist:
-        persister = embed.AsyncPersister(
+        cls = (embed.IncrementalPersister if args.persist_incremental
+               else embed.AsyncPersister)
+        persister = cls(
             trainer, model, args.persist,
             policy=embed.PersistPolicy(every_steps=args.persist_steps))
 
@@ -157,6 +166,17 @@ def main():
                 print(f"  WARNING: {name}: {ov} ids have overflowed the "
                       "hash capacity (rows dropped) — raise capacity or "
                       "capacity_factor")
+
+    import atexit
+    import contextlib
+    profile_stack = contextlib.ExitStack()
+    if args.profile:
+        import jax as _jax
+        profile_stack.enter_context(_jax.profiler.trace(args.profile))
+        # close() is idempotent: atexit finalizes the trace even when the
+        # loop dies mid-run — the run being profiled is often the broken one
+        atexit.register(profile_stack.close)
+        print(f"profiling -> {args.profile}")
 
     t0 = time.perf_counter()
     if args.scan > 1:
@@ -177,7 +197,7 @@ def main():
             window = []
             m = dict(m, loss=np.asarray(m["loss"])[-1])
             if persister is not None:
-                persister.maybe_persist(state)
+                persister.maybe_persist(state, batch=stacked)
             print(f"step {done}: loss {float(m['loss']):.4f}")
             report_overflow()
         trained = done
@@ -185,6 +205,8 @@ def main():
     else:
         state = trainer.offload_prepare(state, first)
         state, m = step(state, first)
+        if persister is not None:
+            persister.maybe_persist(state, batch=first)
         for i in range(1, args.steps):
             batch = next(batches)
             with M.vtimer("train", "step"):
@@ -194,7 +216,7 @@ def main():
             all_scores.append(np.asarray(m["logits"]).reshape(-1))
             M.record_step_stats({k: v for k, v in m.get("stats", {}).items()})
             if persister is not None:
-                persister.maybe_persist(state)
+                persister.maybe_persist(state, batch=batch)
             if i % 20 == 0:
                 print(f"step {i}: loss {float(m['loss']):.4f}")
                 report_overflow()
@@ -202,6 +224,7 @@ def main():
         mode = ""
     loss = float(m["loss"])  # fences the device work
     dt = time.perf_counter() - t0
+    profile_stack.close()
     reporter.stop()
     if persister is not None:
         persister.close()

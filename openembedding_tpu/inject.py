@@ -67,13 +67,21 @@ def _fit_via_framework(model, x, y, *, batch_size=32, epochs=1, shuffle=True,
     # class_weight / validation_split / ... would change results vs Keras
     for key, value in unsupported.items():
         default = _SUPPORTED_DEFAULTS.get(key, object())
-        harmless = (value == default
-                    or (not value and default in (None, 0.0, 0)))
-        if not harmless:
-            raise ValueError(
-                f"inject fit does not support {key}={value!r}; call keras "
-                "fit directly (model without Embedding layers) or use the "
-                "Trainer API")
+        # no `==`/truthiness on the raw value: an ndarray kwarg (e.g.
+        # sample_weight=np.ones(n)) would raise numpy's ambiguous-truth error
+        # instead of the actionable message below
+        if value is None and default is None:
+            continue
+        if isinstance(value, (int, float)) and not isinstance(value, bool) \
+                and value == default:
+            continue
+        if isinstance(value, (list, tuple, dict)) and not value \
+                and default in (None, 0.0, 0):
+            continue
+        raise ValueError(
+            f"inject fit does not support {key}={value!r}; call keras "
+            "fit directly (model without Embedding layers) or use the "
+            "Trainer API")
     if batch_size is None:
         batch_size = 32  # the keras default
 
@@ -169,7 +177,10 @@ def _fit_via_framework(model, x, y, *, batch_size=32, epochs=1, shuffle=True,
 
     h = _History()
     h.history = history
+    h.epoch = list(range(epochs))
     h.model = model
+    h.params = {"epochs": epochs, "steps": -(-n // batch_size),
+                "verbose": verbose}
     return h
 
 

@@ -163,6 +163,11 @@ def from_keras_model(model, optimizer=None, *,
                   if isinstance(l, keras.layers.Embedding)]
     if not emb_layers:
         raise ValueError("no keras.layers.Embedding layers to convert")
+    if len(model.outputs) != 1:
+        raise ValueError(
+            f"the converter supports single-output models; this one has "
+            f"{len(model.outputs)} outputs (a multi-head model would "
+            "silently train only the first head)")
 
     input_by_tensor = {id(t): t for t in model.inputs}
     embeddings = []
@@ -204,11 +209,20 @@ def from_keras_model(model, optimizer=None, *,
         if compiled is not None:
             loss_fn = loss_from_keras(compiled)
         else:
-            # uncompiled model: infer from the output head's activation
-            last = model.layers[-1]
-            act = getattr(last, "activation", None)
-            sigmoid = getattr(keras.activations, "sigmoid", None)
-            loss_fn = prob_logloss if act is sigmoid else binary_logloss
+            # uncompiled: a sigmoid head is unambiguous (binary classifier ->
+            # BCE on probabilities); anything else must be stated, not
+            # guessed — same fail-loud stance as loss_from_keras
+            out_layer = model.outputs[0]._keras_history[0] \
+                if hasattr(model.outputs[0], "_keras_history") \
+                else model.layers[-1]
+            act = getattr(out_layer, "activation", None)
+            if act is getattr(keras.activations, "sigmoid", None):
+                loss_fn = prob_logloss
+            else:
+                raise ValueError(
+                    "uncompiled model without a sigmoid output head: pass "
+                    "loss_fn= (or compile the model with a supported loss) "
+                    "so the training objective is explicit")
 
     emodel = EmbeddingModel(
         KerasDenseModule(dense_model, input_kinds), embeddings,

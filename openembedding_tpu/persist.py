@@ -135,7 +135,10 @@ class AsyncPersister:
         """reference `should_persist_server_model` (`exb.py:697-699`)."""
         return self.policy.should_persist(int(step))
 
-    def maybe_persist(self, state) -> bool:
+    def maybe_persist(self, state, batch=None) -> bool:
+        """`batch` is accepted (and ignored) so call sites can drive
+        AsyncPersister and IncrementalPersister interchangeably."""
+        del batch
         step = int(state.step)
         if not self.should_persist(step):
             return False

@@ -11,7 +11,9 @@ and appends a CSV row — partial results survive an aborted sweep.
     JAX_PLATFORMS=cpu python tools/sweep.py --smoke               # CI-sized
 
 Modes: plain (single device), mesh (all local devices, sharded tables),
-cache (sparse_as_dense dense mirror), prefetch (device-staged input).
+cache (sparse_as_dense dense mirror), prefetch (device-staged input),
+scan (K steps fused per dispatch), offload (host_cached two-tier table),
+offload_scan (both composed — union-of-K admission per window).
 """
 
 import argparse
@@ -29,13 +31,16 @@ EXAMPLE = os.path.join(REPO, "examples", "criteo_deepctr.py")
 MODE_FLAGS = {
     "plain": [],
     "mesh": ["--mesh"],
-    "cache": None,     # filled per-run: --cache <vocabulary>
+    "cache": None,        # filled per-run: --cache <vocabulary>
     "prefetch": ["--prefetch"],
+    "scan": ["--scan", "8"],
+    "offload": None,      # filled per-run: --offload <vocabulary // 4>
+    "offload_scan": None,
 }
 
 THROUGHPUT_RE = re.compile(r"([\d,]+) examples/s \(([\d,]+)/chip\)")
 AUC_RE = re.compile(r"train AUC ([\d.]+)")
-LOSS_RE = re.compile(r"trained \d+ steps, loss ([\d.]+)")
+LOSS_RE = re.compile(r"trained \d+ steps[^,]*, loss ([\d.]+)")
 
 
 def run_cell(model, dim, mode, args):
@@ -46,6 +51,11 @@ def run_cell(model, dim, mode, args):
         cmd += ["--dim", str(dim)]
     if mode == "cache":
         cmd += ["--cache", str(args.vocabulary)]
+    elif mode in ("offload", "offload_scan"):
+        # cache a quarter of the id space: flushes/evictions really happen
+        cmd += ["--offload", str(max(1024, args.vocabulary // 4))]
+        if mode == "offload_scan":
+            cmd += ["--scan", "8"]
     else:
         cmd += MODE_FLAGS[mode]
     existing = os.environ.get("PYTHONPATH")
