@@ -127,14 +127,6 @@ def _fit_via_framework(model, x, y, *, batch_size=32, epochs=1, shuffle=True,
         return {"sparse": sparse, "dense": dense, "label": y[idx],
                 "weight": weight}, batch_size - pad
 
-    if use_mesh:
-        import warnings
-        warnings.warn(
-            "OETPU_INJECT_MESH=1: pre-set Keras embedding rows are NOT "
-            "imported into the sharded tables (training starts from fresh "
-            "init); warm starts need the Trainer/checkpoint API",
-            RuntimeWarning)
-
     state = None
     step = None
     rng = np.random.default_rng(0)
@@ -146,8 +138,7 @@ def _fit_via_framework(model, x, y, *, batch_size=32, epochs=1, shuffle=True,
             b, real = batch_of(order[start:start + batch_size])
             if state is None:
                 state = trainer.init(b)
-                state = import_keras_rows(trainer, state, model) \
-                    if not use_mesh else state
+                state = import_keras_rows(trainer, state, model)
                 step = (trainer.jit_train_step(b, state) if use_mesh
                         else trainer.jit_train_step())
             state, m = step(state, b)
@@ -159,18 +150,12 @@ def _fit_via_framework(model, x, y, *, batch_size=32, epochs=1, shuffle=True,
                   f"loss {history['loss'][-1]:.4f}", flush=True)
 
     if state is not None:
-        # make the user's Keras object serve what was trained
+        # make the user's Keras object serve what was trained (mesh tables
+        # deinterleave host-side inside export_keras_rows)
         module = emodel.module
         assert isinstance(module, KerasDenseModule)
         module.write_back(state.dense_params)
-        if not use_mesh:
-            export_keras_rows(trainer, state, model)
-        else:
-            import warnings
-            warnings.warn(
-                "OETPU_INJECT_MESH=1: sharded table rows are not written "
-                "back into the Keras Embedding variables; save them with "
-                "the Trainer/checkpoint API", RuntimeWarning)
+        export_keras_rows(trainer, state, model)
 
     class _History:
         pass

@@ -237,14 +237,16 @@ def from_keras_model(model, optimizer=None, *,
 
 
 def import_keras_rows(trainer, state, keras_model):
-    """Carry a built Keras model's embedding tables into the converted
-    trainer's table state (single-device trainers; sharded imports go through
-    a checkpoint). Returns the updated TrainState."""
+    """Carry a built Keras model's embedding tables (warm starts, loaded
+    models) into the converted trainer's table state. Works on single devices
+    AND meshes: row-sharded array tables store shard-major rows
+    (id = local * S + shard), so the id-major Keras table is interleaved
+    host-side and placed with the live table's sharding. Returns the updated
+    TrainState."""
     import keras
 
-    if trainer.num_shards != 1:
-        raise ValueError("import_keras_rows is single-device; save/load a "
-                         "checkpoint to import into a mesh")
+    from .checkpoint import _np_interleave, _put_like
+
     new_tables = dict(state.tables)
     by_name = {l.name: l for l in keras_model.layers
                if isinstance(l, keras.layers.Embedding)}
@@ -252,26 +254,32 @@ def import_keras_rows(trainer, state, keras_model):
         layer = by_name.get(name)
         if layer is None:
             continue
-        rows = jnp.asarray(np.asarray(layer.embeddings), spec.dtype)
         ts = new_tables[name]
         if spec.use_hash_table:
             raise ValueError(f"{name}: hash-table import not supported here")
-        new_tables[name] = ts.replace(weights=rows.astype(ts.weights.dtype))
+        id_major = np.asarray(layer.embeddings, np.float32)
+        shard_major = _np_interleave(id_major, trainer.num_shards)
+        new_tables[name] = ts.replace(
+            weights=_put_like(shard_major, ts.weights))
     return state.replace(tables=new_tables)
 
 
 def export_keras_rows(trainer, state, keras_model) -> None:
     """The reverse: write the trained table rows back into the Keras model's
     Embedding variables (with `KerasDenseModule.write_back` this makes the
-    original Keras object serve the trained model natively)."""
+    original Keras object serve the trained model natively). Mesh tables
+    deinterleave host-side (shard-major -> id-major), so this works on any
+    single-host trainer."""
     import keras
 
     by_name = {l.name: l for l in keras_model.layers
                if isinstance(l, keras.layers.Embedding)}
+    S = trainer.num_shards
     for name, spec in trainer.model.ps_specs().items():
         layer = by_name.get(name)
         if layer is None or spec.use_hash_table:
             continue
-        ids = jnp.arange(spec.input_dim, dtype=jnp.int32)
-        rows = trainer.table_lookup(spec, state.tables[name], ids)
-        layer.embeddings.assign(np.asarray(rows, np.float32))
+        from .parallel.sharded import deinterleave_rows
+        shard_major = np.asarray(state.tables[name].weights, np.float32)
+        layer.embeddings.assign(
+            np.asarray(deinterleave_rows(shard_major, S, spec.input_dim)))

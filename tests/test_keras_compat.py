@@ -185,6 +185,12 @@ def test_inject_mesh_trains(tmp_path):
         losses = h.history["loss"]
         assert losses[-1] < losses[0] * 0.7, losses
         print("MESH_FIT_OK", round(losses[0], 4), "->", round(losses[-1], 4))
+        # sharded rows deinterleave back into the Keras variables: the user's
+        # own predict() reflects the mesh training
+        p = np.asarray(m(ids)).reshape(-1)
+        acc = float(((p > 0.5) == (y > 0.5)).mean())
+        assert acc > 0.85, acc
+        print("MESH_PREDICT_OK", round(acc, 3))
     """))
     env = {k: v for k, v in os.environ.items()
            if k not in ("PALLAS_AXON_POOL_IPS",)}
@@ -196,6 +202,7 @@ def test_inject_mesh_trains(tmp_path):
         capture_output=True, text=True, timeout=600, env=env)
     assert p.returncode == 0, f"STDOUT:\n{p.stdout}\nSTDERR:\n{p.stderr}"
     assert "MESH_FIT_OK" in p.stdout
+    assert "MESH_PREDICT_OK" in p.stdout
 
 
 def test_inject_fit_edge_semantics(tmp_path):
@@ -265,3 +272,39 @@ def test_inject_fit_edge_semantics(tmp_path):
     for marker in ("POSITIONAL_AND_PARTIAL_OK", "SMALL_N_OK",
                    "UNSUPPORTED_KWARG_OK", "MSE_OK", "LOSS_GUARD_OK"):
         assert marker in out, out
+
+
+def test_mesh_import_forward_parity():
+    """Warm-start on a mesh: the Keras table interleaves into the row-sharded
+    layout and the converted model predicts EXACTLY what Keras predicts
+    before any training."""
+    out = _run("""
+        import numpy as np, keras
+        import openembedding_tpu as embed
+        from openembedding_tpu.keras_compat import (from_keras_model,
+            import_keras_rows)
+        from openembedding_tpu.parallel import MeshTrainer, make_mesh
+
+        V = 500  # not a multiple of 8: exercises the interleave padding
+        cat = keras.Input(shape=(4,), dtype="int32", name="cat")
+        emb = keras.layers.Embedding(V, 8, name="emb1")(cat)
+        x = keras.layers.Flatten()(emb)
+        out = keras.layers.Dense(1, activation="sigmoid")(x)
+        m = keras.Model(cat, out)
+
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, V, (64, 4)).astype(np.int32)
+        y = rng.integers(0, 2, (64,)).astype(np.float32)
+        batch = {"sparse": {"cat": ids}, "dense": None, "label": y}
+
+        emodel, _ = from_keras_model(m)
+        tr = MeshTrainer(emodel, embed.SGD(learning_rate=0.1),
+                         mesh=make_mesh())
+        state = tr.init(batch)
+        state = import_keras_rows(tr, state, m)
+        got = np.asarray(tr.jit_eval_step(batch, state)(state, batch)["logits"])
+        want = np.asarray(m(ids)).reshape(-1)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+        print("MESH_IMPORT_PARITY_OK")
+    """)
+    assert "MESH_IMPORT_PARITY_OK" in out
