@@ -95,6 +95,11 @@ def bucket_by_owner(ids: jax.Array, valid: jax.Array, num_shards: int,
     capacity are counted in `overflow` and dropped (the reference's dynamic buffers
     can't overflow; static XLA shapes can — callers size capacity via config and tests
     use capacity == n for exactness).
+
+    NOTE: empty bucket slots are ZERO-filled here with `bucket_valid` as the
+    mask; `unique_and_route` (the fused hot path) instead sentinel-fills so
+    validity is derivable from the ids alone — do not apply `bucket_validity`
+    to THIS function's output.
     """
     n = ids.shape[0]
     if ids.ndim == 2:  # split-pair layout: owner via modular pair arithmetic
@@ -191,14 +196,30 @@ def unique_and_route(ids: jax.Array, valid: jax.Array, num_shards: int,
     overflow = jnp.sum((u_owner < S) & (slot_u >= capacity)).astype(jnp.int32)
     flat_pos = jnp.where(in_cap, u_owner * capacity + slot_u, S * capacity)
     lanes = ids.shape[1:]
-    bucket_ids = jnp.zeros((S * capacity,) + lanes, ids.dtype).at[flat_pos].set(
+    # empty bucket slots hold the EMPTY sentinel, NOT zero (id 0 is a real
+    # id): validity is then a pure function of the id payload, so the
+    # exchange ships ONE all_to_all of ids instead of ids + a bool mask
+    # (`bucket_validity`), and the mask scatter disappears
+    if ids.ndim == 2:
+        from .id64 import PAIR_EMPTY
+        empty = jnp.full((S * capacity,) + lanes, PAIR_EMPTY, ids.dtype)
+    else:
+        empty = jnp.full((S * capacity,) + lanes, -1, ids.dtype)
+    bucket_ids = empty.at[flat_pos].set(
         unique_ids, mode="drop").reshape((S, capacity) + lanes)
-    bucket_valid = jnp.zeros((S * capacity,), bool).at[flat_pos].set(
-        True, mode="drop").reshape(S, capacity)
+    bucket_valid = bucket_validity(bucket_ids)
     slot_out = jnp.where(in_cap, slot_u, capacity)
     buckets = BucketResult(bucket_ids, bucket_valid, u_owner, slot_out,
                            overflow)
     return uniq, buckets
+
+
+def bucket_validity(bucket_ids: jax.Array) -> jax.Array:
+    """Occupancy mask of a sentinel-initialized bucket array (see
+    `unique_and_route` — NOT `bucket_by_owner`, whose empty slots are
+    zero-filled): derivable on either side of the all_to_all."""
+    from .id64 import is_pair, pair_valid
+    return pair_valid(bucket_ids) if is_pair(bucket_ids) else bucket_ids >= 0
 
 
 def unbucket(bucket_rows: jax.Array, owner: jax.Array, slot: jax.Array) -> jax.Array:

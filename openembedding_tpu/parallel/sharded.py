@@ -51,7 +51,8 @@ import jax.numpy as jnp
 
 from ..embedding import EmbeddingSpec, EmbeddingTableState
 from ..ops.dedup import (BucketResult, UniqueResult, bucket_by_owner,
-                         unbucket, unique_and_route, unique_with_counts)
+                         bucket_validity, unbucket, unique_and_route,
+                         unique_with_counts)
 from ..ops.sparse import lookup_rows, sparse_apply_dense_table
 from .mesh import DATA_AXIS
 
@@ -147,9 +148,11 @@ def make_plan(spec: EmbeddingSpec, ids: jax.Array, *, axis: str = DATA_AXIS,
     valid = _id_valid(spec, flat)
     cap = _bucket_capacity(n, S, capacity_factor)
     uniq, buckets = unique_and_route(flat, valid, S, cap)
-    # [BOUNDARY: was one RPC per owning server; now one ICI all_to_all]
+    # [BOUNDARY: was one RPC per owning server; now ONE ICI all_to_all —
+    # empty bucket slots carry the EMPTY sentinel, so the receive side
+    # derives validity from the ids and no bool mask rides the wire]
     recv_ids = jax.lax.all_to_all(buckets.bucket_ids, axis, 0, 0)
-    recv_valid = jax.lax.all_to_all(buckets.bucket_valid, axis, 0, 0)
+    recv_valid = bucket_validity(recv_ids)
     return ExchangePlan(uniq, buckets, recv_ids, recv_valid, cap)
 
 
