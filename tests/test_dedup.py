@@ -143,3 +143,75 @@ def test_unique_and_route_matches_split_pipeline(pair, cap_frac):
         # (which ids differ by intra-bucket order)
         assert [len(x) for x in g] == [len(x) for x in o]
         assert int(buckets.overflow) == int(o_buckets.overflow)
+
+
+def test_mesh_training_with_id_zero_matches_single_device():
+    """REGRESSION for the sentinel-filled exchange: id 0 is a real id and an
+    all-zeros bucket slot must NOT alias it. Train a stream saturated with
+    id 0 (plus shard-boundary ids) on the mesh and on one device — losses
+    and the id-0 row must match exactly."""
+    import openembedding_tpu as embed
+    from openembedding_tpu.data import synthetic_criteo  # noqa: F401
+    from openembedding_tpu.embedding import lookup
+    from openembedding_tpu.initializers import Constant
+    from openembedding_tpu.model import Trainer
+    from openembedding_tpu.models import make_deepfm
+    from openembedding_tpu.parallel import MeshTrainer, make_mesh
+    import dataclasses
+
+    S = 8
+    rng = np.random.default_rng(0)
+
+    def build(cls, loss_scale=1.0, **kw):
+        m = make_deepfm(vocabulary=64, dim=4, hidden=(8,))
+        m.specs["categorical"] = dataclasses.replace(
+            m.specs["categorical"], initializer=Constant(0.0))
+        lf = m.loss_fn
+        m.loss_fn = lambda lo, la, *a: loss_scale * lf(lo, la, *a)
+        return cls(m, embed.Adagrad(learning_rate=0.1), **kw)
+
+    # every batch drowns in id 0 and the shard-boundary ids 0..S
+    batches = []
+    for i in range(3):
+        ids = rng.integers(0, 64, (16, 4)).astype(np.int32)
+        ids[:, 0] = 0
+        ids[: S + 1, 1] = np.arange(S + 1)
+        batches.append({"sparse": {"categorical": ids},
+                        "dense": rng.standard_normal((16, 13)).astype(np.float32),
+                        "label": rng.integers(0, 2, (16,)).astype(np.float32)})
+
+    single = build(Trainer, loss_scale=float(S))
+    s_state = single.init(batches[0])
+    sstep = single.jit_train_step()
+    s_losses = []
+    for b in batches:
+        s_state, m = sstep(s_state, b)
+        s_losses.append(float(m["loss"]))
+
+    mesh_tr = build(MeshTrainer, mesh=make_mesh())
+    m_state = mesh_tr.init(batches[0])
+    mstep = mesh_tr.jit_train_step(batches[0], m_state)
+    m_losses = []
+    for b in batches:
+        m_state, m = mstep(m_state, b)
+        m_losses.append(float(m["loss"]))
+
+    # 3 steps of Adagrad compound float-order differences between the
+    # psum'd-grad and scaled-loss formulations; an aliasing bug would be
+    # gross (zeroed/duplicated rows), not 1e-4
+    np.testing.assert_allclose(m_losses, np.asarray(s_losses) / S, rtol=5e-4)
+    spec = single.model.specs["categorical"]
+    probe = jnp.asarray(np.arange(S + 1, dtype=np.int32))
+    want = np.asarray(lookup(spec, s_state.tables["categorical"], probe))
+    from functools import partial
+    from jax.sharding import PartitionSpec as P
+    from openembedding_tpu.parallel.sharded import sharded_lookup
+    pull = jax.jit(jax.shard_map(
+        partial(sharded_lookup, spec, axis=mesh_tr.axis),
+        mesh=mesh_tr.mesh,
+        in_specs=(mesh_tr._table_pspec(spec), P()),
+        out_specs=P(), check_vma=False))
+    got = np.asarray(pull(m_state.tables["categorical"], probe))
+    # bf16 dense towers + 3 steps of reduction-order drift bound parity
+    # near 1e-4 abs; an aliased/missed id-0 update would be O(0.05+)
+    np.testing.assert_allclose(got, want, rtol=0, atol=1e-3)
