@@ -30,7 +30,7 @@ import re
 import shutil
 import threading
 import time
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
@@ -391,6 +391,73 @@ def _ceil_pow2(n: int) -> int:
     return 1 << max(0, (n - 1).bit_length())
 
 
+def _array_global_idx(ids, rows: int, num_shards: int):
+    """Storage row for an id in a (possibly sharded) ARRAY table: shard-major
+    layout — shard = id % S, local = id // S, row = shard * rps + local
+    (`parallel/sharded.py` layout converters are the bulk counterparts)."""
+    import jax.numpy as jnp
+    if num_shards == 1:
+        return ids
+    rps = rows // num_shards
+    return (ids % num_shards) * rps + ids // num_shards
+
+
+def _read_rows(spec, num_shards: int, ts, ids):
+    """Gather (found, weights, slots) for flat padded ids. Array tables work
+    at any shard count (index math above; XLA reshards the O(touched)
+    gather); hash tables only at S == 1 — their probe sequence is per-shard
+    (`_make_mesh_row_reader` is the sharded path)."""
+    import jax.numpy as jnp
+    if spec.use_hash_table:
+        from .tables.hash_table import hash_find
+        slot = hash_find(ts.keys, ids)
+        cap = ts.keys.shape[0]
+        found = slot < cap
+        idx = jnp.clip(slot, 0, cap - 1)
+    else:
+        found = (ids >= 0) & (ids < spec.input_dim)
+        idx = jnp.clip(_array_global_idx(ids, ts.weights.shape[0],
+                                         num_shards),
+                       0, ts.weights.shape[0] - 1)
+    w = jnp.take(ts.weights, idx, axis=0)
+    s = {k: jnp.take(v, idx, axis=0) for k, v in ts.slots.items()}
+    return found, w, s
+
+
+def _make_mesh_row_reader(mesh, axis, state_pspec):
+    """shard_map'd touched-row read for a row-sharded HASH table: each shard
+    probes its local key range for the ids it owns (same ownership/probe
+    rules as the live lookup), rows psum-assemble (zeros elsewhere)."""
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from .tables.hash_table import hash_find
+
+    def read(ts, ids):
+        from .tables.hash_table import shard_probe
+        keys = ts.keys
+        mine, probe = shard_probe(keys, ids, axis)
+        slot = hash_find(keys, probe)
+        cap = keys.shape[0]
+        found_l = mine & (slot < cap)
+        idx = jnp.clip(slot, 0, cap - 1)
+        w = jnp.where(found_l[:, None],
+                      jnp.take(ts.weights, idx, axis=0), 0.0)
+        s = {k: jnp.where(found_l[:, None], jnp.take(v, idx, axis=0), 0.0)
+             for k, v in ts.slots.items()}
+        found = jax.lax.psum(found_l.astype(jnp.int32), axis) > 0
+        w = jax.lax.psum(w, axis)
+        s = {k: jax.lax.psum(v, axis) for k, v in s.items()}
+        return found, w, s
+
+    slot_specs = {k: P() for k in
+                  (state_pspec.slots if isinstance(state_pspec.slots, dict)
+                   else {})}
+    return jax.jit(jax.shard_map(
+        read, mesh=mesh, in_specs=(state_pspec, P()),
+        out_specs=(P(), P(), slot_specs), check_vma=False))
+
+
 class IncrementalPersister(AsyncPersister):
     """AsyncPersister whose steady-state persists are O(touched rows).
 
@@ -407,18 +474,19 @@ class IncrementalPersister(AsyncPersister):
     an unobserved window falls back to a full persist with a warning.)
 
     Persist schedule: a full base every `full_every` persists (bounds the
-    restore replay chain), deltas in between. Single-process only (the
-    multi-host sharded dump streams per-shard and stays full); host-cached
-    tables also fall back to full persists — their store already lives
-    host-side and the admission bookkeeping, not the snapshot, is their cost."""
+    restore replay chain), deltas in between. Works on one device and on a
+    single-host mesh (sharded tables: array rows address through the
+    shard-major layout, hash rows through a shard_map'd probe). Multi-HOST
+    stays full per-shard dumps (AsyncPersister); host-cached tables also
+    fall back to full persists — their store already lives host-side and the
+    admission bookkeeping, not the snapshot, is their cost."""
 
     def __init__(self, trainer, model, root: str, *, full_every: int = 8,
                  **kw):
-        if jax.process_count() > 1 or trainer.num_shards > 1:
+        if jax.process_count() > 1:
             raise ValueError(
-                "IncrementalPersister is single-process/single-shard; "
-                "multi-host training persists full per-shard dumps "
-                "(AsyncPersister)")
+                "IncrementalPersister is single-process; multi-host training "
+                "persists full per-shard dumps (AsyncPersister)")
         if full_every < 1:
             raise ValueError("full_every must be >= 1")
         super().__init__(trainer, model, root, **kw)
@@ -441,24 +509,14 @@ class IncrementalPersister(AsyncPersister):
     def _reader(self, name, spec, padded_n: int):
         key = (name, padded_n)
         if key not in self._readers:
-            import jax.numpy as jnp
-
-            def read(ts, ids):
-                if spec.use_hash_table:
-                    from .tables.hash_table import hash_find
-                    slot = hash_find(ts.keys, ids)
-                    cap = ts.keys.shape[0]
-                    found = slot < cap
-                    idx = jnp.clip(slot, 0, cap - 1)
-                else:
-                    n = ts.weights.shape[0]
-                    found = (ids >= 0) & (ids < n)
-                    idx = jnp.clip(ids, 0, n - 1)
-                w = jnp.take(ts.weights, idx, axis=0)
-                s = {k: jnp.take(v, idx, axis=0) for k, v in ts.slots.items()}
-                return found, w, s
-
-            self._readers[key] = jax.jit(read)
+            S = self.trainer.num_shards
+            if spec.use_hash_table and S > 1:
+                self._readers[key] = _make_mesh_row_reader(
+                    self.trainer.mesh, self.trainer.axis,
+                    self.trainer._table_pspec(spec))
+            else:
+                self._readers[key] = jax.jit(
+                    lambda ts, ids: _read_rows(spec, S, ts, ids))
         return self._readers[key]
 
     def _read_touched(self, state, name, ids64: np.ndarray):
@@ -561,16 +619,21 @@ class IncrementalPersister(AsyncPersister):
         super()._gc()
 
 
-def _apply_delta(state, model, path: str):
-    """Replay one committed delta onto a (single-shard) state: jitted row
-    scatter per table — hash ids re-found-or-inserted with the live probe
-    kernel, array ids written in place."""
+def _apply_delta(state, model, path: str, *, trainer=None, _cache=None):
+    """Replay one committed delta onto the state: jitted row scatter per
+    table — hash ids re-found-or-inserted with the live probe kernel (under
+    shard_map on a mesh), array ids written at their shard-major rows.
+    `_cache` (shared across a chain) holds the compiled kernels; ids pad to
+    the next power of two so a whole chain replays with ONE compile per
+    table instead of one per delta."""
     import json
 
     import jax.numpy as jnp
 
     from .ops.id64 import np_split_ids
 
+    S = trainer.num_shards if trainer is not None else 1
+    cache = _cache if _cache is not None else {}
     with open(os.path.join(path, "meta.json")) as f:
         meta = json.load(f)
     new_tables = dict(state.tables)
@@ -584,42 +647,71 @@ def _apply_delta(state, model, path: str):
                      if k.startswith("slot_")}
         if ids64.size == 0:
             continue
+        n = ids64.size
+        padded = _ceil_pow2(n)
+        ids_p = np.concatenate(
+            [ids64, np.full((padded - n,), -1, np.int64)])
+        w_dev = jnp.asarray(np.concatenate(
+            [w, np.zeros((padded - n,) + w.shape[1:], w.dtype)]))
+        s_dev = {k: jnp.asarray(np.concatenate(
+            [v, np.zeros((padded - n,) + v.shape[1:], v.dtype)]))
+            for k, v in slots.items()}
         if spec.use_hash_table:
             pair = ts.keys.ndim == 2
-            ids_dev = jnp.asarray(np_split_ids(ids64) if pair
-                                  else ids64.astype(ts.keys.dtype))
+            ids_dev = jnp.asarray(np_split_ids(ids_p) if pair
+                                  else ids_p.astype(ts.keys.dtype))
+            if S > 1:
+                # the host-offload mesh admission IS the sharded
+                # insert-and-write-rows kernel (known = every real delta row;
+                # sentinel-padded ids carry known=False and never insert)
+                if ("admit", name) not in cache:
+                    from .tables.host_offload import _make_mesh_admit
+                    cache[("admit", name)] = _make_mesh_admit(
+                        trainer.mesh, trainer.axis,
+                        trainer._table_pspec(spec), list(ts.slots))
+                known = jnp.asarray(np.arange(padded) < n)
+                new_ts, _ = cache[("admit", name)](ts, ids_dev, w_dev, s_dev,
+                                                   known)
+                new_tables[name] = new_ts
+                continue
 
-            def write(ts, ids, w, s):
-                from .tables.hash_table import hash_find_or_insert
-                keys, slot, overflow = hash_find_or_insert(ts.keys, ids)
-                cap = keys.shape[0]
-                target = jnp.where(slot < cap, slot, cap)
-                weights = ts.weights.at[target].set(
-                    w.astype(ts.weights.dtype), mode="drop")
-                new_slots = {k: ts.slots[k].at[target].set(
-                    s[k].astype(ts.slots[k].dtype), mode="drop")
-                    for k in ts.slots}
-                return ts.replace(keys=keys, weights=weights, slots=new_slots,
-                                  overflow=ts.overflow + overflow)
+            if ("hash", name) not in cache:
 
-            new_tables[name] = jax.jit(write, donate_argnums=(0,))(
-                ts, ids_dev, jnp.asarray(w),
-                {k: jnp.asarray(v) for k, v in slots.items()})
+                def write(ts, ids, w, s):
+                    from .tables.hash_table import hash_find_or_insert
+                    keys, slot, overflow = hash_find_or_insert(ts.keys, ids)
+                    cap = keys.shape[0]
+                    target = jnp.where(slot < cap, slot, cap)
+                    weights = ts.weights.at[target].set(
+                        w.astype(ts.weights.dtype), mode="drop")
+                    new_slots = {k: ts.slots[k].at[target].set(
+                        s[k].astype(ts.slots[k].dtype), mode="drop")
+                        for k in ts.slots}
+                    return ts.replace(keys=keys, weights=weights,
+                                      slots=new_slots,
+                                      overflow=ts.overflow + overflow)
+
+                cache[("hash", name)] = jax.jit(write, donate_argnums=(0,))
+            new_tables[name] = cache[("hash", name)](
+                ts, ids_dev, w_dev, s_dev)
         else:
+            if ("array", name) not in cache:
 
-            def write(ts, ids, w, s):
-                n = ts.weights.shape[0]
-                tgt = jnp.where((ids >= 0) & (ids < n), ids, n)
-                weights = ts.weights.at[tgt].set(
-                    w.astype(ts.weights.dtype), mode="drop")
-                new_slots = {k: ts.slots[k].at[tgt].set(
-                    s[k].astype(ts.slots[k].dtype), mode="drop")
-                    for k in ts.slots}
-                return ts.replace(weights=weights, slots=new_slots)
+                def write(ts, ids, w, s):
+                    rows = ts.weights.shape[0]
+                    ok = (ids >= 0) & (ids < spec.input_dim)
+                    tgt = jnp.where(
+                        ok, _array_global_idx(ids, rows, S), rows)
+                    weights = ts.weights.at[tgt].set(
+                        w.astype(ts.weights.dtype), mode="drop")
+                    new_slots = {k: ts.slots[k].at[tgt].set(
+                        s[k].astype(ts.slots[k].dtype), mode="drop")
+                        for k in ts.slots}
+                    return ts.replace(weights=weights, slots=new_slots)
 
-            new_tables[name] = jax.jit(write, donate_argnums=(0,))(
-                ts, jnp.asarray(ids64.astype(np.int32)), jnp.asarray(w),
-                {k: jnp.asarray(v) for k, v in slots.items()})
+                cache[("array", name)] = jax.jit(write, donate_argnums=(0,))
+            new_tables[name] = cache[("array", name)](
+                ts, jnp.asarray(ids_p.astype(np.int32)), w_dev, s_dev)
 
     with np.load(os.path.join(path, "dense.npz")) as z:
         from .checkpoint import _unflatten_params
@@ -630,21 +722,30 @@ def _apply_delta(state, model, path: str):
             {k[len("slots/"):]: z[k] for k in z.files
              if k.startswith("slots/")})
 
+    rep = None
+    if trainer is not None and getattr(trainer, "mesh", None) is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        rep = NamedSharding(trainer.mesh, P())  # dense/scalars replicate
+
+    def _like(leaf, value):
+        arr = jnp.asarray(value).astype(leaf.dtype).reshape(leaf.shape)
+        sharding = rep if rep is not None else getattr(leaf, "sharding", None)
+        return jax.device_put(arr, sharding) if sharding is not None else arr
+
     def _match(template, loaded):
-        """Rebuild the template's pytree with loaded leaves (dtypes pinned)."""
+        """Rebuild the template's pytree with loaded leaves (dtype, shape,
+        and sharding pinned to the live state's)."""
         leaves, treedef = jax.tree_util.tree_flatten(template)
         new_leaves = treedef.flatten_up_to(loaded)
         return jax.tree_util.tree_unflatten(
-            treedef, [jnp.asarray(nl).astype(l.dtype).reshape(l.shape)
-                      for l, nl in zip(leaves, new_leaves)])
+            treedef, [_like(l, nl) for l, nl in zip(leaves, new_leaves)])
 
     return state.replace(
         tables=new_tables,
         dense_params=_match(state.dense_params, params),
         dense_slots=_match(state.dense_slots, dslots),
-        step=jnp.asarray(meta["step"], state.step.dtype),
-        model_version=jnp.asarray(meta["model_version"],
-                                  state.model_version.dtype),
+        step=_like(state.step, meta["step"]),
+        model_version=_like(state.model_version, meta["model_version"]),
     )
 
 
@@ -676,10 +777,21 @@ def restore_server_model(state, model, root: str, *, trainer=None):
         from .checkpoint import load_server_model
         state = load_server_model(state, model, path, num_shards=num_shards,
                                   offload=offload)
-    if deltas and num_shards > 1:
-        raise ValueError("delta replay is single-shard (see "
-                         "IncrementalPersister); restore with a single-device "
-                         "trainer or from a full persist")
+    if deltas and trainer is None and _state_is_sharded(state):
+        # shardedness must come from the STATE: without the trainer the
+        # S=1 replay math would silently scramble shard-major rows
+        raise ValueError("delta replay onto a sharded state needs the "
+                         "trainer (its mesh drives the sharded row scatter): "
+                         "pass trainer= to restore_server_model")
+    cache: Dict = {}
     for d in deltas:
-        state = _apply_delta(state, model, d)
+        state = _apply_delta(state, model, d, trainer=trainer, _cache=cache)
     return state
+
+
+def _state_is_sharded(state) -> bool:
+    for ts in state.tables.values():
+        sh = getattr(ts.weights, "sharding", None)
+        if sh is not None and len(getattr(sh, "device_set", ())) > 1:
+            return True
+    return False

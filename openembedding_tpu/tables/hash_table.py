@@ -121,6 +121,27 @@ def adapt_ids(keys: jax.Array, ids: jax.Array) -> jax.Array:
     return ids.astype(keys.dtype)
 
 
+def shard_probe(keys: jax.Array, ids: jax.Array, axis) -> tuple:
+    """-> (mine, probe) for a row-sharded hash table inside shard_map: `mine`
+    masks the ids this shard owns (`id % S == shard_index`, the
+    `parallel/sharded.py` routing rule) and `probe` is the id batch with
+    non-owned/invalid entries replaced by the EMPTY sentinel so the local
+    probe never matches them. THE one copy of the ownership/sentinel rule —
+    admission, eviction, and the persist row reader all route through it."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.id64 import PAIR_EMPTY, is_pair, pair_mod, pair_valid
+
+    S = jax.lax.axis_size(axis)
+    idx = jax.lax.axis_index(axis)
+    if is_pair(ids):
+        mine = pair_valid(ids) & (pair_mod(ids, S).astype(jnp.int32) == idx)
+        return mine, jnp.where(mine[:, None], ids, PAIR_EMPTY)
+    mine = (ids >= 0) & ((ids % S).astype(jnp.int32) == idx)
+    return mine, jnp.where(mine, ids, -1).astype(keys.dtype)
+
+
 def np_hash_insert(keys, ids, num_shards: int,
                    num_probes: int = DEFAULT_NUM_PROBES):
     """Vectorized host-side insertion of checkpointed keys into a (possibly

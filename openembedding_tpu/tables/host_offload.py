@@ -207,21 +207,11 @@ def _make_mesh_evict(mesh, axis, state_pspec, slot_names):
     from .hash_table import hash_find, hash_find_or_insert
 
     def evict(state, cold_ids, hot_ids, fresh):
-        from ..ops.id64 import PAIR_EMPTY, is_pair, pair_mod, pair_valid
-        S = jax.lax.axis_size(axis)
-        idx = jax.lax.axis_index(axis)
+        from .hash_table import shard_probe
         keys = state.keys
         cap = keys.shape[0]
 
-        def probe_of(ids):
-            if is_pair(ids):
-                mine = pair_valid(ids) & (pair_mod(ids, S).astype(jnp.int32)
-                                          == idx)
-                return mine, jnp.where(mine[:, None], ids, PAIR_EMPTY)
-            mine = (ids >= 0) & ((ids % S).astype(jnp.int32) == idx)
-            return mine, jnp.where(mine, ids, -1).astype(keys.dtype)
-
-        cmine, cprobe = probe_of(cold_ids)
+        cmine, cprobe = shard_probe(keys, cold_ids, axis)
         cslot = hash_find(keys, cprobe)
         cfound_l = cmine & (cslot < cap)
         cidx = jnp.clip(cslot, 0, cap - 1)
@@ -231,7 +221,7 @@ def _make_mesh_evict(mesh, axis, state_pspec, slot_names):
                                jnp.take(v, cidx, axis=0), 0.0)
                   for k, v in state.slots.items()}
 
-        hmine, hprobe = probe_of(hot_ids)
+        hmine, hprobe = shard_probe(keys, hot_ids, axis)
         hslot = hash_find(keys, hprobe)
         hfound_l = hmine & (hslot < cap)
         hidx = jnp.clip(hslot, 0, cap - 1)
@@ -280,17 +270,9 @@ def _make_mesh_admit(mesh, axis, state_pspec, slot_names):
     from .hash_table import hash_find_or_insert
 
     def admit(state, ids, w_rows, s_rows, known):
-        from ..ops.id64 import PAIR_EMPTY, is_pair, pair_mod, pair_valid
-        S = jax.lax.axis_size(axis)
-        idx = jax.lax.axis_index(axis)
+        from .hash_table import shard_probe
         keys = state.keys
-        if is_pair(ids):
-            mine = pair_valid(ids) & (pair_mod(ids, S).astype(jnp.int32)
-                                      == idx)
-            probe = jnp.where(mine[:, None], ids, PAIR_EMPTY)
-        else:
-            mine = (ids >= 0) & ((ids % S).astype(jnp.int32) == idx)
-            probe = jnp.where(mine, ids, -1).astype(keys.dtype)
+        mine, probe = shard_probe(keys, ids, axis)
         new_keys, slot, oflow = hash_find_or_insert(keys, probe)
         cps = keys.shape[0]
         admitted_local = mine & (slot < cps)

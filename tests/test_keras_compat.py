@@ -308,3 +308,35 @@ def test_mesh_import_forward_parity():
         print("MESH_IMPORT_PARITY_OK")
     """)
     assert "MESH_IMPORT_PARITY_OK" in out
+
+
+def test_sequential_model_conversion_and_fit():
+    """keras.Sequential (the most common unmodified-script shape): converts,
+    trains through the framework, predict reflects training."""
+    out = _run("""
+        import numpy as np, keras
+        from openembedding_tpu.inject import install
+        install()
+
+        rng = np.random.default_rng(0)
+        V = 200
+        ids = rng.integers(0, V, (256, 3)).astype(np.int32)
+        y = (ids[:, 0] % 2).astype(np.float32)
+
+        m = keras.Sequential([
+            keras.Input(shape=(3,), dtype="int32", name="cat"),
+            keras.layers.Embedding(V, 8, name="emb"),
+            keras.layers.Flatten(),
+            keras.layers.Dense(16, activation="relu"),
+            keras.layers.Dense(1, activation="sigmoid"),
+        ])
+        m.compile(optimizer=keras.optimizers.Adagrad(learning_rate=0.5),
+                  loss="binary_crossentropy")
+        h = m.fit(ids, y, batch_size=64, epochs=8, verbose=0)
+        assert h.history["loss"][-1] < h.history["loss"][0] * 0.5, h.history
+        p = np.asarray(m(ids)).reshape(-1)
+        acc = float(((p > 0.5) == (y > 0.5)).mean())
+        assert acc > 0.9, acc
+        print("SEQUENTIAL_OK", round(acc, 3))
+    """)
+    assert "SEQUENTIAL_OK" in out
