@@ -285,25 +285,33 @@ def sharded_apply_gradients(
         rg = g
         rc = jnp.where(valid, uniq.counts, 0)
     else:
-        # scatter grads/counts into the plan's bucket positions (payload
-        # follows its id)
+        # scatter grads into the plan's bucket positions (payload follows its
+        # id), with the duplicate COUNT riding as extra payload lanes — the
+        # raw int32 bits BITCAST into the grad dtype (exact for any count, no
+        # upcast: one f32 lane, or two bf16 lanes). Folding the counts into
+        # the grad payload makes the push ONE all_to_all instead of two.
+        counts_i32 = jnp.where(valid, uniq.counts, 0).astype(jnp.int32)
+        count_lanes = jax.lax.bitcast_convert_type(counts_i32, g.dtype)
+        count_lanes = count_lanes.reshape(counts_i32.shape[0], -1)
+        lanes = count_lanes.shape[1]
+        payload = jnp.concatenate([g, count_lanes], axis=1)
+        width = spec.output_dim + lanes
         flat_pos = jnp.where((buckets.owner < S) & (buckets.slot < cap),
                              buckets.owner * cap + buckets.slot, S * cap)
-        g_buckets = jnp.zeros((S * cap, spec.output_dim),
-                              g.dtype).at[flat_pos].set(
-            g, mode="drop").reshape(S, cap, spec.output_dim)
-        c_buckets = jnp.zeros((S * cap,), jnp.int32).at[flat_pos].set(
-            jnp.where(valid, uniq.counts, 0), mode="drop").reshape(S, cap)
+        g_buckets = jnp.zeros((S * cap, width), g.dtype).at[flat_pos].set(
+            payload, mode="drop").reshape(S, cap, width)
 
-        recv_g = jax.lax.all_to_all(g_buckets, axis, 0, 0)
-        recv_c = jax.lax.all_to_all(c_buckets, axis, 0, 0)
+        recv = jax.lax.all_to_all(g_buckets, axis, 0, 0)
 
         # server side: cross-source re-dedup + fused optimizer (MPSC reduce
         # + update)
         rids = (plan.recv_ids.reshape(-1, 2) if pair
                 else plan.recv_ids.reshape(-1))
-        rg = recv_g.reshape(-1, spec.output_dim)
-        rc = recv_c.reshape(-1)
+        flat = recv.reshape(-1, width)
+        rg = flat[:, :spec.output_dim]
+        tail = flat[:, spec.output_dim:]
+        rc = jax.lax.bitcast_convert_type(
+            tail[:, 0] if lanes == 1 else tail, jnp.int32).reshape(-1)
     if spec.use_hash_table:
         from ..tables.hash_table import hash_find
         if pair:

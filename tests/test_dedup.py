@@ -215,3 +215,81 @@ def test_mesh_training_with_id_zero_matches_single_device():
     # bf16 dense towers + 3 steps of reduction-order drift bound parity
     # near 1e-4 abs; an aliased/missed id-0 update would be O(0.05+)
     np.testing.assert_allclose(got, want, rtol=0, atol=1e-3)
+
+
+def test_mesh_step_compiles_three_all_to_alls():
+    """Structural pin on the exchange wire: one full train step moves exactly
+    THREE all_to_alls per table — ids out, rows back, grads+counts out (the
+    validity mask rides the id sentinel, the counts ride the grad payload).
+    A fourth collective reappearing is a protocol regression."""
+    import re
+    import openembedding_tpu as embed
+    from openembedding_tpu.data import synthetic_criteo
+    from openembedding_tpu.models import make_deepfm
+    from openembedding_tpu.parallel import MeshTrainer, make_mesh
+
+    model = make_deepfm(vocabulary=1 << 12, dim=4, hidden=(8,))
+    tr = MeshTrainer(model, embed.Adagrad(learning_rate=0.05), mesh=make_mesh())
+    b = next(synthetic_criteo(32, id_space=1 << 12, steps=1, seed=0))
+    state = tr.init(b)
+    step = tr.jit_train_step(b, state)
+    txt = step.lower(state, b).compile().as_text()
+    # op instantiations only; async backends emit start/done pairs — count
+    # the starts
+    n = len(re.findall(r" all-to-all(?:-start)?\(", txt))
+    assert n == 3, f"expected 3 all-to-alls in the step, found {n}"
+
+
+def test_mesh_bf16_table_counts_ride_two_lanes():
+    """bfloat16 tables push bf16 payloads: the duplicate count bitcasts into
+    TWO bf16 lanes and must round-trip exactly. TestOptimizer is the only
+    count-DIVIDING optimizer, so a corrupted count shows up as a grossly
+    wrong update, not a rounding blip."""
+    import dataclasses
+    import openembedding_tpu as embed
+    from openembedding_tpu.embedding import lookup
+    from openembedding_tpu.initializers import Constant
+    from openembedding_tpu.model import EmbeddingModel, Trainer
+    from openembedding_tpu.models import make_lr
+    from openembedding_tpu.optimizers import TestOptimizer
+    from openembedding_tpu.parallel import MeshTrainer, make_mesh
+
+    def build(cls, **kw):
+        e = embed.Embedding(64, 4, name="categorical", datatype="bfloat16",
+                            embeddings_initializer=Constant(0.0))
+        lr = make_lr(vocabulary=64)
+        m = EmbeddingModel(lr.module, [e], loss_fn=lr.loss_fn)
+        return cls(m, TestOptimizer(learning_rate=0.5), **kw)
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 64, (32, 4)).astype(np.int32)
+    ids[:, 0] = 7  # 32 duplicates of id 7: count division must see 32
+    batch = {"sparse": {"categorical": ids}, "label":
+             rng.integers(0, 2, (32,)).astype(np.float32)}
+
+    single = build(Trainer)
+    s_state = single.init(batch)
+    s_state, _ = single.jit_train_step()(s_state, batch)
+
+    mesh_tr = build(MeshTrainer, mesh=make_mesh())
+    m_state = mesh_tr.init(batch)
+    m_state, _ = mesh_tr.jit_train_step(batch, m_state)(m_state, batch)
+
+    spec = single.model.specs["categorical"]
+    probe = jnp.asarray(np.unique(ids).astype(np.int32))
+    want = np.asarray(lookup(spec, s_state.tables["categorical"],
+                             probe)).astype(np.float32)
+    from functools import partial
+    from jax.sharding import PartitionSpec as P
+    from openembedding_tpu.parallel.sharded import sharded_lookup
+    pull = jax.jit(jax.shard_map(
+        partial(sharded_lookup, spec, axis=mesh_tr.axis),
+        mesh=mesh_tr.mesh,
+        in_specs=(mesh_tr._table_pspec(spec), P()),
+        out_specs=P(), check_vma=False))
+    got = np.asarray(pull(m_state.tables["categorical"],
+                          probe)).astype(np.float32)
+    # a mangled count would divide by garbage (flip-state updates are
+    # O(flip/count)); bf16 rounding is the only legitimate difference
+    np.testing.assert_allclose(got, want, rtol=0.05, atol=0.05)
+    assert np.abs(got).max() > 0  # the step really updated rows
