@@ -6,21 +6,27 @@ one table shard (rows where `id % S == shard_index`, the reference's layout,
 
 PULL (reference `EmbeddingPullOperator`, client dedup -> per-node RPC -> server gather
 -> client reassemble):
-  1. dedup local ids (client-side dedup, `c_api.cc:220-231`)
-  2. bucket unique ids by owner shard (the per-node request vectors)
-  3. `all_to_all` id buckets            [the RPC fan-out, now one ICI collective]
-  4. gather rows from the local shard (server hot loop; hash tables lazily insert —
+  1. dedup + owner-routing in ONE multi-key sort (`ops/dedup.unique_and_route`;
+     client-side dedup, `c_api.cc:220-231`)
+  2. `all_to_all` id buckets            [the RPC fan-out, now one ICI collective]
+     — empty slots carry the EMPTY sentinel, validity derives from the payload
+  3. gather rows from the local shard (server hot loop; hash tables lazily insert —
      the reference's `_new_weights` init-on-pull)
-  5. `all_to_all` rows back, un-bucket, expand duplicates (client `apply_response`)
+  4. `all_to_all` rows back, un-bucket, expand duplicates (client `apply_response`)
 
 PUSH+UPDATE (reference `EmbeddingPushOperator` + `EmbeddingStoreOperator`, collapsed:
 SPMD needs no batch-version gate):
   1. reuse the pull's dedup/bucketing/exchange plan (the reference likewise keeps the
      pull request around; recomputing would double the hot-path sort + id all_to_all)
   2. segment-sum local grads + counts into the unique slots (client pre-sum, `:29-62`)
-  3. bucket + `all_to_all` grads/counts along the same routes
+  3. ONE `all_to_all` of grads along the same routes — the duplicate counts ride as
+     bitcast lanes of the payload
   4. owner re-dedups across sources (the MPSC reducer, `MpscGradientReducer.h`) and
      applies the fused optimizer once per unique row
+
+Collective budget: exactly 3 all_to_alls per table per train step (ids, rows,
+grads+counts), pinned at the HLO level in `tests/test_dedup.py`. `S == 1`
+specializes to identity routing (no collectives, no bucket scatters).
 
 Static capacity: each (src, dst) bucket holds `capacity` ids. `capacity == n` is exact
 but moves S*n ids; real workloads set a capacity_factor so capacity ~ factor * n / S
