@@ -474,3 +474,31 @@ def test_incremental_mesh_hash_table(tmp_path):
 
     np.testing.assert_array_equal(pull_rows(fresh, fstate),
                                   pull_rows(trainer, state))
+
+
+def test_sharded_delta_restore_requires_trainer(tmp_path):
+    """Replaying deltas onto a SHARDED state without the trainer would
+    scramble shard-major rows — detected from the state's sharding, raised."""
+    from openembedding_tpu.parallel import MeshTrainer, make_mesh
+    from openembedding_tpu.persist import IncrementalPersister
+
+    model = make_deepfm(vocabulary=VOCAB, dim=4, hidden=(8,))
+    trainer = MeshTrainer(model, embed.Adagrad(learning_rate=0.05), seed=0,
+                          mesh=make_mesh())
+    batches = list(synthetic_criteo(16, id_space=VOCAB, steps=4, seed=3))
+    state = trainer.init(batches[0])
+    step = trainer.jit_train_step(batches[0], state)
+    root = str(tmp_path / "persist")
+    with IncrementalPersister(trainer, model, root, window=2,
+                              policy=PersistPolicy(every_steps=2),
+                              full_every=100) as p:
+        for b in batches:
+            state, _ = step(state, b)
+            p.maybe_persist(state, batch=b)
+        p.wait()
+
+    fresh = MeshTrainer(model, embed.Adagrad(learning_rate=0.05), seed=0,
+                        mesh=make_mesh())
+    fstate = fresh.init(batches[0])
+    with pytest.raises(ValueError, match="trainer"):
+        restore_server_model(fstate, model, root)  # trainer omitted
