@@ -185,11 +185,26 @@ def read_criteo_tfrecord(paths, batch_size: int, *,
                          vocab_sizes: Optional[Sequence[int]] = None,
                          host_id: int = 0, num_hosts: int = 1,
                          drop_remainder: bool = True,
-                         repeat: bool = False) -> Iterator[Dict]:
+                         repeat: bool = False,
+                         engine: str = "tf") -> Iterator[Dict]:
     """Stream the reference's TFRecord format (`test/benchmark/criteo_tfrecord.py`:
     label int64[1], I1..I13 float32[1], C1..C26 int64[1] — categorical already
-    relabeled to ints). Requires tensorflow (present in this image; the reader is
-    import-guarded so the core library never depends on TF)."""
+    relabeled to ints). `engine="tf"` uses tf.data (import-guarded so the core
+    library never depends on TF); `engine="native"` uses the C++ reader
+    (`native.NativeCriteoTFRecordReader` — no TF at all, CRC-verified framing,
+    threaded proto parse) and yields bit-identical batches."""
+    if engine == "native":
+        from ..native import NativeCriteoTFRecordReader
+        for batch in NativeCriteoTFRecordReader(
+                paths, batch_size, host_id=host_id, num_hosts=num_hosts,
+                drop_remainder=drop_remainder, repeat=repeat):
+            yield {"sparse": {"categorical": _fold_int_ids(
+                       batch["sparse"]["categorical"], id_space, vocab_sizes)},
+                   "dense": batch["dense"],
+                   "label": batch["label"]}
+        return
+    if engine != "tf":
+        raise ValueError(f"engine must be 'tf' or 'native', got {engine!r}")
     import tensorflow as tf  # local import: optional dependency
 
     if isinstance(paths, str):
@@ -201,13 +216,20 @@ def read_criteo_tfrecord(paths, batch_size: int, *,
         columns[f"C{i}"] = tf.io.FixedLenFeature([1], tf.int64)
 
     ds = tf.data.Dataset.from_tensor_slices(list(paths))
-    ds = ds.interleave(lambda p: tf.data.TFRecordDataset(p),
+    # cycle_length=1: deterministic file-sequential record order on EVERY
+    # machine (AUTOTUNE picks a core-count-dependent interleave width, which
+    # silently changes the data order between hosts); the native reader
+    # (`engine="native"`) pins the same order
+    ds = ds.interleave(lambda p: tf.data.TFRecordDataset(p), cycle_length=1,
                        num_parallel_calls=tf.data.AUTOTUNE)
     if num_hosts > 1:
         ds = ds.shard(num_hosts, host_id)
-    if repeat:
-        ds = ds.repeat()
     ds = ds.batch(batch_size, drop_remainder=drop_remainder)
+    if repeat:
+        # repeat AFTER batch: per-epoch batch boundaries, the same repeat
+        # semantics as every other reader here (TSV/CSV/native restart the
+        # pass per epoch; batches never span epochs)
+        ds = ds.repeat()
     ds = ds.map(lambda x: tf.io.parse_example(x, columns),
                 num_parallel_calls=tf.data.AUTOTUNE)
     ds = ds.prefetch(tf.data.AUTOTUNE)

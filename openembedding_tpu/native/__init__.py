@@ -79,6 +79,18 @@ def load() -> ctypes.CDLL:
     lib.oetpu_preprocess.argtypes = [
         ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
         np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")]
+    lib.oetpu_tfr_create.restype = ctypes.c_void_p
+    lib.oetpu_tfr_create.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p), ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int]
+    lib.oetpu_tfr_next.restype = ctypes.c_int
+    lib.oetpu_tfr_next.argtypes = [
+        ctypes.c_void_p,
+        np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")]
+    lib.oetpu_tfr_destroy.restype = None
+    lib.oetpu_tfr_destroy.argtypes = [ctypes.c_void_p]
     _lib = lib
     return lib
 
@@ -148,6 +160,69 @@ class NativeCriteoReader:
                     return
         finally:
             lib.oetpu_reader_destroy(handle)
+
+    def __iter__(self) -> Iterator[Dict]:
+        while True:
+            yield from self._one_pass()
+            if not self.repeat:
+                return
+
+
+class NativeCriteoTFRecordReader:
+    """Streaming batches from the reference's TFRecord benchmark format
+    (`test/benchmark/criteo_tfrecord.py` schema) with NO TensorFlow
+    dependency: C++ record framing (masked-CRC32C verified) + a proto-wire
+    parser for the fixed Example schema, round-robin across files like the
+    tf.data interleave. Yields RAW columns; callers fold the categorical ids
+    (`data.criteo.read_criteo_tfrecord(engine="native")` applies the same
+    `_fold_int_ids` as the tf path, so batches are bit-identical)."""
+
+    def __init__(self, paths: Sequence[str], batch_size: int, *,
+                 host_id: int = 0, num_hosts: int = 1, num_threads: int = 4,
+                 drop_remainder: bool = True, repeat: bool = False):
+        if isinstance(paths, str):
+            paths = [paths]
+        for p in paths:
+            if not os.path.exists(p):
+                raise FileNotFoundError(p)
+        self.paths = [os.fspath(p) for p in paths]
+        self.batch_size = batch_size
+        self.host_id = host_id
+        self.num_hosts = num_hosts
+        self.num_threads = num_threads
+        self.drop_remainder = drop_remainder
+        self.repeat = repeat
+        self._lib = load()
+
+    def _one_pass(self) -> Iterator[Dict]:
+        lib = self._lib
+        arr = (ctypes.c_char_p * len(self.paths))(
+            *[p.encode() for p in self.paths])
+        handle = lib.oetpu_tfr_create(arr, len(self.paths), self.batch_size,
+                                      self.host_id, self.num_hosts,
+                                      self.num_threads)
+        try:
+            while True:
+                labels = np.empty((self.batch_size,), np.float32)
+                dense = np.empty((self.batch_size, NUM_DENSE), np.float32)
+                sparse = np.empty((self.batch_size, NUM_SPARSE), np.int64)
+                n = lib.oetpu_tfr_next(handle, labels, dense, sparse)
+                if n < 0:
+                    raise IOError(f"native TFRecord reader failed (corrupt "
+                                  f"frame or malformed Example) on "
+                                  f"{self.paths}")
+                if n == 0:
+                    return
+                if n < self.batch_size:
+                    if self.drop_remainder:
+                        return
+                    labels, dense, sparse = labels[:n], dense[:n], sparse[:n]
+                yield {"sparse": {"categorical": sparse}, "dense": dense,
+                       "label": labels}
+                if n < self.batch_size:
+                    return
+        finally:
+            lib.oetpu_tfr_destroy(handle)
 
     def __iter__(self) -> Iterator[Dict]:
         while True:

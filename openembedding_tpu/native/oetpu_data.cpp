@@ -317,7 +317,509 @@ class Reader {
   std::vector<std::thread> workers_;
 };
 
+// ---------------------------------------------------------------------------
+// TFRecord reader: the reference's benchmark format (`test/benchmark/
+// criteo_tfrecord.py` — tf.train.Example with label int64[1], I1..I13
+// float[1], C1..C26 int64[1]) WITHOUT a TensorFlow dependency: hand-rolled
+// record framing (uint64 length + masked CRC32C of length and payload) and a
+// proto-wire walker for exactly this schema. Files read SEQUENTIALLY in the
+// given order (the deterministic cycle_length=1 order the Python reader
+// pins — an autotuned interleave width would make the data order
+// machine-dependent), record-level host sharding
+// (global index % num_hosts == host_id).
+// ---------------------------------------------------------------------------
+
+const uint32_t* crc32c_table() {
+  static uint32_t table[256];
+  static bool init = [] {
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; ++k)
+        c = (c & 1) ? 0x82F63B78u ^ (c >> 1) : c >> 1;  // Castagnoli, reflected
+      table[i] = c;
+    }
+    return true;
+  }();
+  (void)init;
+  return table;
+}
+
+uint32_t crc32c(const uint8_t* p, size_t n) {
+  const uint32_t* t = crc32c_table();
+  uint32_t c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < n; ++i) c = t[(c ^ p[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+uint32_t masked_crc32c(const uint8_t* p, size_t n) {
+  uint32_t c = crc32c(p, n);
+  return ((c >> 15) | (c << 17)) + 0xA282EAD8u;
+}
+
+bool read_varint(const uint8_t*& p, const uint8_t* end, uint64_t* out) {
+  uint64_t v = 0;
+  int shift = 0;
+  while (p < end && shift < 64) {
+    uint8_t b = *p++;
+    v |= static_cast<uint64_t>(b & 0x7F) << shift;
+    if (!(b & 0x80)) {
+      *out = v;
+      return true;
+    }
+    shift += 7;
+  }
+  return false;
+}
+
+// Read a (tag, payload-range-or-scalar); only the wire types the Example
+// schema uses. Returns false on malformed input.
+bool skip_field(const uint8_t*& p, const uint8_t* end, uint32_t wire) {
+  uint64_t v;
+  switch (wire) {
+    case 0: return read_varint(p, end, &v);
+    case 1: if (end - p < 8) return false; p += 8; return true;
+    case 2:
+      if (!read_varint(p, end, &v) || static_cast<uint64_t>(end - p) < v)
+        return false;
+      p += v;
+      return true;
+    case 5: if (end - p < 4) return false; p += 4; return true;
+    default: return false;
+  }
+}
+
+// First value of a Feature message: float_list (field 2) or int64_list
+// (field 3), packed or not. kind_out: 2 = float, 3 = int64.
+bool parse_feature(const uint8_t* p, const uint8_t* end, int* kind_out,
+                   double* fval, int64_t* ival) {
+  while (p < end) {
+    uint64_t tag;
+    if (!read_varint(p, end, &tag)) return false;
+    uint32_t field = static_cast<uint32_t>(tag >> 3);
+    uint32_t wire = static_cast<uint32_t>(tag & 7);
+    if ((field == 2 || field == 3) && wire == 2) {
+      uint64_t len;
+      if (!read_varint(p, end, &len) ||
+          static_cast<uint64_t>(end - p) < len)
+        return false;
+      const uint8_t* q = p;
+      const uint8_t* qend = p + len;
+      while (q < qend) {  // the inner list message
+        uint64_t t2;
+        if (!read_varint(q, qend, &t2)) return false;
+        uint32_t f2 = static_cast<uint32_t>(t2 >> 3);
+        uint32_t w2 = static_cast<uint32_t>(t2 & 7);
+        if (f2 == 1 && field == 2 && w2 == 2) {  // packed floats
+          uint64_t blen;
+          if (!read_varint(q, qend, &blen) || blen < 4 ||
+              static_cast<uint64_t>(qend - q) < blen)
+            return false;
+          float f;
+          std::memcpy(&f, q, 4);
+          *kind_out = 2;
+          *fval = f;
+          return true;
+        }
+        if (f2 == 1 && field == 2 && w2 == 5) {  // unpacked float
+          if (qend - q < 4) return false;
+          float f;
+          std::memcpy(&f, q, 4);
+          *kind_out = 2;
+          *fval = f;
+          return true;
+        }
+        if (f2 == 1 && field == 3 && w2 == 2) {  // packed varints
+          uint64_t blen;
+          if (!read_varint(q, qend, &blen) ||
+              static_cast<uint64_t>(qend - q) < blen)
+            return false;
+          const uint8_t* r = q;
+          uint64_t v;
+          if (!read_varint(r, q + blen, &v)) return false;
+          *kind_out = 3;
+          *ival = static_cast<int64_t>(v);
+          return true;
+        }
+        if (f2 == 1 && field == 3 && w2 == 0) {  // unpacked varint
+          uint64_t v;
+          if (!read_varint(q, qend, &v)) return false;
+          *kind_out = 3;
+          *ival = static_cast<int64_t>(v);
+          return true;
+        }
+        if (!skip_field(q, qend, w2)) return false;
+      }
+      p = qend;
+    } else if (!skip_field(p, end, wire)) {
+      return false;
+    }
+  }
+  return false;
+}
+
+// "label" -> (0, 0); "I<k>" -> (1, k-1); "C<k>" -> (2, k-1); else (-1, _).
+void classify_key(const uint8_t* k, size_t n, int* kind, int* idx) {
+  *kind = -1;
+  if (n == 5 && std::memcmp(k, "label", 5) == 0) {
+    *kind = 0;
+    *idx = 0;
+  } else if (n >= 2 && n <= 3 && (k[0] == 'I' || k[0] == 'C')) {
+    // suffix capped at 2 digits (valid range 1..26): an attacker-length
+    // digit string must not overflow the accumulator into a valid index
+    int v = 0;
+    for (size_t i = 1; i < n; ++i) {
+      if (k[i] < '0' || k[i] > '9') return;
+      v = v * 10 + (k[i] - '0');
+    }
+    if (k[0] == 'I' && v >= 1 && v <= kDense) {
+      *kind = 1;
+      *idx = v - 1;
+    } else if (k[0] == 'C' && v >= 1 && v <= kSparse) {
+      *kind = 2;
+      *idx = v - 1;
+    }
+  }
+}
+
+// One serialized tf.train.Example -> row columns. STRICT on the schema: a
+// missing key fails the parse, matching the tf path's FixedLenFeature error
+// — silently zero-filling would train on fabricated data with no signal.
+bool parse_example(const uint8_t* p, const uint8_t* end, float* label,
+                   float* dense, int64_t* sparse) {
+  uint64_t seen = 0;  // bit 0 = label, 1..13 = I, 14..39 = C
+  *label = 0.0f;
+  std::memset(dense, 0, sizeof(float) * kDense);
+  std::memset(sparse, 0, sizeof(int64_t) * kSparse);
+  while (p < end) {
+    uint64_t tag;
+    if (!read_varint(p, end, &tag)) return false;
+    if ((tag >> 3) == 1 && (tag & 7) == 2) {  // Example.features
+      uint64_t flen;
+      if (!read_varint(p, end, &flen) ||
+          static_cast<uint64_t>(end - p) < flen)
+        return false;
+      const uint8_t* fp = p;
+      const uint8_t* fend = p + flen;
+      while (fp < fend) {  // Features.feature map entries
+        uint64_t t2;
+        if (!read_varint(fp, fend, &t2)) return false;
+        if ((t2 >> 3) == 1 && (t2 & 7) == 2) {
+          uint64_t elen;
+          if (!read_varint(fp, fend, &elen) ||
+              static_cast<uint64_t>(fend - fp) < elen)
+            return false;
+          const uint8_t* ep = fp;
+          const uint8_t* eend = fp + elen;
+          const uint8_t* key = nullptr;
+          size_t key_len = 0;
+          const uint8_t* val = nullptr;
+          size_t val_len = 0;
+          while (ep < eend) {  // map entry: key=1 string, value=2 Feature
+            uint64_t t3;
+            if (!read_varint(ep, eend, &t3)) return false;
+            uint64_t l3;
+            if ((t3 & 7) != 2 || !read_varint(ep, eend, &l3) ||
+                static_cast<uint64_t>(eend - ep) < l3)
+              return false;
+            if ((t3 >> 3) == 1) {
+              key = ep;
+              key_len = l3;
+            } else if ((t3 >> 3) == 2) {
+              val = ep;
+              val_len = l3;
+            }
+            ep += l3;
+          }
+          if (key && val) {
+            int kind, idx;
+            classify_key(key, key_len, &kind, &idx);
+            if (kind >= 0) {
+              int vkind;
+              double fv = 0.0;
+              int64_t iv = 0;
+              if (parse_feature(val, val + val_len, &vkind, &fv, &iv)) {
+                if (kind == 0) {
+                  *label = vkind == 3 ? static_cast<float>(iv)
+                                      : static_cast<float>(fv);
+                  seen |= 1ull;
+                } else if (kind == 1) {
+                  dense[idx] = vkind == 3 ? static_cast<float>(iv)
+                                          : static_cast<float>(fv);
+                  seen |= 1ull << (1 + idx);
+                } else {
+                  sparse[idx] = vkind == 3 ? iv : static_cast<int64_t>(fv);
+                  seen |= 1ull << (1 + kDense + idx);
+                }
+              }
+            }
+          }
+          fp += elen;
+        } else if (!skip_field(fp, fend, static_cast<uint32_t>(t2 & 7))) {
+          return false;
+        }
+      }
+      p = fend;
+    } else if (!skip_field(p, end, static_cast<uint32_t>(tag & 7))) {
+      return false;
+    }
+  }
+  const uint64_t all = (1ull << (1 + kDense + kSparse)) - 1;
+  return seen == all;
+}
+
+// A chunk of serialized records handed to parse workers.
+struct TfrChunk {
+  uint64_t seq = 0;
+  std::vector<std::string> records;
+};
+
+// NOTE: TfrReader shares the IO-thread + parse-workers + seq-ordered-merge
+// SHAPE with the TSV Reader above but not its internals: chunk units differ
+// (framed records vs split text), as does the inflight accounting (the TSV
+// pipeline debits on consume, this one on parse). The two are deliberately
+// separate, each with its own shutdown/error tests — a shared template over
+// those differences would couple two proven concurrency paths for ~100
+// saved lines.
+class TfrReader {
+ public:
+  TfrReader(std::vector<std::string> paths, int batch, int host_id,
+            int num_hosts, int n_threads)
+      : paths_(std::move(paths)), batch_(batch), host_id_(host_id),
+        num_hosts_(num_hosts), n_threads_(std::max(1, n_threads)) {
+    io_thread_ = std::thread([this] { io_loop(); });
+    for (int i = 0; i < n_threads_; ++i)
+      workers_.emplace_back([this] { parse_loop(); });
+  }
+
+  ~TfrReader() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      stop_ = true;
+    }
+    cv_in_.notify_all();
+    cv_out_.notify_all();
+    cv_space_.notify_all();
+    io_thread_.join();
+    for (auto& t : workers_) t.join();
+  }
+
+  int next(float* labels, float* dense, int64_t* sparse) {
+    int filled = 0;
+    while (filled < batch_) {
+      if (!cur_ || cur_off_ >= cur_->n) {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_out_.wait(lk, [this] {
+          return stop_ || !error_.empty() || done_.count(next_out_) ||
+                 (io_done_ && inflight_ == 0 && pending_.empty());
+        });
+        if (!error_.empty()) return -1;
+        auto it = done_.find(next_out_);
+        if (it == done_.end()) break;  // clean EOF
+        cur_ = std::move(it->second);
+        done_.erase(it);
+        ++next_out_;
+        cur_off_ = 0;
+        cv_space_.notify_all();
+        continue;
+      }
+      size_t take = std::min<size_t>(cur_->n - cur_off_,
+                                     static_cast<size_t>(batch_ - filled));
+      std::memcpy(labels + filled, cur_->labels.data() + cur_off_,
+                  take * sizeof(float));
+      std::memcpy(dense + static_cast<size_t>(filled) * kDense,
+                  cur_->dense.data() + cur_off_ * kDense,
+                  take * kDense * sizeof(float));
+      std::memcpy(sparse + static_cast<size_t>(filled) * kSparse,
+                  cur_->sparse.data() + cur_off_ * kSparse,
+                  take * kSparse * sizeof(int64_t));
+      filled += static_cast<int>(take);
+      cur_off_ += take;
+    }
+    return filled;
+  }
+
+ private:
+  static constexpr size_t kChunkRecords = 512;
+  static constexpr size_t kMaxPending = 64;
+
+  // Read ONE framed record from f into out; 1 = ok, 0 = clean EOF, -1 = bad.
+  int read_record(std::FILE* f, std::string* out) {
+    uint8_t hdr[12];
+    size_t got = std::fread(hdr, 1, 12, f);
+    if (got == 0) return 0;
+    if (got != 12) return -1;
+    uint64_t len;
+    std::memcpy(&len, hdr, 8);  // little-endian hosts only (x86/ARM)
+    uint32_t len_crc;
+    std::memcpy(&len_crc, hdr + 8, 4);
+    if (masked_crc32c(hdr, 8) != len_crc) return -1;
+    if (len > (1ull << 30)) return -1;  // sanity: 1 GiB record
+    out->resize(len);
+    if (std::fread(out->data(), 1, len, f) != len) return -1;
+    uint8_t crc_buf[4];
+    if (std::fread(crc_buf, 1, 4, f) != 4) return -1;
+    uint32_t data_crc;
+    std::memcpy(&data_crc, crc_buf, 4);
+    if (masked_crc32c(reinterpret_cast<const uint8_t*>(out->data()), len) !=
+        data_crc)
+      return -1;
+    return 1;
+  }
+
+  void io_loop() {
+    std::vector<std::FILE*> files;
+    for (const auto& p : paths_) {
+      std::FILE* f = std::fopen(p.c_str(), "rb");
+      if (!f) {
+        fail("cannot open " + p);
+        for (auto* g : files) std::fclose(g);
+        return;
+      }
+      files.push_back(f);
+    }
+    uint64_t global_idx = 0;
+    uint64_t seq = 0;
+    TfrChunk chunk;
+    std::string rec;
+    bool aborted = false;
+    for (size_t at = 0; at < files.size() && !aborted; ++at) {
+      while (true) {
+        {
+          std::lock_guard<std::mutex> lk(mu_);
+          if (stop_) {
+            aborted = true;
+            break;
+          }
+        }
+        int r = read_record(files[at], &rec);
+        if (r < 0) {
+          fail("corrupt TFRecord in " + paths_[at]);
+          aborted = true;
+          break;
+        }
+        if (r == 0) break;  // next file
+        if (global_idx++ % static_cast<uint64_t>(num_hosts_) ==
+            static_cast<uint64_t>(host_id_))
+          chunk.records.push_back(std::move(rec));
+        if (chunk.records.size() >= kChunkRecords)
+          emit(&chunk, &seq);
+      }
+    }
+    if (!chunk.records.empty()) emit(&chunk, &seq);
+    std::lock_guard<std::mutex> lk(mu_);
+    io_done_ = true;
+    cv_in_.notify_all();
+    cv_out_.notify_all();
+    for (auto* f : files) std::fclose(f);
+  }
+
+  void emit(TfrChunk* chunk, uint64_t* seq) {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_space_.wait(lk, [this] {
+      return stop_ || pending_.size() + done_.size() < kMaxPending;
+    });
+    if (stop_) return;
+    chunk->seq = (*seq)++;
+    pending_.push_back(std::move(*chunk));
+    *chunk = TfrChunk();
+    cv_in_.notify_one();
+  }
+
+  void parse_loop() {
+    while (true) {
+      TfrChunk chunk;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_in_.wait(lk, [this] {
+          return stop_ || !pending_.empty() || io_done_;
+        });
+        if (stop_) return;
+        if (pending_.empty()) {
+          if (io_done_) return;
+          continue;
+        }
+        chunk = std::move(pending_.front());
+        pending_.pop_front();
+        ++inflight_;
+      }
+      auto block = std::make_unique<RowBlock>();
+      block->n = chunk.records.size();
+      block->labels.resize(block->n);
+      block->dense.resize(block->n * kDense);
+      block->sparse.resize(block->n * kSparse);
+      bool ok = true;
+      for (size_t i = 0; i < chunk.records.size(); ++i) {
+        const auto& r = chunk.records[i];
+        const uint8_t* p = reinterpret_cast<const uint8_t*>(r.data());
+        if (!parse_example(p, p + r.size(), &block->labels[i],
+                           &block->dense[i * kDense],
+                           &block->sparse[i * kSparse])) {
+          ok = false;
+          break;
+        }
+      }
+      std::lock_guard<std::mutex> lk(mu_);
+      --inflight_;
+      if (!ok) {
+        error_ = "malformed tf.train.Example (bad wire data or missing schema key)";
+      } else {
+        done_[chunk.seq] = std::move(block);
+      }
+      cv_out_.notify_all();
+    }
+  }
+
+  void fail(std::string msg) {
+    std::lock_guard<std::mutex> lk(mu_);
+    error_ = std::move(msg);
+    io_done_ = true;
+    cv_out_.notify_all();
+    cv_in_.notify_all();
+  }
+
+  const std::vector<std::string> paths_;
+  const int batch_;
+  const int host_id_;
+  const int num_hosts_;
+  const int n_threads_;
+
+  std::mutex mu_;
+  std::condition_variable cv_in_, cv_out_, cv_space_;
+  std::deque<TfrChunk> pending_;
+  std::map<uint64_t, std::unique_ptr<RowBlock>> done_;
+  uint64_t next_out_ = 0;
+  size_t inflight_ = 0;
+  bool io_done_ = false;
+  bool stop_ = false;
+  std::string error_;
+
+  std::unique_ptr<RowBlock> cur_;
+  size_t cur_off_ = 0;
+
+  std::thread io_thread_;
+  std::vector<std::thread> workers_;
+};
+
 }  // namespace
+
+extern "C" {
+
+void* oetpu_tfr_create(const char** paths, int n_paths, int batch, int host_id,
+                       int num_hosts, int n_threads) {
+  std::vector<std::string> ps(paths, paths + n_paths);
+  return new TfrReader(std::move(ps), batch, host_id, num_hosts, n_threads);
+}
+
+int oetpu_tfr_next(void* handle, float* labels, float* dense,
+                   int64_t* sparse) {
+  return static_cast<TfrReader*>(handle)->next(labels, dense, sparse);
+}
+
+void oetpu_tfr_destroy(void* handle) {
+  delete static_cast<TfrReader*>(handle);
+}
+
+}  // extern "C"
 
 extern "C" {
 
