@@ -92,9 +92,10 @@ def read_criteo_tsv(paths, batch_size: int, *, id_space: int = 1 << 25,
     Rows are interleaved across hosts (row i goes to host i % num_hosts) — the
     per-worker sharding the reference gets from tf.data `shard()`.
 
-    `native`: "auto" uses the C++ parse pipeline (`native/oetpu_data.cpp`) when it
-    builds and the files are plain TSV, falling back to this Python parser;
-    "on" requires it; "off" forces Python."""
+    `native`: "auto" uses the C++ parse pipeline (`native/oetpu_data.cpp`) when
+    it builds — plain TSV and .gz alike (zlib inflates in the IO thread) —
+    falling back to this Python parser; "on" requires it; "off" forces
+    Python. Remote URIs always stream through `utils.fs` (Python path)."""
     if isinstance(paths, str):
         paths = [paths]
     if native not in ("auto", "on", "off"):
@@ -104,8 +105,9 @@ def read_criteo_tsv(paths, batch_size: int, *, id_space: int = 1 << 25,
     if any_remote and native == "on":
         raise ValueError("native reader reads local files only; remote URIs "
                          "stream through utils.fs (native='off'/'auto')")
-    if (native != "off" and not any_remote
-            and not any(str(p).endswith(".gz") for p in paths)):
+    if native != "off" and not any_remote:
+        # .gz reads natively too (zlib in the C++ pipeline — Criteo-1TB
+        # ships day_*.gz)
         reader = None
         try:
             # only CONSTRUCTION falls back (no compiler / bad build); a failure
@@ -122,8 +124,6 @@ def read_criteo_tsv(paths, batch_size: int, *, id_space: int = 1 << 25,
         if reader is not None:
             yield from reader
             return
-    elif native == "on":
-        raise ValueError("native reader cannot read .gz files")
     while True:
         pending = []
         for path in paths:

@@ -20,6 +20,10 @@
 //   oetpu_hash_category(token, field, id_space) -> folded id
 //   oetpu_preprocess(in_path, out_path, min_count, vocab_sizes[26]) -> rows (<0 err)
 
+#ifndef OETPU_NO_ZLIB
+#include <zlib.h>
+#endif
+
 #include <algorithm>
 #include <atomic>
 #include <cmath>
@@ -37,6 +41,53 @@
 #include <vector>
 
 namespace {
+
+// Plain or gzip-transparent input (Criteo-1TB ships day_*.gz; the reference
+// streams them through tf.data's GZIP readers, here through zlib directly).
+// Built with -DOETPU_NO_ZLIB (hosts without zlib headers) .gz opens fail
+// loudly and every plain-file path keeps working.
+struct InFile {
+  std::FILE* f = nullptr;
+#ifndef OETPU_NO_ZLIB
+  gzFile gz = nullptr;
+#endif
+
+  bool open(const std::string& path) {
+    if (path.size() > 3 && path.compare(path.size() - 3, 3, ".gz") == 0) {
+#ifndef OETPU_NO_ZLIB
+      gz = gzopen(path.c_str(), "rb");
+      if (gz) gzbuffer(gz, 1 << 20);  // match kChunkBytes, not zlib's 8 KB
+      return gz != nullptr;
+#else
+      return false;  // no zlib in this build
+#endif
+    }
+    f = std::fopen(path.c_str(), "rb");
+    return f != nullptr;
+  }
+
+  // >= 0 bytes read; -1 on stream error (caller must treat as hard error)
+  long read(char* buf, size_t n) {
+#ifndef OETPU_NO_ZLIB
+    if (gz) {
+      int got = gzread(gz, buf, static_cast<unsigned>(n));
+      return got;  // -1 on error
+    }
+#endif
+    size_t got = std::fread(buf, 1, n, f);
+    if (got == 0 && std::ferror(f)) return -1;
+    return static_cast<long>(got);
+  }
+
+  void close() {
+#ifndef OETPU_NO_ZLIB
+    if (gz) gzclose(gz);
+    gz = nullptr;
+#endif
+    if (f) std::fclose(f);
+    f = nullptr;
+  }
+};
 
 constexpr int kDense = 13;
 constexpr int kSparse = 26;
@@ -152,8 +203,8 @@ class Reader {
   void io_loop() {
     uint64_t seq = 0;
     for (const auto& path : paths_) {
-      FILE* f = std::fopen(path.c_str(), "rb");
-      if (!f) {  // unreadable file is an ERROR, matching the Python open()
+      InFile in;
+      if (!in.open(path)) {  // unreadable file is an ERROR, like Python open()
         set_error("cannot open " + path);
         return;
       }
@@ -161,12 +212,17 @@ class Reader {
       std::string carry;  // only the short unterminated tail of each read
       std::vector<char> buf(kChunkBytes);
       while (true) {
-        size_t got = std::fread(buf.data(), 1, buf.size(), f);
+        long got = in.read(buf.data(), buf.size());
+        if (got < 0) {
+          in.close();
+          set_error("read error on " + path);
+          return;
+        }
         if (got == 0) break;
         const char* nl = static_cast<const char*>(
-            memrchr(buf.data(), '\n', got));
+            memrchr(buf.data(), '\n', static_cast<size_t>(got)));
         if (!nl) {  // no newline in the whole read: accumulate and continue
-          carry.append(buf.data(), got);
+          carry.append(buf.data(), static_cast<size_t>(got));
           continue;
         }
         size_t head = static_cast<size_t>(nl - buf.data()) + 1;
@@ -174,19 +230,14 @@ class Reader {
         chunk.text.reserve(carry.size() + head);
         chunk.text = std::move(carry);
         chunk.text.append(buf.data(), head);
-        carry.assign(buf.data() + head, got - head);
+        carry.assign(buf.data() + head, static_cast<size_t>(got) - head);
         chunk.first_row = row;
         row += static_cast<uint64_t>(
             std::count(chunk.text.begin(), chunk.text.end(), '\n'));
         chunk.seq = seq++;
-        if (!push_chunk(std::move(chunk))) { std::fclose(f); return; }
+        if (!push_chunk(std::move(chunk))) { in.close(); return; }
       }
-      if (std::ferror(f)) {
-        std::fclose(f);
-        set_error("read error on " + path);
-        return;
-      }
-      std::fclose(f);
+      in.close();
       if (!carry.empty()) {  // final unterminated line
         TextChunk chunk;
         chunk.text = std::move(carry);
