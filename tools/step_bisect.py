@@ -73,6 +73,24 @@ def main():
         return carry + u.inverse.astype(carry.dtype)
     timeit_scan(dedup, ids, "dedup (unique_with_counts)")
 
+    # 1b. fused dedup + owner routing (the round-4 exchange plan: one
+    # multi-key sort; compare against 1 + a second bucket_by_owner sort)
+    from openembedding_tpu.ops.dedup import unique_and_route
+
+    def fused_route(carry):
+        u, b = unique_and_route(carry, carry >= 0, 8, carry.shape[0] // 8)
+        return carry + u.inverse.astype(carry.dtype) + b.owner.astype(
+            carry.dtype)
+    timeit_scan(fused_route, ids, "dedup+route fused (unique_and_route S=8)")
+
+    def split_route(carry):
+        u = unique_with_counts(carry)
+        b = bucket_by_owner(u.unique_ids, u.counts > 0, 8,
+                            carry.shape[0] // 8)
+        return carry + u.inverse.astype(carry.dtype) + b.owner.astype(
+            carry.dtype)
+    timeit_scan(split_route, ids, "dedup+route split (2 sorts, r3 protocol)")
+
     # 2. gather only
     def gather(carry):
         rows = lookup_rows(table.weights, carry)
