@@ -232,12 +232,13 @@ def case_trainer(dim):
 
 
 def case_mesh1(capacity_factor=0.0, name="mesh1"):
-    """MeshTrainer on a 1-device mesh: same workload as dim9, but through the full
-    sharded pull/push protocol (dedup -> owner bucketing -> all_to_all -> fused
-    apply, `parallel/sharded.py`) — the honest number for the multi-chip path's
-    per-chip overhead. `capacity_factor > 0` is the production configuration
-    (bounded buckets, sized per the rule in `parallel/sharded.py`); 0 = exact
-    mode (cap = n, can never drop)."""
+    """MeshTrainer on a 1-device mesh: same workload as dim9, but through the
+    sharded protocol entry points — the honest number for the multi-chip
+    path's per-chip overhead. NOTE (round 4): at S=1 `make_plan` specializes
+    to identity routing, so the bucket scatters and collectives are gone and
+    `capacity_factor` has no effect (mesh1 == mesh1f by construction; both
+    cases are kept so a regression that reintroduces S-invariant overhead is
+    visible against dim9). Bounded buckets engage from S >= 2."""
     import jax
     import openembedding_tpu as embed
     from openembedding_tpu.models import make_deepfm
