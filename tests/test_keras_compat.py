@@ -386,3 +386,46 @@ def test_multi_embedding_functional_model():
         print("MULTI_EMB_OK")
     """)
     assert "MULTI_EMB_OK" in out
+
+
+def test_converted_model_checkpoint_roundtrip(tmp_path):
+    """The full user journey keeps working through the converter: train a
+    converted Keras model, checkpoint with the Trainer, restore into a FRESH
+    conversion of the same architecture, predictions identical."""
+    out = _run(f"""
+        import numpy as np, keras
+        import openembedding_tpu as embed
+        from openembedding_tpu.keras_compat import from_keras_model
+        from openembedding_tpu.model import Trainer
+
+        def build():
+            cat = keras.Input(shape=(3,), dtype="int32", name="cat")
+            emb = keras.layers.Embedding(200, 8, name="emb")(cat)
+            x = keras.layers.Flatten()(emb)
+            x = keras.layers.Dense(16, activation="relu")(x)
+            out = keras.layers.Dense(1, activation="sigmoid")(x)
+            return keras.Model(cat, out)
+
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, 200, (64, 3)).astype(np.int32)
+        y = (ids[:, 0] % 2).astype(np.float32)
+        batch = {{"sparse": {{"cat": ids}}, "dense": None, "label": y}}
+
+        emodel, _ = from_keras_model(build())
+        tr = Trainer(emodel, embed.Adagrad(learning_rate=0.3))
+        state = tr.init(batch)
+        step = tr.jit_train_step()
+        for _ in range(10):
+            state, m = step(state, batch)
+        want = np.asarray(tr.jit_eval_step()(state, batch)["logits"])
+        tr.save(state, {str(tmp_path / "ck")!r})
+
+        emodel2, _ = from_keras_model(build())
+        tr2 = Trainer(emodel2, embed.Adagrad(learning_rate=0.3))
+        state2 = tr2.init(batch)
+        state2 = tr2.load(state2, {str(tmp_path / "ck")!r})
+        got = np.asarray(tr2.jit_eval_step()(state2, batch)["logits"])
+        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
+        print("CONVERTED_CKPT_OK")
+    """)
+    assert "CONVERTED_CKPT_OK" in out
