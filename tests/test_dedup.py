@@ -293,3 +293,39 @@ def test_mesh_bf16_table_counts_ride_two_lanes():
     # O(flip/count)); bf16 rounding is the only legitimate difference
     np.testing.assert_allclose(got, want, rtol=0.05, atol=0.05)
     assert np.abs(got).max() > 0  # the step really updated rows
+
+
+@pytest.mark.parametrize("case", ["single", "all_invalid", "all_same"])
+def test_unique_and_route_edges(case):
+    """Degenerate inputs through the fused plan: one id, nothing valid, one
+    id duplicated across the whole batch."""
+    from openembedding_tpu.ops.dedup import bucket_validity, unique_and_route
+
+    S, cap = 4, 8
+    if case == "single":
+        ids = jnp.asarray(np.asarray([5], np.int32))
+        valid = jnp.asarray([True])
+    elif case == "all_invalid":
+        ids = jnp.asarray(np.full((16,), -1, np.int32))
+        valid = jnp.zeros((16,), bool)
+    else:
+        ids = jnp.asarray(np.full((16,), 7, np.int32))
+        valid = jnp.ones((16,), bool)
+    uniq, buckets = jax.jit(
+        lambda i, v: unique_and_route(i, v, S, cap))(ids, valid)
+
+    occupancy = int(np.asarray(bucket_validity(buckets.bucket_ids)).sum())
+    if case == "single":
+        assert int(uniq.num_unique) == 1
+        assert occupancy == 1
+        assert int(buckets.owner[0]) == 5 % S
+    elif case == "all_invalid":
+        assert occupancy == 0
+        assert int(buckets.overflow) == 0
+        # every element routed to the invalid pseudo-owner
+        assert np.all(np.asarray(buckets.owner) == S)
+    else:
+        assert int(uniq.num_unique) == 1
+        assert occupancy == 1
+        assert int(np.asarray(uniq.counts)[0]) == 16
+        np.testing.assert_array_equal(np.asarray(uniq.inverse), 0)
