@@ -502,3 +502,46 @@ def test_sharded_delta_restore_requires_trainer(tmp_path):
     fstate = fresh.init(batches[0])
     with pytest.raises(ValueError, match="trainer"):
         restore_server_model(fstate, model, root)  # trainer omitted
+
+
+def test_dirty_tracker_window_semantics():
+    """observe() accumulates per-batch uniques cheaply; take() returns the
+    sorted cross-batch union and resets the window."""
+    from openembedding_tpu.persist import DirtyTracker
+
+    model = make_deepfm(vocabulary=VOCAB, dim=4, hidden=(8,))
+    t = DirtyTracker(model)
+    t.observe({"sparse": {"categorical": np.asarray([[5, 3], [9, 5]])}})
+    t.observe({"sparse": {"categorical": np.asarray([[3, -1], [7, 7]])}})
+    got = t.take()
+    np.testing.assert_array_equal(got["categorical"], [3, 5, 7, 9])  # no -1
+    assert t.take()["categorical"].size == 0  # window reset
+
+
+def test_delta_chain_broken_link_replays_prefix(setup, tmp_path):
+    """Deleting a MIDDLE delta breaks the parent chain: restore replays only
+    the consistent prefix (base + first delta), never skipping a link."""
+    import shutil
+    from openembedding_tpu.persist import (IncrementalPersister, delta_chain,
+                                           list_deltas)
+
+    model, trainer, state, batches = setup
+    step = trainer.jit_train_step()
+    root = str(tmp_path / "persist")
+    with IncrementalPersister(trainer, model, root, window=2,
+                              policy=PersistPolicy(every_steps=1),
+                              full_every=100) as p:
+        for b in batches[:4]:  # full base at 1, deltas at 2, 3, 4
+            state, _ = step(state, b)
+            p.maybe_persist(state, batch=b)
+        p.wait()
+    deltas = list_deltas(root)
+    assert [s for s, _ in deltas] == [2, 3, 4]
+    shutil.rmtree(deltas[1][1])  # delta_3 vanishes
+
+    base, chain = delta_chain(root)
+    assert base is not None
+    assert [os.path.basename(c) for c in chain] == ["delta_000000000002"]
+    restored = restore_server_model(trainer.init(batches[0]), model, root,
+                                    trainer=trainer)
+    assert int(restored.step) == 2  # the consistent prefix, not 4
