@@ -429,3 +429,39 @@ def test_converted_model_checkpoint_roundtrip(tmp_path):
         print("CONVERTED_CKPT_OK")
     """)
     assert "CONVERTED_CKPT_OK" in out
+
+
+def test_from_logits_bce_maps_to_logit_loss():
+    """BinaryCrossentropy(from_logits=True) + linear head converts to the
+    logits objective and trains (the probability path is covered elsewhere)."""
+    out = _run("""
+        import numpy as np, keras
+        import openembedding_tpu as embed
+        from openembedding_tpu.keras_compat import from_keras_model
+        from openembedding_tpu.model import Trainer, binary_logloss
+
+        cat = keras.Input(shape=(2,), dtype="int32", name="cat")
+        emb = keras.layers.Embedding(64, 4, name="emb")(cat)
+        x = keras.layers.Flatten()(emb)
+        out = keras.layers.Dense(1)(x)  # linear head: logits
+        m = keras.Model(cat, out)
+        m.compile(optimizer=keras.optimizers.Adagrad(learning_rate=0.5),
+                  loss=keras.losses.BinaryCrossentropy(from_logits=True))
+
+        emodel, opt = from_keras_model(m)
+        assert emodel.loss_fn is binary_logloss
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, 64, (64, 2)).astype(np.int32)
+        y = (ids[:, 0] % 2).astype(np.float32)
+        batch = {"sparse": {"cat": ids}, "dense": None, "label": y}
+        tr = Trainer(emodel, opt)
+        state = tr.init(batch)
+        step = tr.jit_train_step()
+        losses = []
+        for _ in range(15):
+            state, mtr = step(state, batch)
+            losses.append(float(mtr["loss"]))
+        assert losses[-1] < losses[0] * 0.6, losses
+        print("FROM_LOGITS_OK")
+    """)
+    assert "FROM_LOGITS_OK" in out
