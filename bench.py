@@ -338,6 +338,26 @@ def main():
             continue
         run_case(name, fn)
 
+    # Secondary-only invocations (tools/upwindow.py runs one case per call so a
+    # relay drop loses at most one case): promote the first green case to the
+    # primary slot, else the orchestrator reads `value: null` as red and burns
+    # its whole budget retrying a measurement that in fact succeeded.
+    if RESULT["value"] is None and "dim9" not in cases:
+        for name in cases:
+            out = EXTRA.get(name)
+            if not isinstance(out, dict):
+                continue
+            if "examples_per_sec_per_chip" in out:
+                RESULT["metric"] = f"{name}_examples_per_sec_per_chip"
+                RESULT["value"] = out["examples_per_sec_per_chip"]
+                RESULT["vs_baseline"] = out.get("vs_baseline_dim9")
+                break
+            if "pull_p50_us" in out:
+                RESULT["metric"] = "embedding_pull_p50_us"
+                RESULT["value"] = out["pull_p50_us"]
+                RESULT["unit"] = "us"
+                break
+
     WD.clear()
     return emit()
 

@@ -1,0 +1,41 @@
+#!/bin/bash
+# Detached relay watcher (round 5). Probes the axon relay every 5 minutes
+# with a throwaway subprocess (a hung claim = relay down; the probe eats the
+# hang, not this shell) and, on the first up-window, runs the prioritized
+# evidence battery tools/upwindow.py — committing results case by case.
+# Re-entrant: already-green cases are skipped via /tmp/upwindow_r5_done.json.
+#
+# Launch:  nohup bash tools/chip_watcher.sh >/dev/null 2>&1 &
+# Retire:  touch /tmp/upwindow_r5_stop      (do this before round end so the
+#          driver's own bench.py capture has the chip to itself)
+LOG=/tmp/chip_watcher_r5.log
+MAX_ATTEMPTS=6   # a deterministically-red battery must not commit forever
+attempts=0
+cd "$(dirname "$0")/.." || exit 1
+echo "$(date -u '+%F %T') watcher started (pid $$)" >> "$LOG"
+while true; do
+  if [ -f /tmp/upwindow_r5_stop ]; then
+    echo "$(date -u '+%F %T') stop marker found, exiting" >> "$LOG"
+    exit 0
+  fi
+  if timeout 75 python -c \
+      "import jax; d=jax.devices(); assert d[0].platform != 'cpu'" \
+      >> "$LOG" 2>&1; then
+    echo "$(date -u '+%F %T') RELAY UP — running battery" >> "$LOG"
+    python tools/upwindow.py --no-probe >> /tmp/upwindow_r5.log 2>&1
+    rc=$?
+    attempts=$((attempts + 1))
+    echo "$(date -u '+%F %T') battery rc=$rc (attempt $attempts)" >> "$LOG"
+    if [ "$rc" -eq 0 ]; then
+      echo "$(date -u '+%F %T') all cases green, retiring" >> "$LOG"
+      exit 0
+    fi
+    if [ "$attempts" -ge "$MAX_ATTEMPTS" ]; then
+      echo "$(date -u '+%F %T') $attempts failed batteries, retiring" >> "$LOG"
+      exit 1
+    fi
+  else
+    echo "$(date -u '+%F %T') relay down (probe timeout/fail)" >> "$LOG"
+  fi
+  sleep 300
+done

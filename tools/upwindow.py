@@ -1,0 +1,199 @@
+"""Prioritized chip-evidence battery for a relay up-window.
+
+The axon relay to the one real v5e chip goes down for hours at a time; every
+builder-side perf claim since round 1 is CPU-relative because no up-window
+coincided with a measurement session (VERDICT r4 "What's missing" #1). This
+script spends an up-window in strict priority order so that even a 10-minute
+window yields permanent evidence:
+
+  1. bench dim9        — the headline number (vs 86.5k/chip baseline)
+  2. bench dim64       — packed (V,128)+(V,2) layout, first chip number
+  3. dim64_probe       — memory_analysis(): is the padded table copy gone?
+  4. bench mesh1+mesh1f— the fused exchange route on-chip (r3 chip datum 0.854x
+                         predates the fused route; CPU says ~1.25x)
+  5. bench pull        — p50 latency
+  6. step_bisect       — stage times incl. fused vs split route (feeds the
+                         v5e-64 projection arithmetic, VERDICT item 7)
+  7. offload           — scan-fused offload_train_many ex/s at a >HBM table
+
+After EACH case the raw output is appended to PERF_CHIP_R5.md and committed,
+so a window that dies mid-battery still leaves everything it measured in the
+repo history. Pure-Python orchestrator: jax is only imported in child
+processes (a hung backend claim is uninterruptible in-process — see
+bench.py's orchestrator and the same lesson in PERF.md).
+
+Usage: python tools/upwindow.py [--skip CASE,CASE] [--no-commit]
+Typically invoked by tools/chip_watcher.sh when a probe succeeds.
+"""
+
+import argparse
+import datetime
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "PERF_CHIP_R5.md")
+DONE = "/tmp/upwindow_r5_done.json"  # cases already green (watcher re-entry)
+
+# (name, argv, env overrides, timeout_s). bench.py cases reuse its watchdogs/
+# retries. The orchestrator's TOTAL budget must EXCEED the per-run case budget
+# (the child's deadline is computed from TOTAL's remainder); +240s leaves one
+# probe cycle + margin, while still failing fast if the relay drops mid-battery
+# instead of eating the window's remainder on doomed retries.
+
+
+def bench_case(cases, budget):
+    env = {"OETPU_BENCH_CASES": cases,
+           "OETPU_BENCH_BUDGET_S": str(budget),
+           "OETPU_BENCH_TOTAL_BUDGET_S": str(budget + 240),
+           "OETPU_BENCH_PROBE_TIMEOUT_S": "75"}
+    return ([sys.executable, os.path.join(REPO, "bench.py")], env,
+            budget + 300)
+
+
+CASES = [
+    ("bench_dim9", *bench_case("dim9", 420)),
+    ("bench_dim64", *bench_case("dim64", 420)),
+    ("dim64_probe",
+     [sys.executable, os.path.join(REPO, "tools", "dim64_probe.py")], {}, 600),
+    ("bench_mesh", *bench_case("mesh1,mesh1f", 500)),
+    ("bench_pull", *bench_case("pull", 300)),
+    ("step_bisect",
+     [sys.executable, os.path.join(REPO, "tools", "step_bisect.py")], {}, 900),
+    ("offload",
+     [sys.executable, os.path.join(REPO, "examples", "criteo_deepctr.py"),
+      "--model", "deepfm", "--dim", "64", "--synthetic",
+      "--batch-size", "4096", "--steps", "64", "--scan", "16",
+      "--vocabulary", str(1 << 24), "--offload", str(1 << 20)], {}, 900),
+]
+
+
+def log(msg):
+    print(f"[upwindow t={time.time() - T0:7.1f}s] {msg}", flush=True)
+
+
+T0 = time.time()
+
+
+def append_and_commit(name, text, commit=True):
+    with open(OUT, "a") as f:
+        f.write(text)
+    if not commit:
+        return
+    for attempt in range(5):
+        try:
+            # add (the file starts untracked) + pathspec-scoped commit: must
+            # not sweep up files the interactive session staged concurrently
+            subprocess.run(["git", "add", "PERF_CHIP_R5.md"], cwd=REPO,
+                           check=True, capture_output=True, timeout=60)
+            subprocess.run(
+                ["git", "commit", "-m",
+                 f"Chip evidence: {name} (upwindow battery)",
+                 "--", "PERF_CHIP_R5.md"],
+                cwd=REPO, check=True, capture_output=True, timeout=60)
+            return
+        except subprocess.CalledProcessError as e:
+            # index.lock contention with the interactive session is expected;
+            # "nothing to commit" means a concurrent commit already took it
+            err = (e.stdout or b"").decode() + (e.stderr or b"").decode()
+            if "nothing to commit" in err:
+                return
+            time.sleep(3 + 2 * attempt)
+        except subprocess.TimeoutExpired:
+            time.sleep(3)
+    log(f"WARNING: could not commit {name} (left in working tree)")
+
+
+def run_case(name, argv, env_over, timeout):
+    log(f"case {name}: starting (timeout {timeout}s)")
+    env = dict(os.environ, **env_over)
+    t0 = time.time()
+    try:
+        p = subprocess.run(argv, cwd=REPO, env=env, capture_output=True,
+                           text=True, timeout=timeout)
+        rc, out, err = p.returncode, p.stdout, p.stderr
+    except subprocess.TimeoutExpired as e:
+        rc = 124
+        out = (e.stdout or b"").decode(errors="replace") if isinstance(
+            e.stdout, bytes) else (e.stdout or "")
+        err = (e.stderr or b"").decode(errors="replace") if isinstance(
+            e.stderr, bytes) else (e.stderr or "")
+    dt = time.time() - t0
+    log(f"case {name}: rc={rc} in {dt:.0f}s")
+    stamp = datetime.datetime.utcnow().strftime("%Y-%m-%d %H:%M:%S UTC")
+    tail = lambda s, n: "\n".join(s.strip().splitlines()[-n:])
+    text = (f"\n## {name} — {stamp} (rc={rc}, {dt:.0f}s)\n\n"
+            f"```\n{tail(out, 60)}\n```\n")
+    if rc != 0 or not out.strip():
+        text += f"\nstderr tail:\n```\n{tail(err, 40)}\n```\n"
+    return rc, out, text
+
+
+def probe(timeout=75):
+    """One throwaway-subprocess chip probe; True iff the relay answered."""
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d=jax.devices(); print(d); "
+             "assert d[0].platform != 'cpu'"],
+            capture_output=True, timeout=timeout, cwd=REPO)
+        return p.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--skip", default="", help="comma-separated case names")
+    ap.add_argument("--no-commit", action="store_true")
+    ap.add_argument("--no-probe", action="store_true",
+                    help="assume the relay is up (caller already probed)")
+    ap.add_argument("--force", action="store_true",
+                    help="re-run cases already green in a prior invocation")
+    args = ap.parse_args()
+    skip = set(filter(None, args.skip.split(",")))
+    done = set()
+    if not args.force and os.path.exists(DONE):
+        with open(DONE) as f:
+            done = set(json.load(f))
+        if done:
+            log(f"prior green cases (skipping): {sorted(done)}")
+
+    if not args.no_probe:
+        log("probing relay before spending the window")
+        if not probe():
+            log("relay DOWN — exiting without touching PERF_CHIP_R5.md")
+            return 3
+
+    if not os.path.exists(OUT):
+        append_and_commit("init", (
+            "# PERF_CHIP_R5 — on-chip evidence battery (round 5)\n\n"
+            "Raw per-case output from tools/upwindow.py, appended and\n"
+            "committed after each case during relay up-windows. Analysis\n"
+            "is folded into PERF.md; this file is the primary record.\n"),
+            commit=not args.no_commit)
+
+    results = {}
+    for name, argv, env_over, timeout in CASES:
+        if name in skip or name in done:
+            continue
+        rc, out, text = run_case(name, argv, env_over, timeout)
+        append_and_commit(name, text, commit=not args.no_commit)
+        results[name] = rc
+        if rc == 0:
+            done.add(name)
+            with open(DONE, "w") as f:
+                json.dump(sorted(done), f)
+        if rc != 0 and not probe():
+            log("relay dropped mid-battery — stopping (evidence so far is "
+                "committed); rerun when it returns")
+            break
+    log(f"battery done: {results}")
+    return 0 if all(v == 0 for v in results.values()) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
