@@ -100,6 +100,34 @@ def global_batch(local: Dict, mesh: Mesh, axis: str = DATA_AXIS) -> Dict:
     return jax.tree_util.tree_map(put, local)
 
 
+def allgather_host_ids(ids: np.ndarray) -> np.ndarray:
+    """Union of per-process host-side id sets -> sorted unique int64 array.
+
+    COLLECTIVE: every process must call at the same point with its own local
+    set (the incremental persister's touched-id union — each host observes
+    only its input slice, but a row touched by ANY host's batch must land in
+    the delta; the reference's per-node dump never needs this because each
+    server node already holds the authoritative touched set for its shards,
+    `EmbeddingDumpOperator.cpp:36-96`). Two rounds: gather counts, then the
+    -1-padded id payloads at the max count."""
+    ids = np.unique(np.asarray(ids, np.int64).reshape(-1))
+    ids = ids[ids >= 0]
+    if jax.process_count() == 1:
+        return ids
+    from jax.experimental import multihost_utils
+    counts = multihost_utils.process_allgather(
+        np.asarray([ids.size], np.int64))
+    m = int(np.max(counts))
+    if m == 0:
+        return np.empty((0,), np.int64)
+    padded = np.full((m,), -1, np.int64)
+    padded[:ids.size] = ids
+    gathered = np.asarray(
+        multihost_utils.process_allgather(padded)).reshape(-1)
+    gathered = np.unique(gathered)
+    return gathered[gathered >= 0]
+
+
 def host_sharded_reader(paths: Sequence[str], global_batch_size: int,
                         mesh: Mesh, *, axis: str = DATA_AXIS,
                         id_space: int = 1 << 25, repeat: bool = False,

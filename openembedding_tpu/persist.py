@@ -368,10 +368,22 @@ class DirtyTracker:
         self._chunks = {name: [] for name in self._feats}
         self.observed = 0
 
+    @staticmethod
+    def _host_view(x):
+        """Batch leaf -> host array. Multi-process global batches are not
+        fully addressable; each process observes the rows IT fed (its
+        addressable shards) — the cross-process union happens at persist
+        time (`multihost.allgather_host_ids`)."""
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            return np.concatenate(
+                [np.asarray(s.data) for s in x.addressable_shards], axis=0)
+        return np.asarray(x)
+
     def observe(self, batch) -> None:
         from .ops.id64 import np_ids_as_int64
         for name, feat in self._feats.items():
-            ids = np.unique(np_ids_as_int64(batch["sparse"][feat]))
+            ids = np.unique(np_ids_as_int64(
+                self._host_view(batch["sparse"][feat])))
             ids = ids[ids >= 0]
             if ids.size:
                 self._chunks[name].append(ids)
@@ -458,6 +470,49 @@ def _make_mesh_row_reader(mesh, axis, state_pspec):
         out_specs=(P(), P(), slot_specs), check_vma=False))
 
 
+def _make_shard_row_reader(mesh, axis, state_pspec, use_hash: bool,
+                           input_dim: int):
+    """shard_map'd touched-row read with PER-SHARD outputs: every shard reads
+    the rows it owns out of the same replicated padded id list, and the
+    outputs stay sharded over `axis` — so in a multi-process mesh each
+    process's addressable output shards hold exactly the rows its local
+    table shards own (the reference's per-node dump locality,
+    `EmbeddingDumpOperator.cpp:36-96`), with no cross-host row traffic."""
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from .tables.hash_table import hash_find, shard_probe
+
+    def read(ts, ids):
+        if use_hash:
+            keys = ts.keys
+            mine, probe = shard_probe(keys, ids, axis)
+            slot = hash_find(keys, probe)
+            cap = keys.shape[0]
+            found = mine & (slot < cap)
+            idx = jnp.clip(slot, 0, cap - 1)
+        else:
+            S = jax.lax.axis_size(axis)
+            me = jax.lax.axis_index(axis)
+            ok = (ids >= 0) & (ids < input_dim)
+            mine = ok & ((ids % S).astype(jnp.int32) == me)
+            local = jnp.clip(ids // S, 0, ts.weights.shape[0] - 1)
+            found = mine
+            idx = local
+        w = jnp.where(found[:, None],
+                      jnp.take(ts.weights, idx, axis=0), 0.0)
+        s = {k: jnp.where(found[:, None], jnp.take(v, idx, axis=0), 0.0)
+             for k, v in ts.slots.items()}
+        return found, w, s
+
+    slot_specs = {k: P(axis, None) for k in
+                  (state_pspec.slots if isinstance(state_pspec.slots, dict)
+                   else {})}
+    return jax.jit(jax.shard_map(
+        read, mesh=mesh, in_specs=(state_pspec, P()),
+        out_specs=(P(axis), P(axis, None), slot_specs), check_vma=False))
+
+
 class IncrementalPersister(AsyncPersister):
     """AsyncPersister whose steady-state persists are O(touched rows).
 
@@ -474,19 +529,29 @@ class IncrementalPersister(AsyncPersister):
     an unobserved window falls back to a full persist with a warning.)
 
     Persist schedule: a full base every `full_every` persists (bounds the
-    restore replay chain), deltas in between. Works on one device and on a
-    single-host mesh (sharded tables: array rows address through the
-    shard-major layout, hash rows through a shard_map'd probe). Multi-HOST
-    stays full per-shard dumps (AsyncPersister); host-cached tables also
-    fall back to full persists — their store already lives host-side and the
+    restore replay chain), deltas in between. Works on one device, on a
+    single-host mesh, AND on multi-process meshes (sharded tables: array
+    rows address through the shard-major layout, hash rows through a
+    shard_map'd probe). Multi-process deltas follow the reference's per-node
+    dump (`EmbeddingDumpOperator.cpp:36-96`): the touched-id set is unioned
+    across processes (host allgather — every process must drive persist at
+    the same steps, which synchronous SPMD training guarantees), each
+    process writes ONLY the rows its local shards own
+    (`table_<name>.p<idx>.npz`), and the done-marker/COMMIT protocol of the
+    full path makes the delta crash-consistent. Host-cached tables fall
+    back to full persists — their store already lives host-side and the
     admission bookkeeping, not the snapshot, is their cost."""
 
     def __init__(self, trainer, model, root: str, *, full_every: int = 8,
                  **kw):
         if jax.process_count() > 1:
-            raise ValueError(
-                "IncrementalPersister is single-process; multi-host training "
-                "persists full per-shard dumps (AsyncPersister)")
+            policy = kw.get("policy")
+            if policy is not None and policy.every_seconds > 0:
+                raise ValueError(
+                    "multi-process IncrementalPersister needs a step-driven "
+                    "policy (every_steps): wall-clock policies fire at "
+                    "different steps on different hosts, and the touched-id "
+                    "union is a collective")
         if full_every < 1:
             raise ValueError("full_every must be >= 1")
         super().__init__(trainer, model, root, **kw)
@@ -510,7 +575,12 @@ class IncrementalPersister(AsyncPersister):
         key = (name, padded_n)
         if key not in self._readers:
             S = self.trainer.num_shards
-            if spec.use_hash_table and S > 1:
+            if jax.process_count() > 1:
+                self._readers[key] = _make_shard_row_reader(
+                    self.trainer.mesh, self.trainer.axis,
+                    self.trainer._table_pspec(spec),
+                    spec.use_hash_table, spec.input_dim)
+            elif spec.use_hash_table and S > 1:
                 self._readers[key] = _make_mesh_row_reader(
                     self.trainer.mesh, self.trainer.axis,
                     self.trainer._table_pspec(spec))
@@ -537,6 +607,8 @@ class IncrementalPersister(AsyncPersister):
         else:
             ids_dev = ids_h.astype(np.int32)  # array vocab always < 2^31
         found, w, s = self._reader(name, spec, padded)(ts, ids_dev)
+        if jax.process_count() > 1:
+            return self._collect_local(ids_h, found, w, s)
         found = np.asarray(found)[:n] if n else np.zeros((0,), bool)
         keep = found
         out = {"ids": ids64[keep],
@@ -545,12 +617,43 @@ class IncrementalPersister(AsyncPersister):
             out[f"slot_{k}"] = np.asarray(v)[:n][keep].astype(np.float32)
         return out
 
+    @staticmethod
+    def _collect_local(ids_h, found, w, slots):
+        """Per-process delta payload from the shard reader's SHARDED outputs:
+        every shard's (padded,)-long verdict masks the same global id list,
+        and this process keeps only the rows its addressable shards found —
+        disjoint across processes because row ownership is unique."""
+        by_dev = lambda arr: {sh.device: np.asarray(sh.data)  # noqa: E731
+                              for sh in arr.addressable_shards}
+        fd, wd = by_dev(found), by_dev(w)
+        sd = {k: by_dev(v) for k, v in slots.items()}
+        ids_p, w_p = [], []
+        s_p = {k: [] for k in slots}
+        for dev in fd:
+            keep = fd[dev].astype(bool)
+            ids_p.append(ids_h[keep])
+            w_p.append(wd[dev][keep])
+            for k in sd:
+                s_p[k].append(sd[k][dev][keep])
+        out = {"ids": np.concatenate(ids_p),
+               "weights": np.concatenate(w_p).astype(np.float32)}
+        for k, parts in s_p.items():
+            out[f"slot_{k}"] = np.concatenate(parts).astype(np.float32)
+        return out
+
     # -- persist dispatch ----------------------------------------------------
 
     def persist(self, state) -> str:
         self._raise_pending_error()
         step = int(state.step)
         touched = self.tracker.take()
+        if jax.process_count() > 1:
+            # COLLECTIVE union of the per-host touched sets (sorted table
+            # order so every process gathers in the same sequence); also
+            # makes the full-vs-delta decision below identical on all hosts
+            from .parallel.multihost import allgather_host_ids
+            touched = {name: allgather_host_ids(touched[name])
+                       for name in sorted(touched)}
         unobserved = (not any(v.size for v in touched.values())
                       and self._last_persist_step is not None
                       and step > self._last_persist_step)
@@ -597,8 +700,15 @@ class IncrementalPersister(AsyncPersister):
                              tmp: str) -> None:
         import json
         os.makedirs(tmp, exist_ok=True)
+        pidx, pcount = jax.process_index(), jax.process_count()
+        # per-process shard files (reference: per-node dump); single-process
+        # keeps the unsuffixed name so existing delta roots stay readable
+        suffix = f".p{pidx}" if pcount > 1 else ""
         for name, payload in tables.items():
-            np.savez(os.path.join(tmp, f"table_{name}.npz"), **payload)
+            np.savez(os.path.join(tmp, f"table_{name}{suffix}.npz"),
+                     **payload)
+        if pidx != 0:
+            return  # dense tree + meta are replicated; process 0 writes them
         np.savez(os.path.join(tmp, "dense.npz"),
                  **{f"params/{k}": v for k, v in dense["params"].items()},
                  **{f"slots/{k}": v for k, v in dense["slots"].items()})
@@ -617,6 +727,37 @@ class IncrementalPersister(AsyncPersister):
                 if step <= newest_full:
                     shutil.rmtree(path, ignore_errors=True)
         super()._gc()
+
+
+def _load_delta_table(path: str, name: str):
+    """-> concatenated (ids, weights, slots) for one table of one delta:
+    the single-process `table_<name>.npz` or the union of per-process
+    `table_<name>.p<idx>.npz` shard files (rows are disjoint — each process
+    wrote only the rows its shards own)."""
+    import glob as _glob
+
+    single = os.path.join(path, f"table_{name}.npz")
+    if os.path.exists(single):
+        files = [single]
+    else:
+        files = _glob.glob(os.path.join(path, f"table_{name}.p*.npz"))
+        files.sort(key=lambda p: int(
+            re.search(r"\.p(\d+)\.npz$", p).group(1)))
+    ids_l, w_l, slots_l = [], [], None
+    for fp in files:
+        with np.load(fp) as z:
+            ids_l.append(z["ids"])
+            w_l.append(z["weights"])
+            s = {k[len("slot_"):]: z[k] for k in z.files
+                 if k.startswith("slot_")}
+        if slots_l is None:
+            slots_l = {k: [] for k in s}
+        for k, v in s.items():
+            slots_l[k].append(v)
+    if not files:
+        return np.empty((0,), np.int64), np.empty((0, 0), np.float32), {}
+    return (np.concatenate(ids_l), np.concatenate(w_l),
+            {k: np.concatenate(v) for k, v in (slots_l or {}).items()})
 
 
 def _apply_delta(state, model, path: str, *, trainer=None, _cache=None):
@@ -640,11 +781,7 @@ def _apply_delta(state, model, path: str, *, trainer=None, _cache=None):
     for name in meta["tables"]:
         spec = model.specs[name]
         ts = new_tables[name]
-        with np.load(os.path.join(path, f"table_{name}.npz")) as z:
-            ids64 = z["ids"]
-            w = z["weights"]
-            slots = {k[len("slot_"):]: z[k] for k in z.files
-                     if k.startswith("slot_")}
+        ids64, w, slots = _load_delta_table(path, name)
         if ids64.size == 0:
             continue
         n = ids64.size
@@ -749,6 +886,52 @@ def _apply_delta(state, model, path: str, *, trainer=None, _cache=None):
     )
 
 
+class _StateMeshShim:
+    """Trainer-like facade recovered from a live SHARDED state's
+    NamedShardings, so serving-side restore (no Trainer in the process) can
+    replay delta chains: supplies the mesh/axis/num_shards and per-table
+    pspecs that the sharded row-scatter kernels need. Host-cached tables
+    are out of scope (`offload=None`) — their restore goes through the real
+    trainer's offload handles."""
+
+    offload = None
+
+    def __init__(self, state, model):
+        from jax.sharding import NamedSharding
+
+        self.mesh = self.axis = None
+        for ts in state.tables.values():
+            sh = getattr(ts.weights, "sharding", None)
+            if (isinstance(sh, NamedSharding) and len(sh.device_set) > 1
+                    and len(sh.spec) > 0):
+                axis = sh.spec[0]
+                if isinstance(axis, (tuple, list)):
+                    axis = axis[0]
+                if axis is None:
+                    continue
+                self.mesh, self.axis = sh.mesh, axis
+                break
+        if self.mesh is None:
+            raise ValueError(
+                "state is sharded but no table carries a row-sharded "
+                "NamedSharding to recover the mesh from")
+        self.num_shards = int(self.mesh.shape[self.axis])
+        self._slot_names = {name: list(ts.slots)
+                            for name, ts in state.tables.items()}
+
+    def _table_pspec(self, spec):
+        from jax.sharding import PartitionSpec as P
+
+        from .embedding import EmbeddingTableState
+        return EmbeddingTableState(
+            weights=P(self.axis, None),
+            slots={k: P(self.axis, None)
+                   for k in self._slot_names[spec.name]},
+            keys=P(self.axis) if spec.use_hash_table else None,
+            overflow=P() if spec.use_hash_table else None,
+        )
+
+
 # -- module-level API parity with `exb.py:697-705` ---------------------------
 
 
@@ -767,8 +950,14 @@ def restore_server_model(state, model, root: str, *, trainer=None):
     path, deltas = delta_chain(root)
     if path is None:
         raise FileNotFoundError(f"no committed persist under {root!r}")
-    num_shards = trainer.num_shards if trainer is not None else 1
-    offload = getattr(trainer, "offload", None) or None
+    # trainerless restore of a SHARDED state (serving-side): recover the
+    # mesh/axis/pspecs from the state's own shardings — both the base load's
+    # shard count and the delta replay's row scatter depend on them
+    drv = trainer
+    if drv is None and _state_is_sharded(state):
+        drv = _StateMeshShim(state, model)
+    num_shards = drv.num_shards if drv is not None else 1
+    offload = getattr(drv, "offload", None) or None
     from .parallel.checkpoint import checkpoint_layout, load_sharded
     if checkpoint_layout(path) == "sharded":
         state = load_sharded(state, model, path, num_shards=num_shards,
@@ -777,15 +966,9 @@ def restore_server_model(state, model, root: str, *, trainer=None):
         from .checkpoint import load_server_model
         state = load_server_model(state, model, path, num_shards=num_shards,
                                   offload=offload)
-    if deltas and trainer is None and _state_is_sharded(state):
-        # shardedness must come from the STATE: without the trainer the
-        # S=1 replay math would silently scramble shard-major rows
-        raise ValueError("delta replay onto a sharded state needs the "
-                         "trainer (its mesh drives the sharded row scatter): "
-                         "pass trainer= to restore_server_model")
     cache: Dict = {}
     for d in deltas:
-        state = _apply_delta(state, model, d, trainer=trainer, _cache=cache)
+        state = _apply_delta(state, model, d, trainer=drv, _cache=cache)
     return state
 
 

@@ -177,6 +177,110 @@ def scenario_persist_kill(pid, n, tmp):
             json.dump({"ok": True, "error_surfaced": err}, f)
 
 
+def scenario_persist_incr_train(pid, n, tmp):
+    """Phase A of the incremental-persist crash test: train on the
+    cross-process mesh, persist a full base + per-process delta shards,
+    record the expected local shard bytes, drop uncommitted junk, then
+    SIGKILL every process (the crash). Phase B (`persist_incr_restore`)
+    runs in FRESH processes."""
+    import signal
+
+    import numpy as np
+    import openembedding_tpu as embed
+    from jax.experimental import multihost_utils
+    from openembedding_tpu.parallel import make_mesh, multihost
+    from openembedding_tpu.persist import (IncrementalPersister, list_deltas,
+                                           list_persists)
+
+    mesh = make_mesh()
+    trainer = build_trainer(mesh)
+    gb = 32
+    batches = [multihost.global_batch(
+        local_slice(make_global_batch(s, gb), pid, n), mesh)
+        for s in range(4)]
+    state = trainer.init(batches[0])
+    step = trainer.jit_train_step(batches[0], state)
+    root = os.path.join(tmp, "persists")
+    with IncrementalPersister(trainer, trainer.model, root,
+                              policy=embed.PersistPolicy(every_steps=1),
+                              full_every=100, commit_timeout=300.0) as p:
+        for b in batches:
+            state, _ = step(state, b)
+            p.maybe_persist(state, batch=b)
+        p.wait()
+    multihost_utils.sync_global_devices("incr_committed")
+
+    fulls = [s for s, _ in list_persists(root)]
+    deltas = [s for s, _ in list_deltas(root)]
+    assert fulls == [1], fulls
+    assert deltas == [2, 3, 4], deltas
+    for _, dpath in list_deltas(root):
+        for pidx in range(n):
+            assert os.path.exists(os.path.join(
+                dpath, f"table_categorical.p{pidx}.npz")), dpath
+
+    # expected bytes: this process's local shards of every table array
+    expect = {}
+    for name, ts in state.tables.items():
+        for sh in ts.weights.addressable_shards:
+            expect[f"{name}/w/{sh.device.id}"] = np.asarray(sh.data)
+        for k, v in ts.slots.items():
+            for sh in v.addressable_shards:
+                expect[f"{name}/s_{k}/{sh.device.id}"] = np.asarray(sh.data)
+    np.savez(os.path.join(tmp, f"expected_p{pid}.npz"), **expect)
+
+    if pid == 0:
+        # crash-mid-write junk: an uncommitted delta dir and a stale .writing
+        # dir; the restore in phase B must ignore both
+        junk = os.path.join(root, "delta_000000000099")
+        os.makedirs(junk, exist_ok=True)
+        with open(os.path.join(junk, "meta.json"), "w") as f:
+            f.write("{\"format\": \"oetpu-delta-v1\", \"parent\": 4")  # torn
+        os.makedirs(os.path.join(root, "delta_000000000100.writing"),
+                    exist_ok=True)
+    multihost_utils.sync_global_devices("incr_expected_saved")
+    log(pid, "SIGKILL (simulated crash)")
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
+def scenario_persist_incr_restore(pid, n, tmp):
+    """Phase B: fresh processes restore base+deltas; every local shard must
+    be bit-identical to what phase A recorded before the SIGKILL."""
+    import numpy as np
+    from jax.experimental import multihost_utils
+    from openembedding_tpu.parallel import make_mesh, multihost
+    from openembedding_tpu.persist import restore_server_model
+
+    mesh = make_mesh()
+    trainer = build_trainer(mesh)
+    gb = 32
+    b = multihost.global_batch(
+        local_slice(make_global_batch(0, gb), pid, n), mesh)
+    state = trainer.init(b)
+    root = os.path.join(tmp, "persists")
+    state = restore_server_model(state, trainer.model, root, trainer=trainer)
+    assert int(state.step) == 4, int(state.step)
+
+    with np.load(os.path.join(tmp, f"expected_p{pid}.npz")) as z:
+        expect = {k: z[k] for k in z.files}
+    checked = 0
+    for name, ts in state.tables.items():
+        for sh in ts.weights.addressable_shards:
+            np.testing.assert_array_equal(
+                np.asarray(sh.data), expect[f"{name}/w/{sh.device.id}"])
+            checked += 1
+        for k, v in ts.slots.items():
+            for sh in v.addressable_shards:
+                np.testing.assert_array_equal(
+                    np.asarray(sh.data), expect[f"{name}/s_{k}/{sh.device.id}"])
+                checked += 1
+    assert checked > 0
+    multihost_utils.sync_global_devices("incr_restore_verified")
+    if pid == 0:
+        with open(os.path.join(tmp, "result.json"), "w") as f:
+            json.dump({"ok": True, "shards_checked": checked}, f)
+
+
 def main():
     scenario, pid, n, port, tmp = (sys.argv[1], int(sys.argv[2]),
                                    int(sys.argv[3]), sys.argv[4], sys.argv[5])
@@ -192,7 +296,10 @@ def main():
     log(pid, f"initialized: {len(jax.devices())} global devices")
     {"train_ckpt": scenario_train_ckpt,
      "persist_ok": scenario_persist_ok,
-     "persist_kill": scenario_persist_kill}[scenario](pid, n, tmp)
+     "persist_kill": scenario_persist_kill,
+     "persist_incr_train": scenario_persist_incr_train,
+     "persist_incr_restore": scenario_persist_incr_restore}[scenario](
+        pid, n, tmp)
     log(pid, "done")
 
 

@@ -476,9 +476,13 @@ def test_incremental_mesh_hash_table(tmp_path):
                                   pull_rows(trainer, state))
 
 
-def test_sharded_delta_restore_requires_trainer(tmp_path):
-    """Replaying deltas onto a SHARDED state without the trainer would
-    scramble shard-major rows — detected from the state's sharding, raised."""
+def test_sharded_delta_restore_without_trainer(tmp_path):
+    """Serving-side restore: a delta chain replays onto a SHARDED state with
+    NO trainer in the process — the mesh/axis/pspecs are recovered from the
+    state's own NamedShardings (`persist._StateMeshShim`), and the result is
+    bit-identical to the trainer-driven restore. (Until round 5 this case
+    raised; the reference restores per server node with no worker attached,
+    `EmbeddingRestoreOperator.cpp:108-152`.)"""
     from openembedding_tpu.parallel import MeshTrainer, make_mesh
     from openembedding_tpu.persist import IncrementalPersister
 
@@ -500,8 +504,62 @@ def test_sharded_delta_restore_requires_trainer(tmp_path):
     fresh = MeshTrainer(model, embed.Adagrad(learning_rate=0.05), seed=0,
                         mesh=make_mesh())
     fstate = fresh.init(batches[0])
-    with pytest.raises(ValueError, match="trainer"):
-        restore_server_model(fstate, model, root)  # trainer omitted
+    fstate = restore_server_model(fstate, model, root)  # trainer omitted
+    _state_equal(fstate, state)
+    oracle = restore_server_model(
+        MeshTrainer(model, embed.Adagrad(learning_rate=0.05), seed=0,
+                    mesh=make_mesh()).init(batches[0]),
+        model, root, trainer=trainer)
+    _state_equal(fstate, oracle)
+
+
+def test_shard_row_reader_matches_direct_read(tmp_path):
+    """`_make_shard_row_reader` (the multi-process delta read: per-shard
+    outputs, no cross-shard psum) must agree with the replicated-output
+    mesh reader on the same table — every touched row found exactly once,
+    in the shard that owns it."""
+    from openembedding_tpu.parallel import MeshTrainer, make_mesh
+    from openembedding_tpu.persist import (_make_mesh_row_reader,
+                                           _make_shard_row_reader)
+
+    model = make_deepfm(vocabulary=-1, dim=4, hidden=(8,), hashed=True,
+                        capacity=4096)
+    trainer = MeshTrainer(model, embed.Adagrad(learning_rate=0.05), seed=0,
+                          mesh=make_mesh())
+    batches = list(synthetic_criteo(16, id_space=1 << 40, steps=3, seed=4))
+    state = trainer.init(batches[0])
+    step = trainer.jit_train_step(batches[0], state)
+    for b in batches:
+        state, _ = step(state, b)
+    spec = model.specs["categorical"]
+    ts = state.tables["categorical"]
+
+    ids64 = np.unique(np.concatenate(
+        [b["sparse"]["categorical"].reshape(-1) for b in batches]))
+    n = ids64.size
+    padded = 1 << (n - 1).bit_length()
+    ids_h = np.concatenate([ids64, np.full((padded - n,), -1, np.int64)])
+    ids_dev = ids_h.astype(ts.keys.dtype) if ts.keys.ndim == 1 else None
+    if ids_dev is None:
+        from openembedding_tpu.ops.id64 import np_split_ids
+        ids_dev = np_split_ids(ids_h)
+
+    pspec = trainer._table_pspec(spec)
+    found_r, w_r, s_r = _make_mesh_row_reader(
+        trainer.mesh, trainer.axis, pspec)(ts, ids_dev)
+    found_s, w_s, s_s = _make_shard_row_reader(
+        trainer.mesh, trainer.axis, pspec, True, spec.input_dim)(ts, ids_dev)
+
+    S = trainer.num_shards
+    fs = np.asarray(found_s).reshape(S, padded)
+    ws = np.asarray(w_s).reshape(S, padded, -1)
+    assert (fs.sum(axis=0) <= 1).all(), "an id found in more than one shard"
+    np.testing.assert_array_equal(fs.any(axis=0), np.asarray(found_r))
+    np.testing.assert_array_equal(ws.sum(axis=0), np.asarray(w_r))
+    for k in s_r:
+        np.testing.assert_array_equal(
+            np.asarray(s_s[k]).reshape(S, padded, -1).sum(axis=0),
+            np.asarray(s_r[k]))
 
 
 def test_dirty_tracker_window_semantics():
