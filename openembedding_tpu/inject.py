@@ -19,10 +19,18 @@ the script expects. Models without Embedding layers fall through to native
 Keras fit untouched.
 
 Scope (documented, like the reference's laboratory status): numpy/array `x`
-(dict keyed by input name, single array, or list in `model.inputs` order),
-array `y`, `batch_size`/`epochs`/`shuffle`; `OETPU_INJECT_MESH=1` trains
-data-parallel + row-sharded over every visible device (MeshTrainer) instead
-of single-device.
+(dict keyed by input name, single array, or list in `model.inputs` order)
+with array `y`, OR a batch iterable (`tf.data.Dataset`, generator, or any
+iterable yielding `(x_batch, y_batch)` — generators need `steps_per_epoch`,
+re-iterables restart per epoch); `batch_size`/`epochs`/`shuffle`;
+`callbacks` (REAL Keras callbacks — the live model is synced with the
+trained state every epoch, so `ModelCheckpoint` saves what was actually
+trained and `EarlyStopping`'s `model.stop_training` is honored — the
+reference's hook script drives `ModelCheckpoint` the same way,
+`examples/criteo_deepctr_hook.py`); a compiled AUC metric reports pooled
+train AUC per epoch. `OETPU_INJECT_MESH=1` trains data-parallel +
+row-sharded over every visible device (MeshTrainer) instead of
+single-device.
 """
 
 from __future__ import annotations
@@ -34,34 +42,70 @@ from typing import Any, Dict
 
 def _as_input_dict(model, x) -> Dict[str, Any]:
     import numpy as np
+
+    def rank_fix(v, t):
+        # Keras fit auto-expands (B,) columns to a (None, 1) input; match it
+        v = np.asarray(v)
+        while v.ndim < len(t.shape):
+            v = v[..., None]
+        return v
+
     names = [t.name for t in model.inputs]
     if isinstance(x, dict):
         missing = [n for n in names if n not in x]
         if missing:
             raise ValueError(f"fit(x=dict) is missing inputs {missing}")
-        return {n: np.asarray(x[n]) for n in names}
+        return {t.name: rank_fix(x[t.name], t) for t in model.inputs}
     xs = x if isinstance(x, (list, tuple)) else [x]
     if len(xs) != len(names):
         raise ValueError(
             f"fit got {len(xs)} input arrays for {len(names)} model inputs")
-    return {n: np.asarray(v) for n, v in zip(names, xs)}
+    return {t.name: rank_fix(v, t) for t, v in zip(model.inputs, xs)}
 
 
-_SUPPORTED_DEFAULTS = {"callbacks": None, "validation_split": 0.0,
+_SUPPORTED_DEFAULTS = {"validation_split": 0.0,
                        "validation_data": None, "class_weight": None,
                        "sample_weight": None, "initial_epoch": 0,
-                       "steps_per_epoch": None, "validation_steps": None,
+                       "validation_steps": None,
                        "validation_batch_size": None, "validation_freq": 1}
 
 
+def _is_batch_iterable(x, y) -> bool:
+    """Dataset-style input: yields (x_batch, y_batch) tuples. Arrays/dicts/
+    lists-of-arrays (the array path) all come WITH a y."""
+    import numpy as np
+    if y is not None or x is None:
+        return False
+    if isinstance(x, (dict, np.ndarray, list, tuple)):
+        return False
+    return hasattr(x, "__iter__")
+
+
+def _unpack_item(item):
+    """One yielded dataset element -> (x_batch, y_batch)."""
+    if not isinstance(item, (list, tuple)) or len(item) not in (2, 3):
+        raise ValueError(
+            "dataset/generator input must yield (x_batch, y_batch) tuples "
+            f"(got {type(item).__name__})")
+    if len(item) == 3 and item[2] is not None:
+        raise ValueError("per-batch sample_weight is not supported by the "
+                         "inject fit path")
+    return item[0], item[1]
+
+
 def _fit_via_framework(model, x, y, *, batch_size=32, epochs=1, shuffle=True,
-                       verbose="auto", **unsupported):
+                       verbose="auto", callbacks=None, steps_per_epoch=None,
+                       **unsupported):
+    import types
+
     import numpy as np
 
-    import openembedding_tpu as embed
+    import keras
+
     from .keras_compat import (KerasDenseModule, export_keras_rows,
                                from_keras_model, import_keras_rows)
     from .model import Trainer
+    from .utils import metrics as M
 
     # reject ANY fit option this path cannot honor — silently ignoring
     # class_weight / validation_split / ... would change results vs Keras
@@ -99,72 +143,176 @@ def _fit_via_framework(model, x, y, *, batch_size=32, epochs=1, shuffle=True,
     else:
         trainer = Trainer(emodel, opt)
 
-    inputs = _as_input_dict(model, x)
-    y = np.asarray(y).reshape(-1).astype(np.float32)
-    n = y.shape[0]
     sparse_feats = {s.feature_name for s in emodel.ps_specs().values()} | \
                    {s.feature_name for s in emodel.sad_specs().values()}
-    dense_names = [k for k in inputs if k not in sparse_feats]
+    # a compiled AUC metric -> pooled train AUC per epoch (the reference's
+    # benchmark prints it the same pooled way, `test/benchmark/criteo_deepctr.py`).
+    # Pre-fit the CompileMetrics wrapper is unbuilt, so read the user's raw list.
+    def _metric_names():
+        for mm in getattr(model, "metrics", []):
+            yield str(getattr(mm, "name", mm))
+            for u in (getattr(mm, "_user_metrics", None) or []):
+                yield str(getattr(u, "name", u))
+    want_auc = any("auc" in name.lower() for name in _metric_names())
 
-    def batch_of(idx):
-        """Fixed-size batch: a trailing partial batch pads to batch_size with
-        weight-0 rows (Keras trains the tail too; padding keeps ONE compiled
-        step and the weighted loss matches Keras's mean over the real rows)."""
-        pad = batch_size - idx.size
+    iterable_mode = _is_batch_iterable(x, y)
+    if not iterable_mode:
+        inputs = _as_input_dict(model, x)
+        y_arr = np.asarray(y).reshape(-1).astype(np.float32)
+        n = y_arr.shape[0]
+
+    def make_batch(inp, yb, B):
+        """Fixed-size batch: short batches pad to B with weight-0 rows (ONE
+        compiled step; the weighted loss matches Keras's mean over the real
+        rows)."""
+        yb = np.asarray(yb).reshape(-1).astype(np.float32)
+        b = yb.shape[0]
+        if b > B:
+            raise ValueError(
+                f"dataset batch of {b} rows exceeds the first batch's "
+                f"{B} (the compiled step shape); keep batches uniform")
+        pad = B - b
+
+        def padrow(a):
+            a = np.asarray(a)
+            if pad == 0:
+                return a
+            return np.concatenate([a, np.repeat(a[:1], pad, axis=0)], axis=0)
+
+        weight = np.ones((B,), np.float32)
         if pad:
-            idx = np.concatenate([idx, np.zeros((pad,), idx.dtype)])
-        weight = np.ones((batch_size,), np.float32)
-        if pad:
-            weight[-pad:] = 0.0
-        sparse = {f: inputs[f][idx].astype(np.int32) for f in sparse_feats}
-        if not dense_names:
+            weight[b:] = 0.0
+        sparse = {f: padrow(inp[f]).astype(np.int32) for f in sparse_feats}
+        dn = [k for k in inp if k not in sparse_feats]
+        if not dn:
             dense = None
-        elif len(dense_names) == 1:
-            dense = inputs[dense_names[0]][idx].astype(np.float32)
+        elif len(dn) == 1:
+            dense = padrow(inp[dn[0]]).astype(np.float32)
         else:
-            dense = {k: inputs[k][idx].astype(np.float32)
-                     for k in dense_names}
-        return {"sparse": sparse, "dense": dense, "label": y[idx],
-                "weight": weight}, batch_size - pad
+            dense = {k: padrow(inp[k]).astype(np.float32) for k in dn}
+        return {"sparse": sparse, "dense": dense, "label": padrow(yb),
+                "weight": weight}, b
+
+    persistent_it = None
+    if iterable_mode and isinstance(x, types.GeneratorType):
+        # Keras semantics: a plain generator is consumed ACROSS epochs, so an
+        # epoch needs an explicit length
+        if steps_per_epoch is None:
+            raise ValueError(
+                "a generator input needs steps_per_epoch (re-iterables like "
+                "tf.data.Dataset restart each epoch and do not)")
+        persistent_it = iter(x)
+
+    cbs = None
+    if callbacks:
+        cbs = keras.callbacks.CallbackList(list(callbacks), add_history=False,
+                                           add_progbar=False, model=model)
+        cbs.set_params({"epochs": epochs, "verbose": 0,
+                        "steps": steps_per_epoch})
+    model.stop_training = False
 
     state = None
     step = None
+    B = [None]
     rng = np.random.default_rng(0)
-    history = {"loss": []}
-    for epoch in range(epochs):
-        order = rng.permutation(n) if shuffle else np.arange(n)
-        losses, counts = [], []
-        for start in range(0, n, batch_size):
-            b, real = batch_of(order[start:start + batch_size])
-            if state is None:
-                state = trainer.init(b)
-                state = import_keras_rows(trainer, state, model)
-                step = (trainer.jit_train_step(b, state) if use_mesh
-                        else trainer.jit_train_step())
-            state, m = step(state, b)
-            losses.append(float(m["loss"]))
-            counts.append(real)
-        history["loss"].append(float(np.average(losses, weights=counts)))
-        if verbose:
-            print(f"[inject] epoch {epoch + 1}/{epochs} "
-                  f"loss {history['loss'][-1]:.4f}", flush=True)
+    history: Dict[str, Any] = {"loss": []}
 
-    if state is not None:
-        # make the user's Keras object serve what was trained (mesh tables
-        # deinterleave host-side inside export_keras_rows)
+    def train_one(bdict):
+        nonlocal state, step
+        if state is None:
+            state = trainer.init(bdict)
+            state = import_keras_rows(trainer, state, model)
+            step = (trainer.jit_train_step(bdict, state) if use_mesh
+                    else trainer.jit_train_step())
+        state, m = step(state, bdict)
+        return m
+
+    def sync_back():
+        # the LIVE Keras model reflects the trained state — ModelCheckpoint
+        # (and the user's predict()/save() after fit) see real weights
         module = emodel.module
         assert isinstance(module, KerasDenseModule)
         module.write_back(state.dense_params)
         export_keras_rows(trainer, state, model)
+
+    if cbs is not None:
+        cbs.on_train_begin()
+    ran_epochs = 0
+    for epoch in range(epochs):
+        if cbs is not None:
+            cbs.on_epoch_begin(epoch)
+        losses, counts = [], []
+        pool_s, pool_l = [], []
+
+        def run_batch(inp, yb):
+            if B[0] is None:
+                B[0] = int(np.asarray(yb).reshape(-1).shape[0])
+            bdict, real = make_batch(inp, yb, B[0])
+            m = train_one(bdict)
+            losses.append(float(m["loss"]))
+            counts.append(real)
+            if want_auc and real:
+                pool_s.append(np.asarray(m["logits"]).reshape(-1)[:real])
+                pool_l.append(bdict["label"][:real])
+
+        if iterable_mode:
+            it = persistent_it if persistent_it is not None else iter(x)
+            taken = 0
+            while steps_per_epoch is None or taken < steps_per_epoch:
+                try:
+                    item = next(it)
+                except StopIteration:
+                    if persistent_it is not None:
+                        raise ValueError(
+                            "generator exhausted before steps_per_epoch "
+                            f"({taken}/{steps_per_epoch} at epoch {epoch})")
+                    break
+                xb, yb = _unpack_item(item)
+                run_batch(_as_input_dict(model, xb), yb)
+                taken += 1
+            if not losses:
+                raise ValueError("the dataset yielded no batches")
+        else:
+            order = rng.permutation(n) if shuffle else np.arange(n)
+            if steps_per_epoch is not None:
+                order = order[:steps_per_epoch * batch_size]
+            B[0] = batch_size
+            for start in range(0, order.size, batch_size):
+                idx = order[start:start + batch_size]
+                run_batch({k: v[idx] for k, v in inputs.items()}, y_arr[idx])
+
+        logs = {"loss": float(np.average(losses, weights=counts))}
+        if want_auc and pool_l:
+            logs["auc"] = float(M.auc(np.concatenate(pool_l),
+                                      np.concatenate(pool_s)))
+            history.setdefault("auc", []).append(logs["auc"])
+        history["loss"].append(logs["loss"])
+        ran_epochs = epoch + 1
+        if cbs is not None:
+            sync_back()
+            cbs.on_epoch_end(epoch, logs)
+        if verbose:
+            print("[inject] epoch {}/{} ".format(epoch + 1, epochs)
+                  + " ".join(f"{k} {v:.4f}" for k, v in logs.items()),
+                  flush=True)
+        if getattr(model, "stop_training", False):
+            break
+    if cbs is not None:
+        cbs.on_train_end()
+
+    if state is not None:
+        sync_back()
 
     class _History:
         pass
 
     h = _History()
     h.history = history
-    h.epoch = list(range(epochs))
+    h.epoch = list(range(ran_epochs))
     h.model = model
-    h.params = {"epochs": epochs, "steps": -(-n // batch_size),
+    h.params = {"epochs": epochs,
+                "steps": (steps_per_epoch if iterable_mode
+                          else -(-n // batch_size)),
                 "verbose": verbose}
     return h
 

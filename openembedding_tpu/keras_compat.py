@@ -21,10 +21,17 @@ Constraints (explicit, checked):
   keras; the TF/torch backends cannot trace into an XLA train step);
 - each Embedding layer is fed DIRECTLY by a model `Input` (id preprocessing
   belongs in the input pipeline — the reference's layer has the same shape:
-  ids in, rows out);
-- the dense remainder has no non-trainable variables (BatchNorm-style state
-  does not fit the stateless dense path yet);
-- each Embedding layer is applied once (no shared-layer call sites).
+  ids in, rows out).
+
+Non-trainable dense state (BatchNorm moving stats, seed-generator counters)
+is carried: it rides inside `dense_params` as frozen leaves
+(`KerasDenseModule.split_params`), updates come from the training forward
+pass (`stateless_call(..., training=True)`), and on meshes float stats pmean
+across shards (per-replica batch statistics, like the reference's Horovod
+DP). SHARED Embedding layers (one layer, N call sites) map to ONE table: the
+feeding inputs' id columns concatenate into a synthesized feature named
+after the layer (`EmbeddingModel.batch_transform`) and each call site slices
+its columns back out of the pulled rows.
 
 Batch convention after conversion: sparse ids keyed by the FEEDING INPUT's
 name, one "dense" entry (array for a single non-embedding input, dict of
@@ -95,45 +102,87 @@ def loss_from_keras(loss) -> Any:
 class KerasDenseModule:
     """Adapter giving the sliced dense Keras model the flax-module surface the
     Trainer drives (`init(key, embedded, dense)` / `apply({'params': ...})`).
-    Params are a dict {v<i>: array} in the model's trainable-variable order —
-    a plain pytree, so the Trainer's dense optimizer path and checkpointing
-    treat it like any flax tree."""
+    Params are a dict {v<i>: array} in the model's trainable-variable order
+    plus {n<i>: array} for non-trainable variables (BatchNorm moving stats,
+    seed-generator counters) — one plain pytree, so the Trainer's dense
+    optimizer path and checkpointing treat it like any flax tree. The frozen
+    half rides through `split_params`/`merge_params`; its updates come out of
+    the TRAINING forward pass (`apply_train` -> Keras `stateless_call(...,
+    training=True)` returns the new non-trainables)."""
 
-    def __init__(self, dense_model, input_kinds: List[Tuple[str, str]]):
-        # input_kinds: [(kind, name)] in dense_model.inputs order, where kind
-        # is "emb" (name = embedding layer name) or "dense" (name = input name)
+    def __init__(self, dense_model, input_kinds: List[Tuple[str, Any]]):
+        # input_kinds: [(kind, key)] in dense_model.inputs order, where kind is
+        # "emb" (key = embedding layer name), "embslice" (key = (layer name,
+        # col0, col1, site_rank) — one call site of a SHARED layer) or "dense"
+        # (key = input name)
         self.dense_model = dense_model
         self.input_kinds = input_kinds
+        self._n_tr = len(dense_model.trainable_variables)
+        self._n_fr = len(dense_model.non_trainable_variables)
 
     def _params_now(self) -> Dict[str, Any]:
         # COPIES, not the live buffers: the Trainer's jitted step donates its
         # state, and donating the Keras variables' own arrays would delete
         # them out from under the user's model ("Array has been deleted")
-        return {f"v{i}": jnp.array(v.value, copy=True)
-                for i, v in enumerate(self.dense_model.trainable_variables)}
+        p = {f"v{i}": jnp.array(v.value, copy=True)
+             for i, v in enumerate(self.dense_model.trainable_variables)}
+        p.update({f"n{i}": jnp.array(v.value, copy=True)
+                  for i, v in enumerate(
+                      self.dense_model.non_trainable_variables)})
+        return p
 
     def init(self, key, embedded, dense_inputs):
         del key, embedded, dense_inputs  # the Keras model is already built
         return {"params": self._params_now()}
 
+    # -- frozen-state protocol (driven by Trainer.train_step) ---------------
+
+    def split_params(self, params):
+        tr = {k: v for k, v in params.items() if not k.startswith("n")}
+        fr = {k: v for k, v in params.items() if k.startswith("n")}
+        return tr, fr
+
+    def merge_params(self, tr, fr):
+        return {**tr, **(fr or {})}
+
+    def _tv_ntv(self, params):
+        return ([params[f"v{i}"] for i in range(self._n_tr)],
+                [params[f"n{i}"] for i in range(self._n_fr)])
+
     def _assemble(self, embedded, dense_inputs):
         args = []
-        for kind, name in self.input_kinds:
+        for kind, key in self.input_kinds:
             if kind == "emb":
-                args.append(embedded[name])
+                args.append(embedded[key])
+            elif kind == "embslice":
+                name, c0, c1, site_rank = key
+                rows = embedded[name][:, c0:c1, :]
+                if site_rank == 1:  # the site fed (B,) ids -> expects (B, d)
+                    rows = rows[:, 0, :]
+                args.append(rows)
             elif isinstance(dense_inputs, dict):
-                args.append(jnp.asarray(dense_inputs[name]))
+                args.append(jnp.asarray(dense_inputs[key]))
             else:
                 args.append(jnp.asarray(dense_inputs))
         return args
 
     def apply(self, variables, embedded, dense_inputs):
-        params = variables["params"]
-        tv = [params[f"v{i}"] for i in range(len(params))]
+        """Inference: frozen state read, never written."""
+        tv, ntv = self._tv_ntv(variables["params"])
         outs, _ = self.dense_model.stateless_call(
-            tv, [], self._assemble(embedded, dense_inputs))
+            tv, ntv, self._assemble(embedded, dense_inputs), training=False)
         out = outs[0] if isinstance(outs, (list, tuple)) else outs
         return out.reshape(out.shape[0])
+
+    def apply_train(self, variables, embedded, dense_inputs):
+        """Training forward: returns (logits, new frozen values) — BatchNorm
+        moving stats advance, dropout seed counters tick."""
+        tv, ntv = self._tv_ntv(variables["params"])
+        outs, new_ntv = self.dense_model.stateless_call(
+            tv, ntv, self._assemble(embedded, dense_inputs), training=True)
+        out = outs[0] if isinstance(outs, (list, tuple)) else outs
+        return (out.reshape(out.shape[0]),
+                {f"n{i}": v for i, v in enumerate(new_ntv)})
 
     def write_back(self, params: Dict[str, Any]) -> None:
         """Push trained values into the live Keras variables (so the user's
@@ -141,6 +190,8 @@ class KerasDenseModule:
         converted model stays a usable Keras model the same way)."""
         for i, v in enumerate(self.dense_model.trainable_variables):
             v.assign(np.asarray(params[f"v{i}"]))
+        for i, v in enumerate(self.dense_model.non_trainable_variables):
+            v.assign(np.asarray(params[f"n{i}"]))
 
 
 def from_keras_model(model, optimizer=None, *,
@@ -172,37 +223,65 @@ def from_keras_model(model, optimizer=None, *,
     input_by_tensor = {id(t): t for t in model.inputs}
     embeddings = []
     emb_outputs = []
+    emb_kinds = []
     emb_input_names = set()
+    shared: Dict[str, List[str]] = {}  # layer name -> feeding input names
     for layer in emb_layers:
         nodes = getattr(layer, "_inbound_nodes", [])
-        if len(nodes) != 1:
+        if not nodes:
             raise ValueError(
-                f"Embedding layer {layer.name!r} has {len(nodes)} call "
-                "sites; shared embedding layers are not convertible")
-        (src,) = nodes[0].input_tensors
-        if id(src) not in input_by_tensor:
-            raise ValueError(
-                f"Embedding layer {layer.name!r} must be fed directly by a "
-                "model Input (found an intermediate tensor); move id "
-                "preprocessing into the input pipeline")
-        feature = src.name
-        emb_input_names.add(feature)
+                f"Embedding layer {layer.name!r} has no call sites inside "
+                "the model graph")
+        site_feats, site_ranks = [], []
+        for node in nodes:
+            (src,) = node.input_tensors
+            if id(src) not in input_by_tensor:
+                raise ValueError(
+                    f"Embedding layer {layer.name!r} must be fed directly by "
+                    "a model Input (found an intermediate tensor); move id "
+                    "preprocessing into the input pipeline")
+            site_feats.append(src.name)
+            site_ranks.append(len(src.shape))  # (None, F) = 2, (None,) = 1
+            emb_input_names.add(src.name)
+            emb_outputs.append(node.output_tensors[0])
+        if len(nodes) == 1:
+            feature = site_feats[0]
+            emb_kinds.append(("emb", layer.name))
+        else:
+            # SHARED layer (reference converts these freely, `exb.py:593-642`):
+            # ONE table; the feeding inputs' id columns concatenate into a
+            # synthesized feature named after the layer (batch_transform
+            # below), and each call site slices its columns back out
+            feature = layer.name
+            shared[layer.name] = site_feats
+            col = 0
+            for f, rank, node in zip(site_feats, site_ranks, nodes):
+                src = node.input_tensors[0]
+                width = 1 if rank == 1 else int(src.shape[1])
+                emb_kinds.append(("embslice",
+                                  (layer.name, col, col + width, rank)))
+                col += width
         embeddings.append(OEmbedding(
             input_dim=layer.input_dim, output_dim=layer.output_dim,
             name=layer.name, feature=feature,
             embeddings_initializer=initializer_from_keras(
                 layer.embeddings_initializer)))
-        emb_outputs.append(nodes[0].output_tensors[0])
 
     dense_inputs = [t for t in model.inputs
                     if t.name not in emb_input_names]
-    dense_model = keras.Model(emb_outputs + dense_inputs, model.outputs)
-    if dense_model.non_trainable_variables:
-        raise ValueError(
-            "the dense remainder has non-trainable variables (e.g. "
-            "BatchNorm); the stateless dense path cannot carry them yet")
-    input_kinds = ([("emb", l.name) for l in emb_layers]
-                   + [("dense", t.name) for t in dense_inputs])
+    # Keras's functional constructor BUMPS the `_keras_history` node index of
+    # any already-owned Input tensor reused as a sub-model input, which breaks
+    # the ORIGINAL model's save() afterward (`assert node_key in self._nodes`
+    # in functional.get_config) — snapshot and restore the histories around
+    # the slice so the user's model stays serializable
+    reused = emb_outputs + dense_inputs + list(model.outputs)
+    histories = [(t, t._keras_history) for t in reused]
+    try:
+        dense_model = keras.Model(emb_outputs + dense_inputs, model.outputs)
+    finally:
+        for t, h in histories:
+            t._keras_history = h
+    input_kinds = emb_kinds + [("dense", t.name) for t in dense_inputs]
 
     if loss_fn is None:
         compiled = getattr(model, "loss", None)
@@ -227,6 +306,19 @@ def from_keras_model(model, optimizer=None, *,
     emodel = EmbeddingModel(
         KerasDenseModule(dense_model, input_kinds), embeddings,
         loss_fn=loss_fn)
+    if shared:
+        def transform(batch, _shared=shared):
+            sp = dict(batch["sparse"])
+            for lname, feats in _shared.items():
+                parts = []
+                for f in feats:
+                    ids = jnp.asarray(sp[f])
+                    if ids.ndim == 1:
+                        ids = ids[:, None]
+                    parts.append(ids)
+                sp[lname] = jnp.concatenate(parts, axis=1)
+            return {**batch, "sparse": sp}
+        emodel.batch_transform = transform
 
     opt = None
     if optimizer is not None:

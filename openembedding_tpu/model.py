@@ -104,6 +104,12 @@ class EmbeddingModel:
         # passed back in explicitly.
         self.module = module
         self.config = config
+        # optional pure fn batch -> batch applied at the top of every
+        # train/eval/init path (jit-traceable). The Keras converter uses it to
+        # synthesize the concatenated id feature of a SHARED Embedding layer
+        # (one table, N call sites — reference `exb.py:593-642` clones such
+        # graphs without restriction); None for everything else.
+        self.batch_transform = None
         self.specs: Dict[str, EmbeddingSpec] = {}
         for i, e in enumerate(embeddings):
             spec = dataclasses.replace(e.spec, variable_id=i)
@@ -218,6 +224,8 @@ class Trainer:
         host-cached variables."""
         if not self.offload:
             return state
+        if self.model.batch_transform is not None:
+            batch = self.model.batch_transform(batch)
         new_tables = dict(state.tables)
         for name, ot in self.offload.items():
             ot.adopt(state.tables[name])
@@ -294,6 +302,8 @@ class Trainer:
                         "pair layout instead (ops/id64.np_split_ids or "
                         "ids_dtype='pair').", UserWarning)
         key = jax.random.PRNGKey(self.seed)
+        if self.model.batch_transform is not None:
+            sample_batch = self.model.batch_transform(sample_batch)
         embedded = self._fake_embedded(sample_batch)
         dense_inputs = sample_batch.get("dense")
         variables = self.module_init(key, embedded, dense_inputs)
@@ -307,11 +317,17 @@ class Trainer:
         if sad:
             params = dict(params)
             params["__embeddings__"] = sad
+        # optimizer slots only for the TRAINABLE subtree: modules carrying
+        # frozen state (Keras BatchNorm stats, seed-generator counters) split
+        # it out — those leaves update from the forward pass, never the
+        # optimizer, and integer leaves cannot take optimizer math anyway
+        split = getattr(self.model.module, "split_params", None)
+        slots_over = split(params)[0] if split is not None else params
         tables = self.init_tables()
         return TrainState(
             step=jnp.zeros((), jnp.int32),
             dense_params=params,
-            dense_slots=init_dense_slots(self.optimizer, params),
+            dense_slots=init_dense_slots(self.optimizer, slots_over),
             tables=tables,
             model_version=jnp.zeros((), jnp.int32),
         )
@@ -378,9 +394,22 @@ class Trainer:
         `ops/sparse.packed_layout`).
         """
         model = self.model
+        if model.batch_transform is not None:
+            batch = model.batch_transform(batch)
         ps_specs = model.ps_specs()
         sad_specs = model.sad_specs()
         packed = packed or {}
+        # modules with frozen (non-trainable) state: differentiate only the
+        # trainable subtree, thread the frozen one through as a constant, and
+        # take its NEW values from the training forward pass (Keras BatchNorm
+        # moving stats / seed counters; reference graphs train them the same
+        # way inside `distributed_model()`, `exb.py:593-642`)
+        split = getattr(model.module, "split_params", None)
+        train_apply = getattr(model.module, "apply_train", None)
+        if split is not None:
+            tr0, fr0 = split(state.dense_params)
+        else:
+            tr0, fr0 = state.dense_params, None
 
         # PULL: gather rows for this batch (non-differentiated w.r.t. the table — the
         # rows themselves are the leaf, exactly the reference's pull/push contract).
@@ -400,24 +429,36 @@ class Trainer:
             for k, v in pull_stats.items():
                 stats[f"{name}/{k}"] = v
 
-        def loss_fn(dense_params, pulled_rows):
+        def loss_fn(tr_params, pulled_rows):
+            dense_params = (model.module.merge_params(tr_params, fr0)
+                            if split is not None else tr_params)
             embedded = dict(pulled_rows)
             for name, spec in sad_specs.items():
                 table = dense_params["__embeddings__"][name]
                 ids = jnp.asarray(batch["sparse"][spec.feature_name])
                 embedded[name] = jnp.take(table, ids, axis=0)
-            logits = model.module.apply({"params": dense_params}, embedded,
-                                        batch.get("dense"))
-            return self._loss(logits, batch), logits
+            if train_apply is not None:
+                logits, fr_new = train_apply({"params": dense_params},
+                                             embedded, batch.get("dense"))
+            else:
+                logits = model.module.apply({"params": dense_params},
+                                            embedded, batch.get("dense"))
+                fr_new = None
+            return self._loss(logits, batch), (logits, fr_new)
 
-        (loss, logits), (dense_grads, row_grads) = jax.value_and_grad(
-            loss_fn, argnums=(0, 1), has_aux=True)(state.dense_params, pulled)
+        (loss, (logits, fr_new)), (dense_grads, row_grads) = \
+            jax.value_and_grad(loss_fn, argnums=(0, 1), has_aux=True)(
+                tr0, pulled)
 
         dense_grads = self.reduce_dense_grads(dense_grads)
 
         # DENSE apply (reference: Keras optimizer after Horovod allreduce)
         new_params, new_slots = dense_apply(
-            self.optimizer, state.dense_params, state.dense_slots, dense_grads)
+            self.optimizer, tr0, state.dense_slots, dense_grads)
+        if split is not None:
+            fr = fr_new if fr_new is not None else fr0
+            new_params = model.module.merge_params(
+                new_params, self.reduce_module_state(fr))
 
         # SPARSE push+update (reference: PushGradients + UpdateWeights store op)
         new_tables = dict(state.tables)
@@ -449,6 +490,14 @@ class Trainer:
     def reduce_dense_grads(self, grads):
         return grads
 
+    def reduce_module_state(self, fr):
+        """Frozen-state updates from the training forward pass. On meshes the
+        float leaves (BatchNorm moving stats computed from LOCAL batch
+        statistics — same per-replica behavior the reference's Horovod DP
+        has) pmean to one replicated value; integer leaves (seed counters,
+        identical on every shard) pass through."""
+        return fr
+
     def reduce_metrics(self, metrics):
         return metrics
 
@@ -467,6 +516,8 @@ class Trainer:
 
     def eval_step(self, state: TrainState, batch) -> Dict:
         model = self.model
+        if model.batch_transform is not None:
+            batch = model.batch_transform(batch)
         embedded = {
             name: self.table_lookup(spec, state.tables[name],
                                     jnp.asarray(batch["sparse"][spec.feature_name]))

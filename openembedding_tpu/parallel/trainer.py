@@ -138,6 +138,19 @@ class MeshTrainer(Trainer):
 
     # -- per-device hooks (run inside shard_map) -----------------------------
 
+    def reduce_module_state(self, fr):
+        # BatchNorm-style moving stats: each shard computed its update from
+        # LOCAL batch statistics (per-replica BN, same as the reference's
+        # Horovod DP); pmean makes the replicated frozen state one value.
+        # Integer leaves (seed counters) advance identically on every shard.
+        import jax.numpy as jnp
+
+        def avg(x):
+            if jnp.issubdtype(x.dtype, jnp.floating):
+                return jax.lax.pmean(x, self.axis)
+            return x
+        return jax.tree_util.tree_map(avg, fr)
+
     def reduce_dense_grads(self, grads):
         # reference parity: Horovod allreduce op=Sum (NOT average) — effective dense
         # lr scales with worker count exactly like the reference's examples

@@ -114,6 +114,130 @@ def test_conversion_guards():
     assert "NO_EMB_GUARD_OK" in out and "INTERMEDIATE_GUARD_OK" in out
 
 
+def test_batchnorm_model_conversion_parity():
+    """A BN-bearing tower (DeepCTR's DNN block uses BatchNorm) converts: the
+    frozen moving stats ride in dense_params, advance from the training
+    forward pass, and after 3 identical SGD steps both the trainable weights
+    and the BN moving stats match Keras's own fit (reference converts such
+    graphs freely, `exb.py:593-642`)."""
+    out = _run("""
+        import numpy as np, keras
+        import openembedding_tpu as embed
+        from openembedding_tpu.keras_compat import (from_keras_model,
+            import_keras_rows)
+        from openembedding_tpu.model import Trainer
+
+        cat = keras.Input(shape=(4,), dtype="int32", name="cat")
+        wide = keras.Input(shape=(3,), name="wide")
+        emb = keras.layers.Embedding(300, 8, name="emb1")(cat)
+        x = keras.layers.Flatten()(emb)
+        x = keras.layers.Concatenate()([x, wide])
+        x = keras.layers.Dense(16)(x)
+        x = keras.layers.BatchNormalization(name="bn")(x)
+        x = keras.layers.ReLU()(x)
+        out = keras.layers.Dense(1, activation="sigmoid")(x)
+        m = keras.Model([cat, wide], out)
+
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, 300, (64, 4)).astype(np.int32)
+        w = rng.standard_normal((64, 3)).astype(np.float32)
+        y = rng.integers(0, 2, (64,)).astype(np.float32)
+
+        emodel, _ = from_keras_model(m)
+        trainer = Trainer(emodel, embed.SGD(learning_rate=0.1))
+        batch = {"sparse": {"cat": ids}, "dense": w, "label": y}
+        state = trainer.init(batch)
+        state = import_keras_rows(trainer, state, m)
+
+        want = np.asarray(m([ids, w], training=False)).reshape(-1)
+        got = np.asarray(trainer.jit_eval_step()(state, batch)["logits"])
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+        print("BN_FORWARD_OK")
+
+        step = trainer.jit_train_step()
+        for _ in range(3):
+            state, _ = step(state, batch)
+
+        m.compile(optimizer=keras.optimizers.SGD(learning_rate=0.1),
+                  loss="binary_crossentropy")
+        m.fit([ids, w], y, batch_size=64, epochs=3, shuffle=False, verbose=0)
+
+        dm = emodel.module.dense_model
+        for i, v in enumerate(dm.trainable_variables):
+            np.testing.assert_allclose(
+                np.asarray(state.dense_params[f"v{i}"]),
+                np.asarray(v.value), rtol=1e-3, atol=1e-5)
+        moved = 0
+        for i, v in enumerate(dm.non_trainable_variables):
+            ours = np.asarray(state.dense_params[f"n{i}"])
+            np.testing.assert_allclose(ours, np.asarray(v.value),
+                                       rtol=1e-3, atol=1e-5)
+            moved += int(not np.allclose(
+                ours, np.zeros_like(ours)) and "mean" in v.path)
+        # the moving mean really moved off its 0.0 init (stats are LIVE)
+        assert moved >= 1, [v.path for v in dm.non_trainable_variables]
+        print("BN_TRAIN_PARITY_OK")
+    """)
+    assert "BN_FORWARD_OK" in out and "BN_TRAIN_PARITY_OK" in out
+
+
+def test_shared_embedding_two_tower():
+    """ONE Embedding layer applied at two call sites (two-tower retrieval
+    shape) converts to ONE table: call-site id columns concatenate through
+    `batch_transform`, rows slice back per site, and gradients from both
+    towers accumulate into the same rows — matching Keras fit exactly."""
+    out = _run("""
+        import numpy as np, keras
+        import openembedding_tpu as embed
+        from openembedding_tpu.keras_compat import (from_keras_model,
+            import_keras_rows)
+        from openembedding_tpu.model import Trainer
+
+        user = keras.Input(shape=(2,), dtype="int32", name="user_hist")
+        item = keras.Input(shape=(3,), dtype="int32", name="item_ids")
+        shared = keras.layers.Embedding(400, 8, name="shared_emb")
+        ue = keras.layers.Flatten()(shared(user))
+        ie = keras.layers.Flatten()(shared(item))
+        x = keras.layers.Concatenate()([ue, ie])
+        x = keras.layers.Dense(16, activation="relu")(x)
+        out = keras.layers.Dense(1, activation="sigmoid")(x)
+        m = keras.Model([user, item], out)
+
+        rng = np.random.default_rng(1)
+        u = rng.integers(0, 400, (64, 2)).astype(np.int32)
+        it = rng.integers(0, 400, (64, 3)).astype(np.int32)
+        # overlap between towers so shared-row gradient accumulation is hit
+        it[:, 0] = u[:, 0]
+        y = rng.integers(0, 2, (64,)).astype(np.float32)
+
+        emodel, _ = from_keras_model(m)
+        assert emodel.batch_transform is not None
+        trainer = Trainer(emodel, embed.SGD(learning_rate=0.1))
+        batch = {"sparse": {"user_hist": u, "item_ids": it},
+                 "dense": None, "label": y}
+        state = trainer.init(batch)
+        state = import_keras_rows(trainer, state, m)
+
+        want = np.asarray(m([u, it], training=False)).reshape(-1)
+        got = np.asarray(trainer.jit_eval_step()(state, batch)["logits"])
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+        print("SHARED_FORWARD_OK")
+
+        step = trainer.jit_train_step()
+        for _ in range(3):
+            state, _ = step(state, batch)
+        m.compile(optimizer=keras.optimizers.SGD(learning_rate=0.1),
+                  loss="binary_crossentropy")
+        m.fit([u, it], y, batch_size=64, epochs=3, shuffle=False, verbose=0)
+        np.testing.assert_allclose(
+            np.asarray(state.tables["shared_emb"].weights),
+            np.asarray(m.get_layer("shared_emb").embeddings.value),
+            rtol=1e-4, atol=1e-6)
+        print("SHARED_TRAIN_OK")
+    """)
+    assert "SHARED_FORWARD_OK" in out and "SHARED_TRAIN_OK" in out
+
+
 def test_inject_runner_trains_unmodified_script(tmp_path):
     """The reference's laboratory story end to end: a script written against
     plain Keras (build, compile, fit, predict) runs unmodified under
@@ -272,6 +396,111 @@ def test_inject_fit_edge_semantics(tmp_path):
     for marker in ("POSITIONAL_AND_PARTIAL_OK", "SMALL_N_OK",
                    "UNSUPPORTED_KWARG_OK", "MSE_OK", "LOSS_GUARD_OK"):
         assert marker in out, out
+
+
+def test_inject_callbacks_and_dataset_input(tmp_path):
+    """Round-5 inject surface: REAL Keras callbacks drive off the synced live
+    model (ModelCheckpoint saves per epoch, EarlyStopping stops the loop),
+    and `x` may be a batch iterable — a re-iterable dataset (fresh pass per
+    epoch) or a generator with steps_per_epoch."""
+    ckdir = str(tmp_path / "ck")
+    out = _run(f"""
+        import numpy as np, os, keras
+        from openembedding_tpu.inject import install
+        install()
+
+        rng = np.random.default_rng(0)
+        V = 64
+        ids = rng.integers(0, V, (96, 2)).astype(np.int32)
+        y = (ids[:, 0] % 2).astype(np.float32)
+
+        def build():
+            cat = keras.Input(shape=(2,), dtype="int32", name="cat")
+            emb = keras.layers.Embedding(V, 4, name="emb")(cat)
+            x = keras.layers.Flatten()(emb)
+            out = keras.layers.Dense(1, activation="sigmoid")(x)
+            m = keras.Model(cat, out)
+            m.compile(optimizer=keras.optimizers.Adagrad(learning_rate=0.5),
+                      loss="binary_crossentropy", metrics=["AUC"])
+            return m
+
+        # ModelCheckpoint per epoch off the SYNCED live model
+        os.makedirs({ckdir!r}, exist_ok=True)
+        cb = keras.callbacks.ModelCheckpoint(
+            {ckdir!r} + "/e{{epoch}}.weights.h5", save_weights_only=True)
+        m = build()
+        h = m.fit(ids, y, batch_size=32, epochs=3, verbose=0, callbacks=[cb])
+        assert sorted(os.listdir({ckdir!r})) == [
+            "e1.weights.h5", "e2.weights.h5", "e3.weights.h5"]
+        assert "auc" in h.history and len(h.history["auc"]) == 3
+        # epoch-1 weights differ from epoch-3 weights (real per-epoch saves)
+        m.load_weights({ckdir!r} + "/e1.weights.h5")
+        w1 = np.asarray(m.get_layer("emb").embeddings.value).copy()
+        m.load_weights({ckdir!r} + "/e3.weights.h5")
+        w3 = np.asarray(m.get_layer("emb").embeddings.value)
+        assert not np.allclose(w1, w3)
+        print("CHECKPOINT_CB_OK")
+
+        # EarlyStopping: patience 0 on an always-worsening monitor stops at 1
+        class Bomb(keras.callbacks.Callback):
+            def on_epoch_end(self, epoch, logs=None):
+                self.model.stop_training = True
+        h2 = build().fit(ids, y, batch_size=32, epochs=5, verbose=0,
+                         callbacks=[Bomb()])
+        assert len(h2.history["loss"]) == 1, h2.history
+        print("EARLY_STOP_OK")
+
+        # re-iterable dataset input (list of (x, y) batches; fresh each epoch)
+        batches = [({{"cat": ids[i:i+32]}}, y[i:i+32])
+                   for i in range(0, 96, 32)]
+        class DS:
+            def __iter__(self): return iter(batches)
+        h3 = build().fit(DS(), epochs=2, verbose=0)
+        assert len(h3.history["loss"]) == 2
+        assert h3.history["loss"][-1] < h3.history["loss"][0], h3.history
+        print("DATASET_OK")
+
+        # generator input needs steps_per_epoch; consumed ACROSS epochs
+        def gen():
+            while True:
+                for b in batches:
+                    yield b
+        h4 = build().fit(gen(), epochs=2, steps_per_epoch=3, verbose=0)
+        assert len(h4.history["loss"]) == 2
+        print("GENERATOR_OK")
+        try:
+            build().fit(gen(), epochs=1, verbose=0)
+            raise SystemExit("generator without steps_per_epoch should raise")
+        except ValueError as e:
+            assert "steps_per_epoch" in str(e)
+        print("GENERATOR_GUARD_OK")
+    """)
+    for marker in ("CHECKPOINT_CB_OK", "EARLY_STOP_OK", "DATASET_OK",
+                   "GENERATOR_OK", "GENERATOR_GUARD_OK"):
+        assert marker in out, out
+
+
+def test_inject_runs_ported_hook_example(tmp_path):
+    """The faithful port of the reference's hook script
+    (`examples/criteo_deepctr_hook.py` -> ours) runs UNMODIFIED under
+    `python -m openembedding_tpu.inject`: pandas -> hashed ids -> plain-Keras
+    DeepFM -> fit(dict inputs, ModelCheckpoint, AUC metric) -> save."""
+    import subprocess
+    script = os.path.join(REPO, "examples", "criteo_deepctr_hook.py")
+    ck = str(tmp_path / "hook_ck") + "/"
+    saved = str(tmp_path / "hook.keras")
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("PALLAS_AXON_POOL_IPS",)}
+    env.update({"KERAS_BACKEND": "jax", "JAX_PLATFORMS": "cpu",
+                "PYTHONPATH": REPO})
+    p = subprocess.run(
+        [sys.executable, "-m", "openembedding_tpu.inject", script,
+         "--epochs", "2", "--checkpoint", ck, "--save", saved],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO)
+    assert p.returncode == 0, f"STDOUT:\n{p.stdout}\nSTDERR:\n{p.stderr}"
+    assert "epoch 2/2" in p.stdout and "auc" in p.stdout, p.stdout
+    assert sorted(os.listdir(ck)) == ["1.weights.h5", "2.weights.h5"]
+    assert os.path.exists(saved)
 
 
 def test_mesh_import_forward_parity():
