@@ -61,6 +61,14 @@ def main():
     ap.add_argument("--steps", type=int, default=100)
     ap.add_argument("--mesh", action="store_true",
                     help="MeshTrainer over all visible devices")
+    ap.add_argument("--capacity-factor", type=float, default=0.0,
+                    help="a2a exchange bucket headroom (0 = exact, never "
+                         "drops; sizing rule in parallel/sharded.py)")
+    ap.add_argument("--on-overflow", default="count",
+                    choices=["count", "grow", "raise"],
+                    help="bounded-bucket drop policy: watch counters, grow "
+                         "capacity_factor adaptively (recompiles between "
+                         "windows), or fail loud")
     ap.add_argument("--offload", type=int, default=0, metavar="SLOTS",
                     help="train the table bigger than HBM: keep a SLOTS-row "
                          "device cache, full table in host RAM "
@@ -117,7 +125,9 @@ def main():
     opt = OPTIMIZERS[args.optimizer](args.learning_rate)
     if args.mesh:
         from openembedding_tpu.parallel import MeshTrainer
-        trainer = MeshTrainer(model, opt)
+        trainer = MeshTrainer(model, opt,
+                              capacity_factor=args.capacity_factor,
+                              on_overflow=args.on_overflow)
         print(f"mesh: {trainer.num_shards} devices, tables row-sharded, "
               f"batch data-parallel")
     else:
@@ -200,6 +210,10 @@ def main():
                 persister.maybe_persist(state, batch=stacked)
             print(f"step {done}: loss {float(m['loss']):.4f}")
             report_overflow()
+            if hasattr(trainer, "check_overflow") \
+                    and trainer.check_overflow(m):
+                print(f"  exchange capacity grew to "
+                      f"f={trainer.capacity_factor} (recompiling)")
         trained = done
         mode = f" (scan K={args.scan})"
     else:
@@ -220,6 +234,11 @@ def main():
             if i % 20 == 0:
                 print(f"step {i}: loss {float(m['loss']):.4f}")
                 report_overflow()
+                if hasattr(trainer, "check_overflow") \
+                        and trainer.check_overflow(m):
+                    print(f"  exchange capacity grew to "
+                          f"f={trainer.capacity_factor} (recompiling)")
+                    step = trainer.jit_train_step(batch, state)
         trained = args.steps
         mode = ""
     loss = float(m["loss"])  # fences the device work

@@ -626,9 +626,13 @@ class Trainer:
 
         def body(state, batch):
             state, metrics = self.train_step(state, batch, packed=layouts)
-            return state, metrics["loss"]
+            oflow = jnp.zeros((), jnp.int32)
+            for k, v in metrics.get("stats", {}).items():
+                if k.endswith("_overflow"):
+                    oflow = oflow + jnp.asarray(v).astype(jnp.int32)
+            return state, (metrics["loss"], oflow)
 
-        state, losses = jax.lax.scan(body, state, batches)
+        state, (losses, oflows) = jax.lax.scan(body, state, batches)
 
         if layouts:
             tables = dict(state.tables)
@@ -639,7 +643,10 @@ class Trainer:
                                         spec.dtype)
                 tables[name] = ts.replace(weights=w, slots=slots)
             state = state.replace(tables=tables)
-        return state, {"loss": losses}
+        # "overflow": exchange-bucket drops summed over the window (the scan
+        # returns no per-step stats; this one scalar is what capacity
+        # governance needs — see MeshTrainer.check_overflow)
+        return state, {"loss": losses, "overflow": jnp.sum(oflows)}
 
     def jit_train_many(self):
         """Scan-fused multi-step driver (state DONATED, like jit_train_step)."""

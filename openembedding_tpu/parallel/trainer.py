@@ -25,6 +25,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..embedding import EmbeddingSpec, EmbeddingTableState
 from ..model import EmbeddingModel, TrainState, Trainer, init_dense_slots
 from ..optimizers import SparseOptimizer
+from ..utils import metrics as _metrics
 from .mesh import DATA_AXIS, make_mesh
 from .sharded import (sharded_apply_gradients, sharded_lookup,
                       sharded_lookup_train)
@@ -34,15 +35,69 @@ class MeshTrainer(Trainer):
     def __init__(self, model: EmbeddingModel,
                  optimizer: Optional[SparseOptimizer] = None, *,
                  mesh: Optional[Mesh] = None, seed: int = 0,
-                 capacity_factor: float = 0.0):
+                 capacity_factor: float = 0.0,
+                 on_overflow: str = "count"):
         super().__init__(model, optimizer, seed)
         self.mesh = mesh if mesh is not None else make_mesh()
         self.axis = self.mesh.axis_names[0]
         self.num_shards = self.mesh.devices.size  # overrides Trainer.num_shards
         # per-(src,dst) bucket headroom for the a2a exchange; 0 = exact (capacity = n)
         self.capacity_factor = capacity_factor
+        # bounded buckets can DROP ids (divergence from the reference's
+        # unbounded buffers, `EmbeddingPullOperator.cpp:86-112`); the policy
+        # when `check_overflow` sees drops: "count" (watch the counters),
+        # "grow" (raise capacity_factor, recompile), "raise" (fail loud)
+        if on_overflow not in ("count", "grow", "raise"):
+            raise ValueError(f"on_overflow={on_overflow!r}: expected "
+                             "'count', 'grow', or 'raise'")
+        self.on_overflow = on_overflow
         self._train_step_fn = None
         self._eval_step_fn = None
+
+    # -- overflow governance -------------------------------------------------
+
+    @staticmethod
+    def overflow_count(metrics) -> int:
+        """Exchange-bucket drops in one step's (or one scan window's) metrics."""
+        import numpy as np
+        total = int(np.asarray(metrics.get("overflow", 0)))
+        for k, v in metrics.get("stats", {}).items():
+            if k.endswith("_overflow"):
+                total += int(np.asarray(v))
+        return total
+
+    def check_overflow(self, metrics, *, growth: float = 2.0) -> bool:
+        """Drive the overflow policy with a step/window's metrics. Returns
+        True when the exchange capacity GREW — the caller must rebuild its
+        jitted step (`jit_train_step`/`jit_train_many` return fresh compiled
+        fns after a growth; bucket shapes are trace-time constants, so this
+        is the recompile-between-windows adaptive scheme).
+
+        The reference's buffers are dynamically sized and can never drop
+        (`EmbeddingPullOperator.cpp:86-112`); bounded buckets are the static-
+        shape price, and this policy is the governance: f grows until the
+        hottest shard fits (capped at f = S, where the bucket equals the
+        exact-mode capacity and overflow is impossible)."""
+        dropped = self.overflow_count(metrics)
+        if dropped == 0:
+            return False
+        if self.on_overflow == "raise":
+            raise RuntimeError(
+                f"{dropped} ids overflowed the a2a exchange buckets this "
+                f"window (capacity_factor={self.capacity_factor}); raise "
+                "capacity_factor (sizing rule in parallel/sharded.py) or "
+                "construct MeshTrainer(on_overflow='grow')")
+        if self.on_overflow != "grow" or self.capacity_factor <= 0:
+            return False  # exact mode cannot drop; "count" just watches
+        new = min(self.capacity_factor * growth, float(self.num_shards))
+        if new == self.capacity_factor:
+            return False
+        _metrics.observe("exchange.capacity_grown", 1)
+        self.capacity_factor = new
+        self._train_step_fn = None
+        self._eval_step_fn = None
+        self._train_many_fn = None
+        return True
 
     # -- checkpointing -------------------------------------------------------
 
@@ -233,7 +288,7 @@ class MeshTrainer(Trainer):
         many = jax.shard_map(
             self.train_many, mesh=self.mesh,
             in_specs=(state_spec, stacked_spec),
-            out_specs=(state_spec, {"loss": P()}),
+            out_specs=(state_spec, {"loss": P(), "overflow": P()}),
             check_vma=False,
         )
         self._train_many_fn = jax.jit(many, donate_argnums=(0,))

@@ -81,6 +81,133 @@ def test_capacity_factor_sizing_rule_uniform():
     assert int(m["stats"]["categorical/pull_overflow"]) >= 0
 
 
+def test_on_overflow_grow_adapts_until_zero_drops():
+    """Adaptive capacity (round 5): on_overflow='grow' doubles
+    capacity_factor on every overflowing window and invalidates the compiled
+    step; on the adversarial single-owner stream f climbs 1 -> 8 (= S, the
+    exact-capacity ceiling) and drops reach ZERO — the managed answer to the
+    reference's can't-drop dynamic buffers (`EmbeddingPullOperator.cpp:86-112`)."""
+    model = make_deepfm(vocabulary=VOCAB, dim=4, hidden=(16,))
+    tr = MeshTrainer(model, embed.Adagrad(learning_rate=0.1),
+                     mesh=make_mesh(), capacity_factor=1.0,
+                     on_overflow="grow")
+    b = _skewed_batch()
+    state = tr.init(b)
+    step = tr.jit_train_step(b, state)
+    factors = [tr.capacity_factor]
+    for i in range(8):
+        state, m = step(state, _skewed_batch(seed=i))
+        if tr.check_overflow(m):
+            factors.append(tr.capacity_factor)
+            step = tr.jit_train_step(b, state)  # recompile, bigger buckets
+    assert factors[-1] == float(S), factors  # grew to the exact ceiling
+    state, m = step(state, _skewed_batch(seed=99))
+    assert tr.overflow_count(m) == 0, dict(m["stats"])
+    # and grown-capacity training still converges on a fixed batch
+    fixed = _skewed_batch(seed=7)
+    losses = []
+    for _ in range(30):
+        state, m = step(state, fixed)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0], losses[::10]
+
+
+def test_on_overflow_raise_fails_loud():
+    """on_overflow='raise': the first overflowing window raises with the drop
+    count and the sizing-rule pointer instead of silently training without
+    the dropped rows."""
+    model = make_deepfm(vocabulary=VOCAB, dim=4, hidden=(16,))
+    tr = MeshTrainer(model, embed.Adagrad(learning_rate=0.1),
+                     mesh=make_mesh(), capacity_factor=1.0,
+                     on_overflow="raise")
+    b = _skewed_batch()
+    state = tr.init(b)
+    state, m = tr.jit_train_step(b, state)(state, b)
+    with pytest.raises(RuntimeError, match="capacity_factor"):
+        tr.check_overflow(m)
+    with pytest.raises(ValueError, match="on_overflow"):
+        MeshTrainer(model, embed.Adagrad(learning_rate=0.1),
+                    mesh=make_mesh(), on_overflow="explode")
+
+
+def test_train_many_reports_window_overflow():
+    """The scan path returns no per-step stats; its metrics carry ONE summed
+    'overflow' scalar so window-level governance (and bench reporting) see
+    the drops."""
+    import jax as _jax
+
+    model = make_deepfm(vocabulary=VOCAB, dim=4, hidden=(16,))
+    tr = MeshTrainer(model, embed.Adagrad(learning_rate=0.1),
+                     mesh=make_mesh(), capacity_factor=1.0)
+    batches = [_skewed_batch(seed=s) for s in range(4)]
+    stacked = _jax.tree_util.tree_map(lambda *xs: np.stack(xs), *batches)
+    state = tr.init(batches[0])
+    many = tr.jit_train_many(stacked, state)
+    state, m = many(state, stacked)
+    assert tr.overflow_count(m) > 0
+    # exact mode: same window, zero drops
+    tr0 = MeshTrainer(make_deepfm(vocabulary=VOCAB, dim=4, hidden=(16,)),
+                      embed.Adagrad(learning_rate=0.1), mesh=make_mesh(),
+                      capacity_factor=0.0)
+    state0 = tr0.init(batches[0])
+    many0 = tr0.jit_train_many(stacked, state0)
+    state0, m0 = many0(state0, stacked)
+    assert tr0.overflow_count(m0) == 0
+
+
+def test_zipfian_f1_drop_rate_and_auc_vs_exact():
+    """The PRODUCTION capacity config (f=1.0, bench mesh1f) on the traffic it
+    will actually see — Zipfian planted-signal streams — measured, not
+    assumed. At this deliberately small per-device batch (256 ids/device ->
+    32-id buckets, worst-case relative fluctuation; bench's 106k-id batches
+    sit far inside the sizing rule) the measured reality is: static f=1.0
+    drops ~3.9% of id positions and costs ~0.005 AUC; on_overflow='grow'
+    confines drops to the first windows (~1.3% total, declining) and
+    recovers the AUC to within noise of exact mode. Pins below bound those
+    measurements with margin."""
+    from openembedding_tpu.data import planted_criteo
+    from openembedding_tpu.models import make_lr
+    from openembedding_tpu.utils.metrics import auc
+
+    BATCH, STEPS, EPOCHS = 256, 100, 3
+    heldout = list(planted_criteo(BATCH, steps=10, seed=999))
+    labels = np.concatenate([b["label"] for b in heldout])
+
+    def run(factor, grow=False):
+        tr = MeshTrainer(make_lr(vocabulary=1 << 15),
+                         embed.Adam(learning_rate=0.02), mesh=make_mesh(),
+                         capacity_factor=factor,
+                         on_overflow="grow" if grow else "count")
+        state, many, dropped, total = None, None, 0, 0
+        for epoch in range(EPOCHS):
+            batches = list(planted_criteo(BATCH, steps=STEPS, seed=epoch))
+            stacked = jax.tree_util.tree_map(
+                lambda *xs: np.stack(xs), *batches)
+            if state is None:
+                state = tr.init(batches[0])
+                many = tr.jit_train_many(stacked, state)
+            state, m = many(state, stacked)
+            dropped += tr.overflow_count(m)
+            total += sum(b["sparse"]["categorical"].size for b in batches)
+            if grow and tr.check_overflow(m):
+                many = tr.jit_train_many(stacked, state)  # recompiled
+        ev = tr.jit_eval_step(heldout[0], state)
+        scores = np.concatenate(
+            [np.asarray(ev(state, b)["logits"]).reshape(-1) for b in heldout])
+        return auc(labels, scores), dropped, total
+
+    auc_exact, drop_exact, _ = run(0.0)
+    auc_f1, drop_f1, total = run(1.0)
+    auc_grow, drop_grow, _ = run(1.0, grow=True)
+    assert drop_exact == 0
+    # static f=1.0: drops visible and bounded (measured 3.9%)
+    assert 0 < drop_f1 / total < 0.06, (drop_f1, total)
+    assert auc_f1 > auc_exact - 0.01, (auc_f1, auc_exact, drop_f1)
+    # adaptive: strictly fewer drops than static, AUC within noise of exact
+    assert drop_grow < drop_f1, (drop_grow, drop_f1)
+    assert auc_grow > auc_exact - 0.005, (auc_grow, auc_exact, drop_grow)
+
+
 def test_num_shards_mismatch_warns():
     """A num_shards value that cannot be honored must warn, not lie
     (VERDICT r2 weak #5)."""
