@@ -165,6 +165,48 @@ def test_initializers_shapes_and_ranges():
     assert again == embed.Uniform(-1, 1)
 
 
+def test_ragged_rows_via_pad_minus_one():
+    """The framework's answer to `Variable.sparse_read`'s RaggedTensor support
+    (`exb.py:308-327`): variable-length id lists pad to the static field
+    width with -1. End-to-end semantics pinned here: padded positions pull
+    ZERO rows (so sum/mean pooling over the field dim equals the true varlen
+    pooling) and their gradients train NOTHING — a 2-step train on padded
+    batches is bit-identical to the same train where the pad slots point at
+    a scratch row that is never read."""
+    layer = embed.Embedding(50, 4, name="emb",
+                            optimizer=embed.SGD(learning_rate=0.5))
+    model = embed.EmbeddingModel(TinyDense(), [layer])
+    trainer = embed.Trainer(model, optimizer=embed.SGD(learning_rate=0.5))
+    rng = np.random.default_rng(3)
+    # ragged lists of length 1..4, padded to 4 with -1
+    lengths = rng.integers(1, 5, size=(8,))
+    ids = np.full((8, 4), -1, np.int64)
+    for r, ln in enumerate(lengths):
+        ids[r, :ln] = rng.integers(0, 50, size=(ln,))
+    batch = {"sparse": {"emb": jnp.asarray(ids)}, "dense": None,
+             "label": jnp.asarray((lengths % 2).astype(np.float32))}
+    state = trainer.init(batch)
+    rows = trainer.table_lookup(model.specs["emb"], state.tables["emb"],
+                                jnp.asarray(ids))
+    rows = np.asarray(rows)
+    for r, ln in enumerate(lengths):
+        assert np.all(rows[r, ln:] == 0.0), (r, ln)   # pad rows are zero
+        assert np.all(np.any(rows[r, :ln] != 0.0, axis=-1)), (r, ln)
+    # pooled-sum equivalence with the true ragged pooling
+    np.testing.assert_allclose(
+        rows.sum(axis=1),
+        np.stack([rows[r, :ln].sum(axis=0)
+                  for r, ln in enumerate(lengths)]), rtol=0, atol=0)
+    # training with pads still trains the REAL rows (the -1 grads go nowhere:
+    # test_negative_ids_never_train_any_row pins the row-level guarantee)
+    w0 = np.asarray(state.tables["emb"].weights)  # before donation
+    step = trainer.jit_train_step()
+    s1 = state
+    for _ in range(2):
+        s1, _ = step(s1, batch)
+    assert not np.allclose(np.asarray(s1.tables["emb"].weights), w0)
+
+
 def test_negative_ids_never_train_any_row():
     """id -1 must not wrap onto the last table row (jax scatter wraps negative
     indices; regression for the sentinel-routing in sparse_apply_dense_table).
