@@ -221,6 +221,7 @@ def main():
         state, m = step(state, first)
         if persister is not None:
             persister.maybe_persist(state, batch=first)
+        pending_overflow = 0  # drops accumulate across steps between checks
         for i in range(1, args.steps):
             batch = next(batches)
             with M.vtimer("train", "step"):
@@ -229,16 +230,21 @@ def main():
             all_labels.append(np.asarray(batch["label"]))
             all_scores.append(np.asarray(m["logits"]).reshape(-1))
             M.record_step_stats({k: v for k, v in m.get("stats", {}).items()})
+            if hasattr(trainer, "overflow_count"):
+                pending_overflow += trainer.overflow_count(m)
             if persister is not None:
                 persister.maybe_persist(state, batch=batch)
             if i % 20 == 0:
                 print(f"step {i}: loss {float(m['loss']):.4f}")
                 report_overflow()
-                if hasattr(trainer, "check_overflow") \
-                        and trainer.check_overflow(m):
+                # every step's drops since the last check count — a policy
+                # that only sampled the 20th step would miss the other 19
+                if hasattr(trainer, "check_overflow") and \
+                        trainer.check_overflow({"overflow": pending_overflow}):
                     print(f"  exchange capacity grew to "
                           f"f={trainer.capacity_factor} (recompiling)")
                     step = trainer.jit_train_step(batch, state)
+                pending_overflow = 0
         trained = args.steps
         mode = ""
     loss = float(m["loss"])  # fences the device work

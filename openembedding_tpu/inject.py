@@ -143,8 +143,11 @@ def _fit_via_framework(model, x, y, *, batch_size=32, epochs=1, shuffle=True,
     else:
         trainer = Trainer(emodel, opt)
 
-    sparse_feats = {s.feature_name for s in emodel.ps_specs().values()} | \
-                   {s.feature_name for s in emodel.sad_specs().values()}
+    # keyed by the FEEDING INPUTS' names (a shared layer's synthesized
+    # layer-name feature exists only after batch_transform, inside jit —
+    # spec.feature_name would KeyError on the user's input dict here)
+    from .keras_compat import sparse_input_names
+    sparse_feats = sparse_input_names(model)
     # a compiled AUC metric -> pooled train AUC per epoch (the reference's
     # benchmark prints it the same pooled way, `test/benchmark/criteo_deepctr.py`).
     # Pre-fit the CompileMetrics wrapper is unbuilt, so read the user's raw list.
@@ -300,7 +303,9 @@ def _fit_via_framework(model, x, y, *, batch_size=32, epochs=1, shuffle=True,
     if cbs is not None:
         cbs.on_train_end()
 
-    if state is not None:
+    if state is not None and cbs is None:
+        # with callbacks the last epoch's pre-on_epoch_end sync already wrote
+        # the live model; repeating it would re-export every table
         sync_back()
 
     class _History:

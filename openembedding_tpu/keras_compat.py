@@ -257,7 +257,17 @@ def from_keras_model(model, optimizer=None, *,
             col = 0
             for f, rank, node in zip(site_feats, site_ranks, nodes):
                 src = node.input_tensors[0]
-                width = 1 if rank == 1 else int(src.shape[1])
+                if rank == 1:
+                    width = 1
+                elif src.shape[1] is None:
+                    raise ValueError(
+                        f"shared Embedding layer {layer.name!r}: call site "
+                        f"fed by {f!r} has a variable-length id dimension "
+                        "(shape (None, None)); the column slicing needs a "
+                        "static width — pad each site's ids to a fixed field "
+                        "width (pad id -1 pulls zero rows and trains nothing)")
+                else:
+                    width = int(src.shape[1])
                 emb_kinds.append(("embslice",
                                   (layer.name, col, col + width, rank)))
                 col += width
@@ -326,6 +336,23 @@ def from_keras_model(model, optimizer=None, *,
     elif getattr(model, "optimizer", None) is not None:
         opt = optimizer_from_keras(model.optimizer)
     return emodel, opt
+
+
+def sparse_input_names(model) -> set:
+    """Names of the model Inputs that feed Embedding layers — the keys a
+    USER batch's sparse ids arrive under. For a shared layer these are the
+    per-call-site inputs, NOT the synthesized layer-name feature (that one
+    only exists after `batch_transform`, inside the jitted paths)."""
+    import keras
+
+    names = set()
+    for layer in model.layers:
+        if not isinstance(layer, keras.layers.Embedding):
+            continue
+        for node in getattr(layer, "_inbound_nodes", []):
+            for src in node.input_tensors:
+                names.add(src.name)
+    return names
 
 
 def import_keras_rows(trainer, state, keras_model):

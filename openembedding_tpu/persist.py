@@ -365,6 +365,10 @@ class DirtyTracker:
         self._feats = {name: spec.feature_name
                        for name, spec in model.ps_specs().items()
                        if spec.storage != "host_cached"}
+        # shared-Embedding Keras conversions synthesize a feature (the layer
+        # name) via batch_transform inside the jitted paths; the host-side
+        # tracker must apply the same transform or its feature lookup KeyErrors
+        self._transform = getattr(model, "batch_transform", None)
         self._chunks = {name: [] for name in self._feats}
         self.observed = 0
 
@@ -381,6 +385,8 @@ class DirtyTracker:
 
     def observe(self, batch) -> None:
         from .ops.id64 import np_ids_as_int64
+        if self._transform is not None:
+            batch = self._transform(batch)
         for name, feat in self._feats.items():
             ids = np.unique(np_ids_as_int64(
                 self._host_view(batch["sparse"][feat])))

@@ -480,6 +480,40 @@ def test_inject_callbacks_and_dataset_input(tmp_path):
         assert marker in out, out
 
 
+def test_inject_shared_embedding_model():
+    """Round-5 review regression: inject fit on a SHARED-Embedding model —
+    the user batch is keyed by the feeding inputs' names, the synthesized
+    layer-name feature exists only inside the jitted paths. This used to
+    KeyError('shared_emb') in make_batch."""
+    out = _run("""
+        import numpy as np, keras
+        from openembedding_tpu.inject import install
+        install()
+
+        user = keras.Input(shape=(2,), dtype="int32", name="user_hist")
+        item = keras.Input(shape=(3,), dtype="int32", name="item_ids")
+        shared = keras.layers.Embedding(200, 4, name="shared_emb")
+        x = keras.layers.Concatenate()([
+            keras.layers.Flatten()(shared(user)),
+            keras.layers.Flatten()(shared(item))])
+        out = keras.layers.Dense(1, activation="sigmoid")(
+            keras.layers.Dense(8, activation="relu")(x))
+        m = keras.Model([user, item], out)
+        m.compile(keras.optimizers.Adagrad(learning_rate=0.5),
+                  "binary_crossentropy")
+
+        rng = np.random.default_rng(0)
+        u = rng.integers(0, 200, (64, 2)).astype(np.int32)
+        it = rng.integers(0, 200, (64, 3)).astype(np.int32)
+        y = (u[:, 0] % 2).astype(np.float32)
+        h = m.fit({"user_hist": u, "item_ids": it}, y, batch_size=32,
+                  epochs=4, verbose=0)
+        assert h.history["loss"][-1] < h.history["loss"][0], h.history
+        print("INJECT_SHARED_OK")
+    """)
+    assert "INJECT_SHARED_OK" in out
+
+
 def test_inject_runs_ported_hook_example(tmp_path):
     """The faithful port of the reference's hook script
     (`examples/criteo_deepctr_hook.py` -> ours) runs UNMODIFIED under

@@ -562,6 +562,34 @@ def test_shard_row_reader_matches_direct_read(tmp_path):
             np.asarray(s_r[k]))
 
 
+def test_dirty_tracker_applies_batch_transform():
+    """A model with `batch_transform` (shared-Embedding Keras conversions)
+    synthesizes its table feature inside jit; the HOST-side tracker must run
+    the same transform or its feature lookup KeyErrors (round-5 review
+    regression)."""
+    import jax.numpy as jnp
+
+    from openembedding_tpu.persist import DirtyTracker
+
+    model = make_deepfm(vocabulary=VOCAB, dim=4, hidden=(8,))
+    feat = model.specs["categorical"].feature_name
+
+    def transform(batch, _feat=feat):
+        sp = dict(batch["sparse"])
+        sp[_feat] = jnp.concatenate(
+            [jnp.asarray(sp["site_a"]), jnp.asarray(sp["site_b"])], axis=1)
+        return {**batch, "sparse": sp}
+
+    model.batch_transform = transform
+    tracker = DirtyTracker(model)
+    batch = {"sparse": {"site_a": np.array([[1, 2]], np.int64),
+                        "site_b": np.array([[3, 2, 7]], np.int64)},
+             "dense": None, "label": np.zeros((1,), np.float32)}
+    tracker.observe(batch)
+    ids = tracker.take()["categorical"]
+    np.testing.assert_array_equal(ids, [1, 2, 3, 7])
+
+
 def test_dirty_tracker_window_semantics():
     """observe() accumulates per-batch uniques cheaply; take() returns the
     sorted cross-batch union and resets the window."""
