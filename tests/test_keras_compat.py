@@ -181,6 +181,58 @@ def test_batchnorm_model_conversion_parity():
     assert "BN_FORWARD_OK" in out and "BN_TRAIN_PARITY_OK" in out
 
 
+def test_batchnorm_model_trains_on_mesh():
+    """The frozen-state path under shard_map: BN moving stats are computed
+    from LOCAL batch statistics per shard and pmean'd back to ONE replicated
+    value (`MeshTrainer.reduce_module_state`). Asserts the stats move off
+    init, stay finite, and every device replica holds the SAME bytes."""
+    out = _run("""
+        import numpy as np, keras
+        import openembedding_tpu as embed
+        from openembedding_tpu.keras_compat import from_keras_model
+        from openembedding_tpu.parallel import MeshTrainer, make_mesh
+
+        cat = keras.Input(shape=(4,), dtype="int32", name="cat")
+        emb = keras.layers.Embedding(512, 8, name="emb1")(cat)
+        x = keras.layers.Flatten()(emb)
+        x = keras.layers.Dense(16)(x)
+        x = keras.layers.BatchNormalization(name="bn")(x)
+        x = keras.layers.ReLU()(x)
+        out = keras.layers.Dense(1, activation="sigmoid")(x)
+        m = keras.Model(cat, out)
+
+        emodel, _ = from_keras_model(m)
+        tr = MeshTrainer(emodel, embed.SGD(learning_rate=0.1),
+                         mesh=make_mesh())
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, 512, (64, 4)).astype(np.int32)
+        batch = {"sparse": {"cat": ids}, "dense": None,
+                 "label": (ids[:, 0] % 2).astype(np.float32)}
+        state = tr.init(batch)
+        nt0 = {k: np.asarray(v) for k, v in state.dense_params.items()
+               if k.startswith("n")}
+        assert nt0, "BN model must carry frozen leaves"
+        step = tr.jit_train_step(batch, state)
+        losses = []
+        for _ in range(20):
+            state, mt = step(state, batch)
+            losses.append(float(mt["loss"]))
+        assert losses[-1] < losses[0], losses[::5]
+        moved = 0
+        for k, v in state.dense_params.items():
+            if not k.startswith("n"):
+                continue
+            vals = [np.asarray(s.data) for s in v.addressable_shards]
+            for other in vals[1:]:   # replicas bit-identical after pmean
+                np.testing.assert_array_equal(vals[0], other, err_msg=k)
+            assert np.isfinite(vals[0]).all(), k
+            moved += int(not np.allclose(vals[0], nt0[k]))
+        assert moved >= 2, moved  # moving mean AND variance advanced
+        print("MESH_BN_OK")
+    """)
+    assert "MESH_BN_OK" in out
+
+
 def test_shared_embedding_two_tower():
     """ONE Embedding layer applied at two call sites (two-tower retrieval
     shape) converts to ONE table: call-site id columns concatenate through
@@ -478,6 +530,54 @@ def test_inject_callbacks_and_dataset_input(tmp_path):
     for marker in ("CHECKPOINT_CB_OK", "EARLY_STOP_OK", "DATASET_OK",
                    "GENERATOR_OK", "GENERATOR_GUARD_OK"):
         assert marker in out, out
+
+
+def test_shared_embedding_on_mesh():
+    """batch_transform under shard_map: each shard concatenates ITS batch
+    slice's call-site columns; forward parity vs the live Keras model with
+    imported rows, then training moves the shared table."""
+    out = _run("""
+        import numpy as np, keras
+        import openembedding_tpu as embed
+        from openembedding_tpu.keras_compat import (from_keras_model,
+            import_keras_rows)
+        from openembedding_tpu.parallel import MeshTrainer, make_mesh
+
+        user = keras.Input(shape=(2,), dtype="int32", name="user_hist")
+        item = keras.Input(shape=(3,), dtype="int32", name="item_ids")
+        shared = keras.layers.Embedding(512, 8, name="shared_emb")
+        x = keras.layers.Concatenate()([
+            keras.layers.Flatten()(shared(user)),
+            keras.layers.Flatten()(shared(item))])
+        out = keras.layers.Dense(1, activation="sigmoid")(
+            keras.layers.Dense(16, activation="relu")(x))
+        m = keras.Model([user, item], out)
+
+        rng = np.random.default_rng(2)
+        u = rng.integers(0, 512, (64, 2)).astype(np.int32)
+        it = rng.integers(0, 512, (64, 3)).astype(np.int32)
+        y = (u[:, 0] % 2).astype(np.float32)
+
+        emodel, _ = from_keras_model(m)
+        tr = MeshTrainer(emodel, embed.SGD(learning_rate=0.1),
+                         mesh=make_mesh())
+        batch = {"sparse": {"user_hist": u, "item_ids": it},
+                 "dense": None, "label": y}
+        state = tr.init(batch)
+        state = import_keras_rows(tr, state, m)
+        want = np.asarray(m([u, it], training=False)).reshape(-1)
+        got = np.asarray(tr.jit_eval_step(batch, state)(state, batch)["logits"])
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+        step = tr.jit_train_step(batch, state)
+        losses = []
+        for _ in range(15):
+            state, mt = step(state, batch)
+            losses.append(float(mt["loss"]))
+        assert losses[-1] < losses[0], losses[::5]
+        print("MESH_SHARED_OK")
+    """)
+    assert "MESH_SHARED_OK" in out
 
 
 def test_inject_shared_embedding_model():
