@@ -243,6 +243,121 @@ def scenario_persist_incr_train(pid, n, tmp):
     os.kill(os.getpid(), signal.SIGKILL)
 
 
+def build_hash_trainer(mesh):
+    """Hashed (2^40-id-space) DeepFM — the flagship hash-table config; delta
+    replay goes through the sharded find-or-insert admission kernel."""
+    import dataclasses
+
+    import openembedding_tpu as embed
+    from openembedding_tpu.initializers import Constant
+    from openembedding_tpu.models import make_deepfm
+    from openembedding_tpu.parallel import MeshTrainer
+
+    model = make_deepfm(vocabulary=-1, dim=4, hidden=(8,), hashed=True,
+                        capacity=4096)
+    model.specs["categorical"] = dataclasses.replace(
+        model.specs["categorical"], initializer=Constant(0.0))
+    return MeshTrainer(model, embed.Adagrad(learning_rate=0.1), mesh=mesh,
+                       seed=0)
+
+
+def make_hash_batch(step, gb):
+    import numpy as np
+    rng = np.random.default_rng(300 + step)
+    ids = rng.integers(0, 1 << 40, size=(gb, 3)).astype(np.int64)
+    label = (rng.random(gb) < 0.5).astype(np.float32)
+    return {"sparse": {"categorical": ids}, "dense": None, "label": label}
+
+
+def _hash_pull(trainer, state, ids64):
+    """Rows for sorted unique ids via the sharded lookup (slot layouts may
+    differ between live insertion order and replay order; VALUES by id are
+    the invariant)."""
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+    from openembedding_tpu.parallel.sharded import sharded_lookup
+
+    spec = trainer.model.specs["categorical"]
+    pull = jax.jit(jax.shard_map(
+        partial(sharded_lookup, spec, axis=trainer.axis),
+        mesh=trainer.mesh,
+        in_specs=(trainer._table_pspec(spec), P()),
+        out_specs=P(), check_vma=False))
+    return np.asarray(pull(state.tables["categorical"], jnp.asarray(ids64)))
+
+
+def scenario_persist_incr_hash_train(pid, n, tmp):
+    """Hash-table variant of the incremental crash scenario: train, persist
+    base+deltas, record pulled rows for the touched-id union, SIGKILL."""
+    import signal
+
+    import numpy as np
+    import openembedding_tpu as embed
+    from jax.experimental import multihost_utils
+    from openembedding_tpu.parallel import make_mesh, multihost
+    from openembedding_tpu.persist import IncrementalPersister, list_deltas
+
+    mesh = make_mesh()
+    trainer = build_hash_trainer(mesh)
+    gb = 24
+    batches = [multihost.global_batch(
+        local_slice(make_hash_batch(s, gb), pid, n), mesh)
+        for s in range(4)]
+    state = trainer.init(batches[0])
+    step = trainer.jit_train_step(batches[0], state)
+    root = os.path.join(tmp, "persists")
+    with IncrementalPersister(trainer, trainer.model, root,
+                              policy=embed.PersistPolicy(every_steps=1),
+                              full_every=100, commit_timeout=300.0) as p:
+        for b in batches:
+            state, _ = step(state, b)
+            p.maybe_persist(state, batch=b)
+        p.wait()
+    multihost_utils.sync_global_devices("hash_committed")
+    assert [s for s, _ in list_deltas(root)] == [2, 3, 4]
+
+    ids = np.unique(np.concatenate(
+        [make_hash_batch(s, gb)["sparse"]["categorical"].reshape(-1)
+         for s in range(4)]))
+    rows = _hash_pull(trainer, state, ids)
+    if pid == 0:
+        np.savez(os.path.join(tmp, "expected_rows.npz"), ids=ids, rows=rows)
+    multihost_utils.sync_global_devices("hash_expected_saved")
+    log(pid, "SIGKILL (simulated crash)")
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
+def scenario_persist_incr_hash_restore(pid, n, tmp):
+    """Fresh processes restore the hash model; pulled rows for the touched
+    union must match what phase A recorded."""
+    import numpy as np
+    from jax.experimental import multihost_utils
+    from openembedding_tpu.parallel import make_mesh, multihost
+    from openembedding_tpu.persist import restore_server_model
+
+    mesh = make_mesh()
+    trainer = build_hash_trainer(mesh)
+    gb = 24
+    b = multihost.global_batch(
+        local_slice(make_hash_batch(0, gb), pid, n), mesh)
+    state = trainer.init(b)
+    root = os.path.join(tmp, "persists")
+    state = restore_server_model(state, trainer.model, root, trainer=trainer)
+    assert int(state.step) == 4, int(state.step)
+    with np.load(os.path.join(tmp, "expected_rows.npz")) as z:
+        ids, want = z["ids"], z["rows"]
+    got = _hash_pull(trainer, state, ids)
+    np.testing.assert_array_equal(got, want)
+    multihost_utils.sync_global_devices("hash_restore_verified")
+    if pid == 0:
+        with open(os.path.join(tmp, "result.json"), "w") as f:
+            json.dump({"ok": True, "rows_checked": int(ids.size)}, f)
+
+
 def scenario_persist_incr_restore(pid, n, tmp):
     """Phase B: fresh processes restore base+deltas; every local shard must
     be bit-identical to what phase A recorded before the SIGKILL."""
@@ -298,8 +413,10 @@ def main():
      "persist_ok": scenario_persist_ok,
      "persist_kill": scenario_persist_kill,
      "persist_incr_train": scenario_persist_incr_train,
-     "persist_incr_restore": scenario_persist_incr_restore}[scenario](
-        pid, n, tmp)
+     "persist_incr_restore": scenario_persist_incr_restore,
+     "persist_incr_hash_train": scenario_persist_incr_hash_train,
+     "persist_incr_hash_restore": scenario_persist_incr_hash_restore}[
+        scenario](pid, n, tmp)
     log(pid, "done")
 
 

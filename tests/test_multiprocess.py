@@ -126,6 +126,17 @@ def test_multiprocess_incremental_persist_sigkill_restore(tmp_path):
     assert result["ok"] and result["shards_checked"] > 0
 
 
+def test_multiprocess_incremental_persist_hash_table(tmp_path):
+    """Same crash-and-restore story on the HASH-table (hashed 2^40-id) config:
+    per-process delta shards carry id-keyed rows, replay re-inserts through
+    the sharded find-or-insert kernel, and pulled rows for the touched-id
+    union match bit-exactly (slot order may differ; values by id may not)."""
+    _spawn("persist_incr_hash_train", 2, str(tmp_path), expect_rc=-9,
+           expect_result=False)
+    result = _spawn("persist_incr_hash_restore", 2, str(tmp_path))
+    assert result["ok"] and result["rows_checked"] > 0
+
+
 def test_multiprocess_persist_crash_blocks_commit(tmp_path):
     """2 processes: the second dies before writing anything; the commit wait
     must time out (surfaced to the caller) and NO COMMIT marker may exist —

@@ -10,12 +10,20 @@
 #          driver's own bench.py capture has the chip to itself)
 LOG=/tmp/chip_watcher_r5.log
 MAX_ATTEMPTS=6   # a deterministically-red battery must not commit forever
+# Hard wall-clock deadline (epoch seconds; default +7h): the driver's own
+# round-end bench.py must find the chip FREE — a battery firing into its
+# capture window would eat most of its budget. WATCHER_DEADLINE overrides.
+DEADLINE=${WATCHER_DEADLINE:-$(( $(date +%s) + 7 * 3600 ))}
 attempts=0
 cd "$(dirname "$0")/.." || exit 1
-echo "$(date -u '+%F %T') watcher started (pid $$)" >> "$LOG"
+echo "$(date -u '+%F %T') watcher started (pid $$, deadline $(date -u -d @$DEADLINE '+%F %T'))" >> "$LOG"
 while true; do
   if [ -f /tmp/upwindow_r5_stop ]; then
     echo "$(date -u '+%F %T') stop marker found, exiting" >> "$LOG"
+    exit 0
+  fi
+  if [ "$(date +%s)" -ge "$DEADLINE" ]; then
+    echo "$(date -u '+%F %T') deadline reached, retiring (chip left free for the driver)" >> "$LOG"
     exit 0
   fi
   if timeout 75 python -c \
