@@ -210,8 +210,7 @@ def main():
                 persister.maybe_persist(state, batch=stacked)
             print(f"step {done}: loss {float(m['loss']):.4f}")
             report_overflow()
-            if hasattr(trainer, "check_overflow") \
-                    and trainer.check_overflow(m):
+            if trainer.check_overflow(m):
                 print(f"  exchange capacity grew to "
                       f"f={trainer.capacity_factor} (recompiling)")
         trained = done
@@ -230,8 +229,7 @@ def main():
             all_labels.append(np.asarray(batch["label"]))
             all_scores.append(np.asarray(m["logits"]).reshape(-1))
             M.record_step_stats({k: v for k, v in m.get("stats", {}).items()})
-            if hasattr(trainer, "overflow_count"):
-                pending_overflow += trainer.overflow_count(m)
+            pending_overflow += trainer.overflow_count(m)
             if persister is not None:
                 persister.maybe_persist(state, batch=batch)
             if i % 20 == 0:
@@ -239,8 +237,7 @@ def main():
                 report_overflow()
                 # every step's drops since the last check count — a policy
                 # that only sampled the 20th step would miss the other 19
-                if hasattr(trainer, "check_overflow") and \
-                        trainer.check_overflow({"overflow": pending_overflow}):
+                if trainer.check_overflow({"overflow": pending_overflow}):
                     print(f"  exchange capacity grew to "
                           f"f={trainer.capacity_factor} (recompiling)")
                     step = trainer.jit_train_step(batch, state)

@@ -246,6 +246,20 @@ class Trainer:
             new_tables[name] = ot.state
         return state.replace(tables=new_tables)
 
+    @staticmethod
+    def overflow_count(metrics) -> int:
+        """Exchange-bucket drops in a step's (or scan window's) metrics.
+        Single-device tables have no bounded buckets — always 0 here;
+        MeshTrainer overrides with the real counter read, so training loops
+        can call the governance hooks on either trainer."""
+        del metrics
+        return 0
+
+    def check_overflow(self, metrics, **kw) -> bool:
+        """Overflow-policy hook (no-op off-mesh; see MeshTrainer)."""
+        del metrics, kw
+        return False
+
     def table_overflow(self, state: "TrainState", name: str) -> int:
         """Lifetime dropped-id count for one table — includes overflow banked
         across host-offload cache resets (the device counter alone restarts at
