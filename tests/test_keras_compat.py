@@ -765,7 +765,9 @@ def test_converted_model_checkpoint_roundtrip(tmp_path):
             cat = keras.Input(shape=(3,), dtype="int32", name="cat")
             emb = keras.layers.Embedding(200, 8, name="emb")(cat)
             x = keras.layers.Flatten()(emb)
-            x = keras.layers.Dense(16, activation="relu")(x)
+            x = keras.layers.Dense(16)(x)
+            x = keras.layers.BatchNormalization(name="bn")(x)
+            x = keras.layers.ReLU()(x)
             out = keras.layers.Dense(1, activation="sigmoid")(x)
             return keras.Model(cat, out)
 
@@ -781,12 +783,20 @@ def test_converted_model_checkpoint_roundtrip(tmp_path):
         for _ in range(10):
             state, m = step(state, batch)
         want = np.asarray(tr.jit_eval_step()(state, batch)["logits"])
+        nt_want = {{k: np.asarray(v) for k, v in state.dense_params.items()
+                    if k.startswith("n")}}
+        assert nt_want, "BN model must carry frozen leaves"
         tr.save(state, {str(tmp_path / "ck")!r})
 
         emodel2, _ = from_keras_model(build())
         tr2 = Trainer(emodel2, embed.Adagrad(learning_rate=0.3))
         state2 = tr2.init(batch)
         state2 = tr2.load(state2, {str(tmp_path / "ck")!r})
+        # the frozen (BN moving-stat) leaves restored bit-exactly — inference
+        # after restart normalizes with the TRAINED statistics
+        for k, v in nt_want.items():
+            np.testing.assert_array_equal(
+                np.asarray(state2.dense_params[k]), v, err_msg=k)
         got = np.asarray(tr2.jit_eval_step()(state2, batch)["logits"])
         np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
         print("CONVERTED_CKPT_OK")
