@@ -6,9 +6,9 @@ reference: the benchmark readers in `test/benchmark/criteo_deepctr.py:168-240`
 """
 
 from .criteo import (CriteoBatcher, criteo_fold_offsets, hash_category,
-                     planted_criteo, planted_logit, read_criteo_tsv,
-                     synthetic_criteo, prefetch_to_device)
+                     is_ragged, pad_ragged, planted_criteo, planted_logit,
+                     read_criteo_tsv, synthetic_criteo, prefetch_to_device)
 
 __all__ = ["CriteoBatcher", "criteo_fold_offsets", "hash_category",
-           "planted_criteo", "planted_logit", "read_criteo_tsv",
-           "synthetic_criteo", "prefetch_to_device"]
+           "is_ragged", "pad_ragged", "planted_criteo", "planted_logit",
+           "read_criteo_tsv", "synthetic_criteo", "prefetch_to_device"]

@@ -441,6 +441,42 @@ class CriteoBatcher:
             yield out
 
 
+def pad_ragged(seqs, width: Optional[int] = None, dtype=np.int64) -> np.ndarray:
+    """Variable-length id lists -> a static (len(seqs), width) array padded
+    with -1 (= invalid in every lookup path: pad slots pull zero rows, train
+    nothing, and combiner pooling masks them out). The host-side half of the
+    framework's RaggedTensor answer (reference `Variable.sparse_read` accepts
+    ragged, `exb.py:308-327`; static TPU shapes make pad+mask the idiomatic
+    equivalent — see `embedding.combine`).
+
+    width=None uses the batch's own max length (min 1 so the array is never
+    0-wide). A sequence LONGER than an explicit width is an error — silent
+    truncation would drop features the caller thinks are training."""
+    lens = [len(s) for s in seqs]
+    w = max(lens, default=0) or 1 if width is None else width
+    out = np.full((len(lens), w), -1, dtype)
+    for r, s in enumerate(seqs):
+        if len(s) > w:
+            raise ValueError(
+                f"pad_ragged: sequence {r} has {len(s)} ids > width {w}; "
+                "raise `width` (truncate explicitly if that's what you want)")
+        out[r, :len(s)] = np.asarray(s, dtype)
+    return out
+
+
+def is_ragged(ids) -> bool:
+    """True for a list/tuple/object-array of variable-length id sequences —
+    the inputs `pad_ragged` exists for. Rectangular nested lists and real
+    ndarrays are NOT ragged (they coerce directly)."""
+    if isinstance(ids, np.ndarray):
+        return ids.dtype == object
+    if not isinstance(ids, (list, tuple)) or not ids:
+        return False
+    if not all(isinstance(s, (list, tuple, np.ndarray)) for s in ids):
+        return False
+    return len({len(s) for s in ids}) > 1
+
+
 def prefetch_to_device(it: Iterator, size: int = 2,
                        sharding=None) -> Iterator:
     """Background-thread device prefetch: overlaps host parsing + H2D transfer with

@@ -73,6 +73,15 @@ class EmbeddingSpec:
     # DeepCTR linear feature columns likewise re-read the same input,
     # `test/benchmark/criteo_deepctr.py`).
     feature: str = ""
+    # multivalent-feature pooling over the trailing id axis: "" (no pooling,
+    # the layer emits per-slot rows), "sum", "mean" or "sqrtn". The framework's
+    # answer to the reference's RaggedTensor `sparse_read` (`exb.py:308-327`,
+    # whose downstream Keras graphs pool the ragged rows): variable-length id
+    # lists pad to the static field width with -1 (`data.pad_ragged`) and the
+    # pooling masks the pad slots out of both the value and the gradient, so
+    # the result equals true varlen pooling (TF's safe_embedding_lookup_sparse
+    # combiners) with static TPU-friendly shapes.
+    combiner: str = ""
 
     def __post_init__(self):
         if self.input_dim == 0 or self.input_dim < -1:
@@ -87,6 +96,10 @@ class EmbeddingSpec:
                 f"embedding {self.name!r}: storage='host_cached' needs a "
                 "hash-table variable (input_dim=-1 + capacity) — the device "
                 "cache is keyed by id, not by dense row position")
+        if self.combiner not in ("", "sum", "mean", "sqrtn"):
+            raise ValueError(
+                f"embedding {self.name!r}: unknown combiner "
+                f"{self.combiner!r} (expected '', 'sum', 'mean' or 'sqrtn')")
         if self.storage == "host_cached" and self.sparse_as_dense:
             raise ValueError(
                 f"embedding {self.name!r}: sparse_as_dense (dense-mirrored "
@@ -142,6 +155,7 @@ class EmbeddingSpec:
             "storage": self.storage,
             "variable_id": self.variable_id,
             "feature": self.feature,
+            "combiner": self.combiner,
         }
 
     @classmethod
@@ -223,6 +237,74 @@ def lookup_train(spec: EmbeddingSpec, state: EmbeddingTableState,
     return state, rows.reshape(out_shape + (spec.output_dim,))
 
 
+def valid_mask(spec: EmbeddingSpec, ids: jax.Array) -> jax.Array:
+    """True where an id slot holds a real id — single-lane ids >= 0, split
+    pairs via `pair_valid` (`ops/id64.py`). Shape = `lookup`'s row-output
+    shape (the pair lane dim is dropped), so it broadcasts against rows."""
+    from .ops.id64 import is_pair, pair_valid
+    ids = jnp.asarray(ids)
+    if spec.use_hash_table and is_pair(ids):
+        return pair_valid(ids)
+    return ids >= 0
+
+
+def np_valid_mask(spec: EmbeddingSpec, ids) -> "np.ndarray":
+    """Host-side twin of `valid_mask` for serving paths that hold the ORIGINAL
+    numpy ids. They must mask from the numpy array, not from `jnp.asarray(ids)`:
+    with x64 off that conversion truncates 63-bit int64 ids to int32, flipping
+    real ids whose bit 31 is set to negative — `valid_mask` would silently
+    mark them padding and drop their (correctly fetched) rows from the pool."""
+    import numpy as np
+    ids = np.asarray(ids)
+    from .ops.id64 import HI_INVALID, is_pair
+    if spec.use_hash_table and is_pair(ids):
+        return ids[..., 0] < HI_INVALID
+    return ids >= 0
+
+
+def combine(spec: EmbeddingSpec, ids, rows: jax.Array,
+            mask=None) -> jax.Array:
+    """Pool multivalent rows (..., F, dim) over the id axis F per
+    `spec.combiner`; identity when no combiner is set. Pad slots (-1 /
+    EMPTY-pair ids) contribute zero to the pooled value AND receive zero
+    gradient through the mask multiply — independent of the separate
+    negative-ids-never-train row guarantee. mean/sqrtn divide by the VALID
+    count (clamped >= 1: an all-pad row pools to zeros instead of NaN), which
+    is exactly TF's safe_embedding_lookup_sparse combiner semantics — the op
+    the reference's ragged `sparse_read` consumers feed (`exb.py:308-327`).
+
+    `mask` overrides the id-derived validity — serving paths pass
+    `np_valid_mask` computed on the original host int64 ids, which a device
+    conversion could truncate (see np_valid_mask)."""
+    if not spec.combiner:
+        return rows
+    m = jnp.asarray(mask) if mask is not None else valid_mask(spec, ids)
+    if m.ndim < 2:
+        raise ValueError(
+            f"embedding {spec.name!r}: combiner={spec.combiner!r} needs ids "
+            f"of shape (batch, fields), got rank {m.ndim}")
+    mf = m.astype(rows.dtype)[..., None]
+    s = jnp.sum(rows * mf, axis=-2)
+    if spec.combiner == "sum":
+        return s
+    cnt = jnp.maximum(jnp.sum(mf, axis=-2), jnp.asarray(1, rows.dtype))
+    if spec.combiner == "mean":
+        return s / cnt
+    return s / jnp.sqrt(cnt)
+
+
+def serve_rows(spec: EmbeddingSpec, ids, lookup_fn) -> jax.Array:
+    """The ONE serving-side embed: `lookup_fn(ids)` + combiner pooling with
+    the validity mask taken from the ORIGINAL host ids (np_valid_mask — a
+    device conversion would truncate 63-bit int64 ids under x64-off). Both
+    `StandaloneModel.predict` and `parallel.ShardedModel.predict` route
+    through here so the mask invariant lives in one place."""
+    rows = lookup_fn(ids)
+    if spec.combiner:
+        rows = combine(spec, None, rows, mask=np_valid_mask(spec, ids))
+    return rows
+
+
 def apply_gradients(spec: EmbeddingSpec, state: EmbeddingTableState,
                     optimizer: SparseOptimizer, ids: jax.Array,
                     grads: jax.Array) -> EmbeddingTableState:
@@ -254,7 +336,8 @@ class Embedding:
                  sparse_as_dense: bool = False,
                  capacity: int = 0,
                  storage: str = "hbm",
-                 feature: str = ""):
+                 feature: str = "",
+                 combiner: str = ""):
         self.spec = EmbeddingSpec(
             name=name,
             input_dim=input_dim,
@@ -267,6 +350,7 @@ class Embedding:
             capacity=capacity,
             storage=storage,
             feature=feature,
+            combiner=combiner,
         )
 
     def __repr__(self):

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .checkpoint import (MODEL_META_FILE, _flatten_params, _unflatten_params)
+from .embedding import serve_rows
 from .meta import ModelMeta, ModelVariableMeta
 from .model import EmbeddingModel
 
@@ -329,10 +330,18 @@ class StandaloneModel:
         first = feat(next(iter(self._tables)))
         n = np.asarray(batch["sparse"][first]).shape[0]
         padded = pad_serving_batch(batch, n, bucket_size(n))
-        # sparse_as_dense variables were exported as plain array tables, so every
-        # spec (PS or sad) resolves through the same lookup here
-        embedded = {name: self.lookup(name, padded["sparse"][feat(name)])
-                    for name in self._tables}
+        # sparse_as_dense variables were exported as plain array tables, so
+        # every spec (PS or sad) resolves through the same lookup here;
+        # multivalent (combiner) variables pool via serve_rows — the shared
+        # serving embed that keeps the host-ids mask invariant in one place
+        embedded = {}
+        for name in self._tables:
+            ids = padded["sparse"][feat(name)]
+            if name in specs:
+                embedded[name] = serve_rows(
+                    specs[name], ids, lambda i, n=name: self.lookup(n, i))
+            else:
+                embedded[name] = self.lookup(name, ids)
         out = self._predict_fn(self.dense_params, embedded,
                                padded.get("dense"))
         return out[:n]

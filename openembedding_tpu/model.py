@@ -28,7 +28,8 @@ import jax.numpy as jnp
 from flax import struct
 
 from .embedding import (Embedding, EmbeddingSpec, EmbeddingTableState,
-                        apply_gradients, init_table_state, lookup, lookup_train)
+                        apply_gradients, combine, init_table_state, lookup,
+                        lookup_train)
 from .optimizers import Adagrad, SparseOptimizer
 
 
@@ -71,6 +72,16 @@ def dense_apply(optimizer: SparseOptimizer, params, slots, grads) -> Tuple[Any, 
         new_slots.append(ns)
     return (jax.tree_util.tree_unflatten(treedef, new_params),
             jax.tree_util.tree_unflatten(treedef, new_slots))
+
+
+def sad_rows(table: jax.Array, ids: jax.Array) -> jax.Array:
+    """Dense-mirrored ('Cache' mode) table gather through `lookup_rows` — the
+    ONE implementation of the invalid-id contract (-1 pads and out-of-range
+    ids pull zero rows and train nothing, in value and gradient). A bare
+    `jnp.take(table, ids)` would wrap -1 onto the last table row; serving's
+    lookups already zero-fill, so anything else here is train/serve skew."""
+    from .ops.sparse import lookup_rows
+    return lookup_rows(table, ids)
 
 
 class TrainState(struct.PyTreeNode):
@@ -390,6 +401,8 @@ class Trainer:
             ids = jnp.asarray(batch["sparse"][spec.feature_name])
             shape = (ids.shape[:-1] if spec.use_hash_table and is_pair(ids)
                      else ids.shape)
+            if spec.combiner:  # pooling collapses the trailing field axis
+                shape = shape[:-1]
             out[name] = jnp.zeros(shape + (spec.output_dim,), spec.dtype)
         return out
 
@@ -446,11 +459,19 @@ class Trainer:
         def loss_fn(tr_params, pulled_rows):
             dense_params = (model.module.merge_params(tr_params, fr0)
                             if split is not None else tr_params)
-            embedded = dict(pulled_rows)
+            # combiner pooling happens INSIDE the differentiated function so
+            # autodiff hands table_apply per-slot (B, F, dim) grads that line
+            # up with the (B, F) id array; the mask multiply zeroes pad-slot
+            # grads (see embedding.combine)
+            embedded = {
+                name: combine(ps_specs[name],
+                              jnp.asarray(batch["sparse"][
+                                  ps_specs[name].feature_name]), rows)
+                for name, rows in pulled_rows.items()}
             for name, spec in sad_specs.items():
                 table = dense_params["__embeddings__"][name]
                 ids = jnp.asarray(batch["sparse"][spec.feature_name])
-                embedded[name] = jnp.take(table, ids, axis=0)
+                embedded[name] = combine(spec, ids, sad_rows(table, ids))
             if train_apply is not None:
                 logits, fr_new = train_apply({"params": dense_params},
                                              embedded, batch.get("dense"))
@@ -533,14 +554,16 @@ class Trainer:
         if model.batch_transform is not None:
             batch = model.batch_transform(batch)
         embedded = {
-            name: self.table_lookup(spec, state.tables[name],
-                                    jnp.asarray(batch["sparse"][spec.feature_name]))
+            name: combine(
+                spec, jnp.asarray(batch["sparse"][spec.feature_name]),
+                self.table_lookup(spec, state.tables[name],
+                                  jnp.asarray(batch["sparse"][spec.feature_name])))
             for name, spec in model.ps_specs().items()
         }
         for name, spec in model.sad_specs().items():
             table = state.dense_params["__embeddings__"][name]
-            embedded[name] = jnp.take(
-                table, jnp.asarray(batch["sparse"][spec.feature_name]), axis=0)
+            ids = jnp.asarray(batch["sparse"][spec.feature_name])
+            embedded[name] = combine(spec, ids, sad_rows(table, ids))
         logits = model.module.apply({"params": state.dense_params}, embedded,
                                     batch.get("dense"))
         return {"logits": logits, "loss": self._loss(logits, batch)}

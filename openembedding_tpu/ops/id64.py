@@ -80,6 +80,17 @@ def np_join_ids(pair) -> np.ndarray:
     return out
 
 
+def np_ids_for_table(ids, pair_table: bool) -> jax.Array:
+    """Host-side boundary conversion of an id batch onto a table's key layout:
+    int64 host ids split to pairs when the table keys are pair-layout
+    (`pair_table`, i.e. x64 off), passthrough otherwise. The ONE place the
+    'convert BEFORE jnp.asarray truncates int64 to int32' rule lives —
+    shared by serving lookups and the EmbeddingVariable facade."""
+    if pair_table and not is_pair(ids):
+        return jnp.asarray(np_split_ids(np.asarray(ids, np.int64)))
+    return jnp.asarray(ids)
+
+
 def split_ids(ids: jax.Array) -> jax.Array:
     """Device-side widen of single-lane ids to the pair layout (int64 inputs
     keep all bits — x64-on only; int32 inputs get hi=0). Negative -> EMPTY."""

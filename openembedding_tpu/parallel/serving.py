@@ -318,11 +318,8 @@ class ShardedModel:
         if (spec.use_hash_table
                 and self.tables[name].keys.ndim == 2):
             # split-pair table (x64 off): convert int64 request ids host-side
-            from ..ops.id64 import is_pair, np_split_ids
-            if not is_pair(ids):
-                ids = jnp.asarray(np_split_ids(np.asarray(ids, np.int64)))
-            else:
-                ids = jnp.asarray(ids)
+            from ..ops.id64 import np_ids_for_table
+            ids = np_ids_for_table(ids, True)
         else:
             ids = jnp.asarray(ids)
             if ids.dtype not in (jnp.int32, jnp.int64):
@@ -343,9 +340,12 @@ class ShardedModel:
         first = self.specs[next(iter(self.specs))].feature_name
         n = np.asarray(batch["sparse"][first]).shape[0]
         padded = pad_serving_batch(batch, n, bucket_size(n))
-        embedded = {name: self.lookup(
-            name, padded["sparse"][self.specs[name].feature_name])
-            for name in self.specs}
+        from ..embedding import serve_rows  # shared combiner-aware embed
+        embedded = {}
+        for name, spec in self.specs.items():
+            embedded[name] = serve_rows(
+                spec, padded["sparse"][spec.feature_name],
+                lambda i, n=name: self.lookup(n, i))
         if self._predict_fn is None:
             module = self.model.module
 

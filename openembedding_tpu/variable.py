@@ -30,18 +30,39 @@ class EmbeddingVariable:
         self._pending_ids = None
         self._pending_grads = None
 
+    def _coerce_ids(self, ids) -> jax.Array:
+        """Ragged inputs (list of variable-length id lists — what the
+        reference's `sparse_read` takes as a RaggedTensor, `exb.py:308-327`)
+        pad to the batch max width with -1; pad slots pull zero rows and
+        train nothing (pinned in tests/test_embedding.py), so sum-pooling the
+        result equals true varlen pooling.
+
+        Pair-keyed hash tables (x64 off) convert int64 host ids to the
+        split-pair layout HOST-SIDE (`ops/id64.np_ids_for_table`, shared with
+        `parallel/serving._lookup_raw`): `jnp.asarray(int64)` would truncate
+        63-bit ids to int32 — ids with bit 31 set would silently become
+        padding and the rest collide mod 2^32."""
+        from .data import is_ragged, pad_ragged
+        from .ops.id64 import np_ids_for_table
+        if is_ragged(ids):
+            ids = pad_ragged(ids)
+        return np_ids_for_table(
+            ids, self.spec.use_hash_table and self.state.keys is not None
+            and self.state.keys.ndim == 2)
+
     # -- reference `Variable.sparse_read` (`exb.py:308-327`): the *training* pull,
     #    which lazily initializes unseen ids — for hash tables that inserts keys, so
     #    the table state is threaded through. Use `read_only_pull` for serving.
     def sparse_read(self, ids) -> jax.Array:
-        self.state, rows = lookup_train(self.spec, self.state, jnp.asarray(ids))
+        self.state, rows = lookup_train(self.spec, self.state,
+                                        self._coerce_ids(ids))
         return rows
 
     pull_weights = sparse_read
 
     # -- reference serving path (`read_only_pull` handler): never inserts
     def read_only_pull(self, ids) -> jax.Array:
-        return lookup(self.spec, self.state, jnp.asarray(ids))
+        return lookup(self.spec, self.state, self._coerce_ids(ids))
 
     # -- reference `Variable.prefetch` (`exb.py`, `PrefetchPullWeights` op):
     #    issue the pull EARLY so the rows are ready when the step runs. Under
@@ -52,12 +73,15 @@ class EmbeddingVariable:
     def prefetch(self, ids) -> None:
         if self.spec.use_hash_table:
             self.state, _ = lookup_train(self.spec, self.state,
-                                         jnp.asarray(ids))
+                                         self._coerce_ids(ids))
 
     # -- reference `Variable.push_gradients`: queue grads; applied at update_weights
     def push_gradients(self, ids, grads) -> None:
         from .embedding import _flat_ids
-        ids, _ = _flat_ids(self.spec, jnp.asarray(ids))  # pairs keep lanes
+        # ragged ids coerce exactly like sparse_read's (same batch-max pad
+        # width), so the pull->push round trip accepts the same inputs; the
+        # pad slots' -1 ids train no row whatever grads ride along
+        ids, _ = _flat_ids(self.spec, self._coerce_ids(ids))  # pairs keep lanes
         grads = jnp.asarray(grads).reshape(-1, self.spec.output_dim)
         if self._pending_ids is None:
             self._pending_ids, self._pending_grads = ids, grads
