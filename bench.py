@@ -188,8 +188,9 @@ def _stacked_batches(dim_unused, steps, ids_dtype=np.int32, seed=7,
     return batches, stacked
 
 
-def _measure_many(name, many, state, stacked, extra_out=None):
-    WD.stage(f"{name}:compile", 420)
+def _measure_many(name, many, state, stacked, extra_out=None,
+                  compile_s=420):
+    WD.stage(f"{name}:compile", compile_s)
     state, metrics = many(state, stacked)
     loss = float(metrics["loss"][-1])  # fence: forces the whole scan
     log(f"{name}: compile+warmup done, loss={loss:.4f}")
@@ -232,10 +233,40 @@ def case_trainer(dim):
     # int32 ids: keep x64 off on TPU (VOCAB < 2^31)
     batches, stacked = _stacked_batches(dim, SCAN_STEPS, id_space=vocab)
     state = trainer.init(batches[0])
-    eps = _measure_many(name, trainer.jit_train_many(), state, stacked)
+    packed = bool(trainer._packed_layouts(state))
+    extra = {}
+    try:
+        eps = _measure_many(name, trainer.jit_train_many(), state, stacked)
+    except Exception as e:  # noqa: BLE001 — recorded in extra, then fallback
+        if not packed:
+            raise
+        # r5 chip finding (PERF_CHIP_R5.md bench_dim64): the packed dim-64
+        # program — 2^23 x 128 f32, exactly at the 4 GiB packing gate — dies
+        # in remote compile (tpu_compile_helper exit 1) on every attempt
+        # while dim9 compiles fine. A measured unpacked number (1.291x on
+        # this case's last chip run, r3) beats a red case, so disable
+        # packing and re-measure; `extra` records the mode + original error
+        # so the fallback can never masquerade as the packed result.
+        log(f"{name}: packed-layout program failed "
+            f"({type(e).__name__}: {str(e)[:200]}); retrying UNPACKED")
+        from openembedding_tpu.ops import sparse as sparse_ops
+        packed = False
+        extra["packed_error"] = f"{type(e).__name__}: {e}"[:300]
+        gate = sparse_ops.PACKED_MAX_BYTES
+        sparse_ops.PACKED_MAX_BYTES = 0
+        try:
+            state = trainer.init(batches[0])  # the old state was donated
+            eps = _measure_many(name, trainer.jit_train_many(), state,
+                                stacked)
+        finally:
+            # the gate is module state: leaving it zeroed would silently
+            # unpack every LATER case in this process (mesh1/mesh1f run
+            # after dim64 in the default order) — contaminated numbers
+            # with no marker
+            sparse_ops.PACKED_MAX_BYTES = gate
     return {"examples_per_sec_per_chip": round(eps, 1),
             "vs_baseline_dim9": round(eps / BASELINE_PER_CHIP, 3),
-            "vocab": vocab}
+            "vocab": vocab, "packed": packed, **extra}
 
 
 def case_mesh1(capacity_factor=0.0, name="mesh1"):
@@ -260,7 +291,12 @@ def case_mesh1(capacity_factor=0.0, name="mesh1"):
     state = trainer.init(batches[0])
     many = trainer.jit_train_many(stacked, state)
     extra = {}
-    eps = _measure_many(name, many, state, stacked, extra_out=extra)
+    # the fused exchange program has never finished an on-chip compile inside
+    # the old 420s watchdog (r5: "watchdog timeout in mesh1:compile",
+    # PERF_CHIP_R5.md) — the sorted dedup+route pipeline is a much bigger HLO
+    # than the single-device scan; give the FIRST compile more rope
+    eps = _measure_many(name, many, state, stacked, extra_out=extra,
+                        compile_s=700)
     return {"examples_per_sec_per_chip": round(eps, 1),
             "vs_baseline_dim9": round(eps / BASELINE_PER_CHIP, 3),
             "capacity_factor": capacity_factor, **extra}

@@ -21,57 +21,92 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--vocab", type=int, default=23,
-                    help="log2 table rows (default 23 = the bench case)")
-    ap.add_argument("--batch", type=int, default=4096)
-    ap.add_argument("--steps", type=int, default=8)
-    args = ap.parse_args()
-
+def _probe_one(log2_vocab, batch, steps, packed):
+    """Compile (and once-execute) the bench dim64 program at one config.
+    -> (ok, report dict). Never raises: the error HEAD (the part XLA's
+    allocation dump buries) is captured into the report."""
     import jax
 
     import openembedding_tpu as embed
     from openembedding_tpu.data import synthetic_criteo
     from openembedding_tpu.model import Trainer
     from openembedding_tpu.models import make_deepfm
+    from openembedding_tpu.ops import sparse as sparse_ops
 
-    V = 1 << args.vocab
-    print(f"platform={jax.devices()[0].platform} vocab=2^{args.vocab}",
-          flush=True)
+    sparse_ops.PACKED_MAX_BYTES = (4 << 30) if packed else 0
+    V = 1 << log2_vocab
+    rep = {"vocab_log2": log2_vocab, "packed": packed}
     model = make_deepfm(vocabulary=V, dim=64)
     tr = Trainer(model, embed.Adagrad(learning_rate=0.05))
-    batches = list(synthetic_criteo(args.batch, id_space=V, steps=args.steps,
+    batches = list(synthetic_criteo(batch, id_space=V, steps=steps,
                                     seed=1, ids_dtype=np.int32))
     stacked = jax.device_put(jax.tree_util.tree_map(
         lambda *xs: np.stack(xs), *batches))
     state = tr.init(batches[0])
     layouts = tr._packed_layouts(state)
-    print(f"packed layouts: { {k: v for k, v in layouts.items()} }", flush=True)
-    compiled = jax.jit(tr.train_many, donate_argnums=(0,)).lower(
-        state, stacked).compile()
+    rep["layouts"] = {k: v for k, v in layouts.items()}
+    try:
+        compiled = jax.jit(tr.train_many, donate_argnums=(0,)).lower(
+            state, stacked).compile()
+    except Exception as e:  # noqa: BLE001 — the failure IS the datum
+        head = "\n".join(f"{type(e).__name__}: {e}".splitlines()[:12])
+        rep["compile_error_head"] = head
+        return False, rep
     ma = compiled.memory_analysis()
-    table_bytes = V * 128 * 4
-    print(f"table (packed, V x 128 f32): {table_bytes / 2**30:.2f} GiB")
-    if ma is None:
-        print("memory_analysis() unavailable on this backend", flush=True)
-        return 1
-    for f in ("temp_size_in_bytes", "argument_size_in_bytes",
-              "output_size_in_bytes", "alias_size_in_bytes"):
-        v = getattr(ma, f, None)
-        if v is not None:
-            print(f"{f}: {v / 2**30:.3f} GiB")
-    temp = getattr(ma, "temp_size_in_bytes", None)
-    if temp is None:
-        print("temp_size_in_bytes unavailable on this backend", flush=True)
-    else:
-        ratio = temp / table_bytes
-        print(f"temp/table ratio: {ratio:.2f} "
-              f"({'NO padded table copy' if ratio < 1.0 else 'TABLE-SIZED TEMP PRESENT'})")
-    # run one dispatch so the number is a real program, not just a compile
-    state, m = compiled(state, stacked)
-    print(f"executed: loss={float(np.asarray(m['loss'])[-1]):.4f}")
-    return 0
+    table_bytes = V * (128 if packed else 64) * 4
+    rep["table_gib"] = round(table_bytes / 2**30, 3)
+    if ma is not None:
+        for f in ("temp_size_in_bytes", "argument_size_in_bytes",
+                  "output_size_in_bytes", "alias_size_in_bytes"):
+            v = getattr(ma, f, None)
+            if v is not None:
+                rep[f] = round(v / 2**30, 3)
+        temp = getattr(ma, "temp_size_in_bytes", None)
+        if temp is not None:
+            rep["temp_over_table"] = round(temp / table_bytes, 2)
+    try:
+        state, m = compiled(state, stacked)
+        rep["loss"] = round(float(np.asarray(m["loss"])[-1]), 4)
+    except Exception as e:  # noqa: BLE001
+        rep["exec_error_head"] = "\n".join(
+            f"{type(e).__name__}: {e}".splitlines()[:12])
+        return False, rep
+    return True, rep
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--vocab", type=int, default=23,
+                    help="log2 table rows (default 23 = the bench case)")
+    ap.add_argument("--batch", type=int, default=4096)
+    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--no-bisect", action="store_true",
+                    help="single config only (the pre-r5 behavior)")
+    args = ap.parse_args()
+
+    import jax
+    print(f"platform={jax.devices()[0].platform}", flush=True)
+
+    # r5 chip finding (PERF_CHIP_R5.md): the packed program at 2^23 dies in
+    # remote compile. Each probe runs in THIS process sequentially — the
+    # packing knob is module state, reset per _probe_one call.
+    ok, rep = _probe_one(args.vocab, args.batch, args.steps, packed=True)
+    print(f"packed@2^{args.vocab}: {rep}", flush=True)
+    if ok or args.no_bisect:
+        return 0 if ok else 1
+
+    # packed fails at the bench vocab: find the largest packed vocab that
+    # compiles (the HBM headroom curve), then the unpacked control at the
+    # ORIGINAL vocab — together they say whether the 4 GiB packing gate or
+    # the packed program structure is what the chip rejects.
+    for lv in range(args.vocab - 1, args.vocab - 4, -1):
+        ok, rep = _probe_one(lv, args.batch, args.steps, packed=True)
+        print(f"packed@2^{lv}: {rep}", flush=True)
+        if ok:
+            break
+    ok_u, rep_u = _probe_one(args.vocab, args.batch, args.steps, packed=False)
+    print(f"unpacked@2^{args.vocab}: {rep_u}", flush=True)
+    return 0 if ok_u else 1
 
 
 if __name__ == "__main__":

@@ -56,10 +56,18 @@ def bench_case(cases, budget):
 
 CASES = [
     ("bench_dim9", *bench_case("dim9", 420)),
-    ("bench_dim64", *bench_case("dim64", 420)),
+    # dim64 may need TWO compiles now (packed attempt -> unpacked fallback,
+    # r5 chip finding in PERF_CHIP_R5.md), and mesh1's fused-exchange compile
+    # blew the old 420s watchdog — budgets sized for the slow path
+    ("bench_dim64", *bench_case("dim64", 700)),
     ("dim64_probe",
-     [sys.executable, os.path.join(REPO, "tools", "dim64_probe.py")], {}, 600),
-    ("bench_mesh", *bench_case("mesh1,mesh1f", 500)),
+     [sys.executable, os.path.join(REPO, "tools", "dim64_probe.py")], {}, 900),
+    # one mesh case per battery entry: each is allowed a 700s first compile
+    # (bench.py case_mesh1), so sharing one budget would starve the second
+    # case exactly when the allowance is used; separate entries also mean a
+    # relay drop loses at most one case
+    ("bench_mesh1", *bench_case("mesh1", 1000)),
+    ("bench_mesh1f", *bench_case("mesh1f", 1000)),
     ("bench_pull", *bench_case("pull", 300)),
     ("step_bisect",
      [sys.executable, os.path.join(REPO, "tools", "step_bisect.py")], {}, 900),
