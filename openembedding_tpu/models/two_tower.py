@@ -67,16 +67,23 @@ def make_two_tower(user_vocabulary: int, item_vocabulary: int, dim: int = 16, *,
                    tower=(256, 128), hashed: bool = False,
                    user_capacity: int = 0, item_capacity: int = 0,
                    num_shards: int = -1, optimizer=None,
-                   compute_dtype=jnp.bfloat16) -> EmbeddingModel:
+                   compute_dtype=jnp.bfloat16,
+                   combiner: str = "") -> EmbeddingModel:
+    """`combiner` (sum/mean/sqrtn) makes both towers MULTIVALENT: each request
+    row carries a variable-length id list (watch history, basket) padded with
+    -1 (`data.pad_ragged`), pooled to one (B, dim) vector per tower before the
+    MLP (`embedding.combine`). The tower input width then no longer depends on
+    the field count, so serving accepts any request width — the retrieval-side
+    twin of the reference's ragged `sparse_read` (`exb.py:308-327`)."""
     embs = [
         Embedding(input_dim=-1 if hashed else user_vocabulary, output_dim=dim,
                   name=USER, embeddings_initializer=Normal(stddev=1e-2),
                   optimizer=optimizer, num_shards=num_shards,
-                  capacity=user_capacity),
+                  capacity=user_capacity, combiner=combiner),
         Embedding(input_dim=-1 if hashed else item_vocabulary, output_dim=dim,
                   name=ITEM, embeddings_initializer=Normal(stddev=1e-2),
                   optimizer=optimizer, num_shards=num_shards,
-                  capacity=item_capacity),
+                  capacity=item_capacity, combiner=combiner),
     ]
     from .ctr import _config
     return EmbeddingModel(
@@ -87,4 +94,5 @@ def make_two_tower(user_vocabulary: int, item_vocabulary: int, dim: int = 16, *,
                        item_vocabulary=item_vocabulary, dim=dim,
                        tower=list(tower), hashed=hashed,
                        user_capacity=user_capacity,
-                       item_capacity=item_capacity, num_shards=num_shards))
+                       item_capacity=item_capacity, num_shards=num_shards,
+                       combiner=combiner))

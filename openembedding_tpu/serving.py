@@ -40,6 +40,81 @@ class _BadRequest(Exception):
 MODEL_STATUS = ("CREATING", "NORMAL", "DELETING", "ERROR")
 
 
+def _ids_array(v, *, pooled: bool = False) -> np.ndarray:
+    """Sparse-id JSON payload -> int64 array.
+
+    `pooled=True` — every spec consuming this feature has a combiner, so the
+    field width is free: RAGGED lists of id lists (the natural client
+    encoding for multivalent features) pad to the next power-of-two width
+    with -1 (pad slots pull zero rows and pooling masks them out,
+    `embedding.combine`), rectangular input width-buckets the same way so
+    the jit compile cache stays O(log max_width) programs per feature
+    (`export.bucket_size`, floor 1), and 1-D input rank-expands to one-id
+    lists (Keras fit's convention, mirrored by `inject`).
+
+    `pooled=False` — the model's field count is part of its architecture
+    (e.g. DeepFM's 26 columns): the strict rectangular contract stays, and a
+    ragged payload raises (-> the caller's 400). Padding here would fabricate
+    zero rows into the tower — a silently wrong 200."""
+    from .data import is_ragged
+    from .export import bucket_size
+    if not pooled:
+        return np.asarray(v, dtype=np.int64)
+    if is_ragged(v):
+        return _pad_ragged_bucketed(v)
+    ids = np.asarray(v, dtype=np.int64)
+    if ids.ndim == 1:
+        return ids[:, None]
+    if ids.ndim == 2:
+        b = bucket_size(ids.shape[-1], floor=1)
+        if ids.shape[-1] != b:
+            ids = np.pad(ids, [(0, 0), (0, b - ids.shape[-1])],
+                         constant_values=-1)
+    return ids
+
+
+def _pad_ragged_bucketed(v) -> np.ndarray:
+    """The one ragged-padding policy for serving endpoints: pad to the next
+    power-of-two field width with -1 (`export.bucket_size`, floor 1)."""
+    from .data import pad_ragged
+    from .export import bucket_size
+    return pad_ragged(v, width=bucket_size(max(len(s) for s in v), floor=1))
+
+
+def _pull_ids(v) -> np.ndarray:
+    """Pull-endpoint ids: ragged lists pad to the power-of-two width (the
+    caller reads pad rows back as zeros — shape-explicit); rectangular input
+    passes through UNCHANGED so the response mirrors the requested shape."""
+    from .data import is_ragged
+    if is_ragged(v):
+        return _pad_ragged_bucketed(v)
+    return np.asarray(v, dtype=np.int64)
+
+
+def _pooled_features(servable) -> set:
+    """Feature names whose consuming specs ALL pool (combiner set) — the
+    features whose width is free at serving time. Specs come from either
+    servable kind; unknown specs (recipe-less standalone export) -> empty set
+    (strict coercion everywhere). Memoized on the servable (immutable per
+    load, and this sits on the predict hot path)."""
+    cached = getattr(servable, "_pooled_features_cache", None)
+    if cached is not None:
+        return cached
+    specs = getattr(servable, "specs", None)
+    if not isinstance(specs, dict):
+        m = getattr(servable, "model", None)
+        specs = m.specs if m is not None else {}
+    by_feature = {}
+    for s in specs.values():
+        by_feature.setdefault(s.feature_name, []).append(s)
+    out = {f for f, ss in by_feature.items() if all(x.combiner for x in ss)}
+    try:
+        servable._pooled_features_cache = out
+    except AttributeError:  # __slots__ servables: recompute per request
+        pass
+    return out
+
+
 def resolve_sign(uuid: str, model_version: float) -> str:
     """uuid + "-" + floor(version) (reference `py_api.cc:130-138`)."""
     return f"{uuid}-{int(math.floor(model_version))}"
@@ -376,9 +451,8 @@ class ServingHandler(BaseHTTPRequestHandler):
             if kind == "model" and action == "pull":
                 model, variable = self.manager.find_model_variable(
                     sign, self._field(body, "variable"))
-                ids = self._coerce(
-                    lambda v: np.asarray(v, dtype=np.int64),
-                    self._field(body, "ids"), "ids")
+                ids = self._coerce(_pull_ids, self._field(body, "ids"),
+                                   "ids")
                 rows = model.lookup(variable, ids)
                 # content negotiation: `Accept: application/octet-stream`
                 # streams the rows as npz — JSON-encoding a big pull is pure
@@ -388,10 +462,11 @@ class ServingHandler(BaseHTTPRequestHandler):
                 return self._json(200, {"weights": np.asarray(rows).tolist()})
             if kind == "model" and action == "predict":
                 model = self.manager.find_model(sign)
+                pooled = _pooled_features(model)
                 batch = {
                     "sparse": {k: self._coerce(
-                        lambda v: np.asarray(v, dtype=np.int64), v,
-                        f"sparse.{k}")
+                        lambda v, _p=(k in pooled): _ids_array(v, pooled=_p),
+                        v, f"sparse.{k}")
                         for k, v in body.get("sparse", {}).items()},
                 }
                 if body.get("dense") is not None:
@@ -493,13 +568,23 @@ class ServingClient:
         raise ConnectionError(
             f"no live replica among {self.nodes}: {last}") from last
 
+    @staticmethod
+    def _jsonable_ids(v):
+        """RAGGED id lists stay lists (np.asarray would raise on inhomogeneous
+        shapes before any request is made) — the server pads them
+        (`_ids_array`/`_pull_ids`); everything else normalizes through numpy."""
+        from .data import is_ragged
+        if is_ragged(v):
+            return [[int(x) for x in row] for row in v]
+        return np.asarray(v).tolist()
+
     def pull(self, model_sign: str, variable: str, ids, *,
              binary: bool = False) -> np.ndarray:
         """`binary=True` asks for the npz wire format (Accept negotiation) —
         no JSON float round-trip, the right mode for large/hot pulls."""
         out = self._request("POST", f"/models/{model_sign}/pull",
                             {"variable": variable,
-                             "ids": np.asarray(ids).tolist()},
+                             "ids": self._jsonable_ids(ids)},
                             binary=binary)
         if binary:
             return out["weights"]
@@ -507,7 +592,7 @@ class ServingClient:
 
     def predict(self, model_sign: str, sparse: Dict[str, Any],
                 dense=None) -> np.ndarray:
-        body = {"sparse": {k: np.asarray(v).tolist()
+        body = {"sparse": {k: self._jsonable_ids(v)
                            for k, v in sparse.items()}}
         if dense is not None:
             body["dense"] = np.asarray(dense).tolist()

@@ -338,3 +338,98 @@ def test_binary_pull_negotiation(trained, tmp_path):
         np.testing.assert_allclose(bn, js, rtol=1e-6, atol=1e-7)
     finally:
         srv.shutdown()
+
+
+def test_rest_ragged_multivalent_predict(tmp_path, server):
+    """Ragged JSON id lists serve end to end: the handler pads each sparse
+    feature to its power-of-two field width with -1 (`serving._ids_array`),
+    combiner pooling masks the pads out, and the response equals both the
+    explicitly padded request and the local StandaloneModel prediction. The
+    pull endpoint takes ragged ids the same way (pad rows -> zeros)."""
+    from openembedding_tpu.models import make_two_tower
+
+    model = make_two_tower(64, 64, dim=4, tower=(8,), combiner="mean",
+                           compute_dtype=jnp.float32)
+    trainer = Trainer(model, embed.Adagrad(learning_rate=0.05), seed=0)
+    batch = {"sparse": {"user": jnp.asarray([[1, 2], [3, -1]]),
+                        "item": jnp.asarray([[5, -1], [6, 7]])},
+             "dense": None, "label": None}
+    state = trainer.init(batch)
+    state, _ = trainer.jit_train_step()(state, batch)
+
+    base, _httpd = server
+    path = str(tmp_path / "ragged_export")
+    export_standalone(state, model, path, model_sign="rag-0")
+    status, _ = _req(f"{base}/models", "POST",
+                     {"model_sign": "rag-0", "model_uri": path})
+    assert status == 200
+
+    ragged = {"sparse": {"user": [[1, 2], [3]], "item": [[5], [6, 7]]}}
+    status, out = _req(f"{base}/models/rag-0/predict", "POST", ragged)
+    assert status == 200, out
+    got = np.asarray(out["logits"], np.float32)
+
+    padded = {"sparse": {"user": [[1, 2], [3, -1]], "item": [[5, -1], [6, 7]]}}
+    status, out2 = _req(f"{base}/models/rag-0/predict", "POST", padded)
+    assert status == 200
+    np.testing.assert_array_equal(got, np.asarray(out2["logits"], np.float32))
+
+    sm = StandaloneModel.load(path, model=model)
+    want = np.asarray(sm.predict(
+        {"sparse": {k: np.asarray(v) for k, v in padded["sparse"].items()}}))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    assert np.isfinite(got).all()
+
+    # a DIFFERENT request width compiles its own bucket and still serves
+    status, out3 = _req(f"{base}/models/rag-0/predict", "POST",
+                        {"sparse": {"user": [[1, 2, 3], [9]],
+                                    "item": [[5], [6, 7, 8]]}})
+    assert status == 200 and np.isfinite(np.asarray(out3["logits"])).all()
+
+    # ragged pull: pad rows come back as zeros
+    status, out4 = _req(f"{base}/models/rag-0/pull", "POST",
+                        {"variable": "user", "ids": [[1, 2, 3], [9]]})
+    assert status == 200
+    rows = np.asarray(out4["weights"], np.float32)
+    assert rows.shape[:2] == (2, 4)  # padded to the power-of-two bucket (4)
+    assert (rows[1, 1:] == 0).all() and (rows[0, :3] != 0).any()
+
+    # rectangular input to a POOLED feature width-buckets the same way, so a
+    # client pre-padding to width 3 and one sending ragged lists of max len 3
+    # hit the SAME compiled program and return the SAME logits
+    status, rect = _req(f"{base}/models/rag-0/predict", "POST",
+                        {"sparse": {"user": [[1, 2, 3], [9, -1, -1]],
+                                    "item": [[5], [6]]}})
+    status2, ragg = _req(f"{base}/models/rag-0/predict", "POST",
+                         {"sparse": {"user": [[1, 2, 3], [9]],
+                                     "item": [[5], [6]]}})
+    assert status == 200 and status2 == 200
+    np.testing.assert_array_equal(np.asarray(rect["logits"]),
+                                  np.asarray(ragg["logits"]))
+
+    # the in-repo client speaks the ragged encoding end to end
+    from openembedding_tpu.serving import ServingClient
+    client = ServingClient([base])
+    via_client = client.predict("rag-0", {"user": [[1, 2], [3]],
+                                          "item": [[5], [6, 7]]})
+    np.testing.assert_allclose(via_client, got, rtol=1e-6)
+    crows = client.pull("rag-0", "user", [[1, 2, 3], [9]])
+    np.testing.assert_array_equal(crows, rows)
+
+
+def test_rest_ragged_rejected_for_fixed_field_models(trained, tmp_path,
+                                                     server):
+    """A ragged payload against a model WITHOUT combiners (fixed field count
+    is part of the architecture) stays the CALLER's 400 — padding it would
+    fabricate zero rows into the tower and return wrong logits with a 200."""
+    model, trainer, state, batch = trained
+    base, _httpd = server
+    path = str(tmp_path / "fixed_export")
+    export_standalone(state, model, path, model_sign="fix-0")
+    status, _ = _req(f"{base}/models", "POST",
+                     {"model_sign": "fix-0", "model_uri": path})
+    assert status == 200
+    status, body = _req(f"{base}/models/fix-0/predict", "POST",
+                        {"sparse": {"categorical": [[1, 2], [3]]},
+                         "dense": np.asarray(batch["dense"])[:2].tolist()})
+    assert status == 400 and "categorical" in body["error"]
