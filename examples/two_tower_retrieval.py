@@ -1,0 +1,102 @@
+"""Two-tower retrieval with multivalent (variable-length) features.
+
+The retrieval-side counterpart of the reference's CTR examples
+(`examples/criteo_deepctr.py` there trains fixed-field models; its ragged
+inputs go through `Variable.sparse_read`'s RaggedTensor path,
+`tensorflow/exb.py:308-327`). Here each user row is a variable-length watch
+history and each item row a variable-length tag list: `data.pad_ragged` pads
+them to static widths with -1 and `combiner="mean"` pools the valid slots
+(`embedding.combine`), so the towers are width-free — train, export, then
+query the standalone model with a DIFFERENT request width.
+
+Usage:  python examples/two_tower_retrieval.py [--steps N] [--mesh]
+"""
+
+import argparse
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def synthetic_histories(rng, batch, n_users, n_items, max_hist, max_tags):
+    """Planted preference: user u likes items congruent to u mod 7 — the
+    towers must learn to co-embed them."""
+    from openembedding_tpu.data import pad_ragged
+    users, items = [], []
+    for _ in range(batch):
+        u = int(rng.integers(0, n_users))
+        group = u % 7
+        hist = rng.integers(0, n_users, size=int(rng.integers(1, max_hist)))
+        pos = group + 7 * rng.integers(0, n_items // 7,
+                                       size=int(rng.integers(1, max_tags)))
+        users.append([u] + hist.tolist())
+        items.append(pos.tolist())
+    return {"sparse": {"user": pad_ragged(users, width=max_hist + 1),
+                       "item": pad_ragged(items, width=max_tags)},
+            "dense": None, "label": None}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    def positive_int(v):
+        n = int(v)
+        if n < 1:
+            raise argparse.ArgumentTypeError("--steps must be >= 1")
+        return n
+
+    ap.add_argument("--steps", type=positive_int, default=60)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--dim", type=int, default=16)
+    ap.add_argument("--mesh", action="store_true",
+                    help="train through MeshTrainer on all visible devices")
+    args = ap.parse_args(argv)
+
+    import openembedding_tpu as embed
+    from openembedding_tpu.export import StandaloneModel, export_standalone
+    from openembedding_tpu.model import Trainer
+    from openembedding_tpu.models import make_two_tower
+
+    N_USERS, N_ITEMS = 4096, 2048
+    model = make_two_tower(N_USERS, N_ITEMS, dim=args.dim, tower=(64, 32),
+                           combiner="mean")
+    if args.mesh:
+        from openembedding_tpu.parallel import MeshTrainer, make_mesh
+        trainer = MeshTrainer(model, embed.Adagrad(learning_rate=0.1),
+                              mesh=make_mesh())
+    else:
+        trainer = Trainer(model, embed.Adagrad(learning_rate=0.1))
+
+    rng = np.random.default_rng(0)
+    batch = synthetic_histories(rng, args.batch_size, N_USERS, N_ITEMS, 8, 4)
+    state = trainer.init(batch)
+    step = (trainer.jit_train_step(batch, state) if args.mesh
+            else trainer.jit_train_step())
+    first = None
+    for i in range(args.steps):
+        b = synthetic_histories(rng, args.batch_size, N_USERS, N_ITEMS, 8, 4)
+        state, m = step(state, b)
+        loss = float(np.asarray(m["loss"]))
+        first = loss if first is None else first
+        if i % 10 == 0 or i == args.steps - 1:
+            print(f"step {i:3d}  in-batch softmax loss {loss:.4f}")
+    print(f"loss {first:.4f} -> {loss:.4f}")
+
+    # export + ragged query at a DIFFERENT width than training used
+    with tempfile.TemporaryDirectory(prefix="oetpu_two_tower_") as root:
+        export_standalone(state, model, root, model_sign="tt-demo-0")
+        sm = StandaloneModel.load(root, model=model)
+        scores = np.asarray(sm.predict({"sparse": {
+            "user": np.asarray([[11, 4, -1], [200, -1, -1]], np.int64),
+            "item": np.asarray([[4, 11], [7, -1]], np.int64)}}))
+        assert np.isfinite(scores).all()
+        print(f"served (B,B) score matrix at width 3/2: "
+              f"diag={np.round(np.diagonal(scores), 3).tolist()}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
