@@ -18,14 +18,16 @@ from .ctr import (MLP, LogisticRegression, WideDeep, DeepFM, XDeepFM, DCN,
                   DLRM, make_lr, make_wdl, make_deepfm, make_xdeepfm,
                   make_dcn, make_dlrm, CRITEO_NUM_SPARSE, CRITEO_NUM_DENSE)
 from .two_tower import TwoTower, make_two_tower, in_batch_softmax_loss
-from .sequential import (SASRec, make_sasrec, sasrec_bce_loss,
-                         synthetic_sequences)
+from .sequential import (SASRec, bert4rec_mask_id, make_bert4rec,
+                         make_sasrec, sasrec_bce_loss,
+                         synthetic_masked_sequences, synthetic_sequences)
 
 _FAMILIES = {
     "lr": make_lr, "wdl": make_wdl, "deepfm": make_deepfm,
     "xdeepfm": make_xdeepfm, "dcn": make_dcn, "dlrm": make_dlrm,
     "two_tower": make_two_tower,
     "sasrec": make_sasrec,
+    "bert4rec": make_bert4rec,
 }
 
 
@@ -56,5 +58,6 @@ __all__ = [
     "from_config",
     "TwoTower", "make_two_tower", "in_batch_softmax_loss",
     "SASRec", "make_sasrec", "sasrec_bce_loss", "synthetic_sequences",
+    "make_bert4rec", "bert4rec_mask_id", "synthetic_masked_sequences",
     "CRITEO_NUM_SPARSE", "CRITEO_NUM_DENSE",
 ]

@@ -8,10 +8,14 @@ histories can exceed a single chip's memory. Trained with the standard SASRec
 objective: causal transformer encodes the history, each position scores its next
 item against one positive and one sampled negative (BCE).
 
-Batch convention (Trainer-compatible):
+Batch convention (Trainer-compatible, both families):
     {"sparse": {"item": (B, 3, S)},   # stacked [history, positives, negatives]
-     "label":  (B, S)}                # 1.0 = real position, 0.0 = padding
-A single table pull fetches all three id sets in one exchange (B*3*S ids).
+     "label":  (B, S)}                # 1.0 = SCORED position, 0.0 = unscored
+For SASRec every real position is scored (label = the real-length mask); for
+BERT4Rec (`make_bert4rec`) only the [MASK]ed positions are (label = the
+masked-position mask), and pos/neg ids may be -1 everywhere else — unscored
+positions' scores never reach the loss. A single table pull fetches all three
+id sets in one exchange.
 """
 
 from __future__ import annotations
@@ -50,7 +54,10 @@ def sasrec_bce_loss(logits: jax.Array, labels: jax.Array,
 
 
 class SASRec(nn.Module):
-    """Causal transformer over the item history.
+    """Transformer over the item history — causal (SASRec) by default,
+    bidirectional (`causal=False`) for the BERT4Rec masked-item objective
+    (`make_bert4rec`); everything else (embedding path, CP attention,
+    pos-emb, scoring heads) is shared.
 
     `attention`: "full" (single device / data-parallel), "ring" or "ulysses"
     (context-parallel: REQUIRES running inside shard_map with a `seq_axis` mesh
@@ -63,19 +70,23 @@ class SASRec(nn.Module):
     attention: str = "full"
     seq_axis: str = "seq"
     compute_dtype: jnp.dtype = jnp.bfloat16
+    causal: bool = True
 
-    def _attend(self, q, k, v):
+    def _attend(self, q, k, v, kv_valid):
         from ..parallel.sequence import (reference_attention, ring_attention,
                                          ulysses_attention)
         if self.is_initializing() or self.attention == "full":
             # flax init traces outside shard_map where the seq axis is unbound;
             # attention owns no params, so initializing down the local path
             # produces identical parameters
-            return reference_attention(q, k, v, causal=True)
+            return reference_attention(q, k, v, causal=self.causal,
+                                       kv_valid=kv_valid)
         if self.attention == "ring":
-            return ring_attention(q, k, v, axis=self.seq_axis, causal=True)
+            return ring_attention(q, k, v, axis=self.seq_axis,
+                                  causal=self.causal, kv_valid=kv_valid)
         if self.attention == "ulysses":
-            return ulysses_attention(q, k, v, axis=self.seq_axis, causal=True)
+            return ulysses_attention(q, k, v, axis=self.seq_axis,
+                                     causal=self.causal, kv_valid=kv_valid)
         raise ValueError(f"unknown attention {self.attention!r}")
 
     def _pos_offset(self, s_local: int):
@@ -90,6 +101,14 @@ class SASRec(nn.Module):
         trio = embedded[ITEM]                       # (B, 3, S_local, d)
         hist, e_pos, e_neg = trio[:, 0], trio[:, 1], trio[:, 2]
         B, S, d = hist.shape
+        # key-padding mask from the zero-row property of pad ids (-1 pulls an
+        # exact zero row; real rows are never all-zero under continuous init/
+        # training). BIDIRECTIONAL (BERT4Rec) attention REQUIRES it — unmasked
+        # pad keys make logits depend on the pad width. It is also applied in
+        # causal mode (a provable no-op for the trailing-pad convention, but
+        # it makes INTERIOR pads safe too); cost: one (B,S) bool where, plus
+        # one extra ppermute per ring step — noise next to the block matmuls.
+        kv_valid = jnp.any(hist != 0, axis=-1)      # (B, S_local)
         if d != self.dim:
             raise ValueError(f"embedding dim {d} != module dim {self.dim}")
         H = self.num_heads
@@ -116,7 +135,7 @@ class SASRec(nn.Module):
             qkv = nn.Dense(3 * d, dtype=self.compute_dtype,
                            param_dtype=jnp.float32, name=f"qkv_{b}")(a)
             q, k, v = jnp.split(qkv.reshape(B, S, 3 * H, Dh), 3, axis=2)
-            o = self._attend(q, k, v).reshape(B, S, d)
+            o = self._attend(q, k, v, kv_valid).reshape(B, S, d)
             x = x + nn.Dense(d, dtype=self.compute_dtype,
                              param_dtype=jnp.float32, name=f"proj_{b}")(o)
             f = nn.LayerNorm(dtype=self.compute_dtype, name=f"ln_ffn_{b}")(x)
@@ -133,22 +152,26 @@ class SASRec(nn.Module):
         return jnp.stack([logit_pos, logit_neg], axis=-1)    # (B, S, 2)
 
 
-def make_sasrec(vocabulary: int, dim: int = 32, *, num_heads: int = 2,
-                num_blocks: int = 2, max_len: int = 512,
-                attention: str = "full", seq_axis: str = "seq",
-                hashed: bool = False, capacity: int = 0, num_shards: int = -1,
-                optimizer=None, compute_dtype=jnp.bfloat16) -> EmbeddingModel:
+def _make_sequential(family: str, *, causal: bool, extra_rows: int,
+                     vocabulary: int, dim: int, num_heads: int,
+                     num_blocks: int, max_len: int, attention: str,
+                     seq_axis: str, hashed: bool, capacity: int,
+                     num_shards: int, optimizer, compute_dtype
+                     ) -> EmbeddingModel:
+    """Shared factory body for the sequential families (SASRec causal /
+    BERT4Rec bidirectional): one item table (+`extra_rows` reserved rows,
+    e.g. the [MASK] token), the shared transformer, the shared BCE loss."""
     from .ctr import _config
     emb = Embedding(
-        input_dim=-1 if hashed else vocabulary, output_dim=dim, name=ITEM,
-        embeddings_initializer=Normal(stddev=0.02), optimizer=optimizer,
-        num_shards=num_shards, capacity=capacity)
+        input_dim=-1 if hashed else vocabulary + extra_rows, output_dim=dim,
+        name=ITEM, embeddings_initializer=Normal(stddev=0.02),
+        optimizer=optimizer, num_shards=num_shards, capacity=capacity)
     module = SASRec(dim=dim, num_heads=num_heads, num_blocks=num_blocks,
                     max_len=max_len, attention=attention, seq_axis=seq_axis,
-                    compute_dtype=compute_dtype)
+                    compute_dtype=compute_dtype, causal=causal)
     return EmbeddingModel(
         module, [emb], loss_fn=sasrec_bce_loss,
-        config=_config("sasrec", compute_dtype, vocabulary=vocabulary, dim=dim,
+        config=_config(family, compute_dtype, vocabulary=vocabulary, dim=dim,
                        num_heads=num_heads, num_blocks=num_blocks,
                        max_len=max_len, attention=attention, seq_axis=seq_axis,
                        hashed=hashed, capacity=capacity, num_shards=num_shards,
@@ -156,6 +179,107 @@ def make_sasrec(vocabulary: int, dim: int = 32, *, num_heads: int = 2,
                        # model property: a standalone export rebuilds with
                        # local attention (serving runs outside shard_map)
                        serving_overrides={"attention": "full"}))
+
+
+def make_sasrec(vocabulary: int, dim: int = 32, *, num_heads: int = 2,
+                num_blocks: int = 2, max_len: int = 512,
+                attention: str = "full", seq_axis: str = "seq",
+                hashed: bool = False, capacity: int = 0, num_shards: int = -1,
+                optimizer=None, compute_dtype=jnp.bfloat16) -> EmbeddingModel:
+    return _make_sequential(
+        "sasrec", causal=True, extra_rows=0, vocabulary=vocabulary, dim=dim,
+        num_heads=num_heads, num_blocks=num_blocks, max_len=max_len,
+        attention=attention, seq_axis=seq_axis, hashed=hashed,
+        capacity=capacity, num_shards=num_shards, optimizer=optimizer,
+        compute_dtype=compute_dtype)
+
+
+def make_bert4rec(vocabulary: int, dim: int = 32, *, num_heads: int = 2,
+                  num_blocks: int = 2, max_len: int = 512,
+                  attention: str = "full", seq_axis: str = "seq",
+                  hashed: bool = False, capacity: int = 0,
+                  num_shards: int = -1, optimizer=None,
+                  compute_dtype=jnp.bfloat16) -> EmbeddingModel:
+    """BERT4Rec-style masked-item model: the SAME transformer as SASRec but
+    BIDIRECTIONAL (causal=False, with the key-padding mask the bidirectional
+    path requires), trained to recover items hidden behind a [MASK] token
+    (Cloze objective). Batch convention is SASRec's (B, 3, S) trio —
+    [history-with-masks, true items, sampled negatives] — with `label` = 1.0
+    exactly at the masked prediction positions, so `sasrec_bce_loss` and the
+    whole Trainer/SeqMeshTrainer/CP machinery apply unchanged. The mask token
+    id comes from `bert4rec_mask_id(vocabulary, hashed=...)`: array tables
+    allocate one extra row for it; hashed deployments use a far reserved id
+    in the 63-bit space. Like SASRec this is beyond the reference's CTR-only
+    scope (SURVEY.md §5 long-context)."""
+    return _make_sequential(
+        "bert4rec", causal=False, extra_rows=1, vocabulary=vocabulary,
+        dim=dim, num_heads=num_heads, num_blocks=num_blocks, max_len=max_len,
+        attention=attention, seq_axis=seq_axis, hashed=hashed,
+        capacity=capacity, num_shards=num_shards, optimizer=optimizer,
+        compute_dtype=compute_dtype)
+
+
+def bert4rec_mask_id(vocabulary: int, hashed: bool = False) -> int:
+    """The reserved [MASK] token id for `make_bert4rec(vocabulary, ...)`.
+
+    Array tables: id `vocabulary` (the factory allocates the extra row).
+    Hashed tables have no extra row — any id is hashable, so `vocabulary`
+    itself could collide with a REAL item id; the reserved id is 2^62 - 1,
+    far outside fold-hashed id ranges (`data.hash_category` folds into
+    [0, id_space)). Callers feeding raw ids must not use it for items."""
+    return (1 << 62) - 1 if hashed else vocabulary
+
+
+def _markov_batch(rng, batch_size: int, seq_len: int, vocabulary: int):
+    """The shared synthetic substrate: Markov-ish item chains (stride walks
+    mod vocab, so the model has signal), a sampled negative per position, and
+    variable real lengths. -> (items, stride, neg, real-mask)."""
+    import numpy as np
+
+    start = rng.integers(1, vocabulary, size=(batch_size, 1))
+    stride = rng.integers(1, 7, size=(batch_size, 1))
+    items = (start + stride * np.arange(seq_len)) % vocabulary  # (B, S)
+    neg = rng.integers(0, vocabulary, size=(batch_size, seq_len))
+    lengths = rng.integers(seq_len // 2, seq_len + 1, size=batch_size)
+    real = (np.arange(seq_len)[None, :] < lengths[:, None])
+    return items, stride, neg, real
+
+
+def _seq_batch(hist, pos, neg, hist_keep, score_at):
+    """Assemble the (B,3,S) trio + label: hist kept where `hist_keep`, pos/neg
+    kept ONLY where `score_at` (elsewhere -1 -> zero rows, nothing exchanged —
+    the loss never reads unscored positions, so shipping their ids would just
+    inflate the sparse exchange)."""
+    import numpy as np
+
+    ids = np.stack([np.where(hist_keep, hist, -1),
+                    np.where(score_at, pos, -1),
+                    np.where(score_at, neg, -1)], axis=1).astype(np.int64)
+    return {"sparse": {ITEM: ids}, "label": score_at.astype(np.float32)}
+
+
+def synthetic_masked_sequences(batch_size: int, seq_len: int,
+                               vocabulary: int, *, mask_rate: float = 0.2,
+                               seed: int = 0, steps=None):
+    """Synthetic Cloze data for BERT4Rec: the same Markov-ish chains as
+    `synthetic_sequences`, with ~mask_rate of the REAL positions replaced by
+    the [MASK] token in the history and labeled for prediction. Yields
+    Trainer-ready batches ((B,3,S) ids + (B,S) mask-position labels)."""
+    import itertools
+    import numpy as np
+
+    mask_id = bert4rec_mask_id(vocabulary)
+    rng = np.random.default_rng(seed)
+    it = itertools.count() if steps is None else range(steps)
+    for _ in it:
+        items, _, neg, real = _markov_batch(rng, batch_size, seq_len,
+                                            vocabulary)
+        masked = real & (rng.random((batch_size, seq_len)) < mask_rate)
+        # every row must predict something: force one masked position
+        masked[~masked.any(axis=1), 0] = True
+        neg = np.where(neg == items, (neg + 1) % vocabulary, neg)
+        yield _seq_batch(np.where(masked, mask_id, items), items, neg,
+                         hist_keep=real, score_at=masked)
 
 
 def synthetic_sequences(batch_size: int, seq_len: int, vocabulary: int, *,
@@ -168,14 +292,8 @@ def synthetic_sequences(batch_size: int, seq_len: int, vocabulary: int, *,
     rng = np.random.default_rng(seed)
     it = itertools.count() if steps is None else range(steps)
     for _ in it:
-        start = rng.integers(1, vocabulary, size=(batch_size, 1))
-        stride = rng.integers(1, 7, size=(batch_size, 1))
-        hist = (start + stride * np.arange(seq_len)) % vocabulary  # (B, S)
-        pos = (hist + stride) % vocabulary                         # next item
-        neg = rng.integers(0, vocabulary, size=(batch_size, seq_len))
+        hist, stride, neg, real = _markov_batch(rng, batch_size, seq_len,
+                                                vocabulary)
+        pos = (hist + stride) % vocabulary                     # next item
         neg = np.where(neg == pos, (neg + 1) % vocabulary, neg)
-        lengths = rng.integers(seq_len // 2, seq_len + 1, size=batch_size)
-        mask = (np.arange(seq_len)[None, :] < lengths[:, None])
-        ids = np.stack([hist, pos, neg], axis=1).astype(np.int64)  # (B,3,S)
-        ids = np.where(mask[:, None, :], ids, -1)  # padding ids pull zeros
-        yield {"sparse": {ITEM: ids}, "label": mask.astype(np.float32)}
+        yield _seq_batch(hist, pos, neg, hist_keep=real, score_at=real)

@@ -36,9 +36,15 @@ NEG_INF = -1e30
 
 def reference_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                         causal: bool = True, q_offset=0,
-                        k_offset=0) -> jax.Array:
+                        k_offset=0, kv_valid=None) -> jax.Array:
     """Plain softmax attention; the single-device oracle both CP schemes must
-    match. Offsets give q/k blocks their global positions for causal masking."""
+    match. Offsets give q/k blocks their global positions for causal masking.
+
+    `kv_valid` (B, Sk) bool: key-padding mask — False keys take no softmax
+    mass. Causal attention tolerates trailing pads without it (pads sit after
+    every real query), but BIDIRECTIONAL attention does not: unmasked pad
+    keys would make real positions' outputs depend on how far the sequence
+    was padded (models/sequential.py BERT4Rec)."""
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
     scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
@@ -47,19 +53,25 @@ def reference_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
         qpos = q_offset + jnp.arange(Sq)[:, None]
         kpos = k_offset + jnp.arange(Sk)[None, :]
         scores = jnp.where((qpos >= kpos)[None, None], scores, NEG_INF)
+    if kv_valid is not None:
+        scores = jnp.where(kv_valid[:, None, None, :], scores, NEG_INF)
     out = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(scores, axis=-1),
                      v.astype(jnp.float32))
     return out.astype(q.dtype)
 
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *, axis: str,
-                   causal: bool = True) -> jax.Array:
+                   causal: bool = True, kv_valid=None) -> jax.Array:
     """Ring (context-parallel) attention inside shard_map over `axis`.
 
     Per step t, this device (ring index i) holds the kv block of device
     (i - t) mod P and folds it into a running flash accumulator; kv then moves to
     the next neighbor (one ppermute per step — a bandwidth-optimal ring like the
-    reference's NCCL allreduce rings, but over ICI)."""
+    reference's NCCL allreduce rings, but over ICI).
+
+    `kv_valid` (B, S_local) bool: this device's key-padding mask — it ROTATES
+    around the ring with its kv block, so every device masks every block
+    correctly (see reference_attention for why bidirectional needs it)."""
     P = jax.lax.axis_size(axis)
     i = jax.lax.axis_index(axis)
     B, S, H, D = q.shape
@@ -67,15 +79,17 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *, axis: str,
     scale = 1.0 / jnp.sqrt(jnp.float32(D))
     qpos = i * S + jnp.arange(S)[:, None]                       # (S, 1)
     perm = [(j, (j + 1) % P) for j in range(P)]
+    gb0 = (jnp.ones((B, S), bool) if kv_valid is None else kv_valid)
 
     def step(t, carry):
-        kb, vb, m, l, o = carry
+        kb, vb, gb, m, l, o = carry
         src = (i - t) % P                                        # kv block owner
         scores = jnp.einsum("bqhd,bkhd->bhqk", qf,
                             kb.astype(jnp.float32)) * scale
         if causal:
             kpos = src * S + jnp.arange(S)[None, :]              # (1, S)
             scores = jnp.where((qpos >= kpos)[None, None], scores, NEG_INF)
+        scores = jnp.where(gb[:, None, None, :], scores, NEG_INF)
         m_blk = jnp.max(scores, axis=-1)                         # (B,H,Sq)
         m_new = jnp.maximum(m, m_blk)
         # fully-masked rows keep m == NEG_INF; freeze them so exp() stays 0
@@ -89,26 +103,41 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *, axis: str,
                                               vb.astype(jnp.float32))
         kb = jax.lax.ppermute(kb, axis, perm)
         vb = jax.lax.ppermute(vb, axis, perm)
-        return kb, vb, m_new, l, o
+        gb = jax.lax.ppermute(gb, axis, perm)
+        return kb, vb, gb, m_new, l, o
 
     m0 = jnp.full((B, H, S), NEG_INF, jnp.float32)
     l0 = jnp.zeros((B, H, S), jnp.float32)
     o0 = jnp.zeros((B, H, S, D), jnp.float32)
-    _, _, _, l, o = jax.lax.fori_loop(0, P, step, (k, v, m0, l0, o0))
+    _, _, _, _, l, o = jax.lax.fori_loop(0, P, step, (k, v, gb0, m0, l0, o0))
     out = o / jnp.maximum(l, 1e-30)[..., None]                   # (B,H,S,D)
     return out.transpose(0, 2, 1, 3).astype(q.dtype)
 
 
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, *, axis: str,
-                      causal: bool = True,
+                      causal: bool = True, kv_valid=None,
                       attn_fn: Optional[callable] = None) -> jax.Array:
     """Ulysses (all-to-all) sequence parallelism inside shard_map over `axis`:
-    re-shard seq->heads, run full attention on H/P heads, re-shard back."""
+    re-shard seq->heads, run full attention on H/P heads, re-shard back.
+
+    `kv_valid` (B, S_local) bool key-padding mask: after the seq->heads
+    all_to_all the key axis is GLOBAL, so the mask all_gathers along `axis`
+    (concatenation follows ring order == global position order)."""
     P = jax.lax.axis_size(axis)
     B, S, H, D = q.shape
     if H % P != 0:
         raise ValueError(f"num_heads {H} not divisible by seq-parallel size {P}")
-    attn = attn_fn or partial(reference_attention, causal=causal)
+    if kv_valid is not None:
+        if attn_fn is not None:
+            # a custom kernel's mask contract is unknown — silently dropping
+            # the padding mask would reintroduce the pad-width dependence the
+            # mask exists to kill (tests/test_sequential_model.py pad pin)
+            raise ValueError(
+                "ulysses_attention: kv_valid with a custom attn_fn is not "
+                "supported — apply the key-padding mask inside attn_fn")
+        kv_valid = jax.lax.all_gather(kv_valid, axis, axis=1, tiled=True)
+    attn = attn_fn or partial(reference_attention, causal=causal,
+                              kv_valid=kv_valid)
 
     def to_heads(x):   # (B, S/P*, H, D) -> (B, S, H/P, D)
         return jax.lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
