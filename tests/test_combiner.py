@@ -64,6 +64,22 @@ def ragged_batch(rng, batch=B, width=F, vocab=VOCAB):
             "label": jnp.asarray(label)}, lens
 
 
+def ragged_hash_batch(seed, id_space=1 << 62):
+    """Ragged 63-bit hash-table batch in the x64-appropriate layout (split
+    pairs when x64 is off, plain int64 when on — production feed convention).
+    -> (batch, lens)."""
+    from openembedding_tpu.ops.id64 import np_split_ids
+    r = np.random.default_rng(seed)
+    lens = r.integers(1, F + 1, size=(B,))
+    ids64 = np.full((B, F), -1, np.int64)
+    for row, ln in enumerate(lens):
+        ids64[row, :ln] = r.integers(0, id_space, size=(ln,))
+    feed = (jnp.asarray(ids64) if jax.config.jax_enable_x64
+            else jnp.asarray(np_split_ids(ids64)))
+    return {"sparse": {"emb": feed}, "dense": None,
+            "label": jnp.asarray((lens % 2).astype(np.float32))}, lens
+
+
 def np_pool(table, ids, combiner):
     """Numpy oracle: true variable-length pooling over the valid prefix."""
     out = np.zeros((ids.shape[0], table.shape[1]), np.float32)
@@ -275,29 +291,18 @@ def test_combiner_hash_table_63bit_ids():
     lookup matches the numpy oracle on the valid prefix. The id layout follows
     the x64 config exactly like production feeds do: split pairs when x64 is
     off (`ops/id64.py`), plain int64 when on (pair tables don't exist there)."""
-    from openembedding_tpu.ops.id64 import np_split_ids
-
-    rng = np.random.default_rng(9)
     layer = embed.Embedding(-1, DIM, name="emb", capacity=256,
                             combiner="sum")
     model = embed.EmbeddingModel(PooledDense(), [layer])
     trainer = embed.Trainer(model, optimizer=embed.SGD(learning_rate=0.1))
-    ids64 = np.full((B, F), -1, np.int64)
-    lens = rng.integers(1, F + 1, size=(B,))
-    for r, ln in enumerate(lens):
-        ids64[r, :ln] = rng.integers(0, 1 << 62, size=(ln,))
-    feed = (jnp.asarray(ids64) if jax.config.jax_enable_x64
-            else jnp.asarray(np_split_ids(ids64)))
-    batch = {"sparse": {"emb": feed},
-             "dense": None,
-             "label": jnp.asarray((lens % 2).astype(np.float32))}
+    batch, lens = ragged_hash_batch(9)
     state = trainer.init(batch)
     step = trainer.jit_train_step()
     s1, m = step(state, batch)
     assert np.isfinite(float(m["loss"]))
     # pooled rows via the model == sum over the valid prefix of the raw pull
     raw = np.asarray(trainer.table_lookup(
-        model.specs["emb"], s1.tables["emb"], feed))
+        model.specs["emb"], s1.tables["emb"], batch["sparse"]["emb"]))
     got = np.asarray(trainer.jit_eval_step()(s1, batch)["logits"])
     dense = s1.dense_params["Dense_0"]
     want = (np.stack([raw[r, :lens[r]].sum(0) for r in range(B)])
@@ -468,3 +473,32 @@ def test_combiner_export_serving_roundtrip(tmp_path):
     got = np.asarray(served.predict(
         {"sparse": {k: np.asarray(v) for k, v in batch["sparse"].items()}}))
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_combiner_composes_with_host_offload():
+    """Multivalent pooling over a host-cached (>HBM) hash table: ragged
+    batches drive offload_train_many (union admission + fused scan), and the
+    eval pooling matches the valid-prefix numpy oracle — a cache path that
+    admitted or pooled pad slots would break the equality, not just
+    finiteness."""
+    layer = embed.Embedding(-1, DIM, name="emb", capacity=512,
+                            storage="host_cached", combiner="mean")
+    model = embed.EmbeddingModel(PooledDense(), [layer])
+    trainer = embed.Trainer(model, optimizer=embed.Adagrad(learning_rate=0.1))
+
+    pairs = [ragged_hash_batch(s, id_space=1 << 40) for s in (1, 2)]
+    batches, lens0 = [p[0] for p in pairs], pairs[0][1]
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *batches)
+    state = trainer.init(batches[0])
+    state, m = trainer.offload_train_many(state, stacked)
+    assert np.isfinite(np.asarray(m["loss"])).all()
+    assert trainer.offload["emb"].resident_count > 0
+    # pooled eval == mean over the valid prefix of the raw cached-table pull
+    feed = batches[0]["sparse"]["emb"]
+    raw = np.asarray(trainer.table_lookup(
+        model.specs["emb"], state.tables["emb"], feed))
+    got = np.asarray(trainer.jit_eval_step()(state, batches[0])["logits"])
+    dense = state.dense_params["Dense_0"]
+    pooled = np.stack([raw[r, :lens0[r]].mean(0) for r in range(B)])
+    want = pooled @ np.asarray(dense["kernel"]) + np.asarray(dense["bias"])
+    np.testing.assert_allclose(got, want[:, 0], rtol=1e-5, atol=1e-6)
