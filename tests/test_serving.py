@@ -433,3 +433,59 @@ def test_rest_ragged_rejected_for_fixed_field_models(trained, tmp_path,
                         {"sparse": {"categorical": [[1, 2], [3]]},
                          "dense": np.asarray(batch["dense"])[:2].tolist()})
     assert status == 400 and "categorical" in body["error"]
+
+
+def test_micro_batching_mixed_ragged_widths(tmp_path):
+    """Concurrent ragged predicts of DIFFERENT widths through the
+    MicroBatcher: the shape-keyed grouping isolates widths (a merged group
+    would np.concatenate mismatched trailing dims and 500) and every client
+    matches the unbatched oracle. All widths are DISTINCT on purpose: the
+    two-tower scores in-batch, so merging same-width requests legitimately
+    changes its (B, B) output — aggregation itself is pinned by
+    test_predict_micro_batching on a per-row model."""
+    import concurrent.futures
+
+    from openembedding_tpu.models import make_two_tower
+
+    model = make_two_tower(64, 64, dim=4, tower=(8,), combiner="mean",
+                           compute_dtype=jnp.float32)
+    trainer = Trainer(model, embed.Adagrad(learning_rate=0.05), seed=1)
+    warm = {"sparse": {"user": jnp.asarray([[1, 2], [3, -1]]),
+                       "item": jnp.asarray([[5, -1], [6, 7]])},
+            "dense": None, "label": None}
+    state = trainer.init(warm)
+    state, _ = trainer.jit_train_step()(state, warm)
+    path = str(tmp_path / "mw_export")
+    export_standalone(state, model, path, model_sign="mw-0")
+    srv = make_server(str(tmp_path / "mw_reg"), batch_window_ms=150.0)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        base = f"http://127.0.0.1:{srv.server_address[1]}"
+        status, _ = _req(f"{base}/models", "POST",
+                         {"model_sign": "mw-0", "model_uri": path})
+        assert status == 200
+
+        # widths 1, 2 and 3 (ragged -> server buckets 1/2/4), fired together
+        reqs = [
+            {"sparse": {"user": [[1], [2]], "item": [[5], [6]]}},
+            {"sparse": {"user": [[1, 2], [3]], "item": [[5], [6, 7]]}},
+            {"sparse": {"user": [[1, 2, 3], [9]], "item": [[5], [6, 7, 8]]}},
+        ]
+        def one(r):
+            status, out = _req(f"{base}/models/mw-0/predict", "POST", r)
+            assert status == 200, out
+            return np.asarray(out["logits"])
+
+        with concurrent.futures.ThreadPoolExecutor(len(reqs)) as ex:
+            outs = list(ex.map(one, reqs))
+        # oracle pads with the server's OWN policy so it can never drift
+        from openembedding_tpu.serving import _pad_ragged_bucketed
+        sm = srv.manager.find_model("mw-0")
+        for r, out in zip(reqs, outs):
+            want = np.asarray(sm.predict(
+                {"sparse": {k: _pad_ragged_bucketed(v)
+                            for k, v in r["sparse"].items()}}))
+            np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-5)
+    finally:
+        srv.shutdown()
