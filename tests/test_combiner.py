@@ -502,3 +502,59 @@ def test_combiner_composes_with_host_offload():
     pooled = np.stack([raw[r, :lens0[r]].mean(0) for r in range(B)])
     want = pooled @ np.asarray(dense["kernel"]) + np.asarray(dense["bias"])
     np.testing.assert_allclose(got, want[:, 0], rtol=1e-5, atol=1e-6)
+
+
+def test_randomized_combiner_parity_sweep():
+    """Randomized breadth for the pooling path: {combiner} x {array, hash} x
+    random (batch, width, vocab, lengths incl. all-pad rows) — every config's
+    eval must match the numpy varlen oracle computed from the raw pull. A
+    masking/validity bug anywhere in the lookup->combine->dense chain shows
+    up as a value mismatch, not a shape error."""
+    rng = np.random.default_rng(2024)
+    for trial in range(12):
+        combiner = ["sum", "mean", "sqrtn"][trial % 3]
+        hashed = bool(trial % 2)
+        batch_n = int(rng.integers(2, 12))
+        width = int(rng.integers(1, 7))
+        vocab = int(rng.integers(16, 200))
+        layer = (embed.Embedding(-1, DIM, name="emb", capacity=512,
+                                 combiner=combiner) if hashed
+                 else embed.Embedding(vocab, DIM, name="emb",
+                                      combiner=combiner))
+        model = embed.EmbeddingModel(PooledDense(), [layer])
+        trainer = embed.Trainer(model, optimizer=embed.SGD(learning_rate=0.1),
+                                seed=trial)
+        ids = np.full((batch_n, width), -1, np.int64)
+        lens = rng.integers(0, width + 1, size=(batch_n,))  # 0 = all-pad row
+        if (lens == 0).all():
+            lens[0] = 1  # at least one real id in the batch
+        for r, ln in enumerate(lens):
+            ids[r, :ln] = rng.integers(0, vocab, size=(ln,))
+        batch = {"sparse": {"emb": jnp.asarray(ids)}, "dense": None,
+                 "label": jnp.asarray((lens % 2).astype(np.float32))}
+        state = trainer.init(batch)
+        state, m = trainer.jit_train_step()(state, batch)
+        assert np.isfinite(float(m["loss"])), (trial, combiner, hashed)
+        raw = np.asarray(trainer.table_lookup(
+            model.specs["emb"], state.tables["emb"], jnp.asarray(ids)))
+        got = np.asarray(trainer.jit_eval_step()(state, batch)["logits"])
+        pooled = np_pool_rows(raw, lens, combiner)
+        dense = state.dense_params["Dense_0"]
+        want = pooled @ np.asarray(dense["kernel"]) + np.asarray(dense["bias"])
+        np.testing.assert_allclose(
+            got, want[:, 0], rtol=1e-5, atol=1e-6,
+            err_msg=f"trial {trial}: {combiner} hashed={hashed} "
+                    f"B={batch_n} W={width} V={vocab}")
+
+
+def np_pool_rows(raw, lens, combiner):
+    """Varlen-pool pre-pulled rows (B, W, d) over each row's valid prefix."""
+    out = np.zeros((raw.shape[0], raw.shape[-1]), np.float32)
+    for r, ln in enumerate(lens):
+        if ln == 0:
+            continue
+        rows = raw[r, :ln]
+        out[r] = (rows.sum(0) if combiner == "sum"
+                  else rows.mean(0) if combiner == "mean"
+                  else rows.sum(0) / np.sqrt(ln))
+    return out
