@@ -362,3 +362,45 @@ def test_export_rows_pair_layout_hash(tmp_path, server):
                                         probe.astype(np.int64)))
     got = np.asarray(restored.lookup("categorical", probe.astype(np.int64)))
     np.testing.assert_allclose(got, want, rtol=0, atol=0)
+
+
+def test_sharded_model_serves_combiner_checkpoint(tmp_path):
+    """ShardedModel.predict pools multivalent (combiner) features straight
+    from a sharded checkpoint: ragged-padded requests match the trainer's
+    eval, and a WIDER pad of the same request changes nothing (serve_rows'
+    host-ids mask)."""
+    from openembedding_tpu.models import make_two_tower
+
+    mesh = make_mesh()
+    model = make_two_tower(256, 128, dim=4, tower=(8,), combiner="mean",
+                           compute_dtype=jnp.float32)
+    trainer = MeshTrainer(model, embed.Adagrad(learning_rate=0.1), mesh=mesh,
+                          seed=2)
+    batch = {"sparse": {"user": jnp.asarray([[1, 2, -1], [3, -1, -1]] * 4),
+                        "item": jnp.asarray([[5, -1], [6, 7]] * 4)},
+             "dense": None, "label": None}
+    state = trainer.init(batch)
+    state, _ = trainer.jit_train_step(batch, state)(state, batch)
+    path = str(tmp_path / "comb_ck")
+    trainer.save(state, path)
+
+    sm = ShardedModel.load(path)
+    # oracle: the standalone export of the SAME state (the mesh trainer's own
+    # eval scores per-SHARD in-batch matrices — local negatives under DP —
+    # so serving, which sees the whole request, matches the standalone view)
+    from openembedding_tpu.export import StandaloneModel, export_standalone
+    spath = str(tmp_path / "comb_standalone")
+    export_standalone(state, model, spath, num_shards=trainer.num_shards)
+    req = {"sparse": {k: np.asarray(v) for k, v in batch["sparse"].items()}}
+    want = np.asarray(StandaloneModel.load(spath, model=model).predict(req))
+    got = np.asarray(sm.predict(req))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    # widening the pad (3 -> 5 columns of -1) must not move the logits
+    wider = {"sparse": {
+        "user": np.concatenate(
+            [np.asarray(batch["sparse"]["user"]),
+             np.full((8, 2), -1, np.int64)], axis=1),
+        "item": np.asarray(batch["sparse"]["item"])}}
+    got_w = np.asarray(sm.predict(wider))
+    np.testing.assert_allclose(got_w, got, rtol=1e-5, atol=1e-6)
