@@ -434,6 +434,43 @@ def orchestrate():
 
     emitted = [False]
 
+    def prior_green_capture():
+        """The most recent GREEN bench capture this round, parsed from
+        PERF_CHIP_R5.md (the battery commits raw case output there during
+        relay up-windows). Attached to a RED final emit so the artifact
+        carries the round's real chip evidence in-band — clearly labeled as
+        a PRIOR capture, never promoted to the current measurement."""
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "PERF_CHIP_R5.md")
+        try:
+            with open(path) as f:
+                lines = f.read().splitlines()
+        except OSError:
+            return None
+        best, stamp = None, None
+        for ln in lines:
+            if ln.startswith("## "):
+                stamp = ln[3:].strip()
+            elif ln.lstrip().startswith('{"metric"'):
+                try:
+                    d = json.loads(ln)
+                except ValueError:
+                    continue
+                if d.get("value") is None:
+                    continue
+                cand = {"metric": d["metric"], "value": d["value"],
+                        "unit": d.get("unit"),
+                        "vs_baseline": d.get("vs_baseline"),
+                        "captured": stamp, "source": "PERF_CHIP_R5.md"}
+                # the headline THROUGHPUT metric must never be displaced by a
+                # later green secondary (e.g. a pull-latency case)
+                throughput = d["metric"].endswith("examples_per_sec_per_chip")
+                if (best is None or throughput
+                        or not best["metric"].endswith(
+                            "examples_per_sec_per_chip")):
+                    best = cand
+        return best
+
     def emit_partial(reason, rc=1):
         if emitted[0]:
             return rc
@@ -443,6 +480,10 @@ def orchestrate():
         out.setdefault("stage", "boot")
         out.setdefault("error", reason)
         out["boot"] = boot_info()
+        if out.get("value") is None:
+            prior = prior_green_capture()
+            if prior is not None:
+                out.setdefault("extra", {})["prior_green_capture"] = prior
         print(json.dumps(out), flush=True)
         return rc
 
