@@ -48,7 +48,7 @@ throughput, not host dispatch latency — the same way production input pipeline
 drive TPUs (the axon tunnel adds ~40 ms per dispatch that would otherwise swamp
 the measurement; see PERF.md "Measurement hygiene").
 
-Env knobs: OETPU_BENCH_CASES=dim9[,dim64][,mesh1][,mesh1f][,pull] (default: all),
+Env knobs: OETPU_BENCH_CASES=dim9[,dim64][,mesh1][,mesh1f][,pull][,wire] (default: all),
 OETPU_BENCH_BUDGET_S (default 540), OETPU_BENCH_SCAN_STEPS / _REPEATS (smoke runs),
 OETPU_BENCH_TOTAL_BUDGET_S / _PROBE_TIMEOUT_S / _PROBE_INTERVAL_S (orchestrator).
 """
@@ -299,7 +299,41 @@ def case_mesh1(capacity_factor=0.0, name="mesh1"):
                         compile_s=700)
     return {"examples_per_sec_per_chip": round(eps, 1),
             "vs_baseline_dim9": round(eps / BASELINE_PER_CHIP, 3),
-            "capacity_factor": capacity_factor, **extra}
+            "capacity_factor": capacity_factor,
+            # at S=1 the exchange specializes away (0 collectives, 0 wire
+            # bytes) — recorded so multi-chip captures are comparable
+            "wire_cost": trainer.last_wire_cost, **extra}
+
+
+def case_wire():
+    """Wire-codec overhead on-device: jitted encode+decode round-trip of a
+    (26*4096, 64) f32 row payload for bf16 and int8 — the quantize compute
+    the fused exchange adds around its all_to_alls. The BYTE savings need
+    S >= 2 and are modeled + measured on the CPU mesh in
+    tools/wire_microbench.py; this case bounds the on-chip compute cost."""
+    import jax
+    from openembedding_tpu.ops import wire as wire_mod
+
+    WD.stage("wire:init", 120)
+    rng = np.random.default_rng(0)
+    rows = jax.device_put(
+        rng.standard_normal((26 * 4096, 64)).astype(np.float32))
+    out = {}
+    for fmt in ("bf16", "int8"):
+        fn = jax.jit(lambda x, fmt=fmt: wire_mod.decode_rows(
+            wire_mod.encode_rows(x, fmt), x.shape[1], fmt))
+        WD.stage(f"wire:{fmt}", 180)
+        jax.block_until_ready(fn(rows))
+        times = []
+        for _ in range(max(REPEATS, 5)):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(rows))
+            times.append(time.perf_counter() - t0)
+        best = min(times)
+        out[f"{fmt}_roundtrip_ms"] = round(best * 1e3, 3)
+        # bytes touched: read f32 + write f32 (the wire array in between)
+        out[f"{fmt}_gbps"] = round(rows.size * 4 * 2 / best / 1e9, 1)
+    return out
 
 
 def case_pull():
@@ -359,7 +393,7 @@ def main():
     EXTRA["platform"] = devs[0].platform
 
     cases = os.environ.get("OETPU_BENCH_CASES",
-                           "dim9,dim64,mesh1,mesh1f,pull").split(",")
+                           "dim9,dim64,mesh1,mesh1f,pull,wire").split(",")
 
     # PRIMARY first: whatever happens later, this number is in the artifact.
     if "dim9" in cases:
@@ -372,7 +406,8 @@ def main():
                  ("mesh1", case_mesh1),
                  ("mesh1f", lambda: case_mesh1(capacity_factor=1.0,
                                                name="mesh1f")),
-                 ("pull", case_pull)]
+                 ("pull", case_pull),
+                 ("wire", case_wire)]
     for name, fn in secondary:
         if name not in cases:
             continue
@@ -400,6 +435,11 @@ def main():
                 RESULT["metric"] = "embedding_pull_p50_us"
                 RESULT["value"] = out["pull_p50_us"]
                 RESULT["unit"] = "us"
+                break
+            if "bf16_roundtrip_ms" in out:
+                RESULT["metric"] = "wire_bf16_roundtrip_ms"
+                RESULT["value"] = out["bf16_roundtrip_ms"]
+                RESULT["unit"] = "ms"
                 break
 
     WD.clear()
@@ -457,6 +497,14 @@ def orchestrate():
                 except ValueError:
                     continue
                 if d.get("value") is None:
+                    continue
+                # a red child can still carry a value (an earlier case
+                # measured green before a later one died): such a line must
+                # never be labeled a prior GREEN capture. Green = no error
+                # markers in the JSON itself AND an rc=0 stanza header.
+                if d.get("errors") or d.get("error") or d.get("stage"):
+                    continue
+                if stamp and "rc=" in stamp and "rc=0" not in stamp:
                     continue
                 cand = {"metric": d["metric"], "value": d["value"],
                         "unit": d.get("unit"),

@@ -1,0 +1,53 @@
+"""Compatibility shims for the range of JAX versions the repo runs against.
+
+The codebase is written against the promoted public APIs (`jax.shard_map`
+with `check_vma=`, `jax.enable_x64` as a context manager). Older runtimes
+(e.g. 0.4.x) still carry them under `jax.experimental` with the pre-rename
+keyword (`check_rep`). Installing the aliases once at package import keeps
+every call site on the modern spelling with zero per-call overhead on new
+runtimes, instead of sprinkling try/except at each of the ~30 call sites.
+
+Nothing here changes behavior on a JAX that already has the public names.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def install() -> None:
+    if not hasattr(jax, "shard_map"):
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+                      check_vma=None, check_rep=None, **kw):
+            # the new API renamed check_rep -> check_vma; fold either
+            # spelling onto the old keyword
+            rep = check_vma if check_vma is not None else check_rep
+            if rep is not None:
+                kw["check_rep"] = bool(rep)
+            return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, **kw)
+
+        shard_map.__doc__ = _shard_map.__doc__
+        jax.shard_map = shard_map
+
+    if not hasattr(jax, "enable_x64"):
+        from jax.experimental import enable_x64 as _enable_x64
+        jax.enable_x64 = _enable_x64
+
+    if not hasattr(jax.distributed, "is_initialized"):
+        def is_initialized():
+            from jax._src import distributed as _dist
+            return getattr(_dist.global_state, "client", None) is not None
+        jax.distributed.is_initialized = is_initialized
+
+    if not hasattr(jax.lax, "axis_size"):
+        def axis_size(axis_name):
+            # psum of a unit CONSTANT is special-cased to the static axis
+            # size (a Python int), incl. tuple axis names (product)
+            return jax.lax.psum(1, axis_name)
+        jax.lax.axis_size = axis_size
+
+
+install()

@@ -342,6 +342,8 @@ class StandaloneModel:
                     specs[name], ids, lambda i, n=name: self.lookup(n, i))
             else:
                 embedded[name] = self.lookup(name, ids)
+        from .model import attach_ids
+        attach_ids(embedded, self.model, padded)
         out = self._predict_fn(self.dense_params, embedded,
                                padded.get("dense"))
         return out[:n]

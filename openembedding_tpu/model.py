@@ -74,6 +74,31 @@ def dense_apply(optimizer: SparseOptimizer, params, slots, grads) -> Tuple[Any, 
             jax.tree_util.tree_unflatten(treedef, new_slots))
 
 
+# Reserved key inside the `embedded` dict handed to modules that declare
+# `takes_ids = True`: maps variable name -> that variable's RAW id batch.
+# Lets such modules derive id-level masks (e.g. SASRec's key-padding mask
+# from `ids >= 0` / `pair_valid`) instead of heuristics over pulled rows (an
+# all-zero embedding row is NOT proof of padding). Opt-in, because the
+# documented module contract is "embedded maps variable name -> pulled rows"
+# and modules may iterate the dict.
+IDS_KEY = "__ids__"
+
+
+def raw_ids(model: "EmbeddingModel", batch) -> Dict[str, jax.Array]:
+    """The {var_name: raw id batch} map published under `embedded[IDS_KEY]`
+    (train/eval/init/serving) when the dense module sets `takes_ids`."""
+    return {name: jnp.asarray(batch["sparse"][spec.feature_name])
+            for name, spec in model.specs.items()}
+
+
+def attach_ids(embedded: Dict[str, Any], model: "EmbeddingModel",
+               batch) -> Dict[str, Any]:
+    """Add `embedded[IDS_KEY]` iff the module opted in via `takes_ids`."""
+    if getattr(model.module, "takes_ids", False):
+        embedded[IDS_KEY] = raw_ids(model, batch)
+    return embedded
+
+
 def sad_rows(table: jax.Array, ids: jax.Array) -> jax.Array:
     """Dense-mirrored ('Cache' mode) table gather through `lookup_rows` — the
     ONE implementation of the invalid-id contract (-1 pads and out-of-range
@@ -144,6 +169,19 @@ class EmbeddingModel:
 
     def ps_specs(self) -> Dict[str, EmbeddingSpec]:
         return {n: s for n, s in self.specs.items() if not s.sparse_as_dense}
+
+    def dim_groups(self) -> List[List[str]]:
+        """PS-table names grouped by embedding dim (declaration order): the
+        unit of the fused multi-table exchange. A dim-group's tables share one
+        set of 3 all_to_alls per train step (`parallel/sharded.grouped_*`), so
+        a T-table model with G groups launches 3*G collectives, not 3*T.
+        Static per model — built once and cached."""
+        if getattr(self, "_dim_groups", None) is None:
+            groups: Dict[int, List[str]] = {}
+            for name, spec in self.ps_specs().items():
+                groups.setdefault(spec.output_dim, []).append(name)
+            self._dim_groups = list(groups.values())
+        return self._dim_groups
 
 
 class Trainer:
@@ -404,6 +442,7 @@ class Trainer:
             if spec.combiner:  # pooling collapses the trailing field axis
                 shape = shape[:-1]
             out[name] = jnp.zeros(shape + (spec.output_dim,), spec.dtype)
+        attach_ids(out, self.model, batch)
         return out
 
     # -- the per-device step (pure; shard_map-able) -------------------------
@@ -441,20 +480,10 @@ class Trainer:
         # PULL: gather rows for this batch (non-differentiated w.r.t. the table — the
         # rows themselves are the leaf, exactly the reference's pull/push contract).
         # Hash tables insert unseen ids here, so pull threads the table state.
-        pulled = {}
-        pulled_tables = {}
-        pull_plans = {}
-        stats = {}
-        for name, spec in ps_specs.items():
-            ids = jnp.asarray(batch["sparse"][spec.feature_name])
-            if name in packed:
-                pulled_tables[name], pulled[name], pull_stats, pull_plans[name] = \
-                    self._packed_pull(spec, state.tables[name], ids)
-            else:
-                pulled_tables[name], pulled[name], pull_stats, pull_plans[name] = \
-                    self.table_pull(spec, state.tables[name], ids)
-            for k, v in pull_stats.items():
-                stats[f"{name}/{k}"] = v
+        # MeshTrainer overrides tables_pull/tables_apply with the fused
+        # multi-table exchange (3 all_to_alls per dim-group, not per table).
+        pulled_tables, pulled, stats, pull_plans = self.tables_pull(
+            state.tables, batch, ps_specs, packed)
 
         def loss_fn(tr_params, pulled_rows):
             dense_params = (model.module.merge_params(tr_params, fr0)
@@ -472,6 +501,7 @@ class Trainer:
                 table = dense_params["__embeddings__"][name]
                 ids = jnp.asarray(batch["sparse"][spec.feature_name])
                 embedded[name] = combine(spec, ids, sad_rows(table, ids))
+            attach_ids(embedded, model, batch)
             if train_apply is not None:
                 logits, fr_new = train_apply({"params": dense_params},
                                              embedded, batch.get("dense"))
@@ -497,18 +527,10 @@ class Trainer:
 
         # SPARSE push+update (reference: PushGradients + UpdateWeights store op)
         new_tables = dict(state.tables)
-        for name, spec in ps_specs.items():
-            ids = jnp.asarray(batch["sparse"][spec.feature_name])
-            if name in packed:
-                new_tables[name], push_stats = self._packed_apply(
-                    spec, pulled_tables[name], ids, row_grads[name],
-                    packed[name], pull_plans[name])
-            else:
-                new_tables[name], push_stats = self.table_apply(
-                    spec, pulled_tables[name], ids,
-                    row_grads[name], pull_plans[name])
-            for k, v in push_stats.items():
-                stats[f"{name}/{k}"] = v
+        applied, push_stats = self.tables_apply(
+            ps_specs, pulled_tables, batch, row_grads, packed, pull_plans)
+        new_tables.update(applied)
+        stats.update(push_stats)
 
         new_state = TrainState(
             step=state.step + 1,
@@ -522,6 +544,40 @@ class Trainer:
         return new_state, metrics
 
     # hooks overridden by MeshTrainer:
+    def tables_pull(self, tables, batch, ps_specs, packed):
+        """Pull every PS table's rows for this batch. Default: one pull per
+        table. MeshTrainer overrides with the fused dim-group exchange.
+        -> ({name: new_table}, {name: rows}, {stat: v}, {name: plan})."""
+        pulled_tables, pulled, stats, plans = {}, {}, {}, {}
+        for name, spec in ps_specs.items():
+            ids = jnp.asarray(batch["sparse"][spec.feature_name])
+            pull = self._packed_pull if name in packed else self.table_pull
+            pulled_tables[name], pulled[name], pull_stats, plans[name] = \
+                pull(spec, tables[name], ids)
+            for k, v in pull_stats.items():
+                stats[f"{name}/{k}"] = v
+        return pulled_tables, pulled, stats, plans
+
+    def tables_apply(self, ps_specs, pulled_tables, batch, row_grads, packed,
+                     plans):
+        """Push + fused update for every PS table. Default: one push per
+        table. MeshTrainer overrides with the fused dim-group exchange.
+        -> ({name: new_table}, {stat: v})."""
+        new_tables, stats = {}, {}
+        for name, spec in ps_specs.items():
+            ids = jnp.asarray(batch["sparse"][spec.feature_name])
+            if name in packed:
+                new_tables[name], push_stats = self._packed_apply(
+                    spec, pulled_tables[name], ids, row_grads[name],
+                    packed[name], plans[name])
+            else:
+                new_tables[name], push_stats = self.table_apply(
+                    spec, pulled_tables[name], ids, row_grads[name],
+                    plans[name])
+            for k, v in push_stats.items():
+                stats[f"{name}/{k}"] = v
+        return new_tables, stats
+
     def reduce_dense_grads(self, grads):
         return grads
 
@@ -564,6 +620,7 @@ class Trainer:
             table = state.dense_params["__embeddings__"][name]
             ids = jnp.asarray(batch["sparse"][spec.feature_name])
             embedded[name] = combine(spec, ids, sad_rows(table, ids))
+        attach_ids(embedded, model, batch)
         logits = model.module.apply({"params": state.dense_params}, embedded,
                                     batch.get("dense"))
         return {"logits": logits, "loss": self._loss(logits, batch)}

@@ -72,6 +72,28 @@ class SASRec(nn.Module):
     compute_dtype: jnp.dtype = jnp.bfloat16
     causal: bool = True
 
+    # opt into the raw-id side channel (`model.IDS_KEY`): the key-padding
+    # mask derives from the id VALUES, not from pulled rows
+    takes_ids = True
+
+    def _kv_valid(self, embedded, hist):
+        """(B, S_local) key-padding mask. Primary source: the raw id batch
+        (`embedded[IDS_KEY]`, pad = -1 / the pair EMPTY sentinel) — exact by
+        construction. Fallback (callers that bypass the Trainer/serving
+        paths and don't attach ids): the historical zero-row heuristic,
+        which silently DROPS a real position whose embedding row happens to
+        be all-zero — that hazard is why the id-derived mask is primary."""
+        from ..model import IDS_KEY
+        ids = embedded.get(IDS_KEY, {}).get(ITEM)
+        if ids is None:
+            return jnp.any(hist != 0, axis=-1)
+        hist_ids = ids[:, 0]                       # (B, S[, 2])
+        if hist_ids.dtype == jnp.uint32 and hist_ids.ndim == 3 \
+                and hist_ids.shape[-1] == 2:       # split-pair 63-bit layout
+            from ..ops.id64 import pair_valid
+            return pair_valid(hist_ids)
+        return hist_ids >= 0
+
     def _attend(self, q, k, v, kv_valid):
         from ..parallel.sequence import (reference_attention, ring_attention,
                                          ulysses_attention)
@@ -101,14 +123,14 @@ class SASRec(nn.Module):
         trio = embedded[ITEM]                       # (B, 3, S_local, d)
         hist, e_pos, e_neg = trio[:, 0], trio[:, 1], trio[:, 2]
         B, S, d = hist.shape
-        # key-padding mask from the zero-row property of pad ids (-1 pulls an
-        # exact zero row; real rows are never all-zero under continuous init/
-        # training). BIDIRECTIONAL (BERT4Rec) attention REQUIRES it — unmasked
-        # pad keys make logits depend on the pad width. It is also applied in
-        # causal mode (a provable no-op for the trailing-pad convention, but
-        # it makes INTERIOR pads safe too); cost: one (B,S) bool where, plus
-        # one extra ppermute per ring step — noise next to the block matmuls.
-        kv_valid = jnp.any(hist != 0, axis=-1)      # (B, S_local)
+        # key-padding mask from the id VALUES (`_kv_valid`: pad ids are -1 /
+        # the pair EMPTY sentinel). BIDIRECTIONAL (BERT4Rec) attention
+        # REQUIRES it — unmasked pad keys make logits depend on the pad
+        # width. It is also applied in causal mode (a provable no-op for the
+        # trailing-pad convention, but it makes INTERIOR pads safe too);
+        # cost: one (B,S) bool where, plus one extra ppermute per ring step —
+        # noise next to the block matmuls.
+        kv_valid = self._kv_valid(embedded, hist)   # (B, S_local)
         if d != self.dim:
             raise ValueError(f"embedding dim {d} != module dim {self.dim}")
         H = self.num_heads

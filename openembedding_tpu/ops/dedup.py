@@ -214,6 +214,69 @@ def unique_and_route(ids: jax.Array, valid: jax.Array, num_shards: int,
     return uniq, buckets
 
 
+# ---------------------------------------------------------------------------
+# Grouped routing plan: fuse per-table bucket arrays into ONE wire array so a
+# dim-group of T tables ships 1 all_to_all of ids instead of T. Per-table
+# dedup/routing (unique_and_route) is unchanged — each table keeps its own
+# capacity segment at a fixed slot offset, so the table index is POSITION-
+# encoded (no tag lanes on the wire) and the receiver recovers each table's
+# buckets by slicing. Mixed id layouts widen to a common wire layout via the
+# split-pair machinery (`ops/id64.py`); a uniform group pays zero extra bytes.
+# ---------------------------------------------------------------------------
+
+
+def concat_owner_buckets(bucket_ids_list) -> jax.Array:
+    """[(S, cap_t[, 2]) sentinel-filled bucket arrays] -> one (S, sum_cap[, 2])
+    wire array in the narrowest common layout:
+
+    - all split-pair           -> pair (uint32 lanes) unchanged;
+    - any pair + single-lane   -> everything widens to pair (`split_ids`);
+    - all single-lane          -> widest int dtype (int64 wins over int32).
+
+    Sentinels survive every conversion (-1 <-> PAIR_EMPTY), so
+    `bucket_validity` still works on the fused array and on its slices."""
+    from .id64 import split_ids
+    if any(b.ndim == 3 for b in bucket_ids_list):
+        wire = [b if b.ndim == 3 else split_ids(b) for b in bucket_ids_list]
+    else:
+        dt = max((b.dtype for b in bucket_ids_list),
+                 key=lambda d: jnp.dtype(d).itemsize)
+        wire = [b.astype(dt) for b in bucket_ids_list]
+    return jnp.concatenate(wire, axis=1)
+
+
+def split_owner_buckets(wire_ids: jax.Array, templates) -> list:
+    """Receiver-side inverse of `concat_owner_buckets` (applied AFTER the
+    all_to_all): slice each table's capacity segment and narrow it back to the
+    table's native id layout. `templates`: [(cap, pair: bool, dtype)] in
+    concatenation order. Valid single-lane ids fit their native dtype by
+    construction (array-table ids < input_dim < 2^31; int64 keys only exist
+    when the wire is int64 too), and sentinels map back to -1."""
+    from .id64 import pair_valid
+    outs, off = [], 0
+    for cap, pair, dtype in templates:
+        seg = wire_ids[:, off:off + cap]
+        off += cap
+        if wire_ids.ndim == 3:  # pair wire
+            if pair:
+                outs.append(seg)
+            else:
+                valid = pair_valid(seg)
+                if jnp.dtype(dtype).itemsize >= 8:  # x64-on int64 keys
+                    joined = ((seg[..., 0].astype(jnp.int64) << 32)
+                              | seg[..., 1].astype(jnp.int64))
+                    outs.append(jnp.where(valid, joined, jnp.int64(-1)))
+                else:
+                    outs.append(jnp.where(valid, seg[..., 1].astype(dtype),
+                                          jnp.asarray(-1, dtype)))
+        else:
+            outs.append(seg.astype(dtype))
+    if off != wire_ids.shape[1]:
+        raise ValueError(f"templates cover {off} slots, wire has "
+                         f"{wire_ids.shape[1]}")
+    return outs
+
+
 def bucket_validity(bucket_ids: jax.Array) -> jax.Array:
     """Occupancy mask of a sentinel-initialized bucket array (see
     `unique_and_route` — NOT `bucket_by_owner`, whose empty slots are

@@ -1,0 +1,211 @@
+"""Quantized wire payloads for the sharded exchange (`parallel/sharded.py`).
+
+The ICI wire protocol moves three payload classes per train step: id buckets
+out, pulled rows back, pushed grads+counts out. Table STORAGE and the fused
+optimizer apply stay fp32 (master weights) — only the bytes on the wire are
+reduced, dequantized at the receiving edge. SparCML (arxiv 1802.08021) and
+EQuARX (arxiv 2506.17615) both show sparse/quantized collectives recovering
+2-4x wire bandwidth in exactly this regime.
+
+Formats (`OETPU_WIRE`, default bf16; trainers can override explicitly):
+
+- ``fp32``: payloads travel in their native float dtype (bit-exact; the
+  pre-round-6 protocol). The test suite pins this via `tests/conftest.py` so
+  mesh-vs-single-device parity stays exact; wire-specific tests opt in to the
+  lossy formats explicitly.
+- ``bf16``: rows and grads truncate to bfloat16 on the wire (2x fewer payload
+  bytes vs fp32; ~3 decimal digits, plenty for embedding pulls and grads).
+- ``int8``: rows and grads quantize to int8 with ONE fp32 scale per row
+  (max-abs / 127), the scale riding as 4 bitcast int8 lanes beside the
+  payload (~4x fewer payload bytes; opt-in).
+
+Duplicate COUNTS (the push's second payload) must survive the wire EXACTLY —
+they divide/weight optimizer updates — so they always ride as raw int32 bits
+BITCAST into wire lanes (1 fp32 lane, 2 bf16 lanes, or 4 int8 lanes), never
+quantized. Empty bucket slots are zero-filled: zero bits decode to grad 0,
+scale 0, count 0 in every format, so no validity mask rides the wire.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+WIRE_ENV = "OETPU_WIRE"
+DEFAULT_WIRE = "bf16"
+FORMATS = ("fp32", "bf16", "int8")
+_ALIASES = {"float32": "fp32", "f32": "fp32", "bfloat16": "bf16",
+            "i8": "int8"}
+
+# int8 payloads carry one fp32 per-row scale as 4 bitcast int8 lanes
+_SCALE_LANES = 4
+
+
+def wire_format(override: Optional[str] = None) -> str:
+    """Resolve the wire format: explicit override > $OETPU_WIRE > bf16."""
+    fmt = override or os.environ.get(WIRE_ENV, "") or DEFAULT_WIRE
+    fmt = _ALIASES.get(fmt.lower(), fmt.lower())
+    if fmt not in FORMATS:
+        raise ValueError(
+            f"unknown wire format {fmt!r} (expected one of {FORMATS}; "
+            f"set {WIRE_ENV} or the trainer's wire= argument)")
+    return fmt
+
+
+def wire_dtype(fmt: str):
+    """The array dtype payloads travel in (fp32 keeps the native float)."""
+    return {"fp32": jnp.float32, "bf16": jnp.bfloat16,
+            "int8": jnp.int8}[fmt]
+
+
+def count_lanes(fmt: str) -> int:
+    """Lanes one bitcast int32 count occupies in the wire dtype."""
+    return 4 // jnp.dtype(wire_dtype(fmt)).itemsize
+
+
+# ---------------------------------------------------------------------------
+# Exact int32 <-> wire-lane bitcasts (duplicate counts).
+# ---------------------------------------------------------------------------
+
+
+def counts_to_lanes(counts: jax.Array, fmt: str) -> jax.Array:
+    """(n,) int32 -> (n, count_lanes(fmt)) in the wire dtype, bit-exact."""
+    lanes = jax.lax.bitcast_convert_type(counts.astype(jnp.int32),
+                                         wire_dtype(fmt))
+    return lanes.reshape(counts.shape[0], -1)
+
+
+def lanes_to_counts(lanes: jax.Array) -> jax.Array:
+    """Inverse of counts_to_lanes: (n, L) wire lanes -> (n,) int32."""
+    if lanes.shape[1] == 1:
+        return jax.lax.bitcast_convert_type(lanes[:, 0], jnp.int32)
+    return jax.lax.bitcast_convert_type(lanes, jnp.int32).reshape(-1)
+
+
+# ---------------------------------------------------------------------------
+# Row payloads (the pull's second all_to_all).
+# ---------------------------------------------------------------------------
+
+
+def rows_wire_width(dim: int, fmt: str) -> int:
+    """Wire columns for a (n, dim) float row payload."""
+    return dim + _SCALE_LANES if fmt == "int8" else dim
+
+
+def _quantize_int8(x32: jax.Array) -> jax.Array:
+    """(n, d) f32 -> (n, d + 4) int8: symmetric per-row max-abs scaling with
+    the fp32 scale bitcast into the trailing 4 lanes. All-zero rows get scale
+    0 and decode to exact zeros."""
+    amax = jnp.max(jnp.abs(x32), axis=1)
+    scale = amax / 127.0
+    inv = jnp.where(scale > 0, 1.0 / scale, 0.0)
+    q = jnp.clip(jnp.round(x32 * inv[:, None]), -127, 127).astype(jnp.int8)
+    scale_lanes = jax.lax.bitcast_convert_type(
+        scale.astype(jnp.float32), jnp.int8).reshape(-1, _SCALE_LANES)
+    return jnp.concatenate([q, scale_lanes], axis=1)
+
+
+def _dequantize_int8(wire: jax.Array, dim: int) -> jax.Array:
+    """(n, dim + 4) int8 -> (n, dim) f32."""
+    scale = jax.lax.bitcast_convert_type(
+        wire[:, dim:dim + _SCALE_LANES], jnp.float32).reshape(-1)
+    return wire[:, :dim].astype(jnp.float32) * scale[:, None]
+
+
+def encode_rows(rows: jax.Array, fmt: str) -> jax.Array:
+    """(n, d) float rows -> wire payload (n, rows_wire_width(d, fmt))."""
+    if fmt == "fp32":
+        return rows
+    if fmt == "bf16":
+        return rows.astype(jnp.bfloat16)
+    return _quantize_int8(rows.astype(jnp.float32))
+
+
+def decode_rows(wire: jax.Array, dim: int, fmt: str) -> jax.Array:
+    """Inverse of encode_rows -> (n, d) float32 (callers cast to their
+    compute/table dtype — exact for bf16-kept tables)."""
+    if fmt == "int8":
+        return _dequantize_int8(wire, dim)
+    return wire.astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# Grad+count payloads (the push's single all_to_all).
+# ---------------------------------------------------------------------------
+
+
+def grads_wire_width(dim: int, fmt: str) -> int:
+    """Wire columns for a (n, dim) grad payload + its exact count lanes."""
+    return rows_wire_width(dim, fmt) + count_lanes(fmt)
+
+
+def encode_grads(grads: jax.Array, counts: jax.Array, fmt: str) -> jax.Array:
+    """(n, d) float grads + (n,) int32 counts -> (n, grads_wire_width) wire
+    rows. Counts ride bit-exact; grads quantize like rows."""
+    if fmt == "fp32":
+        g = grads.astype(jnp.float32)
+    elif fmt == "bf16":
+        g = grads.astype(jnp.bfloat16)
+    else:
+        g = _quantize_int8(grads.astype(jnp.float32))
+    return jnp.concatenate([g, counts_to_lanes(counts, fmt)], axis=1)
+
+
+def decode_grads(wire: jax.Array, dim: int, fmt: str):
+    """-> ((n, d) float32 grads, (n,) int32 counts)."""
+    body = rows_wire_width(dim, fmt)
+    return decode_rows(wire[:, :body], dim, fmt), lanes_to_counts(
+        wire[:, body:])
+
+
+# ---------------------------------------------------------------------------
+# Static wire-cost model (bytes/step, collectives/step) — what the metrics
+# gauges, PERF.md and tools/wire_microbench.py report.
+# ---------------------------------------------------------------------------
+
+
+def id_wire_itemsize(pair: bool, itemsize: int) -> int:
+    """Bytes per bucket slot in the fused id exchange: pair layout = 8
+    (2 uint32 lanes), single-lane = the native int itemsize."""
+    return 8 if pair else itemsize
+
+
+def exchange_cost(tables, num_shards: int, fmt: str,
+                  fused: bool = True) -> dict:
+    """Static per-device wire cost of one train step.
+
+    `tables`: list of dicts {dim, cap, pair (bool), id_itemsize} — one per
+    PS table, `cap` the per-(src,dst) bucket capacity of ITS batch. Tables
+    sharing `dim` form one dim-group; `fused=False` prices the pre-round-6
+    per-table protocol for comparison. Bytes are what ONE device ships
+    through the three all_to_alls (recv volume is symmetric).
+    """
+    S = num_shards
+    groups = {}
+    for t in tables:
+        groups.setdefault(t["dim"], []).append(t)
+    n_units = len(groups) if fused else len(tables)
+    bytes_ids = bytes_rows = bytes_grads = 0
+    for dim, members in groups.items():
+        # fused groups widen mixed-layout ids to the common wire layout;
+        # a uniform group keeps its native layout (see dedup.concat_owner_buckets)
+        pair_wire = any(m["pair"] for m in members)
+        iid = max(m["id_itemsize"] for m in members)
+        for m in members:
+            cap = m["cap"]
+            per_id = (id_wire_itemsize(pair_wire, iid) if fused
+                      else id_wire_itemsize(m["pair"], m["id_itemsize"]))
+            bytes_ids += S * cap * per_id
+            w = jnp.dtype(wire_dtype(fmt)).itemsize
+            bytes_rows += S * cap * rows_wire_width(dim, fmt) * w
+            bytes_grads += S * cap * grads_wire_width(dim, fmt) * w
+    total = bytes_ids + bytes_rows + bytes_grads
+    return {"format": fmt, "num_shards": S, "fused": fused,
+            "dim_groups": len(groups), "tables": len(tables),
+            "collectives_per_step": 3 * n_units if S > 1 else 0,
+            "bytes_ids": int(bytes_ids), "bytes_rows": int(bytes_rows),
+            "bytes_grads": int(bytes_grads),
+            "bytes_per_step": int(total) if S > 1 else 0}

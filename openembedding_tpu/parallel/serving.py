@@ -346,6 +346,8 @@ class ShardedModel:
             embedded[name] = serve_rows(
                 spec, padded["sparse"][spec.feature_name],
                 lambda i, n=name: self.lookup(n, i))
+        from ..model import attach_ids
+        attach_ids(embedded, self.model, padded)
         if self._predict_fn is None:
             module = self.model.module
 

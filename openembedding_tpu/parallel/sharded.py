@@ -24,9 +24,17 @@ SPMD needs no batch-version gate):
   4. owner re-dedups across sources (the MPSC reducer, `MpscGradientReducer.h`) and
      applies the fused optimizer once per unique row
 
-Collective budget: exactly 3 all_to_alls per table per train step (ids, rows,
-grads+counts), pinned at the HLO level in `tests/test_dedup.py`. `S == 1`
-specializes to identity routing (no collectives, no bucket scatters).
+Collective budget: exactly 3 all_to_alls per DIM-GROUP per train step (ids, rows,
+grads+counts), pinned at the HLO level in `tests/test_dedup.py` /
+`tests/test_wire.py`. Tables sharing an embedding dim fuse their exchanges
+(`grouped_lookup_train` / `grouped_apply_gradients`): each table's bucket array
+occupies a fixed capacity segment of one concatenated wire array (the table
+index is position-encoded — see `ops/dedup.concat_owner_buckets`), so a
+T-table model with G dim-groups launches 3*G collectives instead of 3*T.
+Row/grad payloads optionally travel quantized (bf16 default / int8 opt-in,
+`ops/wire.py`, `OETPU_WIRE`); id buckets and duplicate-count lanes are always
+exact. `S == 1` specializes to identity routing (no collectives, no bucket
+scatters, no wire quantization).
 
 Static capacity: each (src, dst) bucket holds `capacity` ids. `capacity == n` is exact
 but moves S*n ids; real workloads set a capacity_factor so capacity ~ factor * n / S
@@ -151,15 +159,52 @@ def make_plan(spec: EmbeddingSpec, ids: jax.Array, *, axis: str = DATA_AXIS,
             slot=jnp.arange(n, dtype=jnp.int32),
             overflow=jnp.zeros((), jnp.int32))
         return ExchangePlan(uniq, buckets, recv_ids, recv_valid, n)
-    valid = _id_valid(spec, flat)
-    cap = _bucket_capacity(n, S, capacity_factor)
-    uniq, buckets = unique_and_route(flat, valid, S, cap)
+    uniq, buckets, cap = _client_route(spec, flat, S, capacity_factor)
     # [BOUNDARY: was one RPC per owning server; now ONE ICI all_to_all —
     # empty bucket slots carry the EMPTY sentinel, so the receive side
     # derives validity from the ids and no bool mask rides the wire]
     recv_ids = jax.lax.all_to_all(buckets.bucket_ids, axis, 0, 0)
     recv_valid = bucket_validity(recv_ids)
     return ExchangePlan(uniq, buckets, recv_ids, recv_valid, cap)
+
+
+def _client_route(spec: EmbeddingSpec, flat: jax.Array, S: int,
+                  capacity_factor: float):
+    """Per-table client-side dedup + owner routing: the plan minus its id
+    exchange (shared by `make_plan` and the grouped fused exchange)."""
+    n = flat.shape[0]
+    valid = _id_valid(spec, flat)
+    cap = _bucket_capacity(n, S, capacity_factor)
+    uniq, buckets = unique_and_route(flat, valid, S, cap)
+    return uniq, buckets, cap
+
+
+def grouped_make_plans(specs, ids_list, *, axis: str = DATA_AXIS,
+                       capacity_factor: float = 0.0):
+    """Routing plans for a DIM-GROUP of tables with ONE fused id all_to_all.
+
+    Per-table dedup/bucketing is identical to `make_plan`; only the wire is
+    shared — each table's (S, cap_t) bucket array rides as a fixed capacity
+    segment of one concatenated array (`ops/dedup.concat_owner_buckets`), so
+    the receive side recovers per-table buckets by slicing. `ids_list` must
+    already be in each table's key layout (`adapt_batch_ids`)."""
+    S = jax.lax.axis_size(axis)
+    if S == 1:
+        return [make_plan(spec, ids, axis=axis,
+                          capacity_factor=capacity_factor)
+                for spec, ids in zip(specs, ids_list)]
+    from ..ops.dedup import concat_owner_buckets, split_owner_buckets
+    parts = []
+    for spec, ids in zip(specs, ids_list):
+        flat = flatten_ids(spec, ids)
+        parts.append(_client_route(spec, flat, S, capacity_factor))
+    wire_ids = concat_owner_buckets([b.bucket_ids for _, b, _ in parts])
+    recv = jax.lax.all_to_all(wire_ids, axis, 0, 0)
+    templates = [(cap, b.bucket_ids.ndim == 3, b.bucket_ids.dtype)
+                 for _, b, cap in parts]
+    segs = split_owner_buckets(recv, templates)
+    return [ExchangePlan(uniq, buckets, seg, bucket_validity(seg), cap)
+            for (uniq, buckets, cap), seg in zip(parts, segs)]
 
 
 def _serve_rows(spec: EmbeddingSpec, state: EmbeddingTableState,
@@ -283,7 +328,6 @@ def sharded_apply_gradients(
     # sorted-segment path (see UniqueResult.segment_reduce)
     g = uniq.segment_reduce(gflat)
     valid = (uniq.counts > 0) & _id_valid(spec, uniq.unique_ids)
-    pair = plan.recv_ids.ndim == 3
     if S == 1:
         # identity routing (see make_plan): the local unique slots ARE the
         # server's receive buffer — no bucket scatter, no grad/count a2a
@@ -302,22 +346,43 @@ def sharded_apply_gradients(
         lanes = count_lanes.shape[1]
         payload = jnp.concatenate([g, count_lanes], axis=1)
         width = spec.output_dim + lanes
-        flat_pos = jnp.where((buckets.owner < S) & (buckets.slot < cap),
-                             buckets.owner * cap + buckets.slot, S * cap)
-        g_buckets = jnp.zeros((S * cap, width), g.dtype).at[flat_pos].set(
-            payload, mode="drop").reshape(S, cap, width)
+        g_buckets = _scatter_buckets(payload, buckets, S, cap)
 
         recv = jax.lax.all_to_all(g_buckets, axis, 0, 0)
 
         # server side: cross-source re-dedup + fused optimizer (MPSC reduce
         # + update)
-        rids = (plan.recv_ids.reshape(-1, 2) if pair
+        rids = (plan.recv_ids.reshape(-1, 2) if plan.recv_ids.ndim == 3
                 else plan.recv_ids.reshape(-1))
         flat = recv.reshape(-1, width)
         rg = flat[:, :spec.output_dim]
         tail = flat[:, spec.output_dim:]
         rc = jax.lax.bitcast_convert_type(
             tail[:, 0] if lanes == 1 else tail, jnp.int32).reshape(-1)
+    stats = {"push_overflow": buckets.overflow}
+    return _apply_unique(spec, state, optimizer, rids, rg, rc, S,
+                         packed=packed), stats
+
+
+def _scatter_buckets(payload: jax.Array, buckets: BucketResult, S: int,
+                     cap: int) -> jax.Array:
+    """Scatter per-unique-slot payload rows (n, W) into their (owner, slot)
+    bucket positions -> (S, cap, W); invalid/overflowed slots drop."""
+    width = payload.shape[1]
+    flat_pos = jnp.where((buckets.owner < S) & (buckets.slot < cap),
+                         buckets.owner * cap + buckets.slot, S * cap)
+    return jnp.zeros((S * cap, width), payload.dtype).at[flat_pos].set(
+        payload, mode="drop").reshape(S, cap, width)
+
+
+def _apply_unique(spec: EmbeddingSpec, state: EmbeddingTableState, optimizer,
+                  rids: jax.Array, rg: jax.Array, rc: jax.Array, S: int,
+                  packed=None) -> EmbeddingTableState:
+    """Server-side tail of a push: cross-source re-dedup (the MPSC reducer,
+    `MpscGradientReducer.h`) + ONE fused optimizer apply per unique row.
+    `rids`/`rg`/`rc` are the received flat ids, grads and exact duplicate
+    counts (count 0 = empty/invalid slot)."""
+    pair = rids.ndim == 2
     if spec.use_hash_table:
         from ..tables.hash_table import hash_find
         if pair:
@@ -332,16 +397,146 @@ def sharded_apply_gradients(
     else:
         rows = jnp.where(rc > 0, rids // S, state.weights.shape[0])
         counts = rc
-    stats = {"push_overflow": buckets.overflow}
     if packed is not None:
         from ..ops.sparse import sparse_apply_packed_table
         new_packed = sparse_apply_packed_table(
             optimizer, state.weights, packed, spec.output_dim, rows, rg,
             pre_counts=counts)
-        return state.replace(weights=new_packed), stats
+        return state.replace(weights=new_packed)
     weights, slots = sparse_apply_dense_table(
         optimizer, state.weights, state.slots, rows, rg, pre_counts=counts)
-    return state.replace(weights=weights, slots=slots), stats
+    return state.replace(weights=weights, slots=slots)
+
+
+# ---------------------------------------------------------------------------
+# Grouped multi-table exchange: tables sharing an embedding dim fuse their
+# three all_to_alls (ids / rows / grads+counts) into one each, and the row and
+# grad payloads optionally travel quantized (`ops/wire.py`). Per-table
+# dedup/routing, serving, and the optimizer apply are EXACTLY the per-table
+# protocol above — only the wire is shared, so a group of one table with fp32
+# wire is bit-identical to `sharded_lookup_train`/`sharded_apply_gradients`.
+# ---------------------------------------------------------------------------
+
+
+def grouped_lookup_train(
+    specs, states, ids_list, *,
+    axis: str = DATA_AXIS,
+    capacity_factor: float = 0.0,
+    wire: Optional[str] = None,
+):
+    """Fused training pull for one dim-group. Returns (new_states, outs,
+    stats_list, plans) — parallel lists in the input order; feed `plans` to
+    `grouped_apply_gradients` for the same batch."""
+    from ..ops import wire as wire_mod
+    S = jax.lax.axis_size(axis)
+    dim = specs[0].output_dim
+    for spec in specs:
+        if spec.output_dim != dim:
+            raise ValueError(
+                f"grouped exchange needs one embedding dim per group: "
+                f"{spec.name!r} has dim {spec.output_dim}, group has {dim}")
+    ids_list = [adapt_batch_ids(spec, state, ids)
+                for spec, state, ids in zip(specs, states, ids_list)]
+    plans = grouped_make_plans(specs, ids_list, axis=axis,
+                               capacity_factor=capacity_factor)
+    new_states, rows_list = [], []
+    for spec, state, plan in zip(specs, states, plans):
+        state, rows = _serve_rows(spec, state, plan, train=True, axis=axis)
+        new_states.append(state)
+        rows_list.append(rows)
+    if S == 1:
+        outs = [_reassemble(plan, rows, _out_shape(spec, ids),
+                            spec.output_dim, axis)
+                for spec, ids, plan, rows
+                in zip(specs, ids_list, plans, rows_list)]
+    else:
+        fmt = wire_mod.wire_format(wire)
+        # one encode + ONE all_to_all for the whole group's rows (mixed
+        # table dtypes promote at the concat; decode returns f32 and each
+        # table casts back to its own dtype — exact for bf16-kept tables)
+        stacked = jnp.concatenate(rows_list, axis=1)
+        enc = wire_mod.encode_rows(stacked.reshape(-1, dim), fmt)
+        back = jax.lax.all_to_all(
+            enc.reshape(S, -1, enc.shape[-1]), axis, 0, 0)
+        dec = wire_mod.decode_rows(
+            back.reshape(-1, enc.shape[-1]), dim, fmt).reshape(S, -1, dim)
+        outs, off = [], 0
+        for spec, ids, plan in zip(specs, ids_list, plans):
+            seg = dec[:, off:off + plan.cap]
+            off += plan.cap
+            uniq_rows = unbucket(seg, plan.buckets.owner, plan.buckets.slot)
+            out = jnp.take(uniq_rows, plan.uniq.inverse, axis=0)
+            outs.append(out.astype(spec.dtype).reshape(
+                _out_shape(spec, ids) + (spec.output_dim,)))
+    stats_list = [{
+        "pull_indices": jnp.asarray(ids_positions(spec, ids), jnp.int32),
+        "pull_unique": plan.uniq.num_unique,
+        "pull_overflow": plan.buckets.overflow,
+    } for spec, ids, plan in zip(specs, ids_list, plans)]
+    return new_states, outs, stats_list, plans
+
+
+def grouped_apply_gradients(
+    specs, states, optimizers, ids_list, grads_list, *,
+    axis: str = DATA_AXIS,
+    capacity_factor: float = 0.0,
+    plans=None,
+    packed_list=None,
+    wire: Optional[str] = None,
+):
+    """Fused push + update for one dim-group: ONE all_to_all carries every
+    table's grads+counts (counts bit-exact in wire lanes, grads optionally
+    quantized — dequantized here at the receiving edge, so the fused
+    optimizer apply and table storage keep their full-precision dtypes).
+    Returns (new_states, stats_list)."""
+    from ..ops import wire as wire_mod
+    S = jax.lax.axis_size(axis)
+    dim = specs[0].output_dim
+    if plans is None:
+        ids_list = [adapt_batch_ids(spec, state, ids)
+                    for spec, state, ids in zip(specs, states, ids_list)]
+        plans = grouped_make_plans(specs, ids_list, axis=axis,
+                                   capacity_factor=capacity_factor)
+    if packed_list is None:
+        packed_list = [None] * len(specs)
+    # client side: per-table duplicate pre-sum into the unique slots
+    gs, counts_list = [], []
+    for spec, plan, grads in zip(specs, plans, grads_list):
+        g = plan.uniq.segment_reduce(grads.reshape(-1, dim))
+        valid = (plan.uniq.counts > 0) & _id_valid(spec,
+                                                   plan.uniq.unique_ids)
+        gs.append(g)
+        counts_list.append(jnp.where(valid, plan.uniq.counts, 0)
+                           .astype(jnp.int32))
+    new_states, stats_list = [], []
+    if S == 1:
+        for spec, state, opt, plan, g, rc, packed in zip(
+                specs, states, optimizers, plans, gs, counts_list,
+                packed_list):
+            new_states.append(_apply_unique(
+                spec, state, opt, plan.uniq.unique_ids, g, rc, S,
+                packed=packed))
+            stats_list.append({"push_overflow": plan.buckets.overflow})
+        return new_states, stats_list
+    fmt = wire_mod.wire_format(wire)
+    payloads = [_scatter_buckets(wire_mod.encode_grads(g, rc, fmt),
+                                 plan.buckets, S, plan.cap)
+                for plan, g, rc in zip(plans, gs, counts_list)]
+    recv = jax.lax.all_to_all(jnp.concatenate(payloads, axis=1), axis, 0, 0)
+    width = recv.shape[-1]
+    off = 0
+    for spec, state, opt, plan, g, packed in zip(
+            specs, states, optimizers, plans, gs, packed_list):
+        seg = recv[:, off:off + plan.cap].reshape(-1, width)
+        off += plan.cap
+        rg32, rc = wire_mod.decode_grads(seg, dim, fmt)
+        rids = (plan.recv_ids.reshape(-1, 2) if plan.recv_ids.ndim == 3
+                else plan.recv_ids.reshape(-1))
+        new_states.append(_apply_unique(
+            spec, state, opt, rids, rg32.astype(g.dtype), rc, S,
+            packed=packed))
+        stats_list.append({"push_overflow": plan.buckets.overflow})
+    return new_states, stats_list
 
 
 # ---------------------------------------------------------------------------

@@ -36,13 +36,25 @@ class MeshTrainer(Trainer):
                  optimizer: Optional[SparseOptimizer] = None, *,
                  mesh: Optional[Mesh] = None, seed: int = 0,
                  capacity_factor: float = 0.0,
-                 on_overflow: str = "count"):
+                 on_overflow: str = "count",
+                 wire: Optional[str] = None,
+                 group_exchange: bool = True):
         super().__init__(model, optimizer, seed)
         self.mesh = mesh if mesh is not None else make_mesh()
         self.axis = self.mesh.axis_names[0]
         self.num_shards = self.mesh.devices.size  # overrides Trainer.num_shards
         # per-(src,dst) bucket headroom for the a2a exchange; 0 = exact (capacity = n)
         self.capacity_factor = capacity_factor
+        # wire payload format for the fused exchange: None -> $OETPU_WIRE ->
+        # bf16 (ops/wire.py; "fp32" opts out of quantization entirely)
+        self.wire = wire
+        # group_exchange=False falls back to the pre-round-6 per-table
+        # protocol (3 all_to_alls per TABLE, always-fp32 wire) — the
+        # comparison baseline tools/wire_microbench.py measures against
+        self.group_exchange = group_exchange
+        # static wire-cost model of the last traced step (set at trace time;
+        # also published as exchange.* gauges — utils/metrics.py)
+        self.last_wire_cost = None
         # bounded buckets can DROP ids (divergence from the reference's
         # unbounded buffers, `EmbeddingPullOperator.cpp:86-112`); the policy
         # when `check_overflow` sees drops: "count" (watch the counters),
@@ -222,6 +234,91 @@ class MeshTrainer(Trainer):
                         for k, v in metrics.get("stats", {}).items()}
         return out
 
+    # -- fused multi-table exchange ------------------------------------------
+
+    def _exchange_groups(self, ps_specs):
+        """Dim-groups restricted to the tables actually pulled this step."""
+        return [[n for n in g if n in ps_specs]
+                for g in self.model.dim_groups()
+                if any(n in ps_specs for n in g)]
+
+    def tables_pull(self, tables, batch, ps_specs, packed):
+        """Fused pull: 1 id a2a + 1 (optionally quantized) row a2a per
+        DIM-GROUP instead of per table (`sharded.grouped_lookup_train`).
+        Packed tables need no special pull path — `_serve_rows` self-detects
+        packed rows by width."""
+        self._observe_wire_cost(ps_specs, batch)
+        if not self.group_exchange:
+            return super().tables_pull(tables, batch, ps_specs, packed)
+        from .sharded import grouped_lookup_train
+        pulled_tables, pulled, stats, plans = {}, {}, {}, {}
+        for names in self._exchange_groups(ps_specs):
+            specs = [ps_specs[n] for n in names]
+            ids_list = [jnp.asarray(batch["sparse"][s.feature_name])
+                        for s in specs]
+            new_states, outs, stats_list, plan_list = grouped_lookup_train(
+                specs, [tables[n] for n in names], ids_list, axis=self.axis,
+                capacity_factor=self.capacity_factor, wire=self.wire)
+            for n, ts, out, st, pl in zip(names, new_states, outs,
+                                          stats_list, plan_list):
+                pulled_tables[n], pulled[n], plans[n] = ts, out, pl
+                for k, v in st.items():
+                    stats[f"{n}/{k}"] = v
+        return pulled_tables, pulled, stats, plans
+
+    def tables_apply(self, ps_specs, pulled_tables, batch, row_grads, packed,
+                     plans):
+        """Fused push: 1 grads+counts a2a per DIM-GROUP
+        (`sharded.grouped_apply_gradients`), reusing the pull's plans."""
+        if not self.group_exchange:
+            return super().tables_apply(ps_specs, pulled_tables, batch,
+                                        row_grads, packed, plans)
+        from .sharded import grouped_apply_gradients
+        new_tables, stats = {}, {}
+        for names in self._exchange_groups(ps_specs):
+            specs = [ps_specs[n] for n in names]
+            ids_list = [jnp.asarray(batch["sparse"][s.feature_name])
+                        for s in specs]
+            states, stats_list = grouped_apply_gradients(
+                specs, [pulled_tables[n] for n in names],
+                [self.opt_for(s) for s in specs], ids_list,
+                [row_grads[n] for n in names], axis=self.axis,
+                capacity_factor=self.capacity_factor,
+                plans=[plans[n] for n in names],
+                packed_list=[packed.get(n) for n in names], wire=self.wire)
+            for n, ts, st in zip(names, states, stats_list):
+                new_tables[n] = ts
+                for k, v in st.items():
+                    stats[f"{n}/{k}"] = v
+        return new_tables, stats
+
+    def _observe_wire_cost(self, ps_specs, batch):
+        """Publish the static wire-cost model of the traced step (runs once
+        per trace — all inputs are shapes, not values)."""
+        from ..ops import wire as wire_mod
+        from ..ops.id64 import is_pair
+        from .sharded import _bucket_capacity
+        tables = []
+        for name, spec in ps_specs.items():
+            # `batch` is the per-device shard here (tables_pull runs inside
+            # shard_map), so ids.size IS the per-device position count
+            ids = jnp.asarray(batch["sparse"][spec.feature_name])
+            pair = spec.use_hash_table and is_pair(ids)
+            n = ids.size // 2 if pair else ids.size
+            tables.append({
+                "dim": spec.output_dim,
+                "cap": _bucket_capacity(max(n, 1), self.num_shards,
+                                        self.capacity_factor),
+                "pair": pair,
+                "id_itemsize": jnp.dtype(ids.dtype).itemsize})
+        # the per-table fallback protocol always ships fp32 payloads
+        fmt = (wire_mod.wire_format(self.wire) if self.group_exchange
+               else "fp32")
+        cost = wire_mod.exchange_cost(
+            tables, self.num_shards, fmt, fused=self.group_exchange)
+        self.last_wire_cost = cost
+        _metrics.observe_exchange_cost(cost)
+
     # packed scan layout: the base `_packed_layouts` gate applies per shard
     # (widths are shard-invariant); the sharded pull auto-slices packed rows
     # and the apply takes the layout, so only the two hooks below differ.
@@ -340,13 +437,15 @@ class SeqMeshTrainer(MeshTrainer):
     dim is the sequence and is sharded over 'seq'; label (B, S)."""
 
     def __init__(self, model, optimizer=None, *, mesh: Mesh, seed: int = 0,
-                 capacity_factor: float = 0.0):
+                 capacity_factor: float = 0.0, wire: Optional[str] = None,
+                 group_exchange: bool = True):
         if len(mesh.axis_names) != 2:
             raise ValueError(
                 f"SeqMeshTrainer needs a 2-D (data, seq) mesh, got axes "
                 f"{mesh.axis_names}")
         super().__init__(model, optimizer, mesh=mesh, seed=seed,
-                         capacity_factor=capacity_factor)
+                         capacity_factor=capacity_factor, wire=wire,
+                         group_exchange=group_exchange)
         self.data_axis, self.seq_axis = mesh.axis_names
         # collectives (sparse exchange, psum, metrics) span the flattened mesh
         self.axis = tuple(mesh.axis_names)

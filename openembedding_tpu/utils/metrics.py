@@ -101,6 +101,19 @@ def vtimer(group: str, name: str):
         Accumulator.get(f"{group}.{name}.max_ms", "max").observe(ms)
 
 
+def observe_exchange_cost(cost: Dict[str, "object"]) -> None:
+    """Publish the sharded exchange's static wire-cost model
+    (`ops/wire.exchange_cost`, computed at trace time by
+    `MeshTrainer._observe_wire_cost`) as gauges: how many collectives the
+    step launches and how many bytes one device ships through them — the
+    counters the fused/quantized wire work is measured by."""
+    observe("exchange.collectives_per_step",
+            float(cost.get("collectives_per_step", 0)), "gauge")
+    observe("exchange.wire_bytes_per_step",
+            float(cost.get("bytes_per_step", 0)), "gauge")
+    observe("exchange.dim_groups", float(cost.get("dim_groups", 0)), "gauge")
+
+
 def record_step_stats(stats: Dict[str, "object"]) -> None:
     """Fold a train step's device-side stats dict (`{var}/pull_indices`, `.../
     pull_unique`, `.../pull_overflow`, ...) into host accumulators."""

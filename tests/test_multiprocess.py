@@ -23,8 +23,19 @@ import sys
 import numpy as np
 import pytest
 
+import jax
+
 WORKER = os.path.join(os.path.dirname(__file__), "multiprocess_worker.py")
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# jaxlib < 0.5 cannot run cross-process computations on the CPU backend at
+# all (workers die with "Multiprocess computations aren't implemented on the
+# CPU backend") — a runtime capability gap, not a repo defect. The in-process
+# 8-virtual-device suite still covers the numerics; only the real process
+# boundaries go untested on such runtimes.
+pytestmark = pytest.mark.skipif(
+    tuple(int(x) for x in jax.__version__.split(".")[:2]) < (0, 5),
+    reason="CPU backend of this jaxlib lacks multiprocess computations")
 
 
 def _free_port():

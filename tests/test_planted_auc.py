@@ -65,19 +65,32 @@ def _train_and_score(model, heldout, epochs=EPOCHS):
 # one platform the achieved AUC is deterministic; measured r5 on the CPU suite
 # (oracle 0.8298): lr margin +0.0183, wdl +0.0196, deepfm +0.0308. The slack
 # absorbs cross-version/XLA numeric drift (~1e-3), not regressions.
+#
+# The tight margins are PLATFORM-TUNED (ADVICE r5): they were measured on the
+# CPU suite, and reduction order / bf16 matmul behavior differ enough on TPU
+# (or any other backend) that the snug deepfm bound can trip without any real
+# regression. `_margin` therefore gates the tight bound on the platform it
+# was measured on and falls back to a platform-independent floor of >= 0.03
+# margin (plus 0.01 cross-platform slack) everywhere else.
+
+
+def _margin(cpu_tuned: float) -> float:
+    if jax.default_backend() == "cpu":
+        return cpu_tuned
+    return max(cpu_tuned, 0.03) + 0.01
 
 
 def test_lr_reaches_planted_optimum(heldout):
     _, _, oracle = heldout
     got = _train_and_score(make_lr(vocabulary=VOCAB), heldout)
-    assert got > oracle - 0.024, (got, oracle)
+    assert got > oracle - _margin(0.024), (got, oracle)
 
 
 def test_wdl_reaches_planted_optimum(heldout):
     _, _, oracle = heldout
     got = _train_and_score(
         make_wdl(vocabulary=VOCAB, dim=8, hidden=(64, 32)), heldout)
-    assert got > oracle - 0.025, (got, oracle)
+    assert got > oracle - _margin(0.025), (got, oracle)
 
 
 def test_deepfm_reaches_planted_optimum(heldout):
@@ -86,8 +99,8 @@ def test_deepfm_reaches_planted_optimum(heldout):
         make_deepfm(vocabulary=VOCAB, dim=8, hidden=(64, 32)), heldout)
     # the FM/deep tower takes longer to stop fighting the linear term;
     # measured 0.7990 vs oracle 0.8298 at 1M rows (r5) — margin 0.0308, so
-    # 0.035 is already snug (4.2 millipoints of slack)
-    assert got > oracle - 0.035, (got, oracle)
+    # 0.035 is already snug (4.2 millipoints of slack) on CPU
+    assert got > oracle - _margin(0.035), (got, oracle)
 
 
 def test_mesh_trainer_reaches_planted_optimum(heldout):
@@ -115,4 +128,4 @@ def test_mesh_trainer_reaches_planted_optimum(heldout):
     got = auc(labels, scores)
     # sharded LR trains the same model as test_lr (exchange parity is pinned
     # exactly elsewhere); same data-driven bound as the single-device case
-    assert got > oracle - 0.024, (got, oracle)
+    assert got > oracle - _margin(0.024), (got, oracle)

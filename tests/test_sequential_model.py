@@ -297,3 +297,34 @@ def test_bert4rec_logits_invariant_to_pad_width():
     wide_logits = np.asarray(ev(state, wide)["logits"])
     np.testing.assert_allclose(wide_logits[:, :ids.shape[-1]], narrow_logits,
                                rtol=1e-5, atol=1e-6)
+
+
+def test_key_padding_mask_derives_from_ids_not_zero_rows():
+    """ADVICE r5: the key-padding mask must come from the id array, not from
+    the exact-zero-row property of pulled embeddings. With a Constant(0)
+    item table EVERY real row is all-zero at step 0 — the old heuristic
+    masked every key (all-(-inf) attention logits), the id-derived mask
+    keeps real positions valid and the forward pass finite."""
+    from openembedding_tpu.models.sequential import SASRec, ITEM
+
+    model = make_sasrec(VOCAB, DIM, attention="full")
+    model.specs[ITEM] = dataclasses.replace(
+        model.specs[ITEM], initializer=embed.Constant(0.0))
+    tr = Trainer(model, embed.Adagrad(learning_rate=0.1))
+    batch = _batches(1)[0]
+    state = tr.init(batch)
+    out = tr.jit_eval_step()(state, batch)
+    assert np.isfinite(np.asarray(out["logits"])).all()
+    assert np.isfinite(float(out["loss"]))
+
+    # the mask itself: raw ids win over row content (a zero row at a REAL
+    # position stays a valid attention key; pads (-1) never do)
+    ids = np.asarray(batch["sparse"][ITEM])             # (B, 3, S)
+    hist_zero_rows = jnp.zeros((ids.shape[0], ids.shape[-1], DIM))
+    mod = SASRec(dim=DIM)
+    got = mod._kv_valid({"__ids__": {ITEM: jnp.asarray(ids)}},
+                        hist_zero_rows)
+    np.testing.assert_array_equal(np.asarray(got), ids[:, 0] >= 0)
+    # fallback (no ids attached): the legacy zero-row heuristic
+    got_fb = mod._kv_valid({}, hist_zero_rows)
+    assert not np.asarray(got_fb).any()

@@ -1,0 +1,370 @@
+"""Fused multi-table exchange + quantized wire payloads (`ops/wire.py`,
+`parallel/sharded.grouped_*`).
+
+Covers the round-6 tentpole contracts:
+- 3 all_to_alls per DIM-GROUP (not per table), pinned at the HLO level for a
+  3-table / 2-group model (6 fused vs 9 unfused);
+- the fused exchange with fp32 wire is BIT-identical to the per-table
+  protocol (grouping only shares the wire, never the math);
+- bf16 (default) / int8 (opt-in) wire: pull rows and pushed grads round-trip
+  within format tolerance, duplicate-count lanes and overflow counters stay
+  EXACT, table storage stays full-precision fp32;
+- the static wire-cost model: bf16 moves >= 1.8x fewer exchange bytes/step
+  than fp32 (the tools/wire_microbench.py acceptance number).
+
+The suite-wide default wire is pinned to fp32 in tests/conftest.py (parity
+tests elsewhere assert exact agreement); every lossy-format test here passes
+`wire=` explicitly.
+"""
+
+import numpy as np
+import pytest
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+import openembedding_tpu as embed
+from openembedding_tpu.model import EmbeddingModel
+from openembedding_tpu.ops import wire
+from openembedding_tpu.parallel import MeshTrainer, make_mesh
+
+S = 8
+B = 4 * S
+FMTS = ("fp32", "bf16", "int8")
+
+
+# ---------------------------------------------------------------------------
+# wire codec units
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("fmt", FMTS)
+def test_counts_roundtrip_exact(fmt):
+    """Duplicate counts must survive the wire bit-exactly in EVERY format —
+    they divide/weight optimizer updates (1 fp32 / 2 bf16 / 4 int8 lanes)."""
+    counts = jnp.asarray(
+        np.array([0, 1, 2, 3, 127, 128, 255, 65536, (1 << 30) + 17, 4096],
+                 np.int32))
+    lanes = wire.counts_to_lanes(counts, fmt)
+    assert lanes.shape == (10, wire.count_lanes(fmt))
+    assert lanes.dtype == wire.wire_dtype(fmt)
+    np.testing.assert_array_equal(np.asarray(wire.lanes_to_counts(lanes)),
+                                  np.asarray(counts))
+
+
+@pytest.mark.parametrize("fmt", FMTS)
+def test_rows_roundtrip_within_format_tolerance(fmt):
+    rng = np.random.default_rng(0)
+    rows = rng.standard_normal((64, 16)).astype(np.float32) * 3.0
+    rows[5] = 0.0  # all-zero row: must decode to exact zeros (int8 scale 0)
+    enc = wire.encode_rows(jnp.asarray(rows), fmt)
+    assert enc.shape[1] == wire.rows_wire_width(16, fmt)
+    dec = np.asarray(wire.decode_rows(enc, 16, fmt))
+    if fmt == "fp32":
+        np.testing.assert_array_equal(dec, rows)
+    elif fmt == "bf16":
+        np.testing.assert_allclose(dec, rows, rtol=2 ** -8, atol=1e-7)
+    else:  # int8: per-row max-abs scaling -> error <= scale/2 per element
+        step = np.abs(rows).max(axis=1, keepdims=True) / 127.0
+        assert np.all(np.abs(dec - rows) <= step * 0.5 + 1e-7)
+    np.testing.assert_array_equal(dec[5], 0.0)
+
+
+@pytest.mark.parametrize("fmt", FMTS)
+def test_grads_payload_and_empty_slots(fmt):
+    """encode_grads folds grads + exact counts into one payload row; a ZERO
+    payload row (what empty bucket slots carry) decodes to grad 0, count 0."""
+    rng = np.random.default_rng(1)
+    g = rng.standard_normal((32, 8)).astype(np.float32)
+    counts = jnp.asarray(rng.integers(0, 1 << 20, 32).astype(np.int32))
+    enc = wire.encode_grads(jnp.asarray(g), counts, fmt)
+    assert enc.shape[1] == wire.grads_wire_width(8, fmt)
+    dec_g, dec_c = wire.decode_grads(enc, 8, fmt)
+    np.testing.assert_array_equal(np.asarray(dec_c), np.asarray(counts))
+    tol = {"fp32": 0.0, "bf16": 2 ** -8, "int8": 1 / 64}[fmt]
+    np.testing.assert_allclose(np.asarray(dec_g), g, rtol=tol,
+                               atol=tol * np.abs(g).max() + 1e-7)
+    zero_g, zero_c = wire.decode_grads(jnp.zeros_like(enc), 8, fmt)
+    np.testing.assert_array_equal(np.asarray(zero_g), 0.0)
+    np.testing.assert_array_equal(np.asarray(zero_c), 0)
+
+
+def test_concat_split_buckets_mixed_int_widths():
+    """int32 + int64 bucket arrays fuse onto an int64 wire and narrow back;
+    sentinels (-1) survive both directions."""
+    from openembedding_tpu.ops.dedup import (concat_owner_buckets,
+                                             split_owner_buckets)
+    a = jnp.asarray(np.array([[1, -1, 5], [7, 3, -1]], np.int32))
+    b = jnp.asarray(np.array([[1 << 40, -1], [-1, (1 << 33) + 9]], np.int64))
+    fused = concat_owner_buckets([a, b])
+    assert fused.dtype == jnp.int64 and fused.shape == (2, 5)
+    back = split_owner_buckets(fused, [(3, False, a.dtype),
+                                       (2, False, b.dtype)])
+    np.testing.assert_array_equal(np.asarray(back[0]), np.asarray(a))
+    assert back[0].dtype == jnp.int32
+    np.testing.assert_array_equal(np.asarray(back[1]), np.asarray(b))
+
+
+def test_concat_split_buckets_pair_widening():
+    """A split-pair table beside a single-lane array table widens the group
+    onto the pair wire; the array table's segment narrows back with its
+    sentinels intact (`ops/id64` machinery)."""
+    from openembedding_tpu.ops.dedup import (concat_owner_buckets,
+                                             split_owner_buckets)
+    from openembedding_tpu.ops.id64 import np_split_ids
+    ids64 = np.array([[(1 << 45) + 3, -1], [-1, (1 << 62) - 5]], np.int64)
+    pair = jnp.asarray(np_split_ids(ids64))                  # (2, 2, 2)
+    flat = jnp.asarray(np.array([[4, -1, 0], [-1, 2, 7]], np.int32))
+    fused = concat_owner_buckets([pair, flat])
+    assert fused.ndim == 3 and fused.shape == (2, 5, 2)
+    back = split_owner_buckets(fused, [(2, True, pair.dtype),
+                                       (3, False, flat.dtype)])
+    np.testing.assert_array_equal(np.asarray(back[0]), np.asarray(pair))
+    np.testing.assert_array_equal(np.asarray(back[1]), np.asarray(flat))
+
+
+# ---------------------------------------------------------------------------
+# the 3-table / 2-dim-group model the fused-exchange pins train
+# ---------------------------------------------------------------------------
+
+
+class _ThreeTower(nn.Module):
+    """Reads two dim-8 tables + one dim-1 table -> logits (B,)."""
+
+    @nn.compact
+    def __call__(self, embedded, dense):
+        bias = self.param("bias", nn.initializers.zeros, (1,), jnp.float32)
+        out = (jnp.sum(embedded["a"].astype(jnp.float32), axis=(1, 2))
+               + jnp.sum(embedded["b"].astype(jnp.float32), axis=(1, 2))
+               + jnp.sum(embedded["w"][..., 0].astype(jnp.float32), axis=1))
+        return out + bias[0]
+
+
+def _three_table_model(vocab=64):
+    """3 PS tables in 2 dim-groups: dim-8 {a (array), b (hash)} + dim-1 {w}.
+    The hash table keys in int64 under the suite's x64 config, so the fused
+    id wire exercises the mixed int32/int64 promotion path too."""
+    embs = [
+        embed.Embedding(vocab, 8, name="a",
+                        embeddings_initializer=embed.Constant(0.05)),
+        embed.Embedding(-1, 8, name="b", capacity=4096,
+                        embeddings_initializer=embed.Constant(0.02)),
+        embed.Embedding(vocab, 1, name="w",
+                        embeddings_initializer=embed.Constant(0.0)),
+    ]
+    return EmbeddingModel(_ThreeTower(), embs)
+
+
+def _batch(rng, vocab=64, dupes=True, hash_space=1 << 40,
+           hash_dtype=np.int64):
+    a = rng.integers(0, vocab, (B, 4)).astype(np.int32)
+    b = rng.integers(0, hash_space, (B, 3)).astype(hash_dtype)
+    if dupes:  # duplicate-heavy streams: the count lanes must carry > 1
+        a[:, 0] = 7
+        b[:, 0] = hash_space - 13
+    w = rng.integers(0, vocab, (B, 4)).astype(np.int32)
+    return {"sparse": {"a": a, "b": b, "w": w},
+            "label": rng.integers(0, 2, (B,)).astype(np.float32)}
+
+
+def _train(trainer, batches, state=None):
+    if state is None:
+        state = trainer.init(batches[0])
+    if isinstance(trainer, MeshTrainer):
+        step = trainer.jit_train_step(batches[0], state)
+    else:
+        step = trainer.jit_train_step()
+    losses = []
+    for b in batches:
+        state, m = step(state, b)
+        losses.append(float(m["loss"]))
+    return state, losses
+
+
+def _probe_tables(trainer, state, batches, vocab=64):
+    """Deterministic table reads for comparison across trainers: the array
+    tables read fully, the hash table reads every id the batches trained."""
+    from openembedding_tpu.embedding import lookup as single_lookup
+    from openembedding_tpu.parallel.sharded import sharded_lookup
+    from jax.sharding import PartitionSpec as P
+    from functools import partial
+    out = {}
+    probes = {"a": np.arange(vocab, dtype=np.int32),
+              "b": np.unique(np.concatenate(
+                  [b["sparse"]["b"].reshape(-1) for b in batches])),
+              "w": np.arange(vocab, dtype=np.int32)}
+    for name, probe in probes.items():
+        spec = trainer.model.specs[name]
+        if isinstance(trainer, MeshTrainer):
+            pull = jax.jit(jax.shard_map(
+                partial(sharded_lookup, spec, axis=trainer.axis),
+                mesh=trainer.mesh,
+                in_specs=(trainer._table_pspec(spec), P()),
+                out_specs=P(), check_vma=False))
+            out[name] = np.asarray(pull(state.tables[name],
+                                        jnp.asarray(probe)))
+        else:
+            out[name] = np.asarray(single_lookup(
+                spec, state.tables[name], jnp.asarray(probe)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# fused-exchange pins
+# ---------------------------------------------------------------------------
+
+
+def test_fused_step_compiles_three_all_to_alls_per_dim_group():
+    """THE acceptance pin: a 3-table model in 2 dim-groups compiles to 6
+    all_to_alls per train step (3 per GROUP); the pre-fusion per-table
+    protocol (group_exchange=False) compiles the same model to 9."""
+    import re
+
+    def count_a2a(group_exchange):
+        rng = np.random.default_rng(0)
+        tr = MeshTrainer(_three_table_model(),
+                         embed.Adagrad(learning_rate=0.05), mesh=make_mesh(),
+                         group_exchange=group_exchange)
+        b = _batch(rng)
+        state = tr.init(b)
+        step = tr.jit_train_step(b, state)
+        txt = step.lower(state, b).compile().as_text()
+        return len(re.findall(r" all-to-all(?:-start)?\(", txt))
+
+    assert count_a2a(True) == 6, "fused: expected 3 a2a per dim-group"
+    assert count_a2a(False) == 9, "unfused: expected 3 a2a per table"
+
+
+def test_fused_fp32_bitexact_vs_per_table_protocol():
+    """Grouping shares the WIRE, never the math: with fp32 wire the fused
+    exchange must reproduce the per-table protocol bit for bit (same dedup,
+    same bucket contents, same apply order)."""
+    rng = np.random.default_rng(1)
+    batches = [_batch(rng) for _ in range(3)]
+
+    def run(group_exchange):
+        tr = MeshTrainer(_three_table_model(),
+                         embed.Adagrad(learning_rate=0.1), mesh=make_mesh(),
+                         wire="fp32", group_exchange=group_exchange)
+        state, losses = _train(tr, batches)
+        return _probe_tables(tr, state, batches), losses
+
+    fused, l_fused = run(True)
+    per_table, l_per = run(False)
+    np.testing.assert_array_equal(l_fused, l_per)
+    for name in fused:
+        np.testing.assert_array_equal(fused[name], per_table[name])
+
+
+@pytest.mark.parametrize("fmt", ["bf16", "int8"])
+def test_quantized_wire_parity_and_fp32_storage(fmt):
+    """Lossy wire formats: trained tables stay within format tolerance of the
+    fp32-wire run (pull rows AND pushed grads both cross the wire every
+    step), storage dtype stays fp32, and the duplicate-heavy stream keeps
+    count-dependent updates sane (mangled count lanes would be gross)."""
+    rng = np.random.default_rng(2)
+    batches = [_batch(rng) for _ in range(3)]
+
+    def run(wire_fmt):
+        tr = MeshTrainer(_three_table_model(),
+                         embed.Adagrad(learning_rate=0.1), mesh=make_mesh(),
+                         wire=wire_fmt)
+        state, losses = _train(tr, batches)
+        for ts in state.tables.values():
+            assert ts.weights.dtype == jnp.float32  # storage never quantizes
+        return _probe_tables(tr, state, batches), losses
+
+    exact, l_exact = run("fp32")
+    lossy, l_lossy = run(fmt)
+    # pull rows + grads each round once per step; 3 steps of Adagrad compound
+    tol = 0.02 if fmt == "bf16" else 0.06
+    for name in exact:
+        np.testing.assert_allclose(lossy[name], exact[name], rtol=tol,
+                                   atol=tol)
+    np.testing.assert_allclose(l_lossy, l_exact, rtol=tol)
+    assert max(abs(np.asarray(v)).max() for v in lossy.values()) > 0
+
+
+@pytest.mark.parametrize("fmt", ["bf16", "int8"])
+def test_overflow_drop_paths_unchanged_by_wire(fmt):
+    """Bounded buckets under capacity pressure: overflow counters are an
+    ID-side property and must be IDENTICAL across wire formats; dropped ids
+    still pull zeros / drop grads (training stays finite)."""
+    rng = np.random.default_rng(3)
+    batches = [_batch(rng) for _ in range(2)]
+
+    def run(wire_fmt):
+        tr = MeshTrainer(_three_table_model(),
+                         embed.Adagrad(learning_rate=0.1), mesh=make_mesh(),
+                         capacity_factor=0.25, wire=wire_fmt)
+        state = tr.init(batches[0])
+        step = tr.jit_train_step(batches[0], state)
+        oflow = {}
+        for b in batches:
+            state, m = step(state, b)
+            for k, v in m["stats"].items():
+                if k.endswith("_overflow"):
+                    oflow[k] = oflow.get(k, 0) + int(np.asarray(v))
+            assert np.isfinite(float(m["loss"]))
+        return oflow
+
+    o_exact = run("fp32")
+    o_lossy = run(fmt)
+    # the duplicate-saturated streams overflow the 0.25-factor buckets
+    assert sum(o_exact.values()) > 0
+    assert o_lossy == o_exact
+
+
+def test_wire_cost_model_and_gauges():
+    """Static cost model: bf16 >= 1.8x fewer exchange bytes/step than fp32
+    (the microbench acceptance bound), int8 beats bf16, fused <= unfused
+    collectives; the trainer publishes the gauges at trace time."""
+    from openembedding_tpu.utils import metrics as M
+
+    tables = [{"dim": 16, "cap": 128, "pair": False, "id_itemsize": 4},
+              {"dim": 16, "cap": 128, "pair": False, "id_itemsize": 8},
+              {"dim": 1, "cap": 64, "pair": False, "id_itemsize": 4}]
+    fp32 = wire.exchange_cost(tables, S, "fp32")
+    bf16 = wire.exchange_cost(tables, S, "bf16")
+    int8 = wire.exchange_cost(tables, S, "int8")
+    assert fp32["collectives_per_step"] == 6  # 2 dim-groups
+    assert wire.exchange_cost(tables, S, "fp32",
+                              fused=False)["collectives_per_step"] == 9
+    assert fp32["bytes_per_step"] / bf16["bytes_per_step"] >= 1.8
+    assert int8["bytes_per_step"] < bf16["bytes_per_step"]
+
+    rng = np.random.default_rng(4)
+    tr = MeshTrainer(_three_table_model(), embed.Adagrad(learning_rate=0.1),
+                     mesh=make_mesh(), wire="bf16")
+    b = _batch(rng)
+    state = tr.init(b)
+    _train(tr, [b], state=state)
+    assert tr.last_wire_cost is not None
+    assert tr.last_wire_cost["collectives_per_step"] == 6
+    vals = M.report()
+    assert vals.get("exchange.collectives_per_step") == 6.0
+    assert vals.get("exchange.wire_bytes_per_step", 0) > 0
+
+
+def test_grouped_pair_wire_x64_off():
+    """Under x64-off the hash table keys in the split-pair layout; grouped
+    with an int32 array table the fused id wire widens to pairs. Parity vs
+    the per-table protocol stays exact (fp32 wire)."""
+    with jax.enable_x64(False):
+        rng = np.random.default_rng(5)
+        # int32 ids (< 2^31: nothing to truncate); adapt_batch_ids widens
+        # them onto the pair key layout at the protocol entry
+        batches = [_batch(rng, hash_space=1 << 20, hash_dtype=np.int32)
+                   for _ in range(2)]
+
+        def run(group_exchange):
+            tr = MeshTrainer(_three_table_model(),
+                             embed.Adagrad(learning_rate=0.1),
+                             mesh=make_mesh(), wire="fp32",
+                             group_exchange=group_exchange)
+            state, losses = _train(tr, batches)
+            assert state.tables["b"].keys.ndim == 2  # pair-keyed
+            return losses
+
+        np.testing.assert_array_equal(run(True), run(False))

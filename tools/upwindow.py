@@ -76,6 +76,14 @@ CASES = [
       "--model", "deepfm", "--dim", "64", "--synthetic",
       "--batch-size", "4096", "--steps", "64", "--scan", "16",
       "--vocabulary", str(1 << 24), "--offload", str(1 << 20)], {}, 900),
+    # 8. wire codec on-chip (bench 'wire' case: quant/dequant compute cost;
+    #    S>1 byte savings are CPU-mesh-measured by tools/wire_microbench.py,
+    #    whose stanza is committed here too — it needs no relay, but riding
+    #    the battery keeps all BENCH stanzas in one capture file)
+    ("bench_wire", *bench_case("wire", 300)),
+    ("wire_microbench",
+     [sys.executable, os.path.join(REPO, "tools", "wire_microbench.py")],
+     {"JAX_PLATFORMS": "cpu"}, 600),
 ]
 
 
