@@ -48,7 +48,7 @@ throughput, not host dispatch latency — the same way production input pipeline
 drive TPUs (the axon tunnel adds ~40 ms per dispatch that would otherwise swamp
 the measurement; see PERF.md "Measurement hygiene").
 
-Env knobs: OETPU_BENCH_CASES=dim9[,dim64][,mesh1][,mesh1f][,pull][,wire] (default: all),
+Env knobs: OETPU_BENCH_CASES=dim9[,dim64][,mesh1][,mesh1f][,pull][,wire][,sync] (default: all),
 OETPU_BENCH_BUDGET_S (default 540), OETPU_BENCH_SCAN_STEPS / _REPEATS (smoke runs),
 OETPU_BENCH_TOTAL_BUDGET_S / _PROBE_TIMEOUT_S / _PROBE_INTERVAL_S (orchestrator).
 """
@@ -336,6 +336,84 @@ def case_wire():
     return out
 
 
+def case_sync():
+    """Online-sync delta pipeline end to end, in-process HTTP and all: a
+    2^20-row dim-16 table trains 3 persisted deltas of a 4096x26 Zipfian
+    batch each; a subscriber-backed ModelManager then follows the published
+    feed per wire format. Reported: per-delta sync latency (fetch + decode +
+    apply + RCU swap), applied rows/s, and bytes/delta — the knobs the
+    PERF.md sync wire-cost stanza models. Mostly host-side work by design
+    (the apply path's device cost is one scatter per table), so CPU numbers
+    are already representative; the chip battery entry pins that claim."""
+    import shutil
+    import tempfile
+    import threading
+
+    import openembedding_tpu as embed
+    from openembedding_tpu.model import Trainer
+    from openembedding_tpu.models import make_deepfm
+    from openembedding_tpu.persist import IncrementalPersister, PersistPolicy
+    from openembedding_tpu.export import export_standalone
+    from openembedding_tpu.serving import ModelManager, ModelRegistry, make_server
+    from openembedding_tpu.sync import SyncSubscriber
+    from openembedding_tpu.utils import metrics as metrics_mod
+
+    WD.stage("sync:init", 240)
+    vocab, dim, steps = 1 << 20, 16, 4
+    model = make_deepfm(vocabulary=vocab, dim=dim)
+    trainer = Trainer(model, embed.Adagrad(learning_rate=0.05), seed=0)
+    batches, _ = _stacked_batches(dim, steps, id_space=vocab)
+    state = trainer.init(batches[0])
+    step = trainer.jit_train_step()
+    work = tempfile.mkdtemp(prefix="oetpu_bench_sync_")
+    out = {}
+    try:
+        root = os.path.join(work, "persist")
+        WD.stage("sync:train_persist", 300)
+        with IncrementalPersister(trainer, model, root, window=2,
+                                  policy=PersistPolicy(every_steps=1),
+                                  full_every=100) as p:
+            state, _m = step(state, batches[0])
+            p.maybe_persist(state, batch=batches[0])
+            p.wait()
+            export_dir = os.path.join(work, "export")
+            export_standalone(state, model, export_dir, model_sign="bench")
+            touched = 0
+            for b in batches[1:]:
+                state, _m = step(state, b)
+                ids = np.unique(np.asarray(b["sparse"]["categorical"]))
+                touched += int(ids.size)
+                p.maybe_persist(state, batch=b)
+            p.wait()
+        pub = make_server(os.path.join(work, "reg"), publish={"bench": root})
+        threading.Thread(target=pub.serve_forever, daemon=True).start()
+        url = f"http://127.0.0.1:{pub.server_address[1]}"
+        n_deltas = steps - 1
+        for fmt in ("fp32", "bf16", "int8"):
+            WD.stage(f"sync:{fmt}", 240)
+            mgr = ModelManager(ModelRegistry(os.path.join(work, f"r_{fmt}")))
+            mgr.load_model("bench", export_dir)
+            sub = SyncSubscriber(mgr, "bench", url, wire=fmt)
+            b0 = metrics_mod.Accumulator.get("sync.bytes_fetched").value()
+            t0 = time.perf_counter()
+            applied = sub.poll()
+            dt = time.perf_counter() - t0
+            assert applied == n_deltas, (applied, sub.last_error)
+            bytes_fetched = (metrics_mod.Accumulator.get(
+                "sync.bytes_fetched").value() - b0)
+            out[f"{fmt}_ms_per_delta"] = round(dt * 1e3 / n_deltas, 2)
+            out[f"{fmt}_rows_per_sec"] = round(touched / dt, 1)
+            out[f"{fmt}_bytes_per_delta"] = int(bytes_fetched / n_deltas)
+        out["deltas"] = n_deltas
+        out["touched_rows_total"] = touched
+        out["vs_fp32_bytes"] = round(
+            out["fp32_bytes_per_delta"] / out["bf16_bytes_per_delta"], 2)
+        pub.shutdown()
+        return out
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
 def case_pull():
     """Embedding-pull p50 (BASELINE.md metric). A pull = the serving/forward read:
     dedup + row gather for one 4096x26 Zipfian batch against the 2^24-row dim-9
@@ -393,7 +471,7 @@ def main():
     EXTRA["platform"] = devs[0].platform
 
     cases = os.environ.get("OETPU_BENCH_CASES",
-                           "dim9,dim64,mesh1,mesh1f,pull,wire").split(",")
+                           "dim9,dim64,mesh1,mesh1f,pull,wire,sync").split(",")
 
     # PRIMARY first: whatever happens later, this number is in the artifact.
     if "dim9" in cases:
@@ -407,7 +485,8 @@ def main():
                  ("mesh1f", lambda: case_mesh1(capacity_factor=1.0,
                                                name="mesh1f")),
                  ("pull", case_pull),
-                 ("wire", case_wire)]
+                 ("wire", case_wire),
+                 ("sync", case_sync)]
     for name, fn in secondary:
         if name not in cases:
             continue
@@ -439,6 +518,11 @@ def main():
             if "bf16_roundtrip_ms" in out:
                 RESULT["metric"] = "wire_bf16_roundtrip_ms"
                 RESULT["value"] = out["bf16_roundtrip_ms"]
+                RESULT["unit"] = "ms"
+                break
+            if "fp32_ms_per_delta" in out:
+                RESULT["metric"] = "sync_fp32_ms_per_delta"
+                RESULT["value"] = out["fp32_ms_per_delta"]
                 RESULT["unit"] = "ms"
                 break
 

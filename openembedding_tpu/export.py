@@ -192,6 +192,11 @@ class StandaloneModel:
         self.dense_params = dense_params
         self.model = model         # None if no config recipe and none passed in
         self._predict_fn = None
+        # training step / model_version the materialized weights correspond to
+        # (the export's `extra` block) — the version the online-sync
+        # subscriber negotiates against the publisher feed (`sync/`)
+        self.step = 0
+        self.model_version = 0
 
     @classmethod
     def load(cls, path: str, model: Optional[EmbeddingModel] = None
@@ -201,7 +206,9 @@ class StandaloneModel:
             with fsmod.staged(path) as local:
                 return cls.load(local, model=model)
         with open(os.path.join(path, MODEL_META_FILE)) as f:
-            meta = ModelMeta.from_json(f.read())
+            raw_meta = f.read()
+        meta = ModelMeta.from_json(raw_meta)
+        extra = json.loads(raw_meta).get("extra", {})
         if model is None:
             model = load_model_config(path)
         tables = {}
@@ -220,7 +227,10 @@ class StandaloneModel:
             tables[v.storage_name] = entry
         z = np.load(os.path.join(path, "dense_params.npz"))
         dense_params = _unflatten_params({k: z[k] for k in z.files})
-        return cls(meta, tables, dense_params, model)
+        out = cls(meta, tables, dense_params, model)
+        out.step = int(extra.get("step", 0))
+        out.model_version = int(extra.get("model_version", 0))
+        return out
 
     @property
     def variable_names(self):
@@ -265,6 +275,109 @@ class StandaloneModel:
         """Flat dense-tower params (the export's dense_params.npz content)."""
         return {k: np.asarray(v)
                 for k, v in _flatten_params(self.dense_params).items()}
+
+    # -- online model sync (sync/subscriber.py) ------------------------------
+
+    def apply_update(self, tables: Dict[str, tuple], dense_flat: Dict[str, Any],
+                     *, step: int, model_version: Optional[int] = None
+                     ) -> "StandaloneModel":
+        """One committed delta applied FUNCTIONALLY -> a NEW servable.
+
+        `tables`: {name: (int64 ids, (n, dim) float32 rows)} — the touched
+        rows of one `persist.IncrementalPersister` delta (weights only; a
+        serving replica never carries optimizer slots). `dense_flat`: the
+        delta's FULL flat dense-param tree (`params/...` keys already
+        stripped), including `__embeddings__/<name>` entries for
+        sparse_as_dense tables — those route into their exported array tables.
+
+        RCU contract: `self` is never mutated — hash tables merge into fresh
+        id/weight arrays (update rows win over existing, sort order kept so
+        `lookup`'s binary search stays valid) and array tables update through
+        a functional `.at[].set` — so in-flight predicts on the OLD servable
+        finish unperturbed while `ModelManager.swap` publishes the new one.
+        Any validation failure raises with `self` untouched: the caller's
+        rollback is simply "keep serving the old servable"."""
+        new_tables = dict(self._tables)
+        for name, (ids64, rows) in tables.items():
+            t = new_tables.get(name)
+            if t is None:
+                raise KeyError(f"delta updates unknown variable {name!r}")
+            ids64 = np.asarray(ids64, np.int64).reshape(-1)
+            rows = np.asarray(rows, np.float32)
+            if rows.shape != (ids64.size, int(t["dim"])):
+                raise ValueError(
+                    f"delta rows for {name!r} have shape {rows.shape}, "
+                    f"expected ({ids64.size}, {t['dim']}) — torn payload?")
+            if ids64.size == 0:
+                continue
+            if t["kind"] == "hash":
+                cur_w = np.asarray(t["weights"])
+                all_ids = np.concatenate([t["ids"], ids64])
+                all_w = np.concatenate([cur_w, rows.astype(cur_w.dtype)])
+                # unique over the REVERSED concat: the first occurrence there
+                # is the LAST here, so delta rows supersede existing ones
+                uniq, ridx = np.unique(all_ids[::-1], return_index=True)
+                sel = all_ids.size - 1 - ridx
+                new_tables[name] = {"kind": "hash", "ids": uniq,
+                                    "weights": jnp.asarray(all_w[sel]),
+                                    "dim": t["dim"]}
+            else:
+                w = t["weights"]
+                ok = (ids64 >= 0) & (ids64 < w.shape[0])
+                if not ok.all():
+                    raise ValueError(
+                        f"delta ids for array variable {name!r} fall outside "
+                        f"[0, {w.shape[0]}) — wrong model or torn payload")
+                # array-table vocab < 2^31, so int32 indices are safe even
+                # with x64 disabled in the serving process
+                new_w = w.at[jnp.asarray(ids64.astype(np.int32))].set(
+                    jnp.asarray(rows.astype(np.asarray(w).dtype)))
+                new_tables[name] = {**t, "weights": new_w}
+
+        emb_prefix = "__embeddings__/"
+        cur_flat = _flatten_params(self.dense_params)
+        incoming = {k: v for k, v in dense_flat.items()
+                    if not k.startswith(emb_prefix)}
+        if set(incoming) != set(cur_flat):
+            raise ValueError(
+                "delta dense tree does not match the servable's: "
+                f"missing {sorted(set(cur_flat) - set(incoming))[:3]}, "
+                f"unexpected {sorted(set(incoming) - set(cur_flat))[:3]}")
+        new_flat = {}
+        for k, cur in cur_flat.items():
+            v = np.asarray(incoming[k])
+            if v.shape != tuple(np.shape(cur)):
+                raise ValueError(
+                    f"delta dense param {k!r} has shape {v.shape}, "
+                    f"expected {tuple(np.shape(cur))}")
+            new_flat[k] = jnp.asarray(v.astype(np.asarray(cur).dtype))
+        for k, v in dense_flat.items():
+            if not k.startswith(emb_prefix):
+                continue
+            name = k[len(emb_prefix):]
+            t = new_tables.get(name)
+            if t is None:  # sparse_as_dense table not in this export: skip
+                continue
+            v = np.asarray(v)
+            if v.shape != tuple(np.shape(t["weights"])):
+                raise ValueError(
+                    f"delta rows for sparse_as_dense {name!r} have shape "
+                    f"{v.shape}, expected {tuple(np.shape(t['weights']))}")
+            new_tables[name] = {**t, "weights": jnp.asarray(
+                v.astype(np.asarray(t["weights"]).dtype))}
+
+        out = StandaloneModel(self.meta, new_tables,
+                              _unflatten_params(new_flat), self.model)
+        out.step = int(step)
+        out.model_version = (int(model_version) if model_version is not None
+                             else self.model_version)
+        # the jitted forward closes over the module only (params are call
+        # arguments), so the compiled program is shared across versions
+        out._predict_fn = self._predict_fn
+        cached = getattr(self, "_pooled_features_cache", None)
+        if cached is not None:
+            out._pooled_features_cache = cached
+        return out
 
     def lookup(self, name: str, ids) -> jax.Array:
         """Read-only pull: absent/out-of-range ids -> zero rows (reference

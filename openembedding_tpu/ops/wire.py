@@ -29,10 +29,11 @@ scale 0, count 0 in every format, so no validity mask rides the wire.
 from __future__ import annotations
 
 import os
-from typing import Optional
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 WIRE_ENV = "OETPU_WIRE"
 DEFAULT_WIRE = "bf16"
@@ -130,6 +131,76 @@ def decode_rows(wire: jax.Array, dim: int, fmt: str) -> jax.Array:
     if fmt == "int8":
         return _dequantize_int8(wire, dim)
     return wire.astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# Host-side row codecs (numpy) — the online-sync wire (`sync/`).
+#
+# The model-sync feed ships delta rows trainer -> serving replica over HTTP;
+# neither edge wants a device round-trip just to (de)quantize, so the same
+# three formats get a pure-numpy implementation. Semantics match the jnp
+# codecs above: bf16 truncates with round-to-nearest-even (what
+# `astype(bfloat16)` does in XLA), int8 is symmetric per-row max-abs with the
+# fp32 scale riding as 4 bitcast lanes. bf16 payloads are REPRESENTED as
+# uint16 (numpy has no native bfloat16); `fmt` travels beside the payload.
+# ---------------------------------------------------------------------------
+
+
+def np_wire_dtype(fmt: str):
+    """The numpy dtype an encoded row payload is stored/shipped as."""
+    return {"fp32": np.float32, "bf16": np.uint16, "int8": np.int8}[fmt]
+
+
+def np_encode_rows(rows: np.ndarray, fmt: str) -> np.ndarray:
+    """(n, d) float rows -> host wire payload (n, rows_wire_width(d, fmt))."""
+    rows = np.ascontiguousarray(rows, np.float32)
+    if fmt == "fp32":
+        return rows
+    if fmt == "bf16":
+        u = rows.view(np.uint32)
+        # round-to-nearest-even truncation to the high 16 bits
+        bias = np.uint32(0x7FFF) + ((u >> np.uint32(16)) & np.uint32(1))
+        return ((u + bias) >> np.uint32(16)).astype(np.uint16)
+    amax = np.max(np.abs(rows), axis=1) if rows.shape[1] else \
+        np.zeros((rows.shape[0],), np.float32)
+    scale = (amax / 127.0).astype(np.float32)
+    inv = np.zeros_like(scale)
+    np.divide(np.float32(1.0), scale, out=inv, where=scale > 0)
+    q = np.clip(np.rint(rows * inv[:, None]), -127, 127).astype(np.int8)
+    scale_lanes = np.ascontiguousarray(scale.reshape(-1, 1)).view(np.int8)
+    return np.concatenate([q, scale_lanes], axis=1)
+
+
+def np_decode_rows(wire: np.ndarray, dim: int, fmt: str) -> np.ndarray:
+    """Inverse of np_encode_rows -> (n, dim) float32."""
+    if fmt == "fp32":
+        return np.asarray(wire, np.float32)
+    if fmt == "bf16":
+        u16 = np.ascontiguousarray(wire, dtype=np.uint16)
+        return (u16.astype(np.uint32) << np.uint32(16)).view(np.float32)
+    w = np.ascontiguousarray(wire, dtype=np.int8)
+    scale = np.ascontiguousarray(
+        w[:, dim:dim + _SCALE_LANES]).view(np.float32).reshape(-1)
+    return w[:, :dim].astype(np.float32) * scale[:, None]
+
+
+def sync_delta_cost(tables: Dict[str, Tuple[int, int]], fmt: str) -> dict:
+    """Static wire cost of shipping ONE committed delta to a serving replica
+    (`sync/publisher.py` serves it, `utils/metrics.observe_sync_cost` gauges
+    it): per table {name: (touched_rows, dim)}, ids travel as exact int64
+    (8 B/row — never quantized, like the exchange's id lanes) and rows as the
+    chosen wire format. Optimizer slots never ride this wire at all — the
+    serving feed is weights-only, so even fp32 sync ships ~half the bytes the
+    delta holds on disk."""
+    bytes_ids = bytes_rows = rows_total = 0
+    w = np.dtype(np_wire_dtype(fmt)).itemsize
+    for _name, (n, dim) in tables.items():
+        bytes_ids += n * 8
+        bytes_rows += n * rows_wire_width(dim, fmt) * w
+        rows_total += n
+    return {"format": fmt, "rows": int(rows_total),
+            "bytes_ids": int(bytes_ids), "bytes_rows": int(bytes_rows),
+            "bytes_total": int(bytes_ids + bytes_rows)}
 
 
 # ---------------------------------------------------------------------------

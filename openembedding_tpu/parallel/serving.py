@@ -117,6 +117,11 @@ class ShardedModel:
         self._lookup_fns: Dict[str, Any] = {}
         self._predict_fn = None
         self._resident_cache: Dict[str, np.ndarray] = {}
+        self._apply_fns: Dict[tuple, Any] = {}  # online-sync row writers
+        # training step / model_version of the loaded weights (sync feed
+        # negotiation, same contract as StandaloneModel.step)
+        self.step = 0
+        self.model_version = 0
 
     # -- loading -------------------------------------------------------------
 
@@ -199,8 +204,11 @@ class ShardedModel:
                     f"variable {name!r}: {int(np.asarray(ts.overflow))} "
                     f"checkpointed ids did not fit the serving hash table "
                     f"(shard skew?); raise the serving shard count")
-        return cls(meta, specs, state.tables, state.dense_params, mesh,
-                   model=model)
+        out = cls(meta, specs, state.tables, state.dense_params, mesh,
+                  model=model)
+        out.step = int(np.asarray(state.step))
+        out.model_version = int(np.asarray(state.model_version))
+        return out
 
     # -- serving reads ---------------------------------------------------------
 
@@ -267,6 +275,144 @@ class ShardedModel:
             weights=P(self.axis, None), slots={},
             keys=P(self.axis) if spec.use_hash_table else None,
             overflow=P() if spec.use_hash_table else None)
+
+    # -- online model sync (sync/subscriber.py) ------------------------------
+
+    def _row_writer(self, name: str, spec: EmbeddingSpec):
+        """Jitted, NON-donating touched-row writer for one table. Hash rows
+        find-or-insert through the same per-shard probe the lookup uses (the
+        `host_offload._make_mesh_admit` body, minus slots and minus donation —
+        the OLD table must keep serving in-flight predicts); array rows
+        scatter at their shard-major index. Compiled once per (table, padded
+        id count) and shared across servable versions via `_apply_fns`."""
+        from ..tables.hash_table import hash_find_or_insert, shard_probe
+
+        S = int(self.mesh.devices.size)
+
+        if spec.use_hash_table:
+            def admit(ts, ids, w_rows, known):
+                keys = ts.keys
+                mine, probe = shard_probe(keys, ids, self.axis)
+                new_keys, slot, oflow = hash_find_or_insert(keys, probe)
+                cap = keys.shape[0]
+                ok = known & mine & (slot < cap)
+                target = jnp.where(ok, slot, cap)
+                weights = ts.weights.at[target].set(
+                    w_rows.astype(ts.weights.dtype), mode="drop")
+                overflow = ts.overflow + jax.lax.psum(oflow, self.axis)
+                return ts.replace(keys=new_keys, weights=weights,
+                                  overflow=overflow)
+
+            return jax.jit(jax.shard_map(
+                admit, mesh=self.mesh,
+                in_specs=(self._table_pspec(spec), P(), P(), P()),
+                out_specs=self._table_pspec(spec), check_vma=False))
+
+        def write(ts, ids, w_rows):
+            from ..persist import _array_global_idx
+            rows_tot = ts.weights.shape[0]
+            ok = (ids >= 0) & (ids < spec.input_dim)
+            tgt = jnp.where(ok, _array_global_idx(ids, rows_tot, S), rows_tot)
+            return ts.replace(weights=ts.weights.at[tgt].set(
+                w_rows.astype(ts.weights.dtype), mode="drop"))
+
+        return jax.jit(write)
+
+    def apply_update(self, tables: Dict[str, tuple], dense_flat: Dict[str, Any],
+                     *, step: int, model_version: Optional[int] = None
+                     ) -> "ShardedModel":
+        """One committed delta applied FUNCTIONALLY -> a NEW ShardedModel
+        (same RCU contract as `StandaloneModel.apply_update`: `self` is
+        untouched, compiled lookup/predict/writer programs are shared across
+        versions, validation failures leave the caller on the old servable).
+
+        `tables`: {name: (int64 ids, (n, dim) f32 rows)}; `dense_flat`: the
+        delta's full flat dense-param tree (here INCLUDING
+        `__embeddings__/...` — a sharded servable keeps those in
+        dense_params). A hash row set that no longer fits the serving table
+        raises (overflow would silently serve zeros) — that servable needs a
+        reload at a bigger shard count, the documented DEGRADED exit."""
+        from ..checkpoint import _flatten_params, _unflatten_params
+        from ..ops.id64 import np_split_ids
+        from ..persist import _ceil_pow2
+
+        new_tables = dict(self.tables)
+        for name, (ids64, rows) in tables.items():
+            spec = self.specs.get(name)
+            if spec is None:
+                raise KeyError(f"delta updates unknown variable {name!r}")
+            if spec.sparse_as_dense:
+                continue  # rides in dense_flat's __embeddings__ entries
+            ids64 = np.asarray(ids64, np.int64).reshape(-1)
+            rows = np.asarray(rows, np.float32)
+            if rows.shape != (ids64.size, spec.output_dim):
+                raise ValueError(
+                    f"delta rows for {name!r} have shape {rows.shape}, "
+                    f"expected ({ids64.size}, {spec.output_dim}) — torn "
+                    "payload?")
+            n = ids64.size
+            if n == 0:
+                continue
+            ts = self.tables[name]
+            padded = _ceil_pow2(n)
+            ids_p = np.concatenate(
+                [ids64, np.full((padded - n,), -1, np.int64)])
+            w_p = jnp.asarray(np.concatenate(
+                [rows, np.zeros((padded - n, rows.shape[1]), rows.dtype)]))
+            key = (name, padded)
+            if key not in self._apply_fns:
+                self._apply_fns[key] = self._row_writer(name, spec)
+            if spec.use_hash_table:
+                pair = ts.keys.ndim == 2
+                ids_dev = jnp.asarray(np_split_ids(ids_p) if pair
+                                      else ids_p.astype(ts.keys.dtype))
+                known = jnp.asarray(np.arange(padded) < n)
+                new_ts = self._apply_fns[key](ts, ids_dev, w_p, known)
+                grew = (int(np.asarray(new_ts.overflow))
+                        - int(np.asarray(ts.overflow)))
+                if grew > 0:
+                    raise RuntimeError(
+                        f"variable {name!r}: {grew} delta ids did not fit the "
+                        "serving hash table — reload the model (bigger shard "
+                        "count) to resume syncing")
+            else:
+                if not ((ids64 >= 0) & (ids64 < spec.input_dim)).all():
+                    raise ValueError(
+                        f"delta ids for array variable {name!r} fall outside "
+                        f"[0, {spec.input_dim}) — wrong model or torn payload")
+                new_ts = self._apply_fns[key](
+                    ts, jnp.asarray(ids_p.astype(np.int32)), w_p)
+            new_tables[name] = new_ts
+
+        cur_flat = _flatten_params(self.dense_params)
+        if set(dense_flat) != set(cur_flat):
+            raise ValueError(
+                "delta dense tree does not match the servable's: "
+                f"missing {sorted(set(cur_flat) - set(dense_flat))[:3]}, "
+                f"unexpected {sorted(set(dense_flat) - set(cur_flat))[:3]}")
+        new_flat = {}
+        for k, cur in cur_flat.items():
+            v = np.asarray(dense_flat[k])
+            if v.shape != tuple(np.shape(cur)):
+                raise ValueError(
+                    f"delta dense param {k!r} has shape {v.shape}, "
+                    f"expected {tuple(np.shape(cur))}")
+            arr = jnp.asarray(v.astype(np.asarray(cur).dtype))
+            sh = getattr(cur, "sharding", None)
+            new_flat[k] = jax.device_put(arr, sh) if sh is not None else arr
+
+        out = ShardedModel(self.meta, self.specs, new_tables,
+                           _unflatten_params(new_flat), self.mesh,
+                           model=self.model)
+        out.step = int(step)
+        out.model_version = (int(model_version) if model_version is not None
+                             else self.model_version)
+        # compiled programs and the apply cache are version-independent
+        out._lookup_fns = self._lookup_fns
+        out._predict_fn = self._predict_fn
+        out._apply_fns = self._apply_fns
+        # _resident_cache is NOT carried: hash inserts change the id set
+        return out
 
     def _lookup_fn(self, name: str):
         """shard_map'd read-only pull; the request ids are replicated (serving

@@ -99,7 +99,7 @@ class AsyncPersister:
     def __init__(self, trainer, model, root: str, *, window: int = 2,
                  keep: int = 2, include_optimizer: bool = True,
                  policy: Optional[PersistPolicy] = None,
-                 commit_timeout: float = 600.0):
+                 commit_timeout: float = 600.0, prune_deltas: bool = True):
         from .checkpoint import save_server_model  # noqa: F401 (validated import)
 
         if window < 1:
@@ -108,6 +108,7 @@ class AsyncPersister:
         self.model = model
         self.root = root
         self.keep = keep
+        self.prune_deltas = prune_deltas
         self.include_optimizer = include_optimizer
         self.commit_timeout = commit_timeout
         self.policy = policy or PersistPolicy(every_steps=1000)
@@ -256,7 +257,21 @@ class AsyncPersister:
             f.write(str(step))
 
     def _gc(self) -> None:
+        """Retention after every commit (process 0 only): keep the newest
+        `keep` FULL persists, and — unless `prune_deltas=False` — drop every
+        `delta_<step>` at or below the newest full's step: `delta_chain`
+        anchors at the newest committed full, so those deltas are never
+        replayable again, and without pruning a long online-training run
+        leaks one directory per persist interval. The opt-out exists for
+        sync publishers (`sync/publisher.py`) that deliberately retain
+        history for slow subscribers; with pruning on, size
+        `full_every * keep` to cover the worst-case subscriber lag."""
         persists = list_persists(self.root)
+        if self.prune_deltas and persists:
+            newest_full = persists[-1][0]
+            for step, path in list_deltas(self.root):
+                if step <= newest_full:
+                    shutil.rmtree(path, ignore_errors=True)
         for _, path in persists[:-self.keep] if self.keep > 0 else []:
             shutil.rmtree(path, ignore_errors=True)
 
@@ -722,17 +737,6 @@ class IncrementalPersister(AsyncPersister):
                 "tables": sorted(tables), **scalars}
         with open(os.path.join(tmp, "meta.json"), "w") as f:
             json.dump(meta, f)
-
-    def _gc(self) -> None:
-        """Chain-aware GC: a newly committed full supersedes all older deltas;
-        fulls keep the AsyncPersister policy."""
-        persists = list_persists(self.root)
-        if persists:
-            newest_full = persists[-1][0]
-            for step, path in list_deltas(self.root):
-                if step <= newest_full:
-                    shutil.rmtree(path, ignore_errors=True)
-        super()._gc()
 
 
 def _load_delta_table(path: str, name: str):

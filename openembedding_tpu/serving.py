@@ -270,6 +270,25 @@ class ModelManager:
         with self._lock:
             self._cache.pop(model_sign, None)
 
+    def swap(self, model_sign: str, servable, *, expected=None) -> None:
+        """RCU publish of a new servable version (online sync,
+        `sync/subscriber.py`): requests that already resolved the old object
+        finish on it; the next `find_model` returns the new one. With
+        `expected`, the swap is conditional — it refuses when the cached
+        servable is no longer the one the update was derived from (a
+        concurrent reload/delete won the race; the subscriber re-syncs from
+        the fresh servable's version instead of clobbering it)."""
+        with self._lock:
+            cur = self._cache.get(model_sign)
+            if cur is None:
+                raise KeyError(
+                    f"model {model_sign!r} is not loaded; cannot swap")
+            if expected is not None and cur is not expected:
+                raise RuntimeError(
+                    f"model {model_sign!r} was reloaded concurrently; "
+                    "swap abandoned")
+            self._cache[model_sign] = servable
+
     def load_model(self, model_sign: str, uri: str, *, replica_num: int = 1,
                    shard_num: int = 1) -> dict:
         """create_model + validate-load + NORMAL/ERROR transition (the controller's
@@ -295,6 +314,8 @@ class ModelManager:
 class ServingHandler(BaseHTTPRequestHandler):
     manager: ModelManager = None  # set by make_server
     batcher: "Optional[MicroBatcher]" = None  # set when batching is enabled
+    publishers: dict = {}   # model_sign -> sync.SyncPublisher (make_server)
+    subscribers: dict = {}  # model_sign -> sync.SyncSubscriber (make_server)
     node_info: dict = {}
     quiet = True
 
@@ -304,11 +325,22 @@ class ServingHandler(BaseHTTPRequestHandler):
         if not self.quiet:
             super().log_message(fmt, *args)
 
-    def _json(self, code: int, payload) -> None:
+    def _json(self, code: int, payload, headers: Optional[dict] = None) -> None:
         body = json.dumps(payload).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _blob(self, body: bytes, headers: Optional[dict] = None) -> None:
+        self.send_response(200)
+        self.send_header("Content-Type", "application/octet-stream")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
 
@@ -326,7 +358,13 @@ class ServingHandler(BaseHTTPRequestHandler):
         parts = urlsplit(self.path)
         self.query = {k: v[-1] for k, v in parse_qs(parts.query).items()}
         path = parts.path.rstrip("/")
-        m = re.fullmatch(r"/models/([A-Za-z0-9._-]+)(?::(\w+)|/(pull|predict))?",
+        m = re.fullmatch(
+            r"/models/([A-Za-z0-9._-]+)/delta/(\d+)"
+            r"/(meta|dense|table/[A-Za-z0-9._-]+)", path)
+        if m:
+            return "delta", m.group(1), (int(m.group(2)), m.group(3))
+        m = re.fullmatch(r"/models/([A-Za-z0-9._-]+)"
+                         r"(?::(\w+)|/(pull|predict|publish|sync))?",
                          path)
         if m:
             return "model", m.group(1), m.group(2) or m.group(3)
@@ -362,6 +400,51 @@ class ServingHandler(BaseHTTPRequestHandler):
         try:
             if kind == "models":
                 return self._json(200, self.manager.registry.show_models())
+            if kind == "model" and action == "versions":
+                # online-sync feed (sync/publisher.py): ETag = head commit
+                # step; ?after=<step>&wait_s=<s> bounded long-poll -> 304
+                # when nothing newer commits inside the window
+                pub = self.publishers.get(sign)
+                if pub is None:
+                    return self._json(
+                        404, {"error": f"model {sign!r} has no publisher"})
+                after = self.query.get("after")
+                after = (self._coerce(int, after, "after")
+                         if after is not None else None)
+                wait_s = self._coerce(float, self.query.get("wait_s", 0.0),
+                                      "wait_s")
+                feed, changed = pub.wait_versions(after, wait_s)
+                etag = {"ETag": f'"{feed["head_step"]}"'}
+                if not changed:
+                    self.send_response(304)
+                    self.send_header("ETag", etag["ETag"])
+                    self.end_headers()
+                    return None
+                return self._json(200, feed, headers=etag)
+            if kind == "model" and action == "syncstate":
+                sub = self.subscribers.get(sign)
+                if sub is None:
+                    return self._json(
+                        404, {"error": f"model {sign!r} has no subscriber"})
+                return self._json(200, sub.status())
+            if kind == "delta":
+                pub = self.publishers.get(sign)
+                if pub is None:
+                    return self._json(
+                        404, {"error": f"model {sign!r} has no publisher"})
+                step, fname = action
+                etag = {"ETag": f'"{step}"'}  # committed deltas are immutable
+                if fname == "meta":
+                    return self._json(200, pub.delta_meta(step), headers=etag)
+                if fname == "dense":
+                    return self._blob(pub.delta_dense(step), headers=etag)
+                name = fname[len("table/"):]
+                fmt = self.query.get("wire")
+                if fmt is not None:
+                    from .ops.wire import wire_format
+                    fmt = self._coerce(wire_format, fmt, "wire")
+                return self._blob(pub.delta_table(step, name, fmt),
+                                  headers=etag)
             if kind == "model" and action in ("exportmeta", "rows", "dense"):
                 # live-replica restore surface (reference
                 # `EmbeddingRestoreOperator.cpp:19-106`: iterate a live
@@ -448,6 +531,36 @@ class ServingHandler(BaseHTTPRequestHandler):
                     shard_num=self._coerce(int, body.get("shard_num", 1),
                                            "shard_num"))
                 return self._json(200, entry)
+            if kind == "model" and action == "publish":
+                # register this node as the sync publisher for `sign`:
+                # POST /models/<sign>/publish {"persist_root": ..., "wire": ...}
+                from .sync import SyncPublisher
+                root = self._field(body, "persist_root", "root")
+                if not os.path.isdir(root):
+                    raise _BadRequest(f"persist_root {root!r} is not a "
+                                      "directory")
+                pub = SyncPublisher(root, wire=body.get("wire"))
+                self.publishers[sign] = pub
+                return self._json(200, {"model_sign": sign,
+                                        **pub.versions()})
+            if kind == "model" and action == "sync":
+                # attach a live subscriber on this serving node:
+                # POST /models/<sign>/sync {"feed": url, "interval_s": ...,
+                #                           "wire": ..., "wait_s": ...}
+                from .sync import SyncSubscriber
+                feed = self._field(body, "feed")
+                old = self.subscribers.pop(sign, None)
+                if old is not None:
+                    old.stop()
+                sub = SyncSubscriber(
+                    self.manager, sign, feed,
+                    wire=body.get("wire"),
+                    interval_s=self._coerce(
+                        float, body.get("interval_s", 1.0), "interval_s"),
+                    wait_s=self._coerce(
+                        float, body.get("wait_s", 0.0), "wait_s"))
+                self.subscribers[sign] = sub.start()
+                return self._json(200, sub.status())
             if kind == "model" and action == "pull":
                 model, variable = self.manager.find_model_variable(
                     sign, self._field(body, "variable"))
@@ -502,6 +615,9 @@ class ServingHandler(BaseHTTPRequestHandler):
         kind, sign, _ = self._route()
         try:
             if kind == "model":
+                sub = self.subscribers.pop(sign, None)
+                if sub is not None:
+                    sub.stop()  # a deleted model must not keep syncing
                 self.manager.registry.set_status(sign, "DELETING")
                 self.manager.evict(sign)
                 self.manager.registry.delete_model(sign)
@@ -666,7 +782,7 @@ class MicroBatcher:
         window would turn a mid-window DELETE into the wrong error class)."""
         n = self._request_rows(batch)
         entry = {"batch": batch, "n": n, "done": threading.Event(),
-                 "out": None, "err": None}
+                 "out": None, "err": None, "t0": time.monotonic()}
         key = self._group_key(sign, batch)
         with self._lock:
             group = self._groups.setdefault(key, [])
@@ -705,6 +821,17 @@ class MicroBatcher:
 
     def _run_chunk(self, model, group: list) -> None:
         from .utils import metrics
+        # window tunability (the `window_ms` knob): how long requests parked
+        # waiting for companions, and how full the merged batch came out —
+        # published next to predict_batches/predict_requests so the trade
+        # reads straight off /metrics instead of guesswork
+        now = time.monotonic()
+        for e in group:
+            metrics.observe("serving.batch_wait_ms",
+                            (now - e["t0"]) * 1e3, "avg")
+        metrics.observe("serving.batch_fill_ratio",
+                        min(1.0, sum(e["n"] for e in group) / self.max_batch),
+                        "avg")
         try:
             batches = [e["batch"] for e in group]
             merged = {"sparse": {
@@ -741,9 +868,17 @@ def restore_from_peer(peer: str, model_sign: str, dest: str, *,
     standalone export under `dest` — no shared filesystem required. Register
     `dest` with the local node (POST /models) to finish the restore.
 
+    Crash safety: everything pages into `dest + ".tmp-<pid>"` and renames
+    into place only after the LAST byte (meta/config included) is on disk —
+    a mid-page peer death, timeout, or local crash can never leave a
+    half-written export at `dest` for a later `ModelManager.create_model`
+    to happily load. A pre-existing `dest` (e.g. a prior complete restore)
+    is replaced only at that final swap.
+
     Returns `dest`. Raises on a peer error or a non-NORMAL model.
     """
     import io
+    import shutil
     import urllib.request
     from urllib.parse import quote
 
@@ -757,6 +892,29 @@ def restore_from_peer(peer: str, model_sign: str, dest: str, *,
             f"peer model {model_sign!r} is {entry.get('status')!r}, "
             "not restorable")
     manifest = json.loads(get(f"/models/{model_sign}:exportmeta"))
+
+    tmp = dest.rstrip("/\\") + f".tmp-{os.getpid()}"
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    try:
+        _page_restore(get, manifest, model_sign, tmp, peer, page,
+                      final_uri=dest)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)  # never leave partial pages
+        raise
+    if os.path.exists(dest):
+        shutil.rmtree(dest)  # replaced only once tmp is COMPLETE
+    os.replace(tmp, dest)
+    return dest
+
+
+def _page_restore(get, manifest, model_sign: str, dest: str, peer: str,
+                  page: int, final_uri: str) -> None:
+    """Page the peer's rows/dense/meta into `dest` (restore_from_peer's
+    staging dir — the caller owns atomic-rename/cleanup); the written meta
+    records `final_uri`, where the export will land after the rename."""
+    import io
+    from urllib.parse import quote
 
     os.makedirs(dest, exist_ok=True)
     for v in manifest["variables"]:
@@ -790,7 +948,7 @@ def restore_from_peer(peer: str, model_sign: str, dest: str, *,
              **{k: dense[k] for k in dense.files})
 
     meta = dict(manifest["meta"])
-    meta["uri"] = dest
+    meta["uri"] = final_uri
     meta["num_shards"] = 1  # the restored artifact is a standalone export
     # keep the written meta consistent with the written files: the peer's meta
     # may describe a sharded checkpoint (dense_manifest incl. __embeddings__/
@@ -807,14 +965,19 @@ def restore_from_peer(peer: str, model_sign: str, dest: str, *,
         from .export import MODEL_CONFIG_FILE
         with open(os.path.join(dest, MODEL_CONFIG_FILE), "w") as f:
             json.dump(manifest["model_config"], f, indent=2, sort_keys=True)
-    return dest
 
 
 def make_server(registry_root: str, host: str = "127.0.0.1", port: int = 0, *,
-                batch_window_ms: float = 0.0, max_batch: int = 4096
+                batch_window_ms: float = 0.0, max_batch: int = 4096,
+                publish: Optional[Dict[str, str]] = None,
+                publish_wire: Optional[str] = None
                 ) -> ThreadingHTTPServer:
     """Build (not start) the serving HTTP server; port 0 picks a free port.
-    `batch_window_ms > 0` turns on predict micro-batching (`MicroBatcher`)."""
+    `batch_window_ms > 0` turns on predict micro-batching (`MicroBatcher`).
+    `publish` ({model_sign: persist_root}) registers online-sync publishers
+    (the trainer-side half of `sync/`; more can be added at runtime via
+    POST /models/<sign>/publish, and subscribers attach via
+    POST /models/<sign>/sync)."""
     registry = ModelRegistry(registry_root)
     manager = ModelManager(registry)
 
@@ -825,11 +988,20 @@ def make_server(registry_root: str, host: str = "127.0.0.1", port: int = 0, *,
     Handler.batcher = (MicroBatcher(manager, window_ms=batch_window_ms,
                                     max_batch=max_batch)
                        if batch_window_ms > 0 else None)
+    Handler.publishers = {}
+    Handler.subscribers = {}
+    if publish:
+        from .sync import SyncPublisher
+        for sign, root in publish.items():
+            Handler.publishers[sign] = SyncPublisher(root, wire=publish_wire)
     Handler.node_info = {"node_id": f"{os.uname().nodename}:{os.getpid()}",
                          "registry": registry_root,
-                         "batch_window_ms": batch_window_ms}
+                         "batch_window_ms": batch_window_ms,
+                         "publishes": sorted(Handler.publishers)}
     httpd = ThreadingHTTPServer((host, port), Handler)
     httpd.manager = manager
+    httpd.publishers = Handler.publishers
+    httpd.subscribers = Handler.subscribers
     return httpd
 
 
@@ -845,16 +1017,50 @@ def main(argv=None) -> int:
                          "role)")
     ap.add_argument("--max-batch", type=int, default=4096,
                     help="largest merged predict batch (rows)")
+    ap.add_argument("--publish", action="append", default=[],
+                    metavar="SIGN=PERSIST_ROOT",
+                    help="serve this persist root's committed delta chain as "
+                         "the online-sync feed for SIGN (repeatable)")
+    ap.add_argument("--sync-from", action="append", default=[],
+                    metavar="SIGN=FEED_URL",
+                    help="keep the loaded model SIGN fresh against a "
+                         "publisher node's feed (repeatable; the model must "
+                         "be loaded on this node)")
+    ap.add_argument("--sync-interval", type=float, default=1.0,
+                    help="subscriber poll interval, seconds")
+    ap.add_argument("--sync-wire", default=None,
+                    help="row encoding on the sync wire "
+                         "(fp32|bf16|int8; default fp32)")
     args = ap.parse_args(argv)
+
+    def kv(pairs, what):
+        out = {}
+        for p in pairs:
+            if "=" not in p:
+                ap.error(f"--{what} expects SIGN=VALUE, got {p!r}")
+            k, v = p.split("=", 1)
+            out[k] = v
+        return out
+
     httpd = make_server(args.registry, args.host, args.port,
                         batch_window_ms=args.batch_window_ms,
-                        max_batch=args.max_batch)
+                        max_batch=args.max_batch,
+                        publish=kv(args.publish, "publish"),
+                        publish_wire=args.sync_wire)
+    from .sync import SyncSubscriber
+    for sign, feed in kv(args.sync_from, "sync-from").items():
+        httpd.subscribers[sign] = SyncSubscriber(
+            httpd.manager, sign, feed, wire=args.sync_wire,
+            interval_s=args.sync_interval).start()
     print(f"serving on http://{args.host}:{httpd.server_address[1]} "
           f"(registry: {args.registry})")
     try:
         httpd.serve_forever()
     except KeyboardInterrupt:
         pass
+    finally:
+        for sub in httpd.subscribers.values():
+            sub.stop()
     return 0
 
 

@@ -1,0 +1,37 @@
+"""Online model sync — stream committed embedding deltas from a training run
+into live serving replicas, no restart, no full reload.
+
+The reference keeps serving replicas fresh by replicating models on the PS and
+restoring dead nodes from live peers (HA serving mode,
+`server/EmbeddingRestoreOperator.cpp`); its TF-Serving surface still reloads a
+full SavedModel per version. Here the training side already commits exactly
+the right artifact — `persist.IncrementalPersister`'s `delta_<step>`
+directories hold only the rows touched since the previous persist, chained by
+parent pointers (a sparse row-update stream in the SparCML sense,
+arxiv 1802.08021) — so serving freshness becomes a transport problem:
+
+- `publisher.SyncPublisher` exposes a persist root's committed base/delta
+  chain as a versioned HTTP feed on the existing serving surface
+  (`GET /models/<sign>:versions`, `GET /models/<sign>/delta/<step>/...`),
+  with optional bf16/int8 row encoding on the wire (`ops/wire` numpy codecs;
+  EQuARX-style quantized transport, arxiv 2506.17615);
+- `subscriber.SyncSubscriber` runs inside a serving node: negotiates its
+  servable's version against the feed, fetches only the missing delta suffix,
+  validates the parent-pointer chain (`persist.delta_chain` semantics: apply
+  a consistent prefix, never a torn mix), applies rows off the predict path
+  and atomically swaps the servable in `ModelRegistry`'s manager (RCU:
+  in-flight predicts finish on the old version), rolling back to the last
+  good version on any failed fetch/validate/apply;
+- both halves publish `sync.*` metrics through the existing `/metrics`
+  Prometheus text (version lag, staleness, bytes fetched, apply ms,
+  rollbacks), and the subscriber carries a deliberate fault-injection hook
+  (`FaultInjector`: drop/duplicate/reorder/truncate a delta) so graceful
+  degradation is testable, not aspirational.
+"""
+
+from .publisher import SyncPublisher
+from .subscriber import (FaultInjector, SyncChainError, SyncError,
+                         SyncSubscriber)
+
+__all__ = ["SyncPublisher", "SyncSubscriber", "SyncError", "SyncChainError",
+           "FaultInjector"]

@@ -1,0 +1,272 @@
+"""Subscriber half of online model sync: live delta apply inside a serving node.
+
+Drives a per-model state machine against a publisher feed:
+
+    IDLE ──poll──> FETCHING ──payload ok──> APPLYING ──swap──> IDLE
+      ^                │                        │
+      └── backoff ── DEGRADED <── chain/validate/apply failure ──┘
+
+Every successfully applied delta is published with an ATOMIC servable swap
+(`ModelManager.swap`): predicts that already resolved the old servable finish
+on it untouched (RCU), the next request sees the new version. Because the
+swap happens only after a delta fully validates and applies, "rollback" is
+structural — a failure at ANY point leaves the node serving the last good
+version; `sync.rollbacks` counts those abandonments and the machine enters
+DEGRADED with exponential backoff until the feed yields a consistent chain
+again. A subscriber that has fallen behind the feed's base (its deltas GC'd
+under `persist` retention) cannot catch up incrementally and stays DEGRADED —
+the operator reloads the model (POST /models) to resume; size
+`IncrementalPersister(full_every=..., keep=...)` (or opt out of delta pruning)
+so the retained chain covers the worst-case subscriber lag.
+
+`FaultInjector` is a deliberate chaos hook for tests and soak tooling: it can
+drop, duplicate, reorder or truncate deltas between fetch and apply to prove
+the degradation above is graceful (DEGRADED + rollback + zero failed
+predicts), not theoretical.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional
+from urllib.parse import quote
+
+import numpy as np
+
+from ..ops import wire as wire_mod
+from ..persist import DELTA_FORMAT
+from ..utils import metrics
+
+IDLE, FETCHING, APPLYING, DEGRADED = "IDLE", "FETCHING", "APPLYING", "DEGRADED"
+_STATE_CODE = {IDLE: 0, FETCHING: 1, APPLYING: 2, DEGRADED: 3}
+
+
+class SyncError(RuntimeError):
+    """A sync attempt failed; the node keeps serving the last good version."""
+
+
+class SyncChainError(SyncError):
+    """The fetched delta does not extend the applied chain (torn, reordered,
+    duplicated, foreign-format, or parent-mismatched payload)."""
+
+
+class FaultInjector:
+    """Deliberate fault injection between fetch and apply. Subclass and
+    override either method; the default is a no-op. `plan` may drop,
+    duplicate or reorder the pending step list; `payload` may corrupt or
+    truncate one fetched delta (return the payload dict, mutated or not)."""
+
+    def plan(self, steps: List[int]) -> List[int]:
+        return steps
+
+    def payload(self, step: int, payload: dict) -> dict:
+        return payload
+
+
+class SyncSubscriber:
+    """Keep one model in a `ModelManager` fresh against a publisher feed.
+
+    Drive it either deterministically — `poll()` per tick (tests, soak) — or
+    with `start()`/`stop()` for the background thread the serving node CLI
+    uses. `feed` is the publisher node's base URL; the model must already be
+    loaded on THIS node (POST /models) before the first poll, and its export
+    step must sit on the feed's chain (export the base persist's state).
+    """
+
+    def __init__(self, manager, model_sign: str, feed: str, *,
+                 wire: Optional[str] = None, interval_s: float = 1.0,
+                 wait_s: float = 0.0, max_backoff_s: float = 30.0,
+                 timeout: float = 30.0, faults: Optional[FaultInjector] = None):
+        self.manager = manager
+        self.model_sign = model_sign
+        self.feed = feed.rstrip("/")
+        self.wire = wire_mod.wire_format(wire or "fp32")
+        self.interval_s = interval_s
+        self.wait_s = wait_s
+        self.max_backoff_s = max_backoff_s
+        self.timeout = timeout
+        self.faults = faults
+        self.state = IDLE
+        self.version: Optional[int] = None
+        self.applied = 0
+        self.last_error: Optional[str] = None
+        self._backoff = 0.0
+        self._head_times: Dict[int, float] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- wire ----------------------------------------------------------------
+
+    def _get(self, path: str):
+        req = urllib.request.Request(f"{self.feed}{path}")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                raw = r.read()
+        except urllib.error.HTTPError as e:
+            if e.code == 304:
+                return None
+            raise SyncError(f"feed {path}: HTTP {e.code}") from e
+        except (urllib.error.URLError, ConnectionError, OSError) as e:
+            raise SyncError(f"feed {path}: {e}") from e
+        metrics.observe("sync.bytes_fetched", len(raw))
+        return raw
+
+    def _get_json(self, path: str):
+        raw = self._get(path)
+        return None if raw is None else json.loads(raw)
+
+    def _get_npz(self, path: str) -> dict:
+        import io
+        raw = self._get(path)
+        with np.load(io.BytesIO(raw)) as z:
+            return {k: z[k] for k in z.files}
+
+    def _fetch_delta(self, step: int) -> dict:
+        """-> {"meta", "tables": {name: (ids, rows_f32)}, "dense": flat}."""
+        sign = quote(self.model_sign, safe="")
+        meta = self._get_json(f"/models/{sign}/delta/{step}/meta")
+        tables = {}
+        for name in meta.get("tables", []):
+            z = self._get_npz(
+                f"/models/{sign}/delta/{step}/table/{quote(name, safe='')}"
+                f"?wire={self.wire}")
+            fmt = str(z["fmt"])
+            rows = wire_mod.np_decode_rows(z["wire"], int(z["dim"]), fmt)
+            tables[name] = (np.asarray(z["ids"], np.int64), rows)
+        dense = self._get_npz(f"/models/{sign}/delta/{step}/dense")
+        return {"meta": meta, "tables": tables, "dense": dense}
+
+    # -- state machine -------------------------------------------------------
+
+    def _set_state(self, state: str) -> None:
+        self.state = state
+        metrics.observe("sync.state", _STATE_CODE[state], "gauge")
+
+    def _observe_lag(self, head: Optional[int]) -> None:
+        if head is None or self.version is None:
+            return
+        metrics.observe("sync.version_lag_steps",
+                        max(0, head - self.version), "gauge")
+        t = self._head_times.get(self.version)
+        if t is not None:
+            metrics.observe("sync.staleness_seconds",
+                            max(0.0, time.time() - t), "gauge")
+
+    def sync_once(self) -> int:
+        """One negotiation round; returns deltas applied. Raises SyncError on
+        any failure — state/metrics handling lives in `poll()`."""
+        servable = self.manager.find_model(self.model_sign)
+        if self.version is None:
+            self.version = int(getattr(servable, "step", 0))
+        sign = quote(self.model_sign, safe="")
+        q = (f"?after={self.version}&wait_s={self.wait_s}"
+             if self.wait_s > 0 else "")
+        feed = self._get_json(f"/models/{sign}:versions{q}")
+        if feed is None:  # 304: nothing newer inside the poll window
+            self._observe_lag(self.version)
+            return 0
+        if feed.get("format") != "oetpu-sync-v1":
+            raise SyncError(f"foreign feed format {feed.get('format')!r}")
+        head = feed.get("head_step")
+        self._head_times.update(
+            {d["step"]: d["commit_time"] for d in feed.get("deltas", [])})
+        self._observe_lag(head)
+        if head is None or head <= self.version:
+            return 0
+        base = feed.get("base_step")
+        chain_steps = [d["step"] for d in feed.get("deltas", [])]
+        if self.version != base and self.version not in chain_steps:
+            raise SyncChainError(
+                f"servable version {self.version} is not on the feed chain "
+                f"(base {base}, deltas {chain_steps[:8]}...): fell behind "
+                "retention — reload the model to resume")
+        pending = [s for s in chain_steps if s > self.version]
+        if self.faults is not None:
+            pending = self.faults.plan(list(pending))
+
+        self._set_state(FETCHING)
+        applied = 0
+        for step in pending:
+            with metrics.vtimer("sync", "fetch"):
+                payload = self._fetch_delta(step)
+            if self.faults is not None:
+                payload = self.faults.payload(step, payload)
+            meta = payload.get("meta") or {}
+            if (meta.get("format") != DELTA_FORMAT
+                    or int(meta.get("step", -1)) != int(step)
+                    or int(meta.get("parent", -1)) != int(self.version)):
+                raise SyncChainError(
+                    f"delta {step} does not extend version {self.version} "
+                    f"(parent={meta.get('parent')}, "
+                    f"format={meta.get('format')!r})")
+            self._set_state(APPLYING)
+            with metrics.vtimer("sync", "apply"):
+                new_servable = servable.apply_update(
+                    payload["tables"], payload["dense"], step=int(step),
+                    model_version=meta.get("model_version"))
+            self.manager.swap(self.model_sign, new_servable,
+                              expected=servable)
+            servable = new_servable
+            self.version = int(step)
+            self.applied += 1
+            applied += 1
+            metrics.observe("sync.applied_deltas", 1)
+            self._observe_lag(head)
+            self._set_state(FETCHING)
+        self._set_state(IDLE)
+        return applied
+
+    def poll(self) -> int:
+        """One guarded tick: sync, or record the failure and degrade.
+        Returns deltas applied (0 on failure — check `.state`/`.last_error`)."""
+        try:
+            applied = self.sync_once()
+        except SyncError as e:
+            self.last_error = str(e)
+            metrics.observe("sync.rollbacks", 1)
+            self._set_state(DEGRADED)
+            self._backoff = min(max(self._backoff * 2, self.interval_s),
+                                self.max_backoff_s)
+            return 0
+        except Exception as e:  # noqa: BLE001 — a bug must not kill the loop
+            self.last_error = f"{type(e).__name__}: {e}"
+            metrics.observe("sync.rollbacks", 1)
+            self._set_state(DEGRADED)
+            self._backoff = min(max(self._backoff * 2, self.interval_s),
+                                self.max_backoff_s)
+            return 0
+        self.last_error = None
+        self._backoff = 0.0
+        return applied
+
+    def status(self) -> dict:
+        return {"model_sign": self.model_sign, "feed": self.feed,
+                "state": self.state, "version": self.version,
+                "applied": self.applied, "wire": self.wire,
+                "last_error": self.last_error}
+
+    # -- background loop -----------------------------------------------------
+
+    def start(self) -> "SyncSubscriber":
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._run, daemon=True)
+            self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self.poll()
+            delay = self._backoff if self.state == DEGRADED else self.interval_s
+            if self._stop.wait(max(delay, 0.01)):
+                return
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=10)

@@ -114,6 +114,15 @@ def observe_exchange_cost(cost: Dict[str, "object"]) -> None:
     observe("exchange.dim_groups", float(cost.get("dim_groups", 0)), "gauge")
 
 
+def observe_sync_cost(cost: Dict[str, "object"]) -> None:
+    """Publish the online-sync wire-cost model of the delta most recently
+    served/applied (`ops/wire.sync_delta_cost`) as gauges — the sync twin of
+    `observe_exchange_cost`, same exposition style (`sync.*` in /metrics)."""
+    observe("sync.wire_bytes_per_delta",
+            float(cost.get("bytes_total", 0)), "gauge")
+    observe("sync.rows_per_delta", float(cost.get("rows", 0)), "gauge")
+
+
 def record_step_stats(stats: Dict[str, "object"]) -> None:
     """Fold a train step's device-side stats dict (`{var}/pull_indices`, `.../
     pull_unique`, `.../pull_overflow`, ...) into host accumulators."""

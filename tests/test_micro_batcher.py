@@ -1,0 +1,159 @@
+"""MicroBatcher concurrency semantics, pinned directly (no HTTP in the loop).
+
+Until now these behaviors were only exercised indirectly through handler
+tests: N threads with mixed group keys must merge ONLY structurally identical
+requests, a poisoned group must deliver its error to exactly its own members,
+and the early-wake-on-full path (`max_batch`) must fire without waiting out
+the window. Also pins the wait/occupancy metrics
+(`serving.batch_wait_ms`/`serving.batch_fill_ratio`) published for tuning the
+`window_ms` knob from /metrics.
+"""
+
+import concurrent.futures
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from openembedding_tpu.serving import MicroBatcher
+from openembedding_tpu.utils import metrics
+
+POISON = 666
+
+
+class FakeModel:
+    """Deterministic per-row 'predict' that records every device call.
+    Output row = sum of the row's ids, so each client's slice is checkable
+    regardless of how requests were merged. A batch containing POISON raises.
+    """
+
+    def __init__(self):
+        self.calls = []
+        self._lock = threading.Lock()
+
+    def predict(self, batch):
+        ids = np.asarray(batch["sparse"]["f"])
+        with self._lock:
+            self.calls.append({
+                "rows": int(ids.shape[0]),
+                "width": int(ids.shape[1]),
+                "features": tuple(sorted(batch["sparse"])),
+            })
+        if (ids == POISON).any():
+            raise RuntimeError("poisoned batch")
+        out = ids.sum(axis=1).astype(np.float32)
+        for k in sorted(batch["sparse"]):
+            if k != "f":
+                out = out + np.asarray(batch["sparse"][k]).sum(axis=1)
+        return out
+
+
+def _batch(ids, extra=None):
+    b = {"sparse": {"f": np.asarray(ids, np.int64)}}
+    if extra is not None:
+        b["sparse"]["g"] = np.asarray(extra, np.int64)
+    return b
+
+
+def _expected(b):
+    out = np.asarray(b["sparse"]["f"]).sum(axis=1).astype(np.float32)
+    if "g" in b["sparse"]:
+        out = out + np.asarray(b["sparse"]["g"]).sum(axis=1)
+    return out
+
+
+def test_mixed_group_keys_merge_only_structural_twins():
+    """3 structure classes fired from 9 threads inside one window: same-width
+    same-feature-set requests merge, everything else stays apart, and every
+    client gets ITS OWN correct slice."""
+    model = FakeModel()
+    mb = MicroBatcher(manager=None, window_ms=250.0)
+    rng = np.random.default_rng(0)
+    reqs = []
+    for i in range(3):  # class A: width 2, feature {f}
+        reqs.append(_batch(rng.integers(0, 50, (2, 2))))
+    for i in range(3):  # class B: width 3, feature {f}
+        reqs.append(_batch(rng.integers(0, 50, (2, 3))))
+    for i in range(3):  # class C: width 2, features {f, g}
+        reqs.append(_batch(rng.integers(0, 50, (2, 2)),
+                           extra=rng.integers(0, 50, (2, 2))))
+
+    with concurrent.futures.ThreadPoolExecutor(len(reqs)) as ex:
+        outs = list(ex.map(lambda b: mb.predict(model, "m", b), reqs))
+
+    for b, out in zip(reqs, outs):
+        np.testing.assert_allclose(np.asarray(out), _expected(b))
+    # merging happened within classes, never across them
+    assert len(model.calls) < len(reqs)
+    for call in model.calls:
+        assert (call["width"], call["features"]) in [
+            (2, ("f",)), (3, ("f",)), (2, ("f", "g"))]
+    merged_rows = sum(c["rows"] for c in model.calls)
+    assert merged_rows == sum(np.asarray(b["sparse"]["f"]).shape[0]
+                              for b in reqs)  # nothing dropped or duplicated
+
+
+def test_poisoned_group_fails_alone():
+    """A group whose merged batch raises delivers that error to exactly its
+    own members; the structurally different group is untouched."""
+    model = FakeModel()
+    mb = MicroBatcher(manager=None, window_ms=250.0)
+    good = [_batch(np.full((2, 3), 7)) for _ in range(2)]
+    bad = [_batch([[1, POISON]]), _batch([[2, 3]])]  # width 2: one group
+
+    with concurrent.futures.ThreadPoolExecutor(4) as ex:
+        good_f = [ex.submit(mb.predict, model, "m", b) for b in good]
+        bad_f = [ex.submit(mb.predict, model, "m", b) for b in bad]
+        for f in good_f:
+            np.testing.assert_allclose(np.asarray(f.result(timeout=30)),
+                                       [21.0, 21.0])
+        for f in bad_f:
+            with pytest.raises(RuntimeError, match="poisoned"):
+                f.result(timeout=30)
+
+
+def test_internally_ragged_request_fails_alone_at_enqueue():
+    """A request whose OWN features disagree on the row count raises before
+    it ever joins a group (never poisoning groupmates)."""
+    from openembedding_tpu.export import RaggedBatchError
+    model = FakeModel()
+    mb = MicroBatcher(manager=None, window_ms=50.0)
+    ragged = {"sparse": {"f": np.zeros((2, 2), np.int64),
+                         "g": np.zeros((3, 2), np.int64)}}
+    with pytest.raises(RaggedBatchError):
+        mb.predict(model, "m", ragged)
+    assert model.calls == []  # never reached the device
+
+
+def test_early_wake_on_max_batch():
+    """A group reaching `max_batch` rows wakes the leader immediately — the
+    requests complete far inside the (deliberately huge) window."""
+    model = FakeModel()
+    mb = MicroBatcher(manager=None, window_ms=30_000.0, max_batch=8)
+    reqs = [_batch(np.full((4, 2), i)) for i in range(2)]  # 8 rows total
+    t0 = time.monotonic()
+    with concurrent.futures.ThreadPoolExecutor(2) as ex:
+        outs = list(ex.map(lambda b: mb.predict(model, "m", b), reqs))
+    elapsed = time.monotonic() - t0
+    assert elapsed < 10.0, "leader slept out the window despite a full group"
+    for b, out in zip(reqs, outs):
+        np.testing.assert_allclose(np.asarray(out), _expected(b))
+
+
+def test_batcher_publishes_wait_and_fill_metrics():
+    """serving.batch_wait_ms / serving.batch_fill_ratio accumulate per merged
+    call, next to the existing predict_batches/predict_requests counters, so
+    window_ms is tunable from /metrics."""
+    model = FakeModel()
+    mb = MicroBatcher(manager=None, window_ms=30.0, max_batch=64)
+    wait = metrics.Accumulator.get("serving.batch_wait_ms", "avg")
+    fill = metrics.Accumulator.get("serving.batch_fill_ratio", "avg")
+    w0, f0 = wait.count, fill.count
+    with concurrent.futures.ThreadPoolExecutor(3) as ex:
+        list(ex.map(lambda b: mb.predict(model, "m", b),
+                    [_batch(np.full((2, 2), i)) for i in range(3)]))
+    assert wait.count > w0
+    assert fill.count > f0
+    assert 0.0 < fill.value() <= 1.0
+    assert wait.value() >= 0.0

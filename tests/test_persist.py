@@ -604,6 +604,36 @@ def test_dirty_tracker_window_semantics():
     assert t.take()["categorical"].size == 0  # window reset
 
 
+def test_superseded_delta_gc_opt_out(setup, tmp_path):
+    """`prune_deltas=False` keeps deltas a newer full has superseded — the
+    retention opt-out for sync publishers that serve history to slow
+    subscribers; the default prunes them (long online runs must not leak one
+    directory per persist interval)."""
+    from openembedding_tpu.persist import IncrementalPersister, list_deltas
+
+    model, trainer, _state, batches = setup
+    step = trainer.jit_train_step()
+    for prune, expect_old_deltas in ((True, False), (False, True)):
+        root = str(tmp_path / f"persist_{prune}")
+        with IncrementalPersister(trainer, model, root, window=2, keep=10,
+                                  policy=PersistPolicy(every_steps=1),
+                                  full_every=2,
+                                  prune_deltas=prune) as p:
+            s = trainer.init(batches[0])  # the step donates its input state
+            for b in batches:  # fulls at 1, 4; deltas at 2, 3, 5, 6
+                s, _ = step(s, b)
+                p.maybe_persist(s, batch=b)
+                p.wait()  # serialize so gc sees each commit
+        newest_full = list_persists(root)[-1][0]
+        assert newest_full == 4
+        old = [d for d, _ in list_deltas(root) if d <= newest_full]
+        assert bool(old) == expect_old_deltas, (prune, old)
+        # either way the replayable chain restores to the newest state
+        restored = restore_server_model(trainer.init(batches[0]), model,
+                                        root, trainer=trainer)
+        assert int(restored.step) == 6
+
+
 def test_delta_chain_broken_link_replays_prefix(setup, tmp_path):
     """Deleting a MIDDLE delta breaks the parent chain: restore replays only
     the consistent prefix (base + first delta), never skipping a link."""

@@ -1,0 +1,589 @@
+"""Online model sync tests (`openembedding_tpu/sync/`).
+
+The acceptance battery for the trainer->serving delta stream: a live serving
+node follows a training run's committed `delta_<step>` chain with no restart
+(publisher feed -> subscriber apply -> RCU servable swap), predictions after
+each sync match a from-scratch export of the same step BIT-exactly at fp32
+wire (within codec tolerance at bf16/int8), and injected torn/reordered/
+dropped deltas leave the node serving the last good version (DEGRADED +
+`sync.rollbacks`, zero failed predicts).
+"""
+
+import json
+import os
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+
+import openembedding_tpu as embed
+from openembedding_tpu.data import synthetic_criteo
+from openembedding_tpu.export import StandaloneModel, export_standalone
+from openembedding_tpu.model import Trainer
+from openembedding_tpu.models import make_deepfm
+from openembedding_tpu.ops import wire as wire_mod
+from openembedding_tpu.persist import (IncrementalPersister, PersistPolicy,
+                                       list_deltas, list_persists)
+from openembedding_tpu.serving import make_server
+from openembedding_tpu.sync import (FaultInjector, SyncSubscriber)
+from openembedding_tpu.utils import metrics
+
+VOCAB = 1 << 10
+
+
+# -- wire codec parity --------------------------------------------------------
+
+
+def test_np_wire_codec_matches_device_codec():
+    """The host (numpy) codecs the sync wire uses must agree BIT-for-bit with
+    the device (jnp) codecs the exchange uses — one wire semantics."""
+    import jax.numpy as jnp
+    rng = np.random.default_rng(0)
+    x = (rng.standard_normal((64, 16)) * 3).astype(np.float32)
+    x[5] = 0.0  # all-zero row: int8 scale-0 path
+    for fmt in ("fp32", "bf16", "int8"):
+        enc = wire_mod.np_encode_rows(x, fmt)
+        enc_dev = np.asarray(wire_mod.encode_rows(jnp.asarray(x), fmt))
+        if fmt == "bf16":
+            enc_dev = enc_dev.view(np.uint16)  # np has no bfloat16
+        np.testing.assert_array_equal(enc, enc_dev)
+        dec = wire_mod.np_decode_rows(enc, 16, fmt)
+        dec_dev = np.asarray(wire_mod.decode_rows(
+            wire_mod.encode_rows(jnp.asarray(x), fmt), 16, fmt))
+        np.testing.assert_array_equal(dec, dec_dev)
+    # fp32 round-trips exactly
+    np.testing.assert_array_equal(
+        wire_mod.np_decode_rows(wire_mod.np_encode_rows(x, "fp32"), 16,
+                                "fp32"), x)
+
+
+def test_sync_delta_cost_model():
+    cost32 = wire_mod.sync_delta_cost({"a": (100, 16)}, "fp32")
+    cost16 = wire_mod.sync_delta_cost({"a": (100, 16)}, "bf16")
+    cost8 = wire_mod.sync_delta_cost({"a": (100, 16)}, "int8")
+    assert cost32["bytes_ids"] == cost16["bytes_ids"] == 800  # ids never shrink
+    assert cost32["bytes_rows"] == 100 * 16 * 4
+    assert cost16["bytes_rows"] == 100 * 16 * 2
+    assert cost8["bytes_rows"] == 100 * (16 + 4)  # + per-row scale lanes
+    assert cost32["bytes_total"] > cost16["bytes_total"] > cost8["bytes_total"]
+
+
+# -- harness ------------------------------------------------------------------
+
+
+def _train_setup(tmp_path, *, seed=0):
+    model = make_deepfm(vocabulary=VOCAB, dim=4, hidden=(8,))
+    trainer = Trainer(model, embed.Adagrad(learning_rate=0.05), seed=seed)
+    batches = list(synthetic_criteo(16, id_space=VOCAB, steps=8, seed=1))
+    state = trainer.init(batches[0])
+    step = trainer.jit_train_step()
+    root = str(tmp_path / "persist")
+    return model, trainer, state, step, batches, root
+
+
+@pytest.fixture()
+def publisher_node(tmp_path):
+    """A serving HTTP server (started) whose publisher map tests fill in."""
+    srv = make_server(str(tmp_path / "reg_pub"))
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}", srv
+    srv.shutdown()
+
+
+@pytest.fixture()
+def serving_node(tmp_path):
+    srv = make_server(str(tmp_path / "reg_srv"))
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}", srv
+    for sub in srv.subscribers.values():
+        sub.stop()
+    srv.shutdown()
+
+
+def _req(url, method="GET", payload=None, headers=None):
+    data = json.dumps(payload).encode() if payload is not None else None
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers={"Content-Type": "application/json",
+                                          **(headers or {})})
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, json.loads(resp.read()), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        return e.code, (json.loads(body) if body else {}), dict(e.headers)
+
+
+# -- publisher feed -----------------------------------------------------------
+
+
+def test_publisher_feed_versions_and_payloads(tmp_path, publisher_node):
+    model, trainer, state, step, batches, root = _train_setup(tmp_path)
+    base, srv = publisher_node
+    with IncrementalPersister(trainer, model, root, window=2,
+                              policy=PersistPolicy(every_steps=1),
+                              full_every=100) as p:
+        for b in batches[:3]:  # full base at 1, deltas at 2, 3
+            state, _ = step(state, b)
+            p.maybe_persist(state, batch=b)
+        p.wait()
+
+        from openembedding_tpu.sync import SyncPublisher
+        srv.publishers["m"] = SyncPublisher(root)
+
+        status, feed, hdr = _req(f"{base}/models/m:versions")
+        assert status == 200
+        assert feed["format"] == "oetpu-sync-v1"
+        assert feed["base_step"] == 1 and feed["head_step"] == 3
+        assert [d["step"] for d in feed["deltas"]] == [2, 3]
+        assert [d["parent"] for d in feed["deltas"]] == [1, 2]
+        assert hdr["ETag"] == '"3"'  # ETag = head commit step
+
+        # bounded poll: nothing newer than head -> 304, ETag still present
+        status, _, hdr = _req(f"{base}/models/m:versions?after=3&wait_s=0.1")
+        assert status == 304 and hdr["ETag"] == '"3"'
+        # behind head -> immediate 200
+        status, feed, _ = _req(f"{base}/models/m:versions?after=1")
+        assert status == 200 and feed["head_step"] == 3
+
+        # delta payloads: meta JSON, table npz (ids exact + wire rows), dense
+        status, meta, hdr = _req(f"{base}/models/m/delta/2/meta")
+        assert status == 200 and meta["parent"] == 1 and hdr["ETag"] == '"2"'
+        import io
+        for fmt in ("fp32", "bf16", "int8"):
+            with urllib.request.urlopen(
+                    f"{base}/models/m/delta/2/table/categorical?wire={fmt}"
+                    ) as r:
+                z = np.load(io.BytesIO(r.read()))
+            assert str(z["fmt"]) == fmt
+            assert z["ids"].dtype == np.int64
+            rows = wire_mod.np_decode_rows(z["wire"], int(z["dim"]), fmt)
+            assert rows.shape == (z["ids"].shape[0], int(z["dim"]))
+        with urllib.request.urlopen(f"{base}/models/m/delta/2/dense") as r:
+            z = np.load(io.BytesIO(r.read()))
+        assert z.files and not any(k.startswith("slots/") for k in z.files)
+
+        # unknown step / table / junk wire format -> 404 / 404 / 400
+        assert _req(f"{base}/models/m/delta/99/meta")[0] == 404
+        assert _req(f"{base}/models/m/delta/2/table/nope")[0] == 404
+        assert _req(f"{base}/models/m/delta/2/table/categorical?wire=xx"
+                    )[0] == 400
+        # no publisher registered for that sign -> 404
+        assert _req(f"{base}/models/other:versions")[0] == 404
+
+
+# -- the acceptance battery ---------------------------------------------------
+
+
+def test_online_sync_end_to_end_bit_exact(tmp_path, publisher_node,
+                                          serving_node):
+    """Trainer commits base + 3 deltas while the serving node answers
+    predicts; each delta applies without restart; after each sync the node's
+    prediction equals a from-scratch export of the same step bit-exactly
+    (fp32 wire); zero failed predicts throughout."""
+    model, trainer, state, step, batches, root = _train_setup(tmp_path)
+    pub_url, pub_srv = publisher_node
+    srv_url, srv = serving_node
+
+    with IncrementalPersister(trainer, model, root, window=2,
+                              policy=PersistPolicy(every_steps=1),
+                              full_every=100) as p:
+        state, _ = step(state, batches[0])
+        p.maybe_persist(state, batch=batches[0])
+        p.wait()
+        export_dir = str(tmp_path / "export")
+        export_standalone(state, model, export_dir, model_sign="sync-0")
+
+        from openembedding_tpu.sync import SyncPublisher
+        pub_srv.publishers["sync-0"] = SyncPublisher(root)
+        srv.manager.load_model("sync-0", export_dir)
+
+        # live predict hammer: runs across every swap below
+        stop = threading.Event()
+        failures = []
+        req_body = {"sparse": {"categorical": np.asarray(
+            batches[0]["sparse"]["categorical"]).tolist()},
+            "dense": np.asarray(batches[0]["dense"]).tolist()}
+
+        def hammer():
+            while not stop.is_set():
+                status, out, _ = _req(f"{srv_url}/models/sync-0/predict",
+                                      "POST", req_body)
+                if status != 200:
+                    failures.append(out)
+
+        t = threading.Thread(target=hammer, daemon=True)
+        t.start()
+        try:
+            sub = SyncSubscriber(srv.manager, "sync-0", pub_url)
+            assert sub.poll() == 0 and sub.version == 1  # negotiated
+
+            for i, b in enumerate(batches[1:4], start=2):
+                state, _ = step(state, b)
+                p.maybe_persist(state, batch=b)
+                p.wait()
+                assert sub.poll() == 1, sub.last_error
+                assert sub.state == "IDLE" and sub.version == i
+
+                oracle_dir = str(tmp_path / f"oracle_{i}")
+                export_standalone(state, model, oracle_dir)
+                oracle = StandaloneModel.load(oracle_dir)
+                servable = srv.manager.find_model("sync-0")
+                assert servable.step == i
+                np.testing.assert_array_equal(
+                    np.asarray(servable.predict(batches[0])),
+                    np.asarray(oracle.predict(batches[0])))
+        finally:
+            stop.set()
+            t.join(timeout=10)
+        assert failures == [], failures[:3]
+        assert metrics.Accumulator.get("sync.applied_deltas").value() >= 3
+
+
+def test_online_sync_quantized_wire_within_tolerance(tmp_path, publisher_node):
+    """bf16/int8 subscribers land within codec tolerance of the live rows
+    (storage stays fp32; only the feed bytes shrink)."""
+    model, trainer, state, step, batches, root = _train_setup(tmp_path)
+    pub_url, pub_srv = publisher_node
+    with IncrementalPersister(trainer, model, root, window=2,
+                              policy=PersistPolicy(every_steps=1),
+                              full_every=100) as p:
+        state, _ = step(state, batches[0])
+        p.maybe_persist(state, batch=batches[0])
+        p.wait()
+        export_dir = str(tmp_path / "export")
+        export_standalone(state, model, export_dir, model_sign="q")
+        from openembedding_tpu.sync import SyncPublisher
+        pub_srv.publishers["q"] = SyncPublisher(root)
+        for b in batches[1:4]:
+            state, _ = step(state, b)
+            p.maybe_persist(state, batch=b)
+        p.wait()
+
+    from openembedding_tpu.serving import ModelManager, ModelRegistry
+    live = np.asarray(state.tables["categorical"].weights)
+    for fmt, tol in (("bf16", 3e-2), ("int8", 6e-2)):
+        mgr = ModelManager(ModelRegistry(str(tmp_path / f"reg_{fmt}")))
+        mgr.load_model("q", export_dir)
+        sub = SyncSubscriber(mgr, "q", pub_url, wire=fmt)
+        assert sub.poll() == 3, sub.last_error
+        got = np.asarray(mgr.find_model("q").lookup(
+            "categorical", np.arange(64, dtype=np.int64)))
+        scale = max(1.0, float(np.abs(live[:64]).max()))
+        assert np.abs(got - live[:64]).max() <= tol * scale, fmt
+
+
+class _Truncate(FaultInjector):
+    """Chop rows off one table payload — a torn delta."""
+
+    def __init__(self, step):
+        self.step = step
+
+    def payload(self, step, payload):
+        if step == self.step:
+            name, (ids, rows) = next(iter(payload["tables"].items()))
+            payload["tables"][name] = (ids, rows[:-1])
+        return payload
+
+
+class _Reorder(FaultInjector):
+    def plan(self, steps):
+        return steps[::-1]
+
+
+class _DropMiddle(FaultInjector):
+    def plan(self, steps):
+        return [s for i, s in enumerate(steps) if i != 1 or len(steps) < 2]
+
+
+class _Duplicate(FaultInjector):
+    def plan(self, steps):
+        return steps[:1] + steps
+
+
+@pytest.mark.parametrize("fault_cls", [_Truncate, _Reorder, _DropMiddle,
+                                       _Duplicate])
+def test_sync_fault_injection_degrades_gracefully(tmp_path, publisher_node,
+                                                  serving_node, fault_cls):
+    """Injected torn/reordered/dropped/duplicated deltas: the node keeps
+    serving the last good version (DEGRADED, `sync.rollbacks` incremented,
+    zero failed predicts), and recovers once the fault clears."""
+    model, trainer, state, step, batches, root = _train_setup(tmp_path)
+    pub_url, pub_srv = publisher_node
+    srv_url, srv = serving_node
+    with IncrementalPersister(trainer, model, root, window=2,
+                              policy=PersistPolicy(every_steps=1),
+                              full_every=100) as p:
+        state, _ = step(state, batches[0])
+        p.maybe_persist(state, batch=batches[0])
+        p.wait()
+        export_dir = str(tmp_path / "export")
+        export_standalone(state, model, export_dir, model_sign="f")
+        from openembedding_tpu.sync import SyncPublisher
+        pub_srv.publishers["f"] = SyncPublisher(root)
+        srv.manager.load_model("f", export_dir)
+        for b in batches[1:4]:  # deltas at 2, 3, 4
+            state, _ = step(state, b)
+            p.maybe_persist(state, batch=b)
+        p.wait()
+
+    faults = (fault_cls(2) if fault_cls is _Truncate else fault_cls())
+    sub = SyncSubscriber(srv.manager, "f", pub_url, faults=faults)
+    before = metrics.Accumulator.get("sync.rollbacks").value()
+    assert sub.poll() == 0  # the guarded tick reports the failed round
+    assert sub.state == "DEGRADED"
+    assert sub.last_error
+    assert metrics.Accumulator.get("sync.rollbacks").value() == before + 1
+    # the node still serves the newest version that applied CLEANLY — a
+    # consistent prefix, never a torn mix
+    servable = srv.manager.find_model("f")
+    assert servable.step == sub.version
+    prefix = sub.version - 1  # deltas that applied before the fault point
+    status, out, _ = _req(f"{srv_url}/models/f/predict", "POST",
+                          {"sparse": {"categorical": np.asarray(
+                              batches[0]["sparse"]["categorical"]).tolist()},
+                           "dense": np.asarray(batches[0]["dense"]).tolist()})
+    assert status == 200  # zero failed predicts while degraded
+
+    sub.faults = None  # fault clears -> next poll catches up fully
+    assert sub.poll() == 3 - prefix, sub.last_error
+    assert sub.state == "IDLE" and sub.version == 4
+
+
+def test_sync_behind_feed_retention_degrades(tmp_path, publisher_node):
+    """A subscriber whose version fell behind the feed's base (its deltas
+    GC'd under retention) cannot catch up incrementally: DEGRADED with the
+    documented reload message, old servable untouched."""
+    model, trainer, state, step, batches, root = _train_setup(tmp_path)
+    pub_url, pub_srv = publisher_node
+    with IncrementalPersister(trainer, model, root, window=2,
+                              policy=PersistPolicy(every_steps=1),
+                              full_every=1) as p:  # fulls at 1 and 3
+        state, _ = step(state, batches[0])
+        p.maybe_persist(state, batch=batches[0])
+        p.wait()
+        export_dir = str(tmp_path / "export")
+        export_standalone(state, model, export_dir, model_sign="b")
+        for b in batches[1:3]:
+            state, _ = step(state, b)
+            p.maybe_persist(state, batch=b)
+            p.wait()
+        # deltas newer than the newest full so the head moves past the base
+        p.full_every = 100
+        for b in batches[3:5]:
+            state, _ = step(state, b)
+            p.maybe_persist(state, batch=b)
+            p.wait()
+    assert [s for s, _ in list_persists(root)][-1] == 3
+    assert [s for s, _ in list_deltas(root)] == [4, 5]
+
+    from openembedding_tpu.serving import ModelManager, ModelRegistry
+    from openembedding_tpu.sync import SyncPublisher
+    pub_srv.publishers["b"] = SyncPublisher(root)
+    mgr = ModelManager(ModelRegistry(str(tmp_path / "reg_b")))
+    mgr.load_model("b", export_dir)  # still at step 1 < base 4
+    sub = SyncSubscriber(mgr, "b", pub_url)
+    assert sub.poll() == 0
+    assert sub.state == "DEGRADED"
+    assert "reload" in sub.last_error
+    assert mgr.find_model("b").step == 1
+
+
+def test_sync_over_rest_admin_surface(tmp_path, publisher_node, serving_node):
+    """The operator path: POST /publish on the trainer node, POST /sync on
+    the serving node, progress visible via :syncstate — no Python API use."""
+    model, trainer, state, step, batches, root = _train_setup(tmp_path)
+    pub_url, pub_srv = publisher_node
+    srv_url, srv = serving_node
+    with IncrementalPersister(trainer, model, root, window=2,
+                              policy=PersistPolicy(every_steps=1),
+                              full_every=100) as p:
+        state, _ = step(state, batches[0])
+        p.maybe_persist(state, batch=batches[0])
+        p.wait()
+        export_dir = str(tmp_path / "export")
+        export_standalone(state, model, export_dir, model_sign="r")
+        for b in batches[1:4]:
+            state, _ = step(state, b)
+            p.maybe_persist(state, batch=b)
+        p.wait()
+
+    status, out, _ = _req(f"{pub_url}/models/r/publish", "POST",
+                          {"persist_root": root})
+    assert status == 200 and out["head_step"] == 4
+    status, _, _ = _req(f"{srv_url}/models/r", "POST",
+                        {"model_uri": export_dir})
+    assert status == 200
+    status, out, _ = _req(f"{srv_url}/models/r/sync", "POST",
+                          {"feed": pub_url, "interval_s": 0.05})
+    assert status == 200 and out["state"] in ("IDLE", "FETCHING", "APPLYING")
+    import time
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        status, st, _ = _req(f"{srv_url}/models/r:syncstate")
+        assert status == 200
+        if st["version"] == 4:
+            break
+        time.sleep(0.05)
+    assert st["version"] == 4 and st["applied"] == 3, st
+    # bad requests on the admin surface
+    assert _req(f"{pub_url}/models/x/publish", "POST", {})[0] == 400
+    assert _req(f"{pub_url}/models/x/publish", "POST",
+                {"persist_root": "/nonexistent-dir"})[0] == 400
+    assert _req(f"{srv_url}/models/x:syncstate")[0] == 404
+    # DELETE stops the subscriber with the model
+    status, _, _ = _req(f"{srv_url}/models/r", "DELETE")
+    assert status == 200
+    assert "r" not in srv.subscribers
+
+
+def test_manager_swap_is_conditional(tmp_path):
+    from openembedding_tpu.serving import ModelManager, ModelRegistry
+    model, trainer, state, step, batches, _ = _train_setup(tmp_path)
+    export_dir = str(tmp_path / "export")
+    export_standalone(state, model, export_dir, model_sign="s")
+    mgr = ModelManager(ModelRegistry(str(tmp_path / "reg")))
+    with pytest.raises(KeyError):
+        mgr.swap("s", object())  # not loaded -> refuses
+    mgr.load_model("s", export_dir)
+    cur = mgr.find_model("s")
+    other = StandaloneModel.load(export_dir)
+    with pytest.raises(RuntimeError, match="reloaded concurrently"):
+        mgr.swap("s", other, expected=other)  # cache holds `cur`, not `other`
+    mgr.swap("s", other, expected=cur)
+    assert mgr.find_model("s") is other
+
+
+def test_sharded_servable_apply_update_parity(tmp_path):
+    """ShardedModel.apply_update: delta rows land in their owning shards
+    (array scatter + per-shard hash probe), bit-equal to the live mesh
+    state's rows, and the OLD servable still answers (RCU, no donation)."""
+    from openembedding_tpu.parallel import MeshTrainer, make_mesh
+    from openembedding_tpu.parallel.serving import ShardedModel
+    from openembedding_tpu.persist import _load_delta_table
+
+    mesh = make_mesh()
+    model = make_deepfm(vocabulary=VOCAB, dim=4, hidden=(8,))
+    trainer = MeshTrainer(model, embed.Adagrad(learning_rate=0.05), mesh=mesh,
+                          seed=3)
+    batches = list(synthetic_criteo(16, id_space=VOCAB, steps=4, seed=5))
+    state = trainer.init(batches[0])
+    step = trainer.jit_train_step(batches[0], state)
+    root = str(tmp_path / "persist")
+    with IncrementalPersister(trainer, model, root, window=2,
+                              policy=PersistPolicy(every_steps=1),
+                              full_every=100) as p:
+        state, _ = step(state, batches[0])
+        p.maybe_persist(state, batch=batches[0])
+        p.wait()
+        ck = str(tmp_path / "ck")
+        trainer.save(state, ck)
+        for b in batches[1:3]:
+            state, _ = step(state, b)
+            p.maybe_persist(state, batch=b)
+        p.wait()
+
+    sm = ShardedModel.load(ck)
+    assert sm.step == 1
+    old = sm
+    old_rows = np.asarray(old.lookup("categorical",
+                                     np.arange(32, dtype=np.int64)))
+    for dstep, dpath in list_deltas(root):
+        with open(os.path.join(dpath, "meta.json")) as f:
+            meta = json.load(f)
+        tables = {}
+        for name in meta["tables"]:
+            ids, w, _slots = _load_delta_table(dpath, name)
+            tables[name] = (ids, w)
+        with np.load(os.path.join(dpath, "dense.npz")) as z:
+            dense = {k[len("params/"):]: z[k] for k in z.files
+                     if k.startswith("params/")}
+        sm = sm.apply_update(tables, dense, step=meta["step"],
+                             model_version=meta["model_version"])
+        assert sm.step == dstep
+
+    ids = np.unique(np.concatenate(
+        [np.asarray(b["sparse"]["categorical"]).reshape(-1)
+         for b in batches[:3]]))
+    from functools import partial
+    from jax.sharding import PartitionSpec as P
+    from openembedding_tpu.parallel.sharded import sharded_lookup
+    spec = model.specs["categorical"]
+    pull = jax.jit(jax.shard_map(
+        partial(sharded_lookup, spec, axis=trainer.axis), mesh=trainer.mesh,
+        in_specs=(trainer._table_pspec(spec), P()), out_specs=P(),
+        check_vma=False))
+    import jax.numpy as jnp
+    np.testing.assert_array_equal(
+        np.asarray(sm.lookup("categorical", ids)),
+        np.asarray(pull(state.tables["categorical"], jnp.asarray(ids))))
+    # RCU: the old servable was not donated away mid-apply
+    np.testing.assert_array_equal(
+        np.asarray(old.lookup("categorical", np.arange(32, dtype=np.int64))),
+        old_rows)
+
+
+def test_restore_from_peer_crash_safe(tmp_path, publisher_node, monkeypatch):
+    """A restore that dies mid-page leaves NOTHING at dest (no half-written
+    export a later create_model would load); a complete restore lands
+    atomically and loads."""
+    from openembedding_tpu.serving import restore_from_peer
+
+    model, trainer, state, step, batches, _ = _train_setup(tmp_path)
+    pub_url, pub_srv = publisher_node
+    export_dir = str(tmp_path / "export")
+    export_standalone(state, model, export_dir, model_sign="pr")
+    pub_srv.manager.load_model("pr", export_dir)
+
+    dest = str(tmp_path / "restored")
+    # simulate the peer dying MID-PAGE: the third request (a :rows page, after
+    # the model entry + manifest succeeded and pages started landing in the
+    # staging dir) breaks the connection
+    real_urlopen = urllib.request.urlopen
+    calls = {"n": 0}
+
+    def flaky(url, *a, **kw):
+        calls["n"] += 1
+        if calls["n"] == 3:
+            raise ConnectionError("peer died mid-page")
+        return real_urlopen(url, *a, **kw)
+
+    monkeypatch.setattr(urllib.request, "urlopen", flaky)
+    with pytest.raises(ConnectionError):
+        restore_from_peer(pub_url, "pr", dest)
+    monkeypatch.undo()
+    assert calls["n"] >= 3  # it really was mid-restore, not a pre-flight fail
+    assert not os.path.exists(dest)
+    assert not any(f.startswith("restored.tmp-")
+                   for f in os.listdir(str(tmp_path)))
+
+    out = restore_from_peer(pub_url, "pr", dest)
+    assert out == dest
+    sm = StandaloneModel.load(dest)
+    np.testing.assert_array_equal(
+        np.asarray(sm.lookup("categorical", np.arange(16, dtype=np.int64))),
+        np.asarray(StandaloneModel.load(export_dir).lookup(
+            "categorical", np.arange(16, dtype=np.int64))))
+    # a restore over an EXISTING complete export replaces it atomically
+    out2 = restore_from_peer(pub_url, "pr", dest)
+    assert out2 == dest and os.path.exists(os.path.join(dest, "model_meta"))
+
+
+def test_sync_soak_short(tmp_path):
+    """The soak harness (tools/sync_soak.py) in its tier-1 configuration:
+    trainer thread + subscriber-backed serving node, bounded version lag,
+    zero failed predicts across the swaps."""
+    import importlib.util
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "sync_soak", os.path.join(repo, "tools", "sync_soak.py"))
+    soak = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(soak)
+    report = soak.run(steps=6, persist_every=2, interval_s=0.05,
+                      workdir=str(tmp_path / "soak"), predict_threads=2)
+    assert report["failed_predicts"] == 0
+    assert report["swaps"] >= 2
+    assert report["final_lag_steps"] == 0
+    assert report["predicts"] > 0

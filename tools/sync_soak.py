@@ -1,0 +1,211 @@
+"""Online-sync soak: train-and-persist in a thread while a subscriber-backed
+serving node answers predicts; assert freshness and zero failed predicts.
+
+One process, three actors (the CI-sized version of the production topology):
+
+  trainer thread   — Trainer + IncrementalPersister: full base at the first
+                     persist, then one committed delta every `persist_every`
+                     steps into the persist root;
+  publisher node   — serving HTTP server whose SyncPublisher feeds that root
+                     (`GET /models/<sign>:versions`, `/delta/<step>/...`);
+  serving node     — a second HTTP server that loaded the base export, with a
+                     SyncSubscriber polling the feed and RCU-swapping the
+                     servable, while `predict_threads` hammer /predict.
+
+Asserted at exit: zero failed predicts across every swap, the subscriber
+ended IDLE at the trainer's final committed step (version lag 0), and at
+least K swaps actually happened (the soak is vacuous without them). The
+short configuration rides tier-1 via tests/test_sync.py::test_sync_soak_short;
+`python tools/sync_soak.py` runs the longer standalone battery (also a
+bench.py `sync` case + upwindow battery entry for chip sessions).
+"""
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run(*, steps=24, persist_every=2, interval_s=0.05, workdir="/tmp/oetpu_sync_soak",
+        predict_threads=4, wire="fp32", vocab=1 << 10, batch=16, dim=4,
+        lag_bound_steps=None, step_delay_s=0.0, quiet=False):
+    """-> report dict (see asserts at the bottom). Raises AssertionError when
+    the soak's invariants break."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    import openembedding_tpu as embed
+    from openembedding_tpu.data import synthetic_criteo
+    from openembedding_tpu.export import export_standalone
+    from openembedding_tpu.model import Trainer
+    from openembedding_tpu.models import make_deepfm
+    from openembedding_tpu.persist import IncrementalPersister, PersistPolicy
+    from openembedding_tpu.serving import make_server
+    from openembedding_tpu.sync import SyncSubscriber
+
+    def log(msg):
+        if not quiet:
+            print(f"[sync_soak] {msg}", flush=True)
+
+    shutil.rmtree(workdir, ignore_errors=True)
+    os.makedirs(workdir)
+    root = os.path.join(workdir, "persist")
+    sign = "soak-0"
+
+    model = make_deepfm(vocabulary=vocab, dim=dim, hidden=(8,))
+    trainer = Trainer(model, embed.Adagrad(learning_rate=0.05), seed=0)
+    batches = list(synthetic_criteo(batch, id_space=vocab, steps=steps,
+                                    seed=1))
+    state = trainer.init(batches[0])
+    step_fn = trainer.jit_train_step()
+
+    persister = IncrementalPersister(
+        trainer, model, root, window=2,
+        policy=PersistPolicy(every_steps=persist_every), full_every=10_000)
+    # base: FORCE the first persist (the full anchor) at step 1 — serving
+    # starts from an export of this exact chain step, whatever the policy says
+    state, _ = step_fn(state, batches[0])
+    persister.observe(batches[0])
+    persister.persist(state)
+    persister.wait()
+    export_dir = os.path.join(workdir, "export")
+    export_standalone(state, model, export_dir, model_sign=sign)
+
+    pub_srv = make_server(os.path.join(workdir, "reg_pub"),
+                          publish={sign: root}, publish_wire=wire)
+    threading.Thread(target=pub_srv.serve_forever, daemon=True).start()
+    pub_url = f"http://127.0.0.1:{pub_srv.server_address[1]}"
+    srv = make_server(os.path.join(workdir, "reg_srv"))
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    srv_url = f"http://127.0.0.1:{srv.server_address[1]}"
+    srv.manager.load_model(sign, export_dir)
+    log(f"publisher {pub_url} feeds {root}; serving node {srv_url}")
+
+    sub = SyncSubscriber(srv.manager, sign, pub_url, wire=wire,
+                         interval_s=interval_s)
+
+    # predict hammer: live traffic across every swap
+    stop = threading.Event()
+    counts = {"ok": 0, "fail": 0}
+    lock = threading.Lock()
+    body = json.dumps({
+        "sparse": {"categorical":
+                   np.asarray(batches[0]["sparse"]["categorical"]).tolist()},
+        "dense": np.asarray(batches[0]["dense"]).tolist()}).encode()
+
+    def hammer():
+        url = f"{srv_url}/models/{sign}/predict"
+        while not stop.is_set():
+            req = urllib.request.Request(
+                url, data=body, method="POST",
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req, timeout=30) as r:
+                    ok = r.status == 200
+            except Exception:  # noqa: BLE001 — any failure counts
+                ok = False
+            with lock:
+                counts["ok" if ok else "fail"] += 1
+
+    # warm the predict program before the clock starts (compile != failure)
+    srv.manager.find_model(sign).predict(batches[0])
+    hammers = [threading.Thread(target=hammer, daemon=True)
+               for _ in range(predict_threads)]
+    for t in hammers:
+        t.start()
+
+    trained = {"step": 1}
+    train_done = threading.Event()
+
+    def train():
+        s = state
+        for b in batches[1:]:
+            s, _ = step_fn(s, b)
+            persister.maybe_persist(s, batch=b)
+            trained["step"] = int(s.step)
+            if step_delay_s > 0:  # emulate a real per-step training cadence
+                time.sleep(step_delay_s)
+        persister.wait()
+        train_done.set()
+
+    max_lag = 0
+    t0 = time.monotonic()
+    trainer_thread = threading.Thread(target=train, daemon=True)
+    trainer_thread.start()
+    sub.start()
+    try:
+        while not train_done.is_set():
+            time.sleep(interval_s)
+            max_lag = max(max_lag, trained["step"] - (sub.version or 1))
+        # drain: let the subscriber reach the final committed step
+        deadline = time.monotonic() + 60
+        final = trained["step"] - (trained["step"] - 1) % persist_every
+        while (sub.version or 0) < final and time.monotonic() < deadline:
+            time.sleep(interval_s)
+    finally:
+        sub.stop()
+        stop.set()
+        for t in hammers:
+            t.join(timeout=10)
+        trainer_thread.join(timeout=60)
+        persister.close()
+        pub_srv.shutdown()
+        srv.shutdown()
+
+    report = {
+        "steps": trained["step"],
+        "persist_every": persist_every,
+        "wire": wire,
+        "swaps": sub.applied,
+        "final_version": sub.version,
+        "final_committed": final,
+        "final_lag_steps": final - (sub.version or 0),
+        "max_observed_lag_steps": max_lag,
+        "predicts": counts["ok"] + counts["fail"],
+        "failed_predicts": counts["fail"],
+        "subscriber_state": sub.state,
+        "last_error": sub.last_error,
+        "wall_s": round(time.monotonic() - t0, 2),
+    }
+    log(json.dumps(report, indent=2))
+    assert report["failed_predicts"] == 0, report
+    assert report["final_lag_steps"] == 0, report
+    assert report["swaps"] >= 1, report
+    if lag_bound_steps is not None:
+        assert max_lag <= lag_bound_steps, report
+    return report
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--steps", type=int, default=24)
+    ap.add_argument("--persist-every", type=int, default=2)
+    ap.add_argument("--interval-s", type=float, default=0.05)
+    ap.add_argument("--predict-threads", type=int, default=4)
+    ap.add_argument("--wire", default="fp32")
+    ap.add_argument("--workdir", default="/tmp/oetpu_sync_soak")
+    ap.add_argument("--lag-bound-steps", type=int, default=None,
+                    help="fail if observed version lag ever exceeds this "
+                         "(only meaningful with --step-delay-s pacing the "
+                         "trainer slower than the subscriber poll)")
+    ap.add_argument("--step-delay-s", type=float, default=0.0,
+                    help="sleep per train step, emulating a real step time "
+                         "so version lag is measurable")
+    args = ap.parse_args(argv)
+    report = run(steps=args.steps, persist_every=args.persist_every,
+                 interval_s=args.interval_s,
+                 predict_threads=args.predict_threads, wire=args.wire,
+                 workdir=args.workdir, lag_bound_steps=args.lag_bound_steps,
+                 step_delay_s=args.step_delay_s)
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -84,6 +84,17 @@ CASES = [
     ("wire_microbench",
      [sys.executable, os.path.join(REPO, "tools", "wire_microbench.py")],
      {"JAX_PLATFORMS": "cpu"}, 600),
+    # 9. online-sync delta pipeline (bench 'sync' case: per-delta latency /
+    #    rows/s / bytes per wire format) + the soak's zero-failed-predicts
+    #    invariant under live traffic. Both are host-dominated and already
+    #    measured on CPU (PERF.md sync stanza); the chip entries pin that the
+    #    on-device apply scatter doesn't change the story.
+    ("bench_sync", *bench_case("sync", 300)),
+    ("sync_soak",
+     [sys.executable, os.path.join(REPO, "tools", "sync_soak.py"),
+      "--steps", "24", "--persist-every", "2", "--step-delay-s", "0.2",
+      "--lag-bound-steps", "12"],
+     {"JAX_PLATFORMS": "cpu"}, 600),
 ]
 
 
