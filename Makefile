@@ -13,9 +13,14 @@ PY ?= python
 CPU_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 	XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: ci test dryrun bench-smoke native
+.PHONY: ci test dryrun bench-smoke native lint-metrics
 
-ci: test dryrun bench-smoke
+ci: lint-metrics test dryrun bench-smoke
+
+# metric-name hygiene: every observe()/vtimer()/trace.span() literal call
+# site must follow the documented `group.name` scheme (utils/metrics.py)
+lint-metrics:
+	$(PY) tools/lint_metrics.py
 
 # the full battery (mesh collectives, serving HA processes, persist crash
 # consistency, planted-signal AUC regression, keras parity, ...)

@@ -94,7 +94,17 @@ def main():
     ap.add_argument("--profile", default="", metavar="DIR",
                     help="capture a jax.profiler trace of the train loop "
                          "into DIR (view with xprof/tensorboard)")
+    ap.add_argument("--flight-recorder", type=int, default=0, metavar="N",
+                    help="resize the span/event flight recorder "
+                         "(utils/trace.py; 0 keeps the default)")
+    ap.add_argument("--trace-dump", default="", metavar="PATH",
+                    help="at exit, dump the flight recorder (train-loop "
+                         "spans, persist commits) as Chrome-trace JSON; "
+                         "summarize with tools/trace_report.py")
     args = ap.parse_args()
+    if args.flight_recorder > 0:
+        from openembedding_tpu.utils import trace as T
+        T.configure(args.flight_recorder)
 
     if args.model == "two_tower":
         ap.error("two_tower has its own batch schema; use the zoo API directly")
@@ -259,6 +269,9 @@ def main():
     if all_labels:
         print(f"train AUC {auc(np.concatenate(all_labels), np.concatenate(all_scores)):.4f}")
     print(M.report_table())
+    if args.trace_dump:
+        from openembedding_tpu.utils import trace as T
+        print(f"trace dump -> {T.dump_chrome(args.trace_dump)}")
 
     if args.save:
         trainer.save(state, args.save)

@@ -31,6 +31,7 @@ from .embedding import (Embedding, EmbeddingSpec, EmbeddingTableState,
                         apply_gradients, combine, init_table_state, lookup,
                         lookup_train)
 from .optimizers import Adagrad, SparseOptimizer
+from .utils import trace as _trace
 
 
 def binary_logloss(logits: jax.Array, labels: jax.Array,
@@ -458,6 +459,14 @@ class Trainer:
         `packed`: {name: column layout} for tables whose state currently holds
         the packed weights+slots array (only inside `train_many`'s scan; see
         `ops/sparse.packed_layout`).
+
+        The step phases carry `trainer.{pull,compute,apply}` spans
+        (`utils/trace.py` -> `oetpu_trainer_*_ms` histograms). Under jit they
+        fire at TRACE time — once per compile, measuring how long each phase
+        takes to trace/build, not per-step device time (per-step wall time is
+        the CALLER's span around the jitted fn, e.g. `vtimer("train",
+        "step")`). Run the step eagerly (no jit) and the same spans measure
+        real per-phase execution.
         """
         model = self.model
         if model.batch_transform is not None:
@@ -482,8 +491,9 @@ class Trainer:
         # Hash tables insert unseen ids here, so pull threads the table state.
         # MeshTrainer overrides tables_pull/tables_apply with the fused
         # multi-table exchange (3 all_to_alls per dim-group, not per table).
-        pulled_tables, pulled, stats, pull_plans = self.tables_pull(
-            state.tables, batch, ps_specs, packed)
+        with _trace.span("trainer", "pull"):
+            pulled_tables, pulled, stats, pull_plans = self.tables_pull(
+                state.tables, batch, ps_specs, packed)
 
         def loss_fn(tr_params, pulled_rows):
             dense_params = (model.module.merge_params(tr_params, fr0)
@@ -511,26 +521,29 @@ class Trainer:
                 fr_new = None
             return self._loss(logits, batch), (logits, fr_new)
 
-        (loss, (logits, fr_new)), (dense_grads, row_grads) = \
-            jax.value_and_grad(loss_fn, argnums=(0, 1), has_aux=True)(
-                tr0, pulled)
+        with _trace.span("trainer", "compute"):
+            (loss, (logits, fr_new)), (dense_grads, row_grads) = \
+                jax.value_and_grad(loss_fn, argnums=(0, 1), has_aux=True)(
+                    tr0, pulled)
 
-        dense_grads = self.reduce_dense_grads(dense_grads)
+            dense_grads = self.reduce_dense_grads(dense_grads)
 
-        # DENSE apply (reference: Keras optimizer after Horovod allreduce)
-        new_params, new_slots = dense_apply(
-            self.optimizer, tr0, state.dense_slots, dense_grads)
-        if split is not None:
-            fr = fr_new if fr_new is not None else fr0
-            new_params = model.module.merge_params(
-                new_params, self.reduce_module_state(fr))
+        with _trace.span("trainer", "apply"):
+            # DENSE apply (reference: Keras optimizer after Horovod allreduce)
+            new_params, new_slots = dense_apply(
+                self.optimizer, tr0, state.dense_slots, dense_grads)
+            if split is not None:
+                fr = fr_new if fr_new is not None else fr0
+                new_params = model.module.merge_params(
+                    new_params, self.reduce_module_state(fr))
 
-        # SPARSE push+update (reference: PushGradients + UpdateWeights store op)
-        new_tables = dict(state.tables)
-        applied, push_stats = self.tables_apply(
-            ps_specs, pulled_tables, batch, row_grads, packed, pull_plans)
-        new_tables.update(applied)
-        stats.update(push_stats)
+            # SPARSE push+update (reference: PushGradients + UpdateWeights
+            # store op)
+            new_tables = dict(state.tables)
+            applied, push_stats = self.tables_apply(
+                ps_specs, pulled_tables, batch, row_grads, packed, pull_plans)
+            new_tables.update(applied)
+            stats.update(push_stats)
 
         new_state = TrainState(
             step=state.step + 1,

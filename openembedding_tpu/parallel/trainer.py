@@ -250,20 +250,24 @@ class MeshTrainer(Trainer):
         self._observe_wire_cost(ps_specs, batch)
         if not self.group_exchange:
             return super().tables_pull(tables, batch, ps_specs, packed)
+        from ..utils import trace as _trace
         from .sharded import grouped_lookup_train
         pulled_tables, pulled, stats, plans = {}, {}, {}, {}
-        for names in self._exchange_groups(ps_specs):
-            specs = [ps_specs[n] for n in names]
-            ids_list = [jnp.asarray(batch["sparse"][s.feature_name])
-                        for s in specs]
-            new_states, outs, stats_list, plan_list = grouped_lookup_train(
-                specs, [tables[n] for n in names], ids_list, axis=self.axis,
-                capacity_factor=self.capacity_factor, wire=self.wire)
-            for n, ts, out, st, pl in zip(names, new_states, outs,
-                                          stats_list, plan_list):
-                pulled_tables[n], pulled[n], plans[n] = ts, out, pl
-                for k, v in st.items():
-                    stats[f"{n}/{k}"] = v
+        with _trace.span("trainer", "exchange",
+                         groups=len(self._exchange_groups(ps_specs))):
+            for names in self._exchange_groups(ps_specs):
+                specs = [ps_specs[n] for n in names]
+                ids_list = [jnp.asarray(batch["sparse"][s.feature_name])
+                            for s in specs]
+                new_states, outs, stats_list, plan_list = grouped_lookup_train(
+                    specs, [tables[n] for n in names], ids_list,
+                    axis=self.axis, capacity_factor=self.capacity_factor,
+                    wire=self.wire)
+                for n, ts, out, st, pl in zip(names, new_states, outs,
+                                              stats_list, plan_list):
+                    pulled_tables[n], pulled[n], plans[n] = ts, out, pl
+                    for k, v in st.items():
+                        stats[f"{n}/{k}"] = v
         return pulled_tables, pulled, stats, plans
 
     def tables_apply(self, ps_specs, pulled_tables, batch, row_grads, packed,
@@ -305,12 +309,20 @@ class MeshTrainer(Trainer):
             ids = jnp.asarray(batch["sparse"][spec.feature_name])
             pair = spec.use_hash_table and is_pair(ids)
             n = ids.size // 2 if pair else ids.size
+            cap = _bucket_capacity(max(n, 1), self.num_shards,
+                                   self.capacity_factor)
             tables.append({
                 "dim": spec.output_dim,
-                "cap": _bucket_capacity(max(n, 1), self.num_shards,
-                                        self.capacity_factor),
+                "cap": cap,
                 "pair": pair,
                 "id_itemsize": jnp.dtype(ids.dtype).itemsize})
+            # per-table pull sizes, LABELED by table: the per-table skew
+            # (Parallax: sparse behavior is dominated by it) reads straight
+            # off /metrics as oetpu_exchange_pull_positions{table=...}
+            _metrics.observe("exchange.pull_positions", float(n), "gauge",
+                             labels={"table": name})
+            _metrics.observe("exchange.bucket_capacity", float(cap), "gauge",
+                             labels={"table": name})
         # the per-table fallback protocol always ships fp32 payloads
         fmt = (wire_mod.wire_format(self.wire) if self.group_exchange
                else "fp32")

@@ -35,7 +35,7 @@ from typing import Dict, List, Optional, Tuple
 import jax
 import numpy as np
 
-from .utils import metrics
+from .utils import metrics, trace
 
 COMMIT_FILE = "COMMIT"
 _PERSIST_RE = re.compile(r"persist_(\d+)$")
@@ -195,7 +195,8 @@ class AsyncPersister:
                     self._write_one(write_cb, step, path)
                 metrics.observe("persist.committed", 1)
                 if jax.process_index() == 0:
-                    self._gc()
+                    with trace.span("persist", "gc"):
+                        self._gc()
             except BaseException as e:  # noqa: BLE001 - surfaced to producer
                 self._error = e
             finally:
@@ -255,6 +256,8 @@ class AsyncPersister:
         os.replace(tmp, path)
         with open(os.path.join(path, COMMIT_FILE), "w") as f:
             f.write(str(step))
+        trace.event("persist", "commit", step=int(step),
+                    what=os.path.basename(path))
 
     def _gc(self) -> None:
         """Retention after every commit (process 0 only): keep the newest

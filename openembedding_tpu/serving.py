@@ -32,6 +32,8 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 from .export import StandaloneModel
+from .utils import trace
+from .utils.trace import REQUEST_ID_HEADER
 
 
 class _BadRequest(Exception):
@@ -260,6 +262,15 @@ class ModelManager:
                 self._cache[model_sign] = loaded
             return loaded
 
+    def servable_versions(self) -> Dict[str, dict]:
+        """{sign: {step, kind}} of every LOADED servable (the /statusz view —
+        the registry shows what's registered, this shows what's resident)."""
+        with self._lock:
+            cache = dict(self._cache)
+        return {sign: {"step": int(getattr(m, "step", 0) or 0),
+                       "kind": type(m).__name__}
+                for sign, m in cache.items()}
+
     def find_model_variable(self, model_sign: str, variable: str):
         m = self.find_model(model_sign)
         if variable not in m.variable_names:
@@ -288,6 +299,8 @@ class ModelManager:
                     f"model {model_sign!r} was reloaded concurrently; "
                     "swap abandoned")
             self._cache[model_sign] = servable
+        trace.event("serving", "servable_swap", model=model_sign,
+                    step=int(getattr(servable, "step", 0) or 0))
 
     def load_model(self, model_sign: str, uri: str, *, replica_num: int = 1,
                    shard_num: int = 1) -> dict:
@@ -324,6 +337,31 @@ class ServingHandler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):
         if not self.quiet:
             super().log_message(fmt, *args)
+
+    def send_response(self, code, message=None):
+        """Every response echoes the request id (`X-OETPU-Request-Id`) and
+        stamps the status onto the request's http span."""
+        super().send_response(code, message)
+        rid = getattr(self, "_request_id", None)
+        if rid:
+            self.send_header(REQUEST_ID_HEADER, rid)
+        sp = getattr(self, "_http_span", None)
+        if sp is not None:
+            sp.attrs["status"] = int(code)
+
+    def _traced(self, method: str, handler):
+        """Request-id middleware: adopt the client's `X-OETPU-Request-Id` (or
+        generate one), bind it for the request's lifetime, and wrap the whole
+        handler in the root `serving.http` span — every nested span (predict,
+        queue wait, batch exec, model call; publisher-side delta serves in a
+        sync round) correlates by this id."""
+        rid = self.headers.get(REQUEST_ID_HEADER) or trace.new_request_id()
+        self._request_id = rid
+        with trace.request(rid):
+            with trace.span("serving", "http", method=method,
+                            path=self.path) as sp:
+                self._http_span = sp
+                return handler()
 
     def _json(self, code: int, payload, headers: Optional[dict] = None) -> None:
         body = json.dumps(payload).encode()
@@ -379,6 +417,10 @@ class ServingHandler(BaseHTTPRequestHandler):
             return "healthz", None, None
         if path == "/metrics":
             return "metrics", None, None
+        if path == "/statusz":
+            return "statusz", None, None
+        if path == "/tracez":
+            return "tracez", None, None
         return None, None, None
 
     # -- verbs --------------------------------------------------------------
@@ -395,7 +437,69 @@ class ServingHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _text(self, body: str, code: int = 200) -> None:
+        raw = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "text/plain; charset=utf-8")
+        self.send_header("Content-Length", str(len(raw)))
+        self.end_headers()
+        self.wfile.write(raw)
+
+    def _statusz_text(self) -> str:
+        """Operator one-pager: build/config, servable versions, sync state
+        (with the last DEGRADED reason), publishers, flight-recorder tail."""
+        import platform
+        lines = ["== openembedding_tpu serving /statusz =="]
+        build = {"python": platform.python_version()}
+        try:
+            import jax
+            build["jax"] = jax.__version__
+        except Exception:  # noqa: BLE001 — statusz must render regardless
+            pass
+        lines.append("build: " + " ".join(f"{k}={v}"
+                                          for k, v in build.items()))
+        lines.append("node: " + json.dumps(self.node_info, sort_keys=True))
+        lines.append("")
+        lines.append("-- servables (loaded) --")
+        versions = self.manager.servable_versions()
+        if not versions:
+            lines.append("(none loaded)")
+        for sign, v in sorted(versions.items()):
+            entry = self.manager.registry.get(sign) or {}
+            lines.append(f"{sign}: step={v['step']} kind={v['kind']} "
+                         f"status={entry.get('status', '?')}")
+        lines.append("")
+        lines.append("-- sync subscribers --")
+        if not self.subscribers:
+            lines.append("(none)")
+        for sign, sub in sorted(self.subscribers.items()):
+            st = sub.status()
+            lines.append(
+                f"{sign}: state={st['state']} version={st['version']} "
+                f"applied={st['applied']} "
+                f"last_degraded_reason={st.get('last_degraded_reason')}")
+        lines.append("")
+        lines.append("-- sync publishers --")
+        if not self.publishers:
+            lines.append("(none)")
+        for sign, pub in sorted(self.publishers.items()):
+            try:
+                feed = pub.versions()
+                lines.append(f"{sign}: head_step={feed['head_step']} "
+                             f"base_step={feed['base_step']} "
+                             f"deltas={len(feed['deltas'])}")
+            except Exception as e:  # noqa: BLE001
+                lines.append(f"{sign}: (feed error: {e})")
+        lines.append("")
+        n = int(self.query.get("n", 40)) if hasattr(self, "query") else 40
+        lines.append(f"-- flight recorder (last {n}) --")
+        lines.append(trace.RECORDER.render_text(n))
+        return "\n".join(lines) + "\n"
+
     def do_GET(self):  # noqa: N802 (http.server API)
+        return self._traced("GET", self._handle_get)
+
+    def _handle_get(self):
         kind, sign, action = self._route()
         try:
             if kind == "models":
@@ -488,6 +592,14 @@ class ServingHandler(BaseHTTPRequestHandler):
                 self.end_headers()
                 self.wfile.write(body)
                 return None
+            if kind == "statusz":
+                return self._text(self._statusz_text())
+            if kind == "tracez":
+                n = self._coerce(int, self.query.get("n", 256), "n")
+                return self._json(200, {
+                    "spans": [s.as_dict() for s in trace.RECORDER.spans(n)],
+                    "events": [e.as_dict()
+                               for e in trace.RECORDER.events(n)]})
             return self._json(404, {"error": "not found"})
         except _BadRequest as e:
             return self._json(400, {"error": str(e)})
@@ -517,6 +629,9 @@ class ServingHandler(BaseHTTPRequestHandler):
             raise _BadRequest(f"bad {what!r}: {e}") from e
 
     def do_POST(self):  # noqa: N802
+        return self._traced("POST", self._handle_post)
+
+    def _handle_post(self):
         kind, sign, action = self._route()
         try:
             body = self._body()
@@ -574,33 +689,41 @@ class ServingHandler(BaseHTTPRequestHandler):
                     return self._npz({"weights": np.asarray(rows)})
                 return self._json(200, {"weights": np.asarray(rows).tolist()})
             if kind == "model" and action == "predict":
-                model = self.manager.find_model(sign)
-                pooled = _pooled_features(model)
-                batch = {
-                    "sparse": {k: self._coerce(
-                        lambda v, _p=(k in pooled): _ids_array(v, pooled=_p),
-                        v, f"sparse.{k}")
-                        for k, v in body.get("sparse", {}).items()},
-                }
-                if body.get("dense") is not None:
-                    batch["dense"] = self._coerce(
-                        lambda v: np.asarray(v, dtype=np.float32),
-                        body["dense"], "dense")
-                from .export import RaggedBatchError
-                try:
-                    if self.batcher is not None:
-                        logits = self.batcher.predict(model, sign, batch)
-                    else:
-                        logits = model.predict(batch)
-                except KeyError as e:
-                    # a feature the model needs is absent from the request
-                    # body — the CALLER's error (400), not an unknown sign
-                    raise _BadRequest(
-                        f"predict request is missing sparse feature {e}"
-                    ) from e
-                except RaggedBatchError as e:
-                    raise _BadRequest(str(e)) from e
-                return self._json(200, {"logits": np.asarray(logits).tolist()})
+                # per-request wall time -> labeled latency histogram
+                # (oetpu_serving_predict_ms_bucket{model=...}) AND a span
+                # under the request's http span — one measurement, two views
+                with trace.span("serving", "predict",
+                                labels={"model": sign}, model=sign):
+                    model = self.manager.find_model(sign)
+                    pooled = _pooled_features(model)
+                    batch = {
+                        "sparse": {k: self._coerce(
+                            lambda v, _p=(k in pooled):
+                                _ids_array(v, pooled=_p),
+                            v, f"sparse.{k}")
+                            for k, v in body.get("sparse", {}).items()},
+                    }
+                    if body.get("dense") is not None:
+                        batch["dense"] = self._coerce(
+                            lambda v: np.asarray(v, dtype=np.float32),
+                            body["dense"], "dense")
+                    from .export import RaggedBatchError
+                    try:
+                        if self.batcher is not None:
+                            logits = self.batcher.predict(model, sign, batch)
+                        else:
+                            with trace.span("serving", "model_call"):
+                                logits = model.predict(batch)
+                    except KeyError as e:
+                        # a feature the model needs is absent from the request
+                        # body — the CALLER's error (400), not an unknown sign
+                        raise _BadRequest(
+                            f"predict request is missing sparse feature {e}"
+                        ) from e
+                    except RaggedBatchError as e:
+                        raise _BadRequest(str(e)) from e
+                    return self._json(
+                        200, {"logits": np.asarray(logits).tolist()})
             return self._json(404, {"error": "not found"})
         except _BadRequest as e:
             return self._json(400, {"error": str(e)})
@@ -612,6 +735,9 @@ class ServingHandler(BaseHTTPRequestHandler):
             return self._json(500, {"error": str(e)})
 
     def do_DELETE(self):  # noqa: N802
+        return self._traced("DELETE", self._handle_delete)
+
+    def _handle_delete(self):
         kind, sign, _ = self._route()
         try:
             if kind == "model":
@@ -793,15 +919,23 @@ class MicroBatcher:
         if leader:
             # the first arrival owns the window + the device call; a full
             # group releases it before the window expires
-            deadline = time.monotonic() + self.window_s
-            with self._lock:
-                while (time.monotonic() < deadline
-                       and sum(e["n"] for e in self._groups.get(key, ()))
-                       < self.max_batch):
-                    self._full.wait(timeout=max(
-                        0.0, deadline - time.monotonic()))
-                group = self._groups.pop(key, [])
-            self._run(model, group)
+            with trace.span("serving", "queue_wait", role="leader", rows=n):
+                deadline = time.monotonic() + self.window_s
+                with self._lock:
+                    while (time.monotonic() < deadline
+                           and sum(e["n"] for e in self._groups.get(key, ()))
+                           < self.max_batch):
+                        self._full.wait(timeout=max(
+                            0.0, deadline - time.monotonic()))
+                    group = self._groups.pop(key, [])
+            with trace.span("serving", "batch_exec", requests=len(group),
+                            rows=sum(e["n"] for e in group)):
+                self._run(model, group)
+        else:
+            # a follower's wait covers enqueue -> its group's exec finishing
+            # (it cannot observe the run start; the leader's spans split it)
+            with trace.span("serving", "queue_wait", role="follower", rows=n):
+                entry["done"].wait()
         entry["done"].wait()
         if entry["err"] is not None:
             raise entry["err"]
@@ -841,7 +975,9 @@ class MicroBatcher:
             if batches[0].get("dense") is not None:
                 merged["dense"] = np.concatenate(
                     [np.asarray(b["dense"]) for b in batches])
-            logits = np.asarray(model.predict(merged))
+            with trace.span("serving", "model_call",
+                            rows=sum(e["n"] for e in group)):
+                logits = np.asarray(model.predict(merged))
             metrics.observe("serving.predict_batches", 1)
             metrics.observe("serving.predict_requests", len(group))
             off = 0
@@ -1031,7 +1167,17 @@ def main(argv=None) -> int:
     ap.add_argument("--sync-wire", default=None,
                     help="row encoding on the sync wire "
                          "(fp32|bf16|int8; default fp32)")
+    ap.add_argument("--flight-recorder", type=int, default=0, metavar="N",
+                    help="resize the span/event flight recorder ring buffer "
+                         "(0 keeps the default; tail shows on GET /statusz, "
+                         "full contents on GET /tracez)")
+    ap.add_argument("--trace-dump", default=None, metavar="PATH",
+                    help="on shutdown, write the flight recorder as "
+                         "Chrome-trace JSON to PATH (chrome://tracing / "
+                         "Perfetto; summarize with tools/trace_report.py)")
     args = ap.parse_args(argv)
+    if args.flight_recorder > 0:
+        trace.configure(args.flight_recorder)
 
     def kv(pairs, what):
         out = {}
@@ -1061,6 +1207,8 @@ def main(argv=None) -> int:
     finally:
         for sub in httpd.subscribers.values():
             sub.stop()
+        if args.trace_dump:
+            print(f"trace dump: {trace.dump_chrome(args.trace_dump)}")
     return 0
 
 

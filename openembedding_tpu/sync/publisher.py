@@ -36,7 +36,7 @@ import numpy as np
 
 from ..ops import wire as wire_mod
 from ..persist import COMMIT_FILE, DELTA_FORMAT, delta_chain, list_persists
-from ..utils import metrics
+from ..utils import metrics, trace
 
 # a bounded poll may park a handler thread at most this long
 MAX_WAIT_S = 30.0
@@ -138,15 +138,20 @@ class SyncPublisher:
         path = self._delta_path(step)
         if name not in self.delta_meta(step).get("tables", []):
             raise KeyError(f"delta {step} carries no table {name!r}")
-        ids, weights, _slots = _load_delta_table(path, name)
-        dim = int(weights.shape[1]) if weights.ndim == 2 else 0
-        payload = wire_mod.np_encode_rows(weights, fmt)
-        metrics.observe_sync_cost(
-            wire_mod.sync_delta_cost({name: (int(ids.size), dim)}, fmt))
-        buf = io.BytesIO()
-        np.savez(buf, ids=np.asarray(ids, np.int64), wire=payload,
-                 fmt=np.asarray(fmt), dim=np.asarray(dim, np.int64))
-        return buf.getvalue()
+        # the fetch-side half of the sync trace: a subscriber's request id
+        # (stamped by the serving handler) correlates this serve with the
+        # subscriber's sync.fetch span of the same round
+        with trace.span("sync", "serve_delta", step=int(step), table=name,
+                        wire=fmt):
+            ids, weights, _slots = _load_delta_table(path, name)
+            dim = int(weights.shape[1]) if weights.ndim == 2 else 0
+            payload = wire_mod.np_encode_rows(weights, fmt)
+            metrics.observe_sync_cost(
+                wire_mod.sync_delta_cost({name: (int(ids.size), dim)}, fmt))
+            buf = io.BytesIO()
+            np.savez(buf, ids=np.asarray(ids, np.int64), wire=payload,
+                     fmt=np.asarray(fmt), dim=np.asarray(dim, np.int64))
+            return buf.getvalue()
 
     def delta_dense(self, step: int) -> bytes:
         """The delta's dense params (npz; optimizer slot entries dropped)."""

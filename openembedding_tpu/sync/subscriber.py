@@ -39,7 +39,7 @@ import numpy as np
 
 from ..ops import wire as wire_mod
 from ..persist import DELTA_FORMAT
-from ..utils import metrics
+from ..utils import metrics, trace
 
 IDLE, FETCHING, APPLYING, DEGRADED = "IDLE", "FETCHING", "APPLYING", "DEGRADED"
 _STATE_CODE = {IDLE: 0, FETCHING: 1, APPLYING: 2, DEGRADED: 3}
@@ -94,6 +94,10 @@ class SyncSubscriber:
         self.version: Optional[int] = None
         self.applied = 0
         self.last_error: Optional[str] = None
+        # survives recovery: the reason the machine LAST entered DEGRADED
+        # (shown on /statusz and :syncstate — `last_error` clears on the next
+        # clean round, this stays for the post-mortem)
+        self.last_degraded_reason: Optional[str] = None
         self._backoff = 0.0
         self._head_times: Dict[int, float] = {}
         self._stop = threading.Event()
@@ -102,7 +106,14 @@ class SyncSubscriber:
     # -- wire ----------------------------------------------------------------
 
     def _get(self, path: str):
-        req = urllib.request.Request(f"{self.feed}{path}")
+        # each sync round binds a request id (`sync_once`); stamping it onto
+        # every feed fetch means the PUBLISHER node's handler spans and this
+        # subscriber's fetch/apply spans correlate as one trace
+        headers = {}
+        rid = trace.get_request_id()
+        if rid:
+            headers[trace.REQUEST_ID_HEADER] = rid
+        req = urllib.request.Request(f"{self.feed}{path}", headers=headers)
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as r:
                 raw = r.read()
@@ -142,9 +153,18 @@ class SyncSubscriber:
 
     # -- state machine -------------------------------------------------------
 
-    def _set_state(self, state: str) -> None:
-        self.state = state
+    def _set_state(self, state: str, reason: Optional[str] = None) -> None:
+        prev, self.state = self.state, state
         metrics.observe("sync.state", _STATE_CODE[state], "gauge")
+        if state == DEGRADED and reason:
+            self.last_degraded_reason = reason
+        if state != prev:
+            # discrete transition -> flight recorder (the /statusz tail that
+            # explains a DEGRADED spike after the fact)
+            attrs = {"model": self.model_sign, "from": prev, "to": state}
+            if reason:
+                attrs["reason"] = reason
+            trace.event("sync", "state", **attrs)
 
     def _observe_lag(self, head: Optional[int]) -> None:
         if head is None or self.version is None:
@@ -158,7 +178,13 @@ class SyncSubscriber:
 
     def sync_once(self) -> int:
         """One negotiation round; returns deltas applied. Raises SyncError on
-        any failure — state/metrics handling lives in `poll()`."""
+        any failure — state/metrics handling lives in `poll()`. The round
+        runs under one request id, propagated to the publisher on every
+        fetch (`X-OETPU-Request-Id`)."""
+        with trace.request():
+            return self._sync_once()
+
+    def _sync_once(self) -> int:
         servable = self.manager.find_model(self.model_sign)
         if self.version is None:
             self.version = int(getattr(servable, "step", 0))
@@ -191,7 +217,7 @@ class SyncSubscriber:
         self._set_state(FETCHING)
         applied = 0
         for step in pending:
-            with metrics.vtimer("sync", "fetch"):
+            with trace.span("sync", "fetch", step=int(step)):
                 payload = self._fetch_delta(step)
             if self.faults is not None:
                 payload = self.faults.payload(step, payload)
@@ -204,12 +230,13 @@ class SyncSubscriber:
                     f"(parent={meta.get('parent')}, "
                     f"format={meta.get('format')!r})")
             self._set_state(APPLYING)
-            with metrics.vtimer("sync", "apply"):
+            with trace.span("sync", "apply", step=int(step)):
                 new_servable = servable.apply_update(
                     payload["tables"], payload["dense"], step=int(step),
                     model_version=meta.get("model_version"))
-            self.manager.swap(self.model_sign, new_servable,
-                              expected=servable)
+            with trace.span("sync", "swap", step=int(step)):
+                self.manager.swap(self.model_sign, new_servable,
+                                  expected=servable)
             servable = new_servable
             self.version = int(step)
             self.applied += 1
@@ -220,24 +247,25 @@ class SyncSubscriber:
         self._set_state(IDLE)
         return applied
 
+    def _degrade(self, reason: str) -> None:
+        self.last_error = reason
+        metrics.observe("sync.rollbacks", 1)
+        trace.event("sync", "rollback", model=self.model_sign,
+                    version=self.version, reason=reason)
+        self._set_state(DEGRADED, reason=reason)
+        self._backoff = min(max(self._backoff * 2, self.interval_s),
+                            self.max_backoff_s)
+
     def poll(self) -> int:
         """One guarded tick: sync, or record the failure and degrade.
         Returns deltas applied (0 on failure — check `.state`/`.last_error`)."""
         try:
             applied = self.sync_once()
         except SyncError as e:
-            self.last_error = str(e)
-            metrics.observe("sync.rollbacks", 1)
-            self._set_state(DEGRADED)
-            self._backoff = min(max(self._backoff * 2, self.interval_s),
-                                self.max_backoff_s)
+            self._degrade(str(e))
             return 0
         except Exception as e:  # noqa: BLE001 — a bug must not kill the loop
-            self.last_error = f"{type(e).__name__}: {e}"
-            metrics.observe("sync.rollbacks", 1)
-            self._set_state(DEGRADED)
-            self._backoff = min(max(self._backoff * 2, self.interval_s),
-                                self.max_backoff_s)
+            self._degrade(f"{type(e).__name__}: {e}")
             return 0
         self.last_error = None
         self._backoff = 0.0
@@ -247,7 +275,8 @@ class SyncSubscriber:
         return {"model_sign": self.model_sign, "feed": self.feed,
                 "state": self.state, "version": self.version,
                 "applied": self.applied, "wire": self.wire,
-                "last_error": self.last_error}
+                "last_error": self.last_error,
+                "last_degraded_reason": self.last_degraded_reason}
 
     # -- background loop -----------------------------------------------------
 

@@ -1,8 +1,11 @@
-"""Utility subsystems: metrics/accumulators/timers (see `utils/metrics.py`)."""
+"""Utility subsystems: metrics/accumulators/timers (`utils/metrics.py`) and
+tracing/flight recorder (`utils/trace.py`)."""
 
-from . import metrics
+from . import metrics, trace
 from .metrics import (Accumulator, vtimer, report, report_table,
                       prometheus_text, PeriodicReporter)
+from .trace import span, dump_chrome
 
-__all__ = ["metrics", "Accumulator", "vtimer", "report", "report_table",
-           "prometheus_text", "PeriodicReporter"]
+__all__ = ["metrics", "trace", "Accumulator", "vtimer", "report",
+           "report_table", "prometheus_text", "PeriodicReporter", "span",
+           "dump_chrome"]

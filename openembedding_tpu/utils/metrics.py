@@ -1,53 +1,100 @@
-"""Metrics: accumulators, scope timers, periodic reports, Prometheus exposition.
+"""Metrics: accumulators, histograms, scope timers, periodic reports,
+Prometheus exposition.
 
 Reference parity (SURVEY.md §5 tracing/profiling):
 - `Accumulator<SumAggregator>` counters like `pull_indices`/`pull_unique` gated by
   evaluate-performance mode (`EmbeddingPullOperator.cpp:207-252`) -> `Accumulator`
-  registry (sum/avg/max aggregations, thread-safe, always on — negligible cost in
-  Python; the per-step device counters ride the jitted step's stats dict instead).
+  registry (sum/avg/max/gauge/hist aggregations, thread-safe, always on — negligible
+  cost in Python; the per-step device counters ride the jitted step's stats dict
+  instead).
 - `VTIMER(1, group, name, ms)` scope timers at hot stages
-  (`EmbeddingVariableHandle.cpp:107,140`) -> `vtimer(group, name)` context manager.
+  (`EmbeddingVariableHandle.cpp:107,140`) -> `vtimer(group, name)` context manager,
+  now backed by `kind="hist"` latency histograms (p50/p95/p99 instead of avg-only)
+  and recorded into the flight recorder (`utils/trace.py` — vtimer IS a span).
 - periodic cluster-wide accumulator table when `server.report_interval > 0`
   (`client/WorkerContext.cpp:24-41,140-163`) -> `PeriodicReporter` thread.
 - standalone server's Prometheus exposer flags (`entry/server.cc:7-12,35-36`) ->
   `prometheus_text()` (text exposition format, served at /metrics by `serving.py`).
+
+Beyond the reference: metric LABELS (`observe(name, v, labels={"table": ...})` ->
+`oetpu_pull_ms{table="user"}`) so per-table skew is visible, and `kind="hist"`
+fixed log-spaced-bucket histograms exposing p50/p95/p99 in `report()` and proper
+`_bucket`/`_sum`/`_count` series in `prometheus_text()`.
+
+Naming scheme (enforced by `make lint-metrics` / tools/lint_metrics.py): metric
+names are dot-joined lowercase `group.name[.qualifier]` segments of
+`[a-z0-9_]+` — e.g. `serving.predict.ms`, `sync.rollbacks`,
+`exchange.wire_bytes_per_step`. Per-instance dimensions (table, model) go in
+labels, never in the name.
 """
 
 from __future__ import annotations
 
+import bisect
 import threading
 import time
 from contextlib import contextmanager
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 _LOCK = threading.Lock()
 _REGISTRY: Dict[str, "Accumulator"] = {}
 
+KINDS = ("sum", "avg", "max", "gauge", "hist")
+
+# log-spaced histogram bucket upper bounds (le semantics): sqrt(2) steps from
+# 1e-3 up to ~1.9e5 — 56 buckets covering sub-us timer ticks to minutes-long
+# persist writes at <= ~20% worst-case quantile error before interpolation
+HIST_BOUNDS: Tuple[float, ...] = tuple(
+    1e-3 * (2.0 ** 0.5) ** i for i in range(56))
+
+
+def _label_key(labels: Optional[Dict[str, str]]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{labels[k]}"' for k in sorted(labels))
+    return "{" + inner + "}"
+
 
 class Accumulator:
     """A named metric. kind: "sum" (counter), "avg" (mean of observations),
-    "max" (high-water mark), "gauge" (last value)."""
+    "max" (high-water mark), "gauge" (last value), "hist" (log-spaced-bucket
+    latency/size histogram with p50/p95/p99). `labels` distinguishes series
+    of one metric (per-table, per-model)."""
 
-    def __init__(self, name: str, kind: str = "sum", help: str = ""):
-        if kind not in ("sum", "avg", "max", "gauge"):
+    def __init__(self, name: str, kind: str = "sum", help: str = "",
+                 labels: Optional[Dict[str, str]] = None):
+        if kind not in KINDS:
             raise ValueError(f"bad accumulator kind {kind!r}")
         self.name = name
         self.kind = kind
         self.help = help
+        self.labels = dict(labels) if labels else {}
+        self.key = name + _label_key(labels)
         self._lock = threading.Lock()
         self._total = 0.0
         self._count = 0
         self._max = float("-inf")
+        self._min = float("inf")
+        self._buckets: List[int] = ([0] * (len(HIST_BOUNDS) + 1)
+                                    if kind == "hist" else [])
 
     @classmethod
-    def get(cls, name: str, kind: str = "sum", help: str = "") -> "Accumulator":
+    def get(cls, name: str, kind: str = "sum", help: str = "",
+            labels: Optional[Dict[str, str]] = None) -> "Accumulator":
+        key = name + _label_key(labels)
         with _LOCK:
-            acc = _REGISTRY.get(name)
+            acc = _REGISTRY.get(key)
             if acc is None:
-                acc = _REGISTRY[name] = cls(name, kind, help)
+                # one name must aggregate ONE way across all its label sets —
+                # two call sites registering different kinds would otherwise
+                # silently aggregate with whichever ran first
+                for other in _REGISTRY.values():
+                    if other.name == name and other.kind != kind:
+                        raise ValueError(
+                            f"metric {name!r} already registered with kind "
+                            f"{other.kind!r}, requested {kind!r}")
+                acc = _REGISTRY[key] = cls(name, kind, help, labels)
             elif acc.kind != kind:
-                # two call sites registering the same name with different kinds
-                # would silently aggregate with whichever ran first
                 raise ValueError(
                     f"metric {name!r} already registered with kind "
                     f"{acc.kind!r}, requested {kind!r}")
@@ -62,16 +109,52 @@ class Accumulator:
             else:
                 self._total += value
                 self._count += 1
+            if self.kind == "hist":
+                self._buckets[bisect.bisect_left(HIST_BOUNDS, value)] += 1
             if value > self._max:
                 self._max = value
+            if value < self._min:
+                self._min = value
 
     def value(self) -> float:
         with self._lock:
-            if self.kind == "avg":
+            if self.kind in ("avg", "hist"):
                 return self._total / self._count if self._count else 0.0
             if self.kind == "max":
                 return self._max if self._count else 0.0
             return self._total
+
+    def quantile(self, q: float) -> float:
+        """Histogram quantile by linear interpolation inside the owning
+        bucket, clamped to the observed min/max (tightens narrow
+        distributions that land in few buckets)."""
+        if self.kind != "hist":
+            raise ValueError(f"metric {self.name!r} ({self.kind}) has no "
+                             "quantiles; use kind='hist'")
+        with self._lock:
+            n = self._count
+            if n == 0:
+                return 0.0
+            target = q * n
+            cum = 0.0
+            for i, c in enumerate(self._buckets):
+                if c == 0:
+                    continue
+                if cum + c >= target:
+                    lo = HIST_BOUNDS[i - 1] if i > 0 else 0.0
+                    hi = HIST_BOUNDS[i] if i < len(HIST_BOUNDS) else self._max
+                    lo = max(lo, self._min)
+                    hi = min(hi, self._max)
+                    if hi < lo:
+                        hi = lo
+                    return lo + (hi - lo) * ((target - cum) / c)
+                cum += c
+            return self._max
+
+    def hist_snapshot(self) -> Tuple[List[int], float, int]:
+        """-> (per-bucket counts incl. overflow, sum, count), consistent."""
+        with self._lock:
+            return list(self._buckets), self._total, self._count
 
     @property
     def count(self) -> int:
@@ -82,23 +165,25 @@ class Accumulator:
             self._total = 0.0
             self._count = 0
             self._max = float("-inf")
+            self._min = float("inf")
+            if self.kind == "hist":
+                self._buckets = [0] * (len(HIST_BOUNDS) + 1)
 
 
-def observe(name: str, value: float, kind: str = "sum") -> None:
-    Accumulator.get(name, kind).observe(value)
+def observe(name: str, value: float, kind: str = "sum",
+            labels: Optional[Dict[str, str]] = None) -> None:
+    Accumulator.get(name, kind, labels=labels).observe(value)
 
 
 @contextmanager
 def vtimer(group: str, name: str):
-    """Scope timer -> avg+max ms accumulators (reference VTIMER semantics:
-    `VTIMER(1, group, name, ms)` wraps the hot operator stages)."""
-    t0 = time.perf_counter()
-    try:
+    """Scope timer (reference VTIMER semantics: `VTIMER(1, group, name, ms)`
+    wraps the hot operator stages). Now a full trace span: records into the
+    `{group}.{name}.ms` histogram (p50/p95/p99 on /metrics), the `.max_ms`
+    high-water mark, and the flight recorder (`utils/trace.py`)."""
+    from . import trace  # lazy: trace imports metrics at module level
+    with trace.span(group, name):
         yield
-    finally:
-        ms = (time.perf_counter() - t0) * 1e3
-        Accumulator.get(f"{group}.{name}.ms", "avg").observe(ms)
-        Accumulator.get(f"{group}.{name}.max_ms", "max").observe(ms)
 
 
 def observe_exchange_cost(cost: Dict[str, "object"]) -> None:
@@ -125,21 +210,48 @@ def observe_sync_cost(cost: Dict[str, "object"]) -> None:
 
 def record_step_stats(stats: Dict[str, "object"]) -> None:
     """Fold a train step's device-side stats dict (`{var}/pull_indices`, `.../
-    pull_unique`, `.../pull_overflow`, ...) into host accumulators."""
+    pull_unique`, `.../pull_overflow`, ...) into host accumulators.
+
+    ONE `jax.device_get` of the whole dict — per-key `float()` on device
+    arrays would force one host sync per stat on the hot path. Accepts jax
+    arrays, numpy scalars, and plain floats interchangeably. Per-table stats
+    (`{var}/{stat}` keys) additionally publish as LABELED counters
+    (`oetpu_trainer_pull_indices_total{table="user"}`) so per-table skew
+    reads straight off /metrics."""
+    try:
+        import jax
+        stats = jax.device_get(dict(stats))
+    except Exception:  # noqa: BLE001 — metrics must never break the loop
+        pass
     for key, value in stats.items():
         try:
-            observe(key.replace("/", "."), float(value))
+            v = float(value)
         except (TypeError, ValueError):
             continue
+        observe(key.replace("/", "."), v)
+        var, sep, stat = key.partition("/")
+        if sep and "/" not in stat:
+            observe(f"trainer.{stat}", v, "sum", labels={"table": var})
 
 
 def report(reset: bool = False) -> Dict[str, float]:
+    """{metric key: value}; histograms add `.p50`/`.p95`/`.p99` keys beside
+    their mean. `reset=True` zeroes windowed kinds (sum/avg/max) but SKIPS
+    gauges (one-shot values like `exchange.*` wire costs would vanish from
+    /metrics after the first periodic report) and histograms (Prometheus
+    histogram series are cumulative by contract)."""
     with _LOCK:
         accs = list(_REGISTRY.values())
-    out = {a.name: a.value() for a in accs}
+    out: Dict[str, float] = {}
+    for a in accs:
+        out[a.key] = a.value()
+        if a.kind == "hist" and a.count:
+            for q, suffix in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
+                out[f"{a.key}.{suffix}"] = a.quantile(q)
     if reset:
         for a in accs:
-            a.reset()
+            if a.kind not in ("gauge", "hist"):
+                a.reset()
     return out
 
 
@@ -154,6 +266,9 @@ def report_table(reset: bool = False) -> str:
 
 
 def reset_all() -> None:
+    """Hard reset of EVERY accumulator, gauges and histograms included
+    (test/bench isolation — the periodic-report path uses `report(reset=True)`
+    which preserves them)."""
     with _LOCK:
         accs = list(_REGISTRY.values())
     for a in accs:
@@ -163,25 +278,71 @@ def reset_all() -> None:
 _SANE = str.maketrans({c: "_" for c in ".-/ "})
 
 
+def _esc(v: str) -> str:
+    """Prometheus label-value escaping (backslash, quote, newline)."""
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n",
+                                                                    "\\n")
+
+
+def _labels_text(labels: Dict[str, str],
+                 extra: Optional[Tuple[str, str]] = None) -> str:
+    pairs = [(k, labels[k]) for k in sorted(labels)]
+    if extra is not None:
+        pairs.append(extra)
+    if not pairs:
+        return ""
+    return "{" + ",".join(f'{k}="{_esc(v)}"' for k, v in pairs) + "}"
+
+
+def _fmt_bound(b: float) -> str:
+    return f"{b:.6g}"
+
+
 def prometheus_text() -> str:
-    """Prometheus text exposition (0.0.4) of every accumulator."""
-    lines = []
+    """Prometheus text exposition (0.0.4) of every accumulator.
+
+    Conformance: counters carry the `_total` suffix; label values are
+    escaped; avg/max kinds emit a single well-typed gauge series; hist kinds
+    emit cumulative `_bucket{le=...}` (empty interior buckets elided — le
+    boundaries stay monotone), `_sum` and `_count` series."""
+    lines: List[str] = []
     with _LOCK:
-        accs = sorted(_REGISTRY.values(), key=lambda a: a.name)
+        accs = sorted(_REGISTRY.values(), key=lambda a: (a.name, a.key))
+    seen = set()
     for a in accs:
-        metric = "oetpu_" + a.name.translate(_SANE)
+        base = "oetpu_" + a.name.translate(_SANE)
+        family = base + ("_total" if a.kind == "sum" else "")
         ptype = {"sum": "counter", "avg": "gauge", "max": "gauge",
-                 "gauge": "gauge"}[a.kind]
-        if a.help:
-            lines.append(f"# HELP {metric} {a.help}")
-        lines.append(f"# TYPE {metric} {ptype}")
-        lines.append(f"{metric} {a.value()}")
+                 "gauge": "gauge", "hist": "histogram"}[a.kind]
+        if family not in seen:
+            seen.add(family)
+            if a.help:
+                lines.append(f"# HELP {family} {a.help}")
+            lines.append(f"# TYPE {family} {ptype}")
+        if a.kind == "hist":
+            buckets, total, count = a.hist_snapshot()
+            cum = 0
+            for i, c in enumerate(buckets[:-1]):
+                if c == 0:
+                    continue
+                cum += c
+                le = _fmt_bound(HIST_BOUNDS[i])
+                lines.append(f"{base}_bucket"
+                             f"{_labels_text(a.labels, ('le', le))} {cum}")
+            lines.append(f"{base}_bucket"
+                         f"{_labels_text(a.labels, ('le', '+Inf'))} {count}")
+            lines.append(f"{base}_sum{_labels_text(a.labels)} {total}")
+            lines.append(f"{base}_count{_labels_text(a.labels)} {count}")
+        else:
+            lines.append(f"{family}{_labels_text(a.labels)} {a.value()}")
     return "\n".join(lines) + "\n"
 
 
 class PeriodicReporter:
     """Background thread printing the accumulator table every `interval` seconds
-    (enabled when interval > 0, like the reference's `server.report_interval`)."""
+    (enabled when interval > 0, like the reference's `server.report_interval`).
+    `reset=True` resets windowed kinds between reports; gauges and histograms
+    are preserved (see `report`)."""
 
     def __init__(self, interval: float, sink: Optional[Callable[[str], None]] = None,
                  reset: bool = True):

@@ -1,0 +1,286 @@
+"""Tracing: spans, request ids, a flight recorder, Chrome-trace export.
+
+The reference's operational surfaces stop at scope timers and accumulator
+tables (`VTIMER`, evaluate-performance counters, the Prometheus exposer —
+`utils/metrics.py` carries those). This module adds the layer they cannot
+express: following ONE request (a serving predict, a sync round) through
+queue -> batch -> swap across threads, and explaining a tail-latency spike
+or a DEGRADED transition after the fact.
+
+- `span(group, name, **attrs)`: thread-safe scope span. Parent/child nesting
+  rides a contextvar, so nesting works across `with` blocks in one thread
+  and — via `contextvars.copy_context()` — across thread handoffs. Every
+  span also lands in the `{group}.{name}.ms` latency histogram
+  (`metrics.Accumulator(kind="hist")`), so /metrics p50/p95/p99 and the
+  trace view are two projections of the same measurements.
+- request ids: `request(rid)` binds a trace id that every span opened inside
+  it carries. The serving HTTP surface propagates `X-OETPU-Request-Id`
+  (generated when absent) and the sync subscriber stamps each negotiation
+  round, so publisher-side handler spans and subscriber-side fetch/apply
+  spans of one round share an id.
+- flight recorder: a bounded ring buffer of recent spans + discrete events
+  (sync state transitions with reason, rollbacks, persist commits, servable
+  swaps). `RECORDER.render_text()` is what `GET /statusz` prints;
+  `GET /tracez` serves the same buffer as JSON.
+- `dump_chrome(path)`: Chrome-trace/Perfetto JSON ("traceEvents" array,
+  complete "X" events + instant "i" events) — load in chrome://tracing or
+  ui.perfetto.dev; `tools/trace_report.py` turns a dump into a latency table.
+
+Spans cost two clock reads, a histogram observe, and a deque append — cheap
+enough to stay always-on, like the accumulators. NOTE on jitted code: a span
+around traced (jit/shard_map/scan) Python measures TRACE time, once per
+compile — honest for compile structure, not per-step execution. Put spans
+around the jitted CALL (dispatch+wall) or host-side stages for runtime
+numbers; `model.Trainer.train_step`'s phase spans are the trace-time kind
+and say so.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import itertools
+import json
+import os
+import threading
+import time
+import uuid
+from collections import deque
+from contextlib import contextmanager
+from typing import Any, Dict, Iterable, List, Optional
+
+from . import metrics
+
+REQUEST_ID_HEADER = "X-OETPU-Request-Id"
+
+# map the monotonic span clock onto wall time once, at import: every span/event
+# timestamp is then comparable across threads AND meaningful as an epoch time
+_PERF0 = time.perf_counter()
+_WALL0 = time.time()
+
+
+def _wall(perf_t: float) -> float:
+    return _WALL0 + (perf_t - _PERF0)
+
+
+_current_span: contextvars.ContextVar[Optional["Span"]] = \
+    contextvars.ContextVar("oetpu_current_span", default=None)
+_request_id: contextvars.ContextVar[Optional[str]] = \
+    contextvars.ContextVar("oetpu_request_id", default=None)
+_span_ids = itertools.count(1)
+
+
+def new_request_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+def get_request_id() -> Optional[str]:
+    return _request_id.get()
+
+
+@contextmanager
+def request(rid: Optional[str] = None):
+    """Bind a request/trace id for the duration of the block; every span
+    opened inside carries it as `trace_id` (generated when not given)."""
+    rid = rid or new_request_id()
+    token = _request_id.set(rid)
+    try:
+        yield rid
+    finally:
+        _request_id.reset(token)
+
+
+class Span:
+    """One timed scope. Mutable while open; recorded on close."""
+
+    __slots__ = ("group", "name", "span_id", "parent_id", "trace_id",
+                 "start", "duration_ms", "thread", "attrs")
+
+    def __init__(self, group: str, name: str, parent: Optional["Span"],
+                 attrs: Dict[str, Any]):
+        self.group = group
+        self.name = name
+        self.span_id = next(_span_ids)
+        self.parent_id = parent.span_id if parent is not None else None
+        self.trace_id = _request_id.get()
+        self.start = time.perf_counter()
+        self.duration_ms: Optional[float] = None
+        self.thread = threading.get_ident()
+        self.attrs = attrs
+
+    def as_dict(self) -> dict:
+        return {"kind": "span", "group": self.group, "name": self.name,
+                "span_id": self.span_id, "parent_id": self.parent_id,
+                "request_id": self.trace_id, "start": _wall(self.start),
+                "duration_ms": self.duration_ms, "thread": self.thread,
+                "attrs": dict(self.attrs)}
+
+
+class Event:
+    """A discrete moment (state transition, rollback, commit, swap)."""
+
+    __slots__ = ("group", "name", "ts", "trace_id", "thread", "attrs")
+
+    def __init__(self, group: str, name: str, attrs: Dict[str, Any]):
+        self.group = group
+        self.name = name
+        self.ts = time.perf_counter()
+        self.trace_id = _request_id.get()
+        self.thread = threading.get_ident()
+        self.attrs = attrs
+
+    def as_dict(self) -> dict:
+        return {"kind": "event", "group": self.group, "name": self.name,
+                "request_id": self.trace_id, "ts": _wall(self.ts),
+                "thread": self.thread, "attrs": dict(self.attrs)}
+
+
+class FlightRecorder:
+    """Bounded ring buffer of completed spans + events, oldest evicted first.
+    Append order = completion order (a parent span lands AFTER its children).
+    """
+
+    def __init__(self, capacity: int = 2048):
+        self._lock = threading.Lock()
+        self._buf: deque = deque(maxlen=int(capacity))
+
+    @property
+    def capacity(self) -> int:
+        return self._buf.maxlen
+
+    def configure(self, capacity: int) -> None:
+        """Resize, keeping the newest entries."""
+        with self._lock:
+            self._buf = deque(self._buf, maxlen=int(capacity))
+
+    def record(self, item) -> None:
+        with self._lock:
+            self._buf.append(item)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buf.clear()
+
+    def tail(self, n: Optional[int] = None) -> List[Any]:
+        with self._lock:
+            items = list(self._buf)
+        return items if n is None else items[-int(n):]
+
+    def spans(self, n: Optional[int] = None) -> List[Span]:
+        out = [x for x in self.tail() if isinstance(x, Span)]
+        return out if n is None else out[-int(n):]
+
+    def events(self, n: Optional[int] = None) -> List[Event]:
+        out = [x for x in self.tail() if isinstance(x, Event)]
+        return out if n is None else out[-int(n):]
+
+    def render_text(self, n: int = 40) -> str:
+        """The flight-recorder tail as text (the /statusz rendering)."""
+        lines = []
+        for item in self.tail(n):
+            d = item.as_dict()
+            ts = d.get("start", d.get("ts"))
+            stamp = time.strftime("%H:%M:%S", time.localtime(ts)) + \
+                f".{int((ts % 1) * 1e3):03d}"
+            rid = f" rid={d['request_id']}" if d["request_id"] else ""
+            attrs = " ".join(f"{k}={v}" for k, v in d["attrs"].items())
+            if d["kind"] == "span":
+                lines.append(
+                    f"[{stamp}] SPAN {d['group']}.{d['name']} "
+                    f"{d['duration_ms']:.3f}ms{rid}"
+                    + (f" {attrs}" if attrs else ""))
+            else:
+                lines.append(f"[{stamp}] EVT  {d['group']}.{d['name']}{rid}"
+                             + (f" {attrs}" if attrs else ""))
+        return "\n".join(lines) if lines else "(flight recorder empty)"
+
+
+RECORDER = FlightRecorder()
+
+
+def configure(capacity: int) -> None:
+    """Resize the global flight recorder (`--flight-recorder N`)."""
+    RECORDER.configure(capacity)
+
+
+@contextmanager
+def span(group: str, name: str, *, labels: Optional[Dict[str, str]] = None,
+         **attrs):
+    """Timed scope: nests under the current span (contextvar), records into
+    the flight recorder on exit, and observes the `{group}.{name}.ms`
+    latency histogram (+ `.max_ms` high-water mark) — with `labels`, the
+    histogram series carries them (`oetpu_..._ms_bucket{model="m"}`)."""
+    parent = _current_span.get()
+    s = Span(group, name, parent, dict(attrs))
+    token = _current_span.set(s)
+    t0 = s.start
+    try:
+        yield s
+    except BaseException as e:
+        s.attrs.setdefault("error", f"{type(e).__name__}: {e}")
+        raise
+    finally:
+        ms = (time.perf_counter() - t0) * 1e3
+        s.duration_ms = ms
+        _current_span.reset(token)
+        RECORDER.record(s)
+        metrics.observe(f"{group}.{name}.ms", ms, "hist", labels=labels)
+        metrics.observe(f"{group}.{name}.max_ms", ms, "max", labels=labels)
+
+
+def current_span() -> Optional[Span]:
+    return _current_span.get()
+
+
+def event(group: str, name: str, **attrs) -> Event:
+    """Record a discrete event into the flight recorder."""
+    e = Event(group, name, attrs)
+    RECORDER.record(e)
+    return e
+
+
+# -- export -------------------------------------------------------------------
+
+
+def _jsonable(v):
+    try:
+        json.dumps(v)
+        return v
+    except (TypeError, ValueError):
+        return str(v)
+
+
+def chrome_events(items: Optional[Iterable] = None) -> List[dict]:
+    """Flight-recorder contents as Chrome-trace event dicts (ts/dur in us)."""
+    pid = os.getpid()
+    out = []
+    for item in (RECORDER.tail() if items is None else items):
+        args = {k: _jsonable(v) for k, v in item.attrs.items()}
+        if item.trace_id:
+            args["request_id"] = item.trace_id
+        if isinstance(item, Span):
+            args["span_id"] = item.span_id
+            if item.parent_id is not None:
+                args["parent_id"] = item.parent_id
+            out.append({"name": f"{item.group}.{item.name}",
+                        "cat": item.group, "ph": "X",
+                        "ts": _wall(item.start) * 1e6,
+                        "dur": (item.duration_ms or 0.0) * 1e3,
+                        "pid": pid, "tid": item.thread, "args": args})
+        else:
+            out.append({"name": f"{item.group}.{item.name}",
+                        "cat": item.group, "ph": "i", "s": "g",
+                        "ts": _wall(item.ts) * 1e6,
+                        "pid": pid, "tid": item.thread, "args": args})
+    return out
+
+
+def dump_chrome(path: str) -> str:
+    """Write the flight recorder as Chrome-trace/Perfetto JSON; returns
+    `path`. Load in chrome://tracing / ui.perfetto.dev, or feed to
+    `tools/trace_report.py` for a per-group latency table."""
+    doc = {"traceEvents": chrome_events(), "displayTimeUnit": "ms"}
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, path)
+    return path

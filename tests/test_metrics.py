@@ -57,6 +57,36 @@ def test_record_step_stats_from_device_dict():
     rep = metrics.report()
     assert rep["categorical.pull_indices"] == 128
     assert rep["categorical.pull_unique"] == 50
+    # per-table stats double as LABELED counters (per-table skew on /metrics)
+    assert rep['trainer.pull_indices{table="categorical"}'] == 128
+
+
+def test_record_step_stats_single_host_sync_and_mixed_types(monkeypatch):
+    """The hot-path contract: ONE jax.device_get for the whole stats dict
+    (per-key float() on device arrays = one host sync per stat), accepting
+    jax arrays, numpy scalars, and plain floats interchangeably."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    calls = {"n": 0}
+    real = jax.device_get
+
+    def counting(x):
+        calls["n"] += 1
+        return real(x)
+
+    monkeypatch.setattr(jax, "device_get", counting)
+    metrics.record_step_stats({"t/pull_indices": jnp.asarray(7),
+                               "t/pull_unique": np.float32(3.5),
+                               "t/pull_overflow": 0.25,
+                               "t/not_numeric": "skipped"})
+    assert calls["n"] == 1
+    rep = metrics.report()
+    assert rep["t.pull_indices"] == 7
+    assert rep["t.pull_unique"] == 3.5
+    assert rep["t.pull_overflow"] == 0.25
+    assert "t.not_numeric" not in rep
 
 
 def test_report_reset():
@@ -65,14 +95,83 @@ def test_report_reset():
     assert metrics.report()["x"] == 0
 
 
+def test_reset_skips_gauges():
+    """Regression: one-shot gauges (`exchange.*` wire costs,
+    `sync.wire_bytes_per_delta`) must survive `report(reset=True)` — the
+    PeriodicReporter wiped them from /metrics after its first report."""
+    metrics.observe("exchange.wire_bytes_per_step", 4096, "gauge")
+    metrics.observe("win.count", 2)
+    rep = metrics.report(reset=True)
+    assert rep["exchange.wire_bytes_per_step"] == 4096
+    rep = metrics.report()
+    assert rep["exchange.wire_bytes_per_step"] == 4096  # gauge survives
+    assert rep["win.count"] == 0                        # counter windowed
+    # the PeriodicReporter path (report_table(reset=True)) behaves the same
+    metrics.PeriodicReporter(0).interval  # (construction only; no thread)
+    metrics.report_table(reset=True)
+    assert metrics.report()["exchange.wire_bytes_per_step"] == 4096
+
+
+def test_hist_survives_reset_and_reports_quantiles():
+    for v in (1.0, 2.0, 3.0, 4.0):
+        metrics.observe("lat.ms", v, "hist")
+    rep = metrics.report(reset=True)
+    assert rep["lat.ms"] == 2.5  # mean under the bare key
+    assert set(k for k in rep if k.startswith("lat.ms.")) == {
+        "lat.ms.p50", "lat.ms.p95", "lat.ms.p99"}
+    # histogram series are cumulative (Prometheus contract): not windowed
+    assert metrics.Accumulator.get("lat.ms", "hist").count == 4
+
+
 def test_prometheus_text():
     metrics.observe("pull.indices", 10)
     metrics.Accumulator.get("step.ms", "avg", help="step time").observe(5.0)
     text = metrics.prometheus_text()
-    assert "# TYPE oetpu_pull_indices counter" in text
-    assert "oetpu_pull_indices 10.0" in text
+    # counters carry the _total suffix (Prometheus conformance)
+    assert "# TYPE oetpu_pull_indices_total counter" in text
+    assert "oetpu_pull_indices_total 10.0" in text
+    # avg/max kinds stay a single well-typed gauge series
     assert "# HELP oetpu_step_ms step time" in text
     assert "# TYPE oetpu_step_ms gauge" in text
+    assert "oetpu_step_ms 5.0" in text
+
+
+def test_prometheus_histogram_series():
+    for v in (0.5, 1.0, 2.0, 400.0):
+        metrics.observe("serving.predict.ms", v, "hist",
+                        labels={"model": "m-0"})
+    text = metrics.prometheus_text()
+    assert "# TYPE oetpu_serving_predict_ms histogram" in text
+    assert 'oetpu_serving_predict_ms_bucket{model="m-0",le="+Inf"} 4' in text
+    assert 'oetpu_serving_predict_ms_count{model="m-0"} 4' in text
+    assert 'oetpu_serving_predict_ms_sum{model="m-0"} 403.5' in text
+    # cumulative bucket counts, monotone le boundaries
+    import re
+    pairs = re.findall(
+        r'oetpu_serving_predict_ms_bucket\{model="m-0",le="([^"]+)"\} (\d+)',
+        text)
+    counts = [int(c) for _le, c in pairs]
+    assert counts == sorted(counts) and counts[-1] == 4
+
+
+def test_prometheus_label_escaping():
+    metrics.observe("pull.rows", 1, "gauge",
+                    labels={"table": 'we"ird\\na\nme'})
+    text = metrics.prometheus_text()
+    assert r'oetpu_pull_rows{table="we\"ird\\na\nme"} 1.0' in text
+
+
+def test_label_series_are_distinct_and_kinds_consistent():
+    metrics.observe("pull.rows_total", 3, labels={"table": "user"})
+    metrics.observe("pull.rows_total", 5, labels={"table": "item"})
+    metrics.observe("pull.rows_total", 1, labels={"table": "user"})
+    rep = metrics.report()
+    assert rep['pull.rows_total{table="user"}'] == 4
+    assert rep['pull.rows_total{table="item"}'] == 5
+    # one name aggregates ONE way across all its label sets
+    with pytest.raises(ValueError, match="kind"):
+        metrics.Accumulator.get("pull.rows_total", "gauge",
+                                labels={"table": "other"})
 
 
 def test_periodic_reporter():
@@ -98,7 +197,7 @@ def test_serving_metrics_endpoint(tmp_path):
         url = f"http://127.0.0.1:{httpd.server_address[1]}/metrics"
         with urllib.request.urlopen(url) as resp:
             body = resp.read().decode()
-        assert "oetpu_serving_requests 3.0" in body
+        assert "oetpu_serving_requests_total 3.0" in body
     finally:
         httpd.shutdown()
 

@@ -352,6 +352,59 @@ def test_sync_fault_injection_degrades_gracefully(tmp_path, publisher_node,
     assert sub.state == "IDLE" and sub.version == 4
 
 
+def test_statusz_shows_degraded_state_and_reason(tmp_path, publisher_node,
+                                                 serving_node):
+    """Operator surface for the fault path: after an injected torn delta the
+    serving node's GET /statusz renders the subscriber's DEGRADED state WITH
+    the last DEGRADED reason (and :syncstate carries it as
+    `last_degraded_reason`), and the reason survives recovery."""
+    model, trainer, state, step, batches, root = _train_setup(tmp_path)
+    pub_url, pub_srv = publisher_node
+    srv_url, srv = serving_node
+    with IncrementalPersister(trainer, model, root, window=2,
+                              policy=PersistPolicy(every_steps=1),
+                              full_every=100) as p:
+        state, _ = step(state, batches[0])
+        p.maybe_persist(state, batch=batches[0])
+        p.wait()
+        export_dir = str(tmp_path / "export")
+        export_standalone(state, model, export_dir, model_sign="z")
+        from openembedding_tpu.sync import SyncPublisher
+        pub_srv.publishers["z"] = SyncPublisher(root)
+        srv.manager.load_model("z", export_dir)
+        for b in batches[1:3]:  # deltas at 2, 3
+            state, _ = step(state, b)
+            p.maybe_persist(state, batch=b)
+        p.wait()
+
+    sub = SyncSubscriber(srv.manager, "z", pub_url, faults=_Truncate(2))
+    srv.subscribers["z"] = sub  # registered on the node, like POST /sync
+    assert sub.poll() == 0 and sub.state == "DEGRADED"
+
+    with urllib.request.urlopen(f"{srv_url}/statusz") as resp:
+        assert resp.status == 200
+        text = resp.read().decode()
+    assert "z: state=DEGRADED" in text
+    assert "last_degraded_reason=" in text
+    assert "torn payload" in text  # the actual apply-failure reason
+    status, st, _ = _req(f"{srv_url}/models/z:syncstate")
+    assert status == 200 and st["state"] == "DEGRADED"
+    assert "torn payload" in st["last_degraded_reason"]
+    # the DEGRADED->... transition + rollback landed in the flight recorder
+    status, tz, _ = _req(f"{srv_url}/tracez")
+    assert status == 200
+    evs = [e for e in tz["events"] if e["group"] == "sync"]
+    assert any(e["name"] == "rollback" for e in evs)
+    assert any(e["name"] == "state" and e["attrs"].get("to") == "DEGRADED"
+               for e in evs)
+
+    sub.faults = None  # fault clears; the reason is kept for the post-mortem
+    assert sub.poll() == 2 and sub.state == "IDLE"
+    status, st, _ = _req(f"{srv_url}/models/z:syncstate")
+    assert st["last_error"] is None
+    assert "torn payload" in st["last_degraded_reason"]
+
+
 def test_sync_behind_feed_retention_degrades(tmp_path, publisher_node):
     """A subscriber whose version fell behind the feed's base (its deltas
     GC'd under retention) cannot catch up incrementally: DEGRADED with the

@@ -1,0 +1,293 @@
+"""Trace core tests (`utils/trace.py`): span nesting (same-thread and across
+threads), ring-buffer eviction, histogram quantile accuracy, Chrome-trace
+export, request-id propagation through a live serving request, /statusz and
+/tracez, and the tools/trace_report.py smoke."""
+
+import contextvars
+import json
+import os
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from openembedding_tpu.utils import metrics, trace
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    metrics._REGISTRY.clear()
+    trace.RECORDER.clear()
+    yield
+    metrics._REGISTRY.clear()
+    trace.RECORDER.clear()
+
+
+# -- span core ----------------------------------------------------------------
+
+
+def test_span_nesting_and_request_id():
+    with trace.request("req-1"):
+        with trace.span("g", "outer", foo=1) as outer:
+            with trace.span("g", "inner") as inner:
+                assert trace.current_span() is inner
+            assert trace.current_span() is outer
+    assert trace.current_span() is None
+    spans = trace.RECORDER.spans()
+    # completion order: inner lands before outer
+    assert [(s.name, s.trace_id) for s in spans] == [("inner", "req-1"),
+                                                     ("outer", "req-1")]
+    inner, outer = spans
+    assert inner.parent_id == outer.span_id
+    assert outer.parent_id is None
+    assert outer.attrs == {"foo": 1}
+    assert outer.duration_ms >= inner.duration_ms >= 0
+    # every span doubles as a latency histogram observation
+    assert metrics.Accumulator.get("g.outer.ms", "hist").count == 1
+
+
+def test_span_nesting_across_threads():
+    """A thread launched with copy_context() nests under the launching span;
+    a bare thread starts a fresh trace (no parent, no inherited id)."""
+    results = {}
+
+    def child():
+        with trace.span("g", "child"):
+            pass
+        results["rid"] = trace.get_request_id()
+
+    with trace.request("req-t"):
+        with trace.span("g", "parent") as parent:
+            ctx = contextvars.copy_context()
+            t = threading.Thread(target=ctx.run, args=(child,))
+            t.start()
+            t.join()
+    child_span = next(s for s in trace.RECORDER.spans() if s.name == "child")
+    assert child_span.parent_id == parent.span_id
+    assert child_span.trace_id == "req-t"
+    assert results["rid"] == "req-t"
+
+    trace.RECORDER.clear()
+    t = threading.Thread(target=child)  # no context handoff
+    t.start()
+    t.join()
+    bare = trace.RECORDER.spans()[0]
+    assert bare.parent_id is None and bare.trace_id is None
+    assert results["rid"] is None
+
+
+def test_span_records_error_and_reraises():
+    with pytest.raises(ValueError):
+        with trace.span("g", "boom"):
+            raise ValueError("no")
+    s = trace.RECORDER.spans()[0]
+    assert s.attrs["error"] == "ValueError: no"
+    assert s.duration_ms is not None
+
+
+def test_flight_recorder_eviction_order():
+    rec = trace.FlightRecorder(capacity=4)
+    for i in range(10):
+        rec.record(trace.Event("g", f"e{i}", {}))
+    names = [e.name for e in rec.tail()]
+    assert names == ["e6", "e7", "e8", "e9"]  # oldest evicted, order kept
+    rec.configure(2)
+    assert [e.name for e in rec.tail()] == ["e8", "e9"]  # newest survive
+    assert rec.capacity == 2
+
+
+def test_events_and_render_text():
+    trace.event("sync", "state", frm="IDLE", to="DEGRADED", reason="torn")
+    with trace.span("g", "s"):
+        pass
+    text = trace.RECORDER.render_text()
+    assert "EVT  sync.state" in text and "reason=torn" in text
+    assert "SPAN g.s" in text
+
+
+# -- histogram quantiles ------------------------------------------------------
+
+
+def test_histogram_quantiles_match_numpy():
+    """Log-spaced buckets + in-bucket interpolation: p50/p95/p99 within a
+    bucket-width (sqrt2) relative tolerance of exact numpy percentiles on a
+    known heavy-tailed latency distribution."""
+    rng = np.random.default_rng(7)
+    vals = rng.lognormal(mean=1.0, sigma=1.2, size=8000)
+    acc = metrics.Accumulator.get("q.lat.ms", "hist")
+    for v in vals:
+        acc.observe(v)
+    for q in (0.5, 0.95, 0.99):
+        exact = float(np.percentile(vals, q * 100))
+        got = acc.quantile(q)
+        assert abs(got - exact) <= 0.25 * exact, (q, got, exact)
+    # degenerate cases: empty -> 0, single value -> that value (clamping)
+    empty = metrics.Accumulator.get("q.none.ms", "hist")
+    assert empty.quantile(0.5) == 0.0
+    one = metrics.Accumulator.get("q.one.ms", "hist")
+    one.observe(3.25)
+    assert one.quantile(0.5) == pytest.approx(3.25)
+
+
+# -- chrome export + report tool ----------------------------------------------
+
+
+def _load_tool(name):
+    import importlib.util
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(repo, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_dump_chrome_and_trace_report(tmp_path, capsys):
+    with trace.request("req-d"):
+        with trace.span("serving", "http"):
+            with trace.span("serving", "predict", model="m"):
+                pass
+    trace.event("persist", "commit", step=3)
+    path = trace.dump_chrome(str(tmp_path / "dump.json"))
+
+    with open(path) as f:
+        doc = json.load(f)  # valid Chrome-trace JSON
+    evs = doc["traceEvents"]
+    xs = [e for e in evs if e["ph"] == "X"]
+    instants = [e for e in evs if e["ph"] == "i"]
+    assert {e["name"] for e in xs} == {"serving.http", "serving.predict"}
+    assert instants[0]["name"] == "persist.commit"
+    for e in xs:
+        assert isinstance(e["ts"], float) and isinstance(e["dur"], float)
+        assert e["args"]["request_id"] == "req-d"
+        assert {"pid", "tid", "cat"} <= set(e)
+    child = next(e for e in xs if e["name"] == "serving.predict")
+    parent = next(e for e in xs if e["name"] == "serving.http")
+    assert child["args"]["parent_id"] == parent["args"]["span_id"]
+
+    # tier-1-riding smoke for tools/trace_report.py on the same dump
+    tr = _load_tool("trace_report")
+    rows = tr.report(tr.load_events(path))
+    assert {r["key"] for r in rows} == {"serving.http", "serving.predict"}
+    for r in rows:
+        assert r["count"] == 1
+        assert r["p99_ms"] >= r["p50_ms"] >= 0
+    table = tr.format_table(rows)
+    assert "serving.http" in table and "p99_ms" in table
+    assert tr.main([path, "--by", "group", "--sort", "mean"]) == 0
+    assert "serving" in capsys.readouterr().out
+
+
+# -- live serving: request-id propagation + /statusz + /tracez ----------------
+
+
+@pytest.fixture()
+def served_model(tmp_path):
+    """A serving node with micro-batching ON and a tiny deepfm loaded."""
+    import openembedding_tpu as embed
+    from openembedding_tpu.data import synthetic_criteo
+    from openembedding_tpu.export import export_standalone
+    from openembedding_tpu.model import Trainer
+    from openembedding_tpu.models import make_deepfm
+    from openembedding_tpu.serving import make_server
+
+    model = make_deepfm(vocabulary=256, dim=4, hidden=(8,))
+    trainer = Trainer(model, embed.Adagrad(learning_rate=0.05))
+    batch = next(iter(synthetic_criteo(8, id_space=256, steps=1, seed=0)))
+    state = trainer.init(batch)
+    export_dir = str(tmp_path / "export")
+    export_standalone(state, model, export_dir, model_sign="t-0")
+    srv = make_server(str(tmp_path / "reg"), port=0, batch_window_ms=2.0)
+    srv.manager.load_model("t-0", export_dir)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}", srv, batch
+    srv.shutdown()
+
+
+def test_request_id_propagates_through_live_predict(served_model):
+    """ONE predict request yields >= 4 nested spans (http -> predict ->
+    batch exec -> model call, plus queue wait) all correlated by the
+    caller's X-OETPU-Request-Id, which the response echoes; /metrics gains
+    the predict-latency histogram."""
+    base, srv, batch = served_model
+    body = json.dumps({
+        "sparse": {"categorical":
+                   np.asarray(batch["sparse"]["categorical"]).tolist()},
+        "dense": np.asarray(batch["dense"]).tolist()}).encode()
+    req = urllib.request.Request(
+        f"{base}/models/t-0/predict", data=body, method="POST",
+        headers={"Content-Type": "application/json",
+                 "X-OETPU-Request-Id": "req-e2e"})
+    with urllib.request.urlopen(req) as resp:
+        assert resp.status == 200
+        assert resp.headers["X-OETPU-Request-Id"] == "req-e2e"
+        json.loads(resp.read())
+
+    with urllib.request.urlopen(f"{base}/tracez") as resp:
+        tz = json.loads(resp.read())
+    spans = {s["span_id"]: s for s in tz["spans"]
+             if s["request_id"] == "req-e2e"}
+    names = {s["name"] for s in spans.values()}
+    assert {"http", "predict", "queue_wait", "batch_exec",
+            "model_call"} <= names
+    assert len(spans) >= 4
+
+    # parent chain: model_call -> batch_exec -> predict -> http (depth 4)
+    def chain(s):
+        out = [s["name"]]
+        while s["parent_id"] in spans:
+            s = spans[s["parent_id"]]
+            out.append(s["name"])
+        return out
+
+    mc = next(s for s in spans.values() if s["name"] == "model_call")
+    assert chain(mc) == ["model_call", "batch_exec", "predict", "http"]
+    qw = next(s for s in spans.values() if s["name"] == "queue_wait")
+    assert chain(qw) == ["queue_wait", "predict", "http"]
+    assert all(s["attrs"].get("status") == 200 for s in spans.values()
+               if s["name"] == "http")
+
+    with urllib.request.urlopen(f"{base}/metrics") as resp:
+        text = resp.read().decode()
+    assert 'oetpu_serving_predict_ms_bucket{model="t-0",le="+Inf"} 1' in text
+    assert 'oetpu_serving_predict_ms_count{model="t-0"} 1' in text
+    assert "oetpu_serving_http_ms_bucket" in text
+
+
+def test_statusz_and_tracez_surfaces(served_model):
+    base, srv, batch = served_model
+    with urllib.request.urlopen(f"{base}/statusz") as resp:
+        assert resp.status == 200
+        assert resp.headers["Content-Type"].startswith("text/plain")
+        text = resp.read().decode()
+    assert "== openembedding_tpu serving /statusz ==" in text
+    assert "t-0: step=0 kind=StandaloneModel status=NORMAL" in text
+    assert "-- sync subscribers --" in text
+    assert "-- flight recorder" in text
+    # a request id was generated for the statusz request itself
+    with urllib.request.urlopen(f"{base}/tracez?n=8") as resp:
+        tz = json.loads(resp.read())
+    assert any(s["name"] == "http" and s["request_id"]
+               for s in tz["spans"])
+
+
+def test_trainer_phase_histograms_on_metrics(served_model):
+    """An (eager) train step records trainer.{pull,compute,apply} phase
+    spans; /metrics then exposes them as histogram series."""
+    import openembedding_tpu as embed
+    from openembedding_tpu.data import synthetic_criteo
+    from openembedding_tpu.model import Trainer
+    from openembedding_tpu.models import make_deepfm
+
+    base, srv, _ = served_model
+    model = make_deepfm(vocabulary=128, dim=4, hidden=(8,))
+    trainer = Trainer(model, embed.Adagrad(learning_rate=0.05))
+    batch = next(iter(synthetic_criteo(4, id_space=128, steps=1, seed=2)))
+    state = trainer.init(batch)
+    trainer.train_step(state, batch)  # eager: spans time real execution
+    with urllib.request.urlopen(f"{base}/metrics") as resp:
+        text = resp.read().decode()
+    for phase in ("pull", "compute", "apply"):
+        assert f"# TYPE oetpu_trainer_{phase}_ms histogram" in text
+        assert f"oetpu_trainer_{phase}_ms_count 1" in text

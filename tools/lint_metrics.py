@@ -1,0 +1,84 @@
+"""Lint metric names at observe()/vtimer()/trace.span() call sites.
+
+The documented naming scheme (utils/metrics.py module doc): metric names are
+dot-joined lowercase `group.name[.qualifier]` segments matching `[a-z0-9_]+`
+(e.g. `serving.predict.ms`, `sync.rollbacks`); timer/span call sites pass
+group and name as separate lowercase segments. Per-instance dimensions
+(table, model) belong in labels, never in the name — so a name that smuggles
+one in (`pull.user_table.ms`) reads the same as a conforming name and only a
+human (or this lint) catches it at review time.
+
+Scans literal string arguments only (f-strings and variables pass through —
+they are composed FROM checked literals). `make lint-metrics` runs this and
+fails CI on any violation.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+NAME = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)+$")
+SEGMENT = re.compile(r"^[a-z0-9_]+$")
+
+# observe("metric.name", ...) — metrics.observe or bare observe
+OBSERVE = re.compile(r"""(?<![\w.])(?:metrics\.|M\.)?observe\(\s*
+                         (["'])(?P<name>[^"']+)\1""", re.VERBOSE)
+# vtimer("group", "name") / trace.span("group", "name") / span("group", ...)
+TIMER = re.compile(r"""(?<![\w.])(?:metrics\.|M\.|trace\.|_trace\.)?
+                       (?:vtimer|span)\(\s*
+                       (["'])(?P<group>[^"']+)\1\s*,\s*
+                       (["'])(?P<name>[^"']+)\3""", re.VERBOSE)
+
+SCAN_DIRS = ("openembedding_tpu", "examples", "tools")
+SKIP = {os.path.join("tools", "lint_metrics.py")}
+
+
+def lint_file(path: str, rel: str) -> list:
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    bad = []
+    for m in OBSERVE.finditer(text):
+        name = m.group("name")
+        if not NAME.fullmatch(name):
+            line = text.count("\n", 0, m.start()) + 1
+            bad.append(f"{rel}:{line}: observe({name!r}) — metric names are "
+                       "dot-joined lowercase group.name segments")
+    for m in TIMER.finditer(text):
+        for part in (m.group("group"), m.group("name")):
+            if not SEGMENT.fullmatch(part):
+                line = text.count("\n", 0, m.start()) + 1
+                bad.append(f"{rel}:{line}: timer/span segment {part!r} — "
+                           "group and name are single lowercase "
+                           "[a-z0-9_]+ segments")
+    return bad
+
+
+def main(argv=None) -> int:
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    bad = []
+    for d in SCAN_DIRS:
+        base = os.path.join(root, d)
+        if not os.path.isdir(base):
+            continue
+        for dirpath, _dirs, files in os.walk(base):
+            for fn in sorted(files):
+                if not fn.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fn)
+                rel = os.path.relpath(path, root)
+                if rel in SKIP:
+                    continue
+                bad.extend(lint_file(path, rel))
+    if bad:
+        print("\n".join(bad))
+        print(f"\nlint-metrics: {len(bad)} metric name(s) outside the "
+              "documented group.name scheme (utils/metrics.py)")
+        return 1
+    print("lint-metrics: all observe()/vtimer()/span() call sites conform")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,94 @@
+"""Turn a `trace.dump_chrome()` dump into a per-group latency table.
+
+    python tools/trace_report.py /tmp/serving_trace.json
+    python tools/trace_report.py /tmp/serving_trace.json --by name --sort p99
+
+Reads the Chrome-trace JSON the flight recorder exports (`utils/trace.py
+dump_chrome`, serving `--trace-dump`, examples `--trace-dump`), aggregates
+the complete ("X") events per span name (or per group/category with
+`--by group`) and prints count / mean / p50 / p95 / p99 / max / total
+milliseconds — the offline twin of the live `/metrics` histograms, with the
+advantage that it works on a dump mailed from a production node.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from typing import Dict, List
+
+
+def load_events(path: str) -> List[dict]:
+    with open(path) as f:
+        doc = json.load(f)
+    events = doc.get("traceEvents", doc if isinstance(doc, list) else [])
+    if not isinstance(events, list):
+        raise ValueError(f"{path}: not a Chrome-trace dump "
+                         "(no traceEvents array)")
+    return events
+
+
+def report(events: List[dict], by: str = "name") -> List[dict]:
+    """-> rows [{key, count, mean_ms, p50_ms, p95_ms, p99_ms, max_ms,
+    total_ms}], slowest p99 first. `by`: "name" (span name) or "group"
+    (Chrome-trace category)."""
+    import numpy as np
+
+    if by not in ("name", "group"):
+        raise ValueError(f"by={by!r}: expected 'name' or 'group'")
+    field = "name" if by == "name" else "cat"
+    groups: Dict[str, List[float]] = {}
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        key = str(ev.get(field, "?"))
+        groups.setdefault(key, []).append(float(ev.get("dur", 0.0)) / 1e3)
+    rows = []
+    for key, durs in groups.items():
+        d = np.asarray(durs)
+        rows.append({"key": key, "count": int(d.size),
+                     "mean_ms": float(d.mean()),
+                     "p50_ms": float(np.percentile(d, 50)),
+                     "p95_ms": float(np.percentile(d, 95)),
+                     "p99_ms": float(np.percentile(d, 99)),
+                     "max_ms": float(d.max()),
+                     "total_ms": float(d.sum())})
+    rows.sort(key=lambda r: r["p99_ms"], reverse=True)
+    return rows
+
+
+def format_table(rows: List[dict]) -> str:
+    if not rows:
+        return "(no complete spans in dump)"
+    cols = ("count", "mean_ms", "p50_ms", "p95_ms", "p99_ms", "max_ms",
+            "total_ms")
+    width = max(len("span"), max(len(r["key"]) for r in rows))
+    head = "span".ljust(width) + "".join(c.rjust(12) for c in cols)
+    lines = [head, "-" * len(head)]
+    for r in rows:
+        cells = "".join(
+            (f"{r[c]:d}" if c == "count" else f"{r[c]:.3f}").rjust(12)
+            for c in cols)
+        lines.append(r["key"].ljust(width) + cells)
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="per-group latency table from a trace.dump_chrome() dump")
+    ap.add_argument("dump", help="Chrome-trace JSON path")
+    ap.add_argument("--by", choices=("name", "group"), default="name",
+                    help="aggregate per span name (default) or per group")
+    ap.add_argument("--sort", choices=("p50", "p95", "p99", "mean", "max",
+                                       "total", "count"), default="p99",
+                    help="sort column (descending)")
+    args = ap.parse_args(argv)
+    rows = report(load_events(args.dump), by=args.by)
+    key = args.sort if args.sort == "count" else f"{args.sort}_ms"
+    rows.sort(key=lambda r: r[key], reverse=True)
+    print(format_table(rows))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
