@@ -48,7 +48,7 @@ throughput, not host dispatch latency — the same way production input pipeline
 drive TPUs (the axon tunnel adds ~40 ms per dispatch that would otherwise swamp
 the measurement; see PERF.md "Measurement hygiene").
 
-Env knobs: OETPU_BENCH_CASES=dim9[,dim64][,mesh1][,mesh1f][,pull][,wire][,sync] (default: all),
+Env knobs: OETPU_BENCH_CASES=dim9[,dim64][,mesh1][,mesh1f][,pull][,wire][,sync][,skew] (default: all),
 OETPU_BENCH_BUDGET_S (default 540), OETPU_BENCH_SCAN_STEPS / _REPEATS (smoke runs),
 OETPU_BENCH_TOTAL_BUDGET_S / _PROBE_TIMEOUT_S / _PROBE_INTERVAL_S (orchestrator).
 """
@@ -414,6 +414,57 @@ def case_sync():
         shutil.rmtree(work, ignore_errors=True)
 
 
+def case_skew():
+    """Workload-skew telemetry overhead (round 9): (a) the per-shard load
+    accounting inside the jitted exchange (`sharded.exchange_load_stats`,
+    always-on by default) measured as shard_stats=True vs False on the
+    mesh1 workload, and (b) the host-side Space-Saving + count-min sketch
+    (`utils/sketch.py`) in ms per 4096x26 Zipfian batch — the acceptance
+    bound is combined overhead <= 5% of step time at the defaults."""
+    import jax
+    import openembedding_tpu as embed
+    from openembedding_tpu.models import make_deepfm
+    from openembedding_tpu.parallel import MeshTrainer, make_mesh
+    from openembedding_tpu.utils.sketch import SpaceSaving
+
+    WD.stage("skew:init", 240)
+    batches, stacked = _stacked_batches(9, SCAN_STEPS)
+    eps = {}
+    for flag in (True, False):
+        model = make_deepfm(vocabulary=VOCAB, dim=9)
+        trainer = MeshTrainer(model, embed.Adagrad(learning_rate=0.05),
+                              mesh=make_mesh(jax.devices()[:1]),
+                              shard_stats=flag)
+        state = trainer.init(batches[0])
+        many = trainer.jit_train_many(stacked, state)
+        # same compile allowance as mesh1 (the fused-exchange HLO)
+        eps[flag] = _measure_many(f"skew:stats_{'on' if flag else 'off'}",
+                                  many, state, stacked, compile_s=700)
+    out = {
+        "stats_on_examples_per_sec": round(eps[True], 1),
+        "stats_off_examples_per_sec": round(eps[False], 1),
+        # positive = the load accounting costs throughput
+        "stats_overhead_pct": round((eps[False] / eps[True] - 1.0) * 100, 2),
+    }
+    WD.stage("skew:sketch", 180)
+    sk = SpaceSaving(k=64)
+    id_batches = [np.asarray(b["sparse"]["categorical"]) for b in batches]
+    sk.update(id_batches[0])  # warm the numpy paths
+    t0 = time.perf_counter()
+    for ids in id_batches:
+        sk.update(ids)
+    sketch_ms = (time.perf_counter() - t0) * 1e3 / len(id_batches)
+    step_ms = BATCH / eps[True] * 1e3
+    out["sketch_ms_per_batch"] = round(sketch_ms, 3)
+    # the monitor enqueues and updates on a worker thread, so this is the
+    # WORKER's cost; the step only pays the queue put. Reported against the
+    # step anyway as the worst (synchronous) case.
+    out["sketch_pct_of_step"] = round(sketch_ms / step_ms * 100, 2)
+    out["total_overhead_pct"] = round(
+        out["stats_overhead_pct"] + out["sketch_pct_of_step"], 2)
+    return out
+
+
 def case_pull():
     """Embedding-pull p50 (BASELINE.md metric). A pull = the serving/forward read:
     dedup + row gather for one 4096x26 Zipfian batch against the 2^24-row dim-9
@@ -470,8 +521,9 @@ def main():
     log(f"devices: {devs}")
     EXTRA["platform"] = devs[0].platform
 
-    cases = os.environ.get("OETPU_BENCH_CASES",
-                           "dim9,dim64,mesh1,mesh1f,pull,wire,sync").split(",")
+    cases = os.environ.get(
+        "OETPU_BENCH_CASES",
+        "dim9,dim64,mesh1,mesh1f,pull,wire,sync,skew").split(",")
 
     # PRIMARY first: whatever happens later, this number is in the artifact.
     if "dim9" in cases:
@@ -486,7 +538,8 @@ def main():
                                                name="mesh1f")),
                  ("pull", case_pull),
                  ("wire", case_wire),
-                 ("sync", case_sync)]
+                 ("sync", case_sync),
+                 ("skew", case_skew)]
     for name, fn in secondary:
         if name not in cases:
             continue
@@ -524,6 +577,10 @@ def main():
                 RESULT["metric"] = "sync_fp32_ms_per_delta"
                 RESULT["value"] = out["fp32_ms_per_delta"]
                 RESULT["unit"] = "ms"
+                break
+            if "stats_on_examples_per_sec" in out:
+                RESULT["metric"] = "skew_stats_on_examples_per_sec"
+                RESULT["value"] = out["stats_on_examples_per_sec"]
                 break
 
     WD.clear()

@@ -101,6 +101,11 @@ def main():
                     help="at exit, dump the flight recorder (train-loop "
                          "spans, persist commits) as Chrome-trace JSON; "
                          "summarize with tools/trace_report.py")
+    ap.add_argument("--skew-report", action="store_true",
+                    help="feed per-table id batches into the heavy-hitter "
+                         "sketches (utils/sketch.py, off the hot path) and "
+                         "print the end-of-run hot-id + shard-balance "
+                         "tables beside the trace dump")
     args = ap.parse_args()
     if args.flight_recorder > 0:
         from openembedding_tpu.utils import trace as T
@@ -142,6 +147,9 @@ def main():
               f"batch data-parallel")
     else:
         trainer = Trainer(model, opt)
+    if args.skew_report:
+        # per-table id batches ride offload_prepare into the sketches
+        trainer.enable_skew_monitor()
 
     if args.data:
         rows = read_criteo_tsv(args.data, args.batch_size,
@@ -269,6 +277,13 @@ def main():
     if all_labels:
         print(f"train AUC {auc(np.concatenate(all_labels), np.concatenate(all_scores)):.4f}")
     print(M.report_table())
+    if args.skew_report:
+        from openembedding_tpu.utils import sketch
+        sketch.MONITOR.drain()  # fold every enqueued batch before printing
+        print("== workload skew: hot ids (Space-Saving top-K) ==")
+        print(sketch.MONITOR.render_text())
+        print("== workload skew: shard balance (exchange load) ==")
+        print(sketch.shard_balance_text())
     if args.trace_dump:
         from openembedding_tpu.utils import trace as T
         print(f"trace dump -> {T.dump_chrome(args.trace_dump)}")

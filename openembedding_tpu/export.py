@@ -442,6 +442,13 @@ class StandaloneModel:
 
         first = feat(next(iter(self._tables)))
         n = np.asarray(batch["sparse"][first]).shape[0]
+        # heavy-hitter telemetry (utils/sketch.py): record the RAW request
+        # ids per feature off the hot path (bounded-queue put; padding -1
+        # ids are filtered by the sketch) — covers REST predicts, the
+        # MicroBatcher's merged calls, and direct Python users alike
+        from .utils import sketch
+        for fname, fids in batch["sparse"].items():
+            sketch.record_ids(fname, fids)
         padded = pad_serving_batch(batch, n, bucket_size(n))
         # sparse_as_dense variables were exported as plain array tables, so
         # every spec (PS or sad) resolves through the same lookup here;

@@ -202,6 +202,35 @@ class Trainer:
         # storage="host_cached" variables (tables/host_offload.py), filled by
         # init_tables; empty when every table lives fully in HBM
         self.offload: Dict[str, Any] = {}
+        # heavy-hitter skew telemetry (utils/sketch.py), opt-in via
+        # enable_skew_monitor(): per-table id batches feed the global
+        # Space-Saving sketches off the hot path
+        self._skew = None
+
+    def enable_skew_monitor(self, monitor=None):
+        """Feed every trained batch's ids (per table) into the heavy-hitter
+        sketches (`utils/sketch.MONITOR` unless one is given). The feed is a
+        bounded-queue put per table per batch — batches are DROPPED (and
+        counted in `skew.dropped_batches`) when the sketch worker falls
+        behind, so it can never slow the loop it measures."""
+        from .utils import sketch
+        self._skew = monitor if monitor is not None else sketch.MONITOR
+        return self._skew
+
+    def record_batch_skew(self, batch) -> None:
+        """Enqueue one batch's per-table ids into the skew monitor (no-op
+        until `enable_skew_monitor()`). Called by `offload_prepare`, so the
+        example loops get it for free; scan windows pass stacked batches
+        (the sketch flattens)."""
+        if self._skew is None:
+            return
+        if self.model.batch_transform is not None:
+            batch = self.model.batch_transform(batch)
+        sparse = batch.get("sparse") or {}
+        for name, spec in self.model.ps_specs().items():
+            ids = sparse.get(spec.feature_name)
+            if ids is not None:
+                self._skew.observe(name, ids)
 
     # -- checkpointing (reference: model.save/save_weights/load_weights wiring,
     #    `exb.py:550-583`) -------------------------------------------------------
@@ -271,7 +300,9 @@ class Trainer:
         """Admit the batch's ids into each host-cached table's device cache
         (flushing first if the cache would exceed its high-water mark) and
         return the state with the refreshed cache tables. No-op without
-        host-cached variables."""
+        host-cached variables. Also the per-batch host-side hook the skew
+        monitor rides (`record_batch_skew` — no-op unless enabled)."""
+        self.record_batch_skew(batch)
         if not self.offload:
             return state
         if self.model.batch_transform is not None:

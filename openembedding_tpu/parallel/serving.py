@@ -485,6 +485,11 @@ class ShardedModel:
         # KeyError(name), which the REST layer maps to 400
         first = self.specs[next(iter(self.specs))].feature_name
         n = np.asarray(batch["sparse"][first]).shape[0]
+        # heavy-hitter telemetry: raw request ids per feature, off the hot
+        # path (same hook as StandaloneModel.predict — utils/sketch.py)
+        from ..utils import sketch
+        for fname, fids in batch["sparse"].items():
+            sketch.record_ids(fname, fids)
         padded = pad_serving_batch(batch, n, bucket_size(n))
         from ..embedding import serve_rows  # shared combiner-aware embed
         embedded = {}

@@ -207,6 +207,59 @@ def grouped_make_plans(specs, ids_list, *, axis: str = DATA_AXIS,
             for (uniq, buckets, cap), seg in zip(parts, segs)]
 
 
+def _flat_axis_index(axis) -> jax.Array:
+    """This device's flattened position along `axis` (tuple axes compose
+    row-major, matching the flattened collective order)."""
+    if isinstance(axis, (tuple, list)):
+        idx = jnp.zeros((), jnp.int32)
+        for a in axis:
+            idx = idx * jax.lax.axis_size(a) + jax.lax.axis_index(a)
+        return idx
+    return jax.lax.axis_index(axis)
+
+
+def exchange_load_stats(plan: ExchangePlan, *, axis: str = DATA_AXIS
+                        ) -> Dict[str, jax.Array]:
+    """Per-shard load accounting from one pull plan — the workload-skew
+    counters Parallax (arXiv:1808.02621) argues partitioning must be tuned
+    by, computed INSIDE the already-jitted step (pure array math on the
+    plan; no host sync, no extra collective — the caller's stats psum
+    carries them out).
+
+    Each (S,) vector is this device's local contribution; after the stats
+    psum (`reduce_metrics`) they read as:
+
+    - ``shard_rows[d]``   — unique rows shard *d* serves this step (the
+      wire/gather load; this source's routed-unique count per destination).
+    - ``shard_positions[d]`` — duplicate-WEIGHTED id positions owned by
+      shard *d* (the access skew `exchange.shard_imbalance` derives from —
+      dedup hides it from shard_rows, real traffic concentrates it).
+    - ``bucket_fill[s]``  — fraction of source shard *s*'s fullest outgoing
+      a2a bucket (one-hot at this shard, so the psum assembles the
+      per-source vector). The hash-routing bucket-occupancy/overflow
+      predictor: raise `capacity_factor` while it nears 1.0.
+
+    `metrics.record_step_stats` folds these into labeled gauges
+    (`exchange.shard_rows{table=,shard=}`) and the derived
+    `exchange.shard_imbalance{table=}` histogram."""
+    S = jax.lax.axis_size(axis)
+    routed = jnp.sum(plan.buckets.bucket_valid, axis=1).astype(jnp.int32)
+    # duplicate-weighted positions per destination: sum each unique slot's
+    # count into its owner segment. `buckets.owner` is ASCENDING (the
+    # owner-major sort in `unique_and_route`; zeros at S == 1), so this is
+    # the vectorized sorted-segment path — an unsorted scatter-add
+    # serializes (the ops/dedup.py lesson). Invalid/padding slots carry
+    # owner == S at S > 1 and count 0 at S == 1 — either way they drop out.
+    w = jnp.where(plan.uniq.counts > 0, plan.uniq.counts, 0).astype(jnp.int32)
+    positions = jax.ops.segment_sum(
+        w, plan.buckets.owner, num_segments=S + 1,
+        indices_are_sorted=True)[:S].astype(jnp.int32)
+    occ = routed.max().astype(jnp.float32) / float(max(plan.cap, 1))
+    fill = jnp.zeros((S,), jnp.float32).at[_flat_axis_index(axis)].set(occ)
+    return {"shard_rows": routed, "shard_positions": positions,
+            "bucket_fill": fill}
+
+
 def _serve_rows(spec: EmbeddingSpec, state: EmbeddingTableState,
                 plan: ExchangePlan, *, train: bool, axis: str
                 ) -> Tuple[EmbeddingTableState, jax.Array]:
@@ -266,9 +319,12 @@ def sharded_lookup_train(
     *,
     axis: str = DATA_AXIS,
     capacity_factor: float = 0.0,
+    load_stats: bool = True,
 ) -> Tuple[EmbeddingTableState, jax.Array, Dict[str, jax.Array], ExchangePlan]:
     """Training pull inside shard_map. Returns (new_shard_state, rows, stats, plan);
-    feed the plan to `sharded_apply_gradients` for the same batch."""
+    feed the plan to `sharded_apply_gradients` for the same batch.
+    `load_stats=False` drops the per-shard skew vectors
+    (`exchange_load_stats`) from the stats dict."""
     ids = adapt_batch_ids(spec, state, ids)
     plan = make_plan(spec, ids, axis=axis, capacity_factor=capacity_factor)
     state, rows = _serve_rows(spec, state, plan, train=True, axis=axis)
@@ -279,6 +335,8 @@ def sharded_lookup_train(
         "pull_unique": plan.uniq.num_unique,                # `pull_unique` counter
         "pull_overflow": plan.buckets.overflow,
     }
+    if load_stats:
+        stats.update(exchange_load_stats(plan, axis=axis))
     return state, out, stats, plan
 
 
@@ -423,10 +481,13 @@ def grouped_lookup_train(
     axis: str = DATA_AXIS,
     capacity_factor: float = 0.0,
     wire: Optional[str] = None,
+    load_stats: bool = True,
 ):
     """Fused training pull for one dim-group. Returns (new_states, outs,
     stats_list, plans) — parallel lists in the input order; feed `plans` to
-    `grouped_apply_gradients` for the same batch."""
+    `grouped_apply_gradients` for the same batch. `load_stats=False` drops
+    the per-shard skew vectors (`exchange_load_stats`) from each table's
+    stats dict."""
     from ..ops import wire as wire_mod
     S = jax.lax.axis_size(axis)
     dim = specs[0].output_dim
@@ -468,11 +529,16 @@ def grouped_lookup_train(
             out = jnp.take(uniq_rows, plan.uniq.inverse, axis=0)
             outs.append(out.astype(spec.dtype).reshape(
                 _out_shape(spec, ids) + (spec.output_dim,)))
-    stats_list = [{
-        "pull_indices": jnp.asarray(ids_positions(spec, ids), jnp.int32),
-        "pull_unique": plan.uniq.num_unique,
-        "pull_overflow": plan.buckets.overflow,
-    } for spec, ids, plan in zip(specs, ids_list, plans)]
+    stats_list = []
+    for spec, ids, plan in zip(specs, ids_list, plans):
+        st = {
+            "pull_indices": jnp.asarray(ids_positions(spec, ids), jnp.int32),
+            "pull_unique": plan.uniq.num_unique,
+            "pull_overflow": plan.buckets.overflow,
+        }
+        if load_stats:
+            st.update(exchange_load_stats(plan, axis=axis))
+        stats_list.append(st)
     return new_states, outs, stats_list, plans
 
 

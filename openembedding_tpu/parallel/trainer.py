@@ -38,7 +38,8 @@ class MeshTrainer(Trainer):
                  capacity_factor: float = 0.0,
                  on_overflow: str = "count",
                  wire: Optional[str] = None,
-                 group_exchange: bool = True):
+                 group_exchange: bool = True,
+                 shard_stats: bool = True):
         super().__init__(model, optimizer, seed)
         self.mesh = mesh if mesh is not None else make_mesh()
         self.axis = self.mesh.axis_names[0]
@@ -55,6 +56,13 @@ class MeshTrainer(Trainer):
         # static wire-cost model of the last traced step (set at trace time;
         # also published as exchange.* gauges — utils/metrics.py)
         self.last_wire_cost = None
+        # per-shard load accounting inside the jitted step (workload-skew
+        # telemetry: `sharded.exchange_load_stats` -> exchange.shard_rows /
+        # shard_positions / bucket_fill vectors in the step stats, folded to
+        # labeled gauges by `metrics.record_step_stats`). Pure array math on
+        # the routing plan — bench.py's `skew` case bounds the cost; turn
+        # off to shave the last percent from a tuned production step
+        self.shard_stats = shard_stats
         # bounded buckets can DROP ids (divergence from the reference's
         # unbounded buffers, `EmbeddingPullOperator.cpp:86-112`); the policy
         # when `check_overflow` sees drops: "count" (watch the counters),
@@ -262,7 +270,7 @@ class MeshTrainer(Trainer):
                 new_states, outs, stats_list, plan_list = grouped_lookup_train(
                     specs, [tables[n] for n in names], ids_list,
                     axis=self.axis, capacity_factor=self.capacity_factor,
-                    wire=self.wire)
+                    wire=self.wire, load_stats=self.shard_stats)
                 for n, ts, out, st, pl in zip(names, new_states, outs,
                                               stats_list, plan_list):
                     pulled_tables[n], pulled[n], plans[n] = ts, out, pl
@@ -347,7 +355,8 @@ class MeshTrainer(Trainer):
     def table_pull(self, spec, table, ids):
         return sharded_lookup_train(
             spec, table, ids, axis=self.axis,
-            capacity_factor=self.capacity_factor)
+            capacity_factor=self.capacity_factor,
+            load_stats=self.shard_stats)
 
     def table_apply(self, spec, table, ids, grads, plan=None):
         return sharded_apply_gradients(
@@ -450,14 +459,15 @@ class SeqMeshTrainer(MeshTrainer):
 
     def __init__(self, model, optimizer=None, *, mesh: Mesh, seed: int = 0,
                  capacity_factor: float = 0.0, wire: Optional[str] = None,
-                 group_exchange: bool = True):
+                 group_exchange: bool = True, shard_stats: bool = True):
         if len(mesh.axis_names) != 2:
             raise ValueError(
                 f"SeqMeshTrainer needs a 2-D (data, seq) mesh, got axes "
                 f"{mesh.axis_names}")
         super().__init__(model, optimizer, mesh=mesh, seed=seed,
                          capacity_factor=capacity_factor, wire=wire,
-                         group_exchange=group_exchange)
+                         group_exchange=group_exchange,
+                         shard_stats=shard_stats)
         self.data_axis, self.seq_axis = mesh.axis_names
         # collectives (sparse exchange, psum, metrics) span the flattened mesh
         self.axis = tuple(mesh.axis_names)

@@ -329,6 +329,7 @@ class ServingHandler(BaseHTTPRequestHandler):
     batcher: "Optional[MicroBatcher]" = None  # set when batching is enabled
     publishers: dict = {}   # model_sign -> sync.SyncPublisher (make_server)
     subscribers: dict = {}  # model_sign -> sync.SyncSubscriber (make_server)
+    peers: list = []        # default /fleetz scrape set (make_server/--peers)
     node_info: dict = {}
     quiet = True
 
@@ -417,6 +418,8 @@ class ServingHandler(BaseHTTPRequestHandler):
             return "healthz", None, None
         if path == "/metrics":
             return "metrics", None, None
+        if path == "/fleetz":
+            return "fleetz", None, None
         if path == "/statusz":
             return "statusz", None, None
         if path == "/tracez":
@@ -491,10 +494,51 @@ class ServingHandler(BaseHTTPRequestHandler):
             except Exception as e:  # noqa: BLE001
                 lines.append(f"{sign}: (feed error: {e})")
         lines.append("")
+        lines.append("-- workload skew (hot ids) --")
+        from .utils import sketch
+        lines.append(sketch.MONITOR.render_text(
+            top=int(self.query.get("top", 8)) if hasattr(self, "query")
+            else 8))
+        lines.append("")
         n = int(self.query.get("n", 40)) if hasattr(self, "query") else 40
         lines.append(f"-- flight recorder (last {n}) --")
         lines.append(trace.RECORDER.render_text(n))
         return "\n".join(lines) + "\n"
+
+    def _fleetz_text(self) -> str:
+        """Merged fleet /metrics: this node's scrape + every peer's, summed
+        per `utils/metrics.merge_prometheus` (counters + histogram buckets
+        sum; gauges keep an `instance` label). Peers come from `?peers=`
+        (comma-separated base URLs) or the node's `--peers` config;
+        unreachable peers degrade to a comment line, never a 500 — a fleet
+        view with one dead node is still a fleet view."""
+        import urllib.request
+        from .utils import metrics as metrics_mod
+        from .utils import sketch
+        sketch.MONITOR.publish()
+        q = self.query.get("peers") if hasattr(self, "query") else None
+        peers = ([p for p in q.split(",") if p] if q is not None
+                 else list(self.peers))
+        scrapes = [(self.node_info.get("node_id", "self"),
+                    metrics_mod.prometheus_text())]
+        comments = [f"# fleet: {1 + len(peers)} node(s): self + "
+                    + (", ".join(peers) if peers else "(no peers)")]
+        for peer in peers:
+            url = peer.rstrip("/")
+            if not url.startswith("http"):
+                url = f"http://{url}"
+            try:
+                with urllib.request.urlopen(f"{url}/metrics",
+                                            timeout=5.0) as r:
+                    scrapes.append((peer, r.read().decode()))
+            except Exception as e:  # noqa: BLE001 — degrade, don't 500
+                comments.append(f"# fleet: peer {peer} unreachable: {e}")
+                metrics_mod.observe("fleet.scrape_errors", 1)
+        metrics_mod.observe("fleet.peers", float(len(peers)), "gauge")
+        metrics_mod.observe("fleet.nodes_answering", float(len(scrapes)),
+                            "gauge")
+        return ("\n".join(comments) + "\n"
+                + metrics_mod.merge_prometheus(scrapes))
 
     def do_GET(self):  # noqa: N802 (http.server API)
         return self._traced("GET", self._handle_get)
@@ -584,7 +628,9 @@ class ServingHandler(BaseHTTPRequestHandler):
             if kind == "healthz":
                 return self._json(200, {"status": "ok"})
             if kind == "metrics":
+                from .utils import sketch
                 from .utils.metrics import prometheus_text
+                sketch.MONITOR.publish()  # fold top-K into skew.* gauges
                 body = prometheus_text().encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "text/plain; version=0.0.4")
@@ -592,6 +638,8 @@ class ServingHandler(BaseHTTPRequestHandler):
                 self.end_headers()
                 self.wfile.write(body)
                 return None
+            if kind == "fleetz":
+                return self._text(self._fleetz_text())
             if kind == "statusz":
                 return self._text(self._statusz_text())
             if kind == "tracez":
@@ -681,6 +729,10 @@ class ServingHandler(BaseHTTPRequestHandler):
                     sign, self._field(body, "variable"))
                 ids = self._coerce(_pull_ids, self._field(body, "ids"),
                                    "ids")
+                # heavy-hitter telemetry, off the hot path (bounded queue;
+                # predict ids are recorded by the servables themselves)
+                from .utils import sketch
+                sketch.record_ids(variable, ids)
                 rows = model.lookup(variable, ids)
                 # content negotiation: `Accept: application/octet-stream`
                 # streams the rows as npz — JSON-encoding a big pull is pure
@@ -1106,14 +1158,17 @@ def _page_restore(get, manifest, model_sign: str, dest: str, peer: str,
 def make_server(registry_root: str, host: str = "127.0.0.1", port: int = 0, *,
                 batch_window_ms: float = 0.0, max_batch: int = 4096,
                 publish: Optional[Dict[str, str]] = None,
-                publish_wire: Optional[str] = None
+                publish_wire: Optional[str] = None,
+                peers: Optional[list] = None
                 ) -> ThreadingHTTPServer:
     """Build (not start) the serving HTTP server; port 0 picks a free port.
     `batch_window_ms > 0` turns on predict micro-batching (`MicroBatcher`).
     `publish` ({model_sign: persist_root}) registers online-sync publishers
     (the trainer-side half of `sync/`; more can be added at runtime via
     POST /models/<sign>/publish, and subscribers attach via
-    POST /models/<sign>/sync)."""
+    POST /models/<sign>/sync). `peers` (base URLs of other fleet nodes)
+    seeds the `GET /fleetz` merged-metrics scrape set (overridable per
+    request with `?peers=`)."""
     registry = ModelRegistry(registry_root)
     manager = ModelManager(registry)
 
@@ -1126,6 +1181,7 @@ def make_server(registry_root: str, host: str = "127.0.0.1", port: int = 0, *,
                        if batch_window_ms > 0 else None)
     Handler.publishers = {}
     Handler.subscribers = {}
+    Handler.peers = list(peers or [])
     if publish:
         from .sync import SyncPublisher
         for sign, root in publish.items():
@@ -1133,7 +1189,8 @@ def make_server(registry_root: str, host: str = "127.0.0.1", port: int = 0, *,
     Handler.node_info = {"node_id": f"{os.uname().nodename}:{os.getpid()}",
                          "registry": registry_root,
                          "batch_window_ms": batch_window_ms,
-                         "publishes": sorted(Handler.publishers)}
+                         "publishes": sorted(Handler.publishers),
+                         "peers": Handler.peers}
     httpd = ThreadingHTTPServer((host, port), Handler)
     httpd.manager = manager
     httpd.publishers = Handler.publishers
@@ -1167,6 +1224,11 @@ def main(argv=None) -> int:
     ap.add_argument("--sync-wire", default=None,
                     help="row encoding on the sync wire "
                          "(fp32|bf16|int8; default fp32)")
+    ap.add_argument("--peers", action="append", default=[], metavar="URL",
+                    help="other fleet nodes' base URLs (repeatable, or "
+                         "comma-separated): GET /fleetz on this node merges "
+                         "their /metrics with its own (counters + histogram "
+                         "buckets sum, gauges keep an instance label)")
     ap.add_argument("--flight-recorder", type=int, default=0, metavar="N",
                     help="resize the span/event flight recorder ring buffer "
                          "(0 keeps the default; tail shows on GET /statusz, "
@@ -1192,7 +1254,9 @@ def main(argv=None) -> int:
                         batch_window_ms=args.batch_window_ms,
                         max_batch=args.max_batch,
                         publish=kv(args.publish, "publish"),
-                        publish_wire=args.sync_wire)
+                        publish_wire=args.sync_wire,
+                        peers=[p for arg in args.peers
+                               for p in arg.split(",") if p])
     from .sync import SyncSubscriber
     for sign, feed in kv(args.sync_from, "sync-from").items():
         httpd.subscribers[sign] = SyncSubscriber(

@@ -131,30 +131,17 @@ class Accumulator:
         if self.kind != "hist":
             raise ValueError(f"metric {self.name!r} ({self.kind}) has no "
                              "quantiles; use kind='hist'")
-        with self._lock:
-            n = self._count
-            if n == 0:
-                return 0.0
-            target = q * n
-            cum = 0.0
-            for i, c in enumerate(self._buckets):
-                if c == 0:
-                    continue
-                if cum + c >= target:
-                    lo = HIST_BOUNDS[i - 1] if i > 0 else 0.0
-                    hi = HIST_BOUNDS[i] if i < len(HIST_BOUNDS) else self._max
-                    lo = max(lo, self._min)
-                    hi = min(hi, self._max)
-                    if hi < lo:
-                        hi = lo
-                    return lo + (hi - lo) * ((target - cum) / c)
-                cum += c
-            return self._max
+        return snapshot_quantile(self.hist_snapshot(), q)
 
-    def hist_snapshot(self) -> Tuple[List[int], float, int]:
-        """-> (per-bucket counts incl. overflow, sum, count), consistent."""
+    def hist_snapshot(self) -> Tuple[List[int], float, int, float, float]:
+        """-> (per-bucket counts incl. overflow, sum, count, min, max) under
+        ONE lock acquisition — the consistent view `report()` and
+        `prometheus_text()` derive mean AND quantiles from (separate
+        value()/quantile() reads under load could pair a newer count with an
+        older bucket array)."""
         with self._lock:
-            return list(self._buckets), self._total, self._count
+            return (list(self._buckets), self._total, self._count,
+                    self._min, self._max)
 
     @property
     def count(self) -> int:
@@ -168,6 +155,30 @@ class Accumulator:
             self._min = float("inf")
             if self.kind == "hist":
                 self._buckets = [0] * (len(HIST_BOUNDS) + 1)
+
+
+def snapshot_quantile(snapshot, q: float) -> float:
+    """Quantile from one `hist_snapshot()` (buckets, sum, count, min, max)
+    by linear interpolation inside the owning bucket, clamped to the
+    observed min/max."""
+    buckets, _total, n, vmin, vmax = snapshot
+    if n == 0:
+        return 0.0
+    target = q * n
+    cum = 0.0
+    for i, c in enumerate(buckets):
+        if c == 0:
+            continue
+        if cum + c >= target:
+            lo = HIST_BOUNDS[i - 1] if i > 0 else 0.0
+            hi = HIST_BOUNDS[i] if i < len(HIST_BOUNDS) else vmax
+            lo = max(lo, vmin)
+            hi = min(hi, vmax)
+            if hi < lo:
+                hi = lo
+            return lo + (hi - lo) * ((target - cum) / c)
+        cum += c
+    return vmax
 
 
 def observe(name: str, value: float, kind: str = "sum",
@@ -217,21 +228,64 @@ def record_step_stats(stats: Dict[str, "object"]) -> None:
     arrays, numpy scalars, and plain floats interchangeably. Per-table stats
     (`{var}/{stat}` keys) additionally publish as LABELED counters
     (`oetpu_trainer_pull_indices_total{table="user"}`) so per-table skew
-    reads straight off /metrics."""
+    reads straight off /metrics.
+
+    VECTOR stats are the per-shard load accounting from the jitted exchange
+    (`parallel/sharded.exchange_load_stats`): a `{var}/{stat}` key holding an
+    (S,) array folds into per-shard labeled gauges
+    (`exchange.shard_rows{table=,shard=}`), `shard_positions` additionally
+    derives the `exchange.shard_imbalance{table=}` histogram (max/mean over
+    shards — Parallax's access-skew number), and
+    `pull_unique`/`pull_indices` derive `exchange.unique_ratio{table=}`."""
     try:
         import jax
         stats = jax.device_get(dict(stats))
     except Exception:  # noqa: BLE001 — metrics must never break the loop
         pass
+    import numpy as np
+    per_table: Dict[str, Dict[str, float]] = {}
     for key, value in stats.items():
+        var, sep, stat = key.partition("/")
+        table_stat = sep and "/" not in stat
         try:
+            if np.ndim(value) >= 1:
+                if table_stat and stat in _SHARD_STATS:
+                    _fold_shard_stat(var, stat,
+                                     np.asarray(value, np.float64).reshape(-1))
+                    continue
+                if np.size(value) > 1:
+                    continue  # unknown vector stat: nothing sane to fold
             v = float(value)
         except (TypeError, ValueError):
             continue
         observe(key.replace("/", "."), v)
-        var, sep, stat = key.partition("/")
-        if sep and "/" not in stat:
+        if table_stat:
             observe(f"trainer.{stat}", v, "sum", labels={"table": var})
+            per_table.setdefault(var, {})[stat] = v
+    for var, d in per_table.items():
+        if d.get("pull_indices"):
+            observe("exchange.unique_ratio",
+                    d.get("pull_unique", 0.0) / d["pull_indices"], "gauge",
+                    labels={"table": var})
+
+
+# per-shard vector stats emitted by `parallel/sharded.exchange_load_stats`
+_SHARD_STATS = ("shard_rows", "shard_positions", "bucket_fill")
+
+
+def _fold_shard_stat(var: str, stat: str, vec) -> None:
+    """One per-shard vector stat -> labeled gauges + derived imbalance.
+    `shard_rows`/`shard_positions` index by DESTINATION shard (who serves),
+    `bucket_fill` by SOURCE shard (whose outgoing a2a bucket is fullest) —
+    see `parallel/sharded.exchange_load_stats`."""
+    for i, v in enumerate(vec):
+        observe(f"exchange.{stat}", float(v), "gauge",
+                labels={"table": var, "shard": str(i)})
+    if stat == "shard_positions":
+        mean = float(vec.mean())
+        if mean > 0:
+            observe("exchange.shard_imbalance", float(vec.max()) / mean,
+                    "hist", labels={"table": var})
 
 
 def report(reset: bool = False) -> Dict[str, float]:
@@ -244,10 +298,18 @@ def report(reset: bool = False) -> Dict[str, float]:
         accs = list(_REGISTRY.values())
     out: Dict[str, float] = {}
     for a in accs:
-        out[a.key] = a.value()
-        if a.kind == "hist" and a.count:
-            for q, suffix in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
-                out[f"{a.key}.{suffix}"] = a.quantile(q)
+        if a.kind == "hist":
+            # ONE snapshot per accumulator: mean and quantiles derive from
+            # the same locked view, so a report taken under load can never
+            # show quantiles inconsistent with count/sum
+            snap = a.hist_snapshot()
+            count = snap[2]
+            out[a.key] = snap[1] / count if count else 0.0
+            if count:
+                for q, suffix in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
+                    out[f"{a.key}.{suffix}"] = snapshot_quantile(snap, q)
+        else:
+            out[a.key] = a.value()
     if reset:
         for a in accs:
             if a.kind not in ("gauge", "hist"):
@@ -320,7 +382,7 @@ def prometheus_text() -> str:
                 lines.append(f"# HELP {family} {a.help}")
             lines.append(f"# TYPE {family} {ptype}")
         if a.kind == "hist":
-            buckets, total, count = a.hist_snapshot()
+            buckets, total, count, _mn, _mx = a.hist_snapshot()
             cum = 0
             for i, c in enumerate(buckets[:-1]):
                 if c == 0:
@@ -361,7 +423,12 @@ class PeriodicReporter:
 
     def _run(self) -> None:
         while not self._stop.wait(self.interval):
-            self.sink("== accumulator report ==\n" + report_table(reset=self.reset))
+            try:
+                self.sink("== accumulator report ==\n"
+                          + report_table(reset=self.reset))
+            except Exception:  # noqa: BLE001 — a broken pipe/sink must not
+                # kill periodic reporting for the rest of the run
+                observe("metrics.report_errors", 1)
 
     def stop(self) -> None:
         self._stop.set()
@@ -374,6 +441,194 @@ class PeriodicReporter:
 
     def __exit__(self, *exc) -> None:
         self.stop()
+
+
+# ---------------------------------------------------------------------------
+# Fleet aggregation: parse + merge Prometheus text scrapes from N nodes into
+# one exposition (`GET /fleetz` on any serving node, tools/metrics_fleet.py).
+# Every node's /metrics is otherwise an island; one trainer + N replicas
+# should answer "is the whole fleet healthy" from ONE endpoint.
+# ---------------------------------------------------------------------------
+
+_SAMPLE_RE = None  # compiled lazily (re import kept local below)
+
+
+def parse_prometheus(text: str) -> Dict[str, "object"]:
+    """Parse a Prometheus text-exposition scrape.
+
+    -> {"types": {family: type}, "help": {family: text},
+        "samples": [(name, {label: raw_value}, float), ...]} in input order.
+    Label values keep their ESCAPED form (the merger re-emits them
+    verbatim); timestamps are not supported (we never emit them)."""
+    import re
+    global _SAMPLE_RE
+    if _SAMPLE_RE is None:
+        _SAMPLE_RE = (
+            re.compile(r"^([A-Za-z_:][A-Za-z0-9_:]*)"
+                       r"(?:\{(.*)\})?\s+([^\s]+)\s*$"),
+            re.compile(r'([A-Za-z_][A-Za-z0-9_]*)="((?:[^"\\]|\\.)*)"'))
+    sample_re, label_re = _SAMPLE_RE
+    types: Dict[str, str] = {}
+    helps: Dict[str, str] = {}
+    samples = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                types[parts[2]] = parts[3]
+            elif len(parts) >= 3 and parts[1] == "HELP":
+                helps[parts[2]] = parts[3] if len(parts) > 3 else ""
+            continue
+        m = sample_re.match(line)
+        if m is None:
+            continue
+        name, raw_labels, raw_value = m.groups()
+        try:
+            value = float(raw_value)
+        except ValueError:
+            continue
+        labels = dict(label_re.findall(raw_labels)) if raw_labels else {}
+        samples.append((name, labels, value))
+    return {"types": types, "help": helps, "samples": samples}
+
+
+def _series_family(name: str, types: Dict[str, str]) -> Tuple[str, str]:
+    """-> (family, type) for one sample name. Histogram children
+    (`_bucket`/`_sum`/`_count`) resolve to their base family."""
+    if name in types:
+        return name, types[name]
+    for suffix in ("_bucket", "_sum", "_count"):
+        if name.endswith(suffix):
+            base = name[:-len(suffix)]
+            if types.get(base) == "histogram":
+                return base, "histogram"
+    # untyped: infer counters by convention so foreign scrapes still merge
+    if name.endswith("_total"):
+        return name, "counter"
+    return name, "untyped"
+
+
+def merge_prometheus(scrapes) -> str:
+    """Merge N Prometheus text scrapes into one fleet exposition.
+
+    `scrapes`: [(instance, text), ...] (or bare texts, numbered). Merge
+    rules: counters and histogram series SUM across instances per label set
+    (histogram `_bucket` series are de-cumulated per instance, summed on the
+    union `le` grid, and re-cumulated — nodes may elide different empty
+    buckets); gauges/untyped keep per-instance series (an `instance` label
+    is added; the last write wins per (labels, instance), so re-merging a
+    merged scrape is stable). The fleet `_count` of every histogram equals
+    the sum of the parts' `_count` — the invariant tests pin."""
+    pairs = [s if isinstance(s, tuple) else (f"node{i}", s)
+             for i, s in enumerate(scrapes)]
+    parsed = [(inst, parse_prometheus(text)) for inst, text in pairs]
+    types: Dict[str, str] = {}
+    helps: Dict[str, str] = {}
+    for _inst, p in parsed:
+        for k, v in p["types"].items():
+            types.setdefault(k, v)
+        for k, v in p["help"].items():
+            helps.setdefault(k, v)
+
+    def lkey(labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
+        return tuple(sorted(labels.items()))
+
+    sums: Dict[Tuple, float] = {}
+    gauges: Dict[Tuple, float] = {}
+    # (family, labels-without-le) -> {instance: {le_string: cum_value}}
+    hists: Dict[Tuple, Dict[str, Dict[str, float]]] = {}
+    order: List[Tuple[str, Tuple]] = []  # first-seen emit order
+    order_seen = set()
+
+    def seen(kind: str, key: Tuple) -> None:
+        tag = (kind, key)
+        if tag not in order_seen:
+            order_seen.add(tag)
+            order.append(tag)
+
+    for inst, p in parsed:
+        for name, labels, value in p["samples"]:
+            family, ptype = _series_family(name, p["types"] or types)
+            if ptype == "histogram" and name.endswith("_bucket"):
+                base = dict(labels)
+                le = base.pop("le", "+Inf")
+                key = (family, name, lkey(base))
+                hists.setdefault(key, {}).setdefault(inst, {})[le] = value
+                seen("hist", key)
+            elif ptype in ("counter", "histogram"):
+                key = (name, lkey(labels))
+                sums[key] = sums.get(key, 0.0) + value
+                seen("sum", key)
+            else:
+                labeled = dict(labels)
+                labeled["instance"] = _esc(inst)
+                key = (name, lkey(labeled))
+                gauges[key] = value
+                seen("gauge", key)
+
+    def fmt_labels(items: Tuple[Tuple[str, str], ...]) -> str:
+        if not items:
+            return ""
+        return "{" + ",".join(f'{k}="{v}"' for k, v in items) + "}"
+
+    lines: List[str] = []
+    emitted_family = set()
+
+    def family_header(name: str) -> None:
+        family, ptype = _series_family(name, types)
+        if family in emitted_family:
+            return
+        emitted_family.add(family)
+        if family in helps:
+            lines.append(f"# HELP {family} {helps[family]}")
+        if ptype != "untyped":
+            lines.append(f"# TYPE {family} {ptype}")
+
+    done_hist = set()
+    for kind, key in order:
+        if kind == "hist":
+            if key in done_hist:
+                continue
+            done_hist.add(key)
+            family, name, base_items = key
+            family_header(name)
+            # de-cumulate each instance on its own le grid, sum increments
+            # on the union grid, re-cumulate ascending
+            def le_sort(le: str) -> float:
+                return float("inf") if le in ("+Inf", "inf") else float(le)
+            incr: Dict[str, float] = {}
+            for inst_series in hists[key].values():
+                les = sorted(inst_series, key=le_sort)
+                prev = 0.0
+                for le in les:
+                    incr[le] = incr.get(le, 0.0) + (inst_series[le] - prev)
+                    prev = inst_series[le]
+            cum = 0.0
+            for le in sorted(incr, key=le_sort):
+                cum += incr[le]
+                items = base_items + (("le", le),)
+                items = tuple(sorted(items))
+                lines.append(f"{name}{fmt_labels(items)} {_fmt_num(cum)}")
+        elif kind == "sum":
+            name, items = key
+            if key not in sums:
+                continue
+            family_header(name)
+            lines.append(f"{name}{fmt_labels(items)} {_fmt_num(sums[key])}")
+        else:
+            name, items = key
+            if key not in gauges:
+                continue
+            family_header(name)
+            lines.append(f"{name}{fmt_labels(items)} {_fmt_num(gauges[key])}")
+    return "\n".join(lines) + "\n"
+
+
+def _fmt_num(v: float) -> str:
+    return str(int(v)) if float(v).is_integer() else repr(float(v))
 
 
 def auc(labels, scores) -> float:

@@ -1,0 +1,435 @@
+"""Workload-skew telemetry: heavy-hitter sketches, per-shard load accounting,
+and fleet-wide /metrics aggregation (round 9).
+
+E2E acceptance (ISSUE 4): a Zipf id stream through the sharded exchange must
+raise `exchange.shard_imbalance` above a uniform stream's; the Space-Saving
+top-K must contain the true top-K of an exact counter (with the documented
+`est - err <= true <= est` bound); `/statusz` shows the hot-id table; and
+`merge_prometheus` over two live node scrapes yields histogram bucket counts
+equal to the sum of the parts (verified against each node's `_count`)."""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+import openembedding_tpu as embed
+from openembedding_tpu.utils import metrics
+from openembedding_tpu.utils.sketch import (CountMin, SkewMonitor,
+                                            SpaceSaving, shard_balance_text)
+
+S = 8  # conftest forces 8 virtual CPU devices
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    metrics._REGISTRY.clear()
+    yield
+    metrics._REGISTRY.clear()
+
+
+# -- sketches -----------------------------------------------------------------
+
+
+def test_count_min_never_undercounts():
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 5000, size=200_000)
+    cm = CountMin(width=2048, depth=4)
+    uniq, cnt = np.unique(ids, return_counts=True)
+    for chunk in np.array_split(np.arange(uniq.size), 7):
+        cm.add(uniq[chunk], cnt[chunk])
+    est = cm.query(uniq)
+    assert (est >= cnt).all()  # over-count only, by construction
+    assert cm.total == ids.size
+
+
+def test_space_saving_topk_contains_true_topk():
+    """The acceptance bound: the sketch's tracked set must contain the exact
+    counter's true top-K, and every tracked estimate must satisfy
+    est - err <= true <= est (the documented Space-Saving invariant)."""
+    rng = np.random.default_rng(1)
+    # heavy Zipf head over a vocab far bigger than the sketch
+    ids = rng.zipf(1.3, size=300_000)
+    ids = ids[ids < 100_000]
+    sk = SpaceSaving(k=64)
+    for chunk in np.array_split(ids, 23):  # stream in batches
+        sk.update(chunk)
+    uniq, cnt = np.unique(ids, return_counts=True)
+    true = dict(zip(uniq.tolist(), cnt.tolist()))
+    true_top10 = set(uniq[np.argsort(-cnt)][:10].tolist())
+    tracked = {hid: (est, err) for hid, est, err in sk.topk()}
+    missing = true_top10 - set(tracked)
+    assert not missing, f"true top-10 ids missing from sketch: {missing}"
+    for hid in true_top10:
+        est, err = tracked[hid]
+        assert est - err <= true[hid] <= est, (hid, est, err, true[hid])
+    assert sk.total == ids.size
+
+
+def test_space_saving_pair_and_padding_ids():
+    """Split-pair (n, 2) uint32 batches re-join to int64; -1 serving padding
+    is dropped, not counted."""
+    from openembedding_tpu.ops.id64 import np_split_ids
+    ids64 = np.array([7, 7, 7, (1 << 40) + 3, (1 << 40) + 3, 9], np.int64)
+    sk = SpaceSaving(k=8)
+    sk.update(np_split_ids(ids64))
+    sk.update(np.array([-1, -1, 7]))
+    top = dict((h, e) for h, e, _ in sk.topk())
+    assert top[7] == 4
+    assert top[(1 << 40) + 3] == 2
+    assert sk.total == 7  # padding ids never counted
+
+
+def test_skew_monitor_publishes_rank_labeled_gauges():
+    mon = SkewMonitor(k=8, sync=True)
+    mon.observe("user", np.array([5, 5, 5, 5, 9, 9, 3]))
+    mon.publish()
+    rep = metrics.report()
+    assert rep['skew.hot_id{rank="0",table="user"}'] == 5
+    assert rep['skew.hot_id_count{rank="0",table="user"}'] == 4
+    assert rep['skew.stream_ids{table="user"}'] == 7
+    assert "hot" in mon.render_text() or "id=5" in mon.render_text()
+
+
+def test_skew_monitor_worker_thread_drains():
+    mon = SkewMonitor(k=8)
+    for _ in range(10):
+        assert mon.observe("t", np.arange(100) % 7)
+    mon.drain()
+    assert mon.sketch("t").total == 1000
+
+
+# -- per-shard load accounting through the sharded exchange -------------------
+
+
+def _mesh_step_stats(ids):
+    """Run ONE jitted MeshTrainer step over the 8-device CPU mesh with the
+    given (B, F) id batch; -> host stats dict."""
+    import jax
+    from openembedding_tpu.data import synthetic_criteo
+    from openembedding_tpu.models import make_deepfm
+    from openembedding_tpu.parallel import MeshTrainer
+
+    model = make_deepfm(vocabulary=1 << 14, dim=4)
+    trainer = MeshTrainer(model, embed.Adagrad(0.05))
+    batch = next(synthetic_criteo(ids.shape[0], id_space=1 << 14,
+                                  num_fields=ids.shape[1],
+                                  ids_dtype=np.int64))
+    batch["sparse"]["categorical"] = ids.astype(np.int64)
+    state = trainer.init(batch)
+    step = trainer.jit_train_step(batch, state)
+    _state, m = step(state, batch)
+    return jax.device_get(m["stats"])
+
+
+def _imbalance(stats):
+    pos = np.asarray(stats["categorical/shard_positions"], np.float64)
+    return float(pos.max() / pos.mean())
+
+
+def test_zipf_stream_raises_shard_imbalance_above_uniform():
+    """E2E acceptance: Zipf -> hot shards -> exchange.shard_imbalance above
+    the uniform stream's, end to end through the jitted exchange AND the
+    record_step_stats fold into labeled gauges."""
+    rng = np.random.default_rng(7)
+    B, F = 64, 26
+    uniform = rng.integers(0, 1 << 14, size=(B, F))
+    # planted heavy hitters: half of all positions hit 4 hot ids that share
+    # owner shard (id % 8 == 5) — the unambiguous skew case
+    zipf = rng.integers(0, 1 << 14, size=(B, F))
+    hot = rng.random((B, F)) < 0.5
+    zipf[hot] = np.array([5, 13, 21, 29])[rng.integers(0, 4, hot.sum())]
+
+    s_uni = _mesh_step_stats(uniform)
+    metrics.record_step_stats(s_uni)
+    s_zipf = _mesh_step_stats(zipf)
+    metrics.record_step_stats(s_zipf)
+
+    assert _imbalance(s_zipf) > _imbalance(s_uni) + 0.5, (
+        _imbalance(s_zipf), _imbalance(s_uni))
+    rep = metrics.report()
+    # the labeled gauge series exist per shard, and the imbalance histogram
+    # (mean of the two steps) sits above the uniform baseline
+    assert rep['exchange.shard_rows{shard="0",table="categorical"}'] >= 0
+    assert rep['exchange.shard_imbalance{table="categorical"}'] > 1.0
+    # shard 5 received the planted hot mass
+    per_shard = [rep[f'exchange.shard_positions{{shard="{i}",'
+                     f'table="categorical"}}'] for i in range(S)]
+    assert int(np.argmax(per_shard)) == 5
+    # derived unique ratio present and sane
+    assert 0 < rep['exchange.unique_ratio{table="categorical"}'] <= 1.0
+    # bucket_fill: per-source occupancy fractions in (0, 1]
+    fills = [rep[f'exchange.bucket_fill{{shard="{i}",'
+                 f'table="categorical"}}'] for i in range(S)]
+    assert all(0 < f <= 1.0 for f in fills)
+    # renderer smoke
+    text = shard_balance_text()
+    assert "categorical" in text and "shard_positions" in text
+
+
+def test_shard_stats_off_drops_vectors():
+    import jax
+    from openembedding_tpu.data import synthetic_criteo
+    from openembedding_tpu.models import make_deepfm
+    from openembedding_tpu.parallel import MeshTrainer
+
+    model = make_deepfm(vocabulary=1 << 12, dim=4)
+    trainer = MeshTrainer(model, embed.Adagrad(0.05), shard_stats=False)
+    batch = next(synthetic_criteo(32, id_space=1 << 12, ids_dtype=np.int64))
+    state = trainer.init(batch)
+    step = trainer.jit_train_step(batch, state)
+    _state, m = step(state, batch)
+    stats = jax.device_get(m["stats"])
+    assert "categorical/shard_rows" not in stats
+    assert "categorical/pull_indices" in stats  # scalars stay
+
+
+# -- fleet aggregation --------------------------------------------------------
+
+
+def _serve(tmp_path, name, **kw):
+    from openembedding_tpu.serving import make_server
+    httpd = make_server(str(tmp_path / name), port=0, **kw)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd, f"http://127.0.0.1:{httpd.server_address[1]}"
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.read().decode()
+
+
+def test_merge_prometheus_sums_counters_and_hist_buckets():
+    metrics.observe("serving.requests", 3)
+    for v in (0.5, 2.0, 400.0):
+        metrics.observe("serving.predict.ms", v, "hist",
+                        labels={"model": "m-0"})
+    metrics.observe("exchange.wire_bytes_per_step", 128, "gauge")
+    text = metrics.prometheus_text()
+    merged = metrics.merge_prometheus([("a", text), ("b", text)])
+    p = metrics.parse_prometheus(merged)
+    samples = {(n, tuple(sorted(l.items()))): v for n, l, v in p["samples"]}
+    # counters sum
+    assert samples[("oetpu_serving_requests_total", ())] == 6
+    # histogram _count/_sum/buckets sum; +Inf bucket == summed _count
+    key_count = ("oetpu_serving_predict_ms_count", (("model", "m-0"),))
+    assert samples[key_count] == 6
+    inf = ("oetpu_serving_predict_ms_bucket",
+           (("le", "+Inf"), ("model", "m-0")))
+    assert samples[inf] == 6
+    # gauges keep per-instance series (last write wins per instance)
+    ga = ("oetpu_exchange_wire_bytes_per_step",
+          (("instance", "a"),))
+    gb = ("oetpu_exchange_wire_bytes_per_step",
+          (("instance", "b"),))
+    assert samples[ga] == 128 and samples[gb] == 128
+    # bucket series stay monotone on the union grid
+    cums = [v for (n, l), v in samples.items()
+            if n == "oetpu_serving_predict_ms_bucket"]
+    assert cums == sorted(cums)
+
+
+def test_merge_handles_differently_elided_buckets():
+    """Nodes elide different empty interior buckets; the merge must
+    de-cumulate per node, sum on the union le grid, and re-cumulate."""
+    a = ("# TYPE m_ms histogram\n"
+         'm_ms_bucket{le="1"} 2\nm_ms_bucket{le="+Inf"} 3\n'
+         "m_ms_sum 10.0\nm_ms_count 3\n")
+    b = ("# TYPE m_ms histogram\n"
+         'm_ms_bucket{le="4"} 1\nm_ms_bucket{le="+Inf"} 5\n'
+         "m_ms_sum 40.0\nm_ms_count 5\n")
+    p = metrics.parse_prometheus(metrics.merge_prometheus([("a", a),
+                                                           ("b", b)]))
+    got = {(n, tuple(sorted(l.items()))): v for n, l, v in p["samples"]}
+    assert got[("m_ms_count", ())] == 8
+    assert got[("m_ms_bucket", (("le", "1"),))] == 2   # only a's mass
+    assert got[("m_ms_bucket", (("le", "4"),))] == 3   # a's 2 + b's 1
+    assert got[("m_ms_bucket", (("le", "+Inf"),))] == 8
+
+
+def test_fleetz_merges_two_live_nodes(tmp_path):
+    """E2E acceptance: two live serving nodes; /fleetz on node A (peers=B)
+    returns bucket/_count sums equal to the sum of the two /metrics parts."""
+    metrics.observe("serving.requests", 2)
+    for v in (1.0, 3.0):
+        metrics.observe("serving.predict.ms", v, "hist")
+    ha, url_a = _serve(tmp_path, "a")
+    hb, url_b = _serve(tmp_path, "b")
+    try:
+        part_a = metrics.parse_prometheus(_get(f"{url_a}/metrics"))
+        part_b = metrics.parse_prometheus(_get(f"{url_b}/metrics"))
+        def count_of(p):
+            return sum(v for n, _l, v in p["samples"]
+                       if n == "oetpu_serving_predict_ms_count")
+        fleet = metrics.parse_prometheus(
+            _get(f"{url_a}/fleetz?peers={url_b}"))
+        assert count_of(fleet) == count_of(part_a) + count_of(part_b)
+        reqs = {n: v for n, _l, v in fleet["samples"]}
+        assert reqs["oetpu_serving_requests_total"] == sum(
+            v for p in (part_a, part_b) for n, _l, v in p["samples"]
+            if n == "oetpu_serving_requests_total")
+    finally:
+        ha.shutdown()
+        hb.shutdown()
+
+
+def test_fleetz_degrades_on_dead_peer(tmp_path):
+    metrics.observe("serving.requests", 1)
+    ha, url_a = _serve(tmp_path, "a")
+    try:
+        body = _get(f"{url_a}/fleetz?peers=http://127.0.0.1:1/")
+        assert "unreachable" in body
+        assert "oetpu_serving_requests_total" in body  # own scrape survives
+    finally:
+        ha.shutdown()
+
+
+def test_metrics_fleet_tool(tmp_path, capsys):
+    import tools.metrics_fleet as mf
+    metrics.observe("serving.requests", 4)
+    ha, url_a = _serve(tmp_path, "a")
+    try:
+        assert mf.main([url_a, url_a]) == 0
+        out = capsys.readouterr().out
+        assert "oetpu_serving_requests_total 8" in out
+    finally:
+        ha.shutdown()
+
+
+# -- operator surfaces --------------------------------------------------------
+
+
+def test_statusz_shows_hot_id_table(tmp_path):
+    from openembedding_tpu.utils import sketch
+    sketch.MONITOR.reset()
+    sketch.MONITOR.observe("categorical", np.array([42] * 9 + [7, 7, 1]))
+    sketch.MONITOR.drain()
+    ha, url_a = _serve(tmp_path, "a")
+    try:
+        body = _get(f"{url_a}/statusz")
+        assert "workload skew (hot ids)" in body
+        assert "table categorical" in body
+        assert "id=42" in body
+    finally:
+        ha.shutdown()
+        sketch.MONITOR.reset()
+
+
+def test_metrics_endpoint_publishes_skew_series(tmp_path):
+    from openembedding_tpu.utils import sketch
+    sketch.MONITOR.reset()
+    sketch.MONITOR.observe("categorical", np.array([42] * 5))
+    sketch.MONITOR.drain()
+    ha, url_a = _serve(tmp_path, "a")
+    try:
+        body = _get(f"{url_a}/metrics")
+        assert ('oetpu_skew_hot_id_count{rank="0",table="categorical"} 5'
+                in body)
+        assert 'oetpu_skew_stream_ids{table="categorical"} 5' in body
+    finally:
+        ha.shutdown()
+        sketch.MONITOR.reset()
+
+
+def test_skew_report_tool_renders_scrape(tmp_path, capsys):
+    import tools.skew_report as sr
+    from openembedding_tpu.utils import sketch
+    sketch.MONITOR.reset()
+    sketch.MONITOR.observe("categorical", np.array([42] * 5 + [9]))
+    sketch.MONITOR.drain()
+    sketch.MONITOR.publish()
+    scrape = tmp_path / "metrics.txt"
+    scrape.write_text(metrics.prometheus_text())
+    assert sr.main([str(scrape)]) == 0
+    out = capsys.readouterr().out
+    assert "table categorical" in out and "42" in out
+    sketch.MONITOR.reset()
+
+
+def test_serving_predict_feeds_sketch(tmp_path):
+    """Predict ids reach the heavy-hitter sketch through the servable hook
+    (export.StandaloneModel.predict)."""
+    from openembedding_tpu.data import synthetic_criteo
+    from openembedding_tpu.export import export_standalone
+    from openembedding_tpu.model import Trainer
+    from openembedding_tpu.models import make_deepfm
+    from openembedding_tpu.serving import make_server
+    from openembedding_tpu.utils import sketch
+
+    sketch.MONITOR.reset()
+    model = make_deepfm(vocabulary=512, dim=4)
+    trainer = Trainer(model, embed.Adagrad(0.05))
+    batch = next(synthetic_criteo(8, id_space=512, ids_dtype=np.int64))
+    state = trainer.init(batch)
+    step = trainer.jit_train_step()
+    state, _ = step(state, batch)
+    export_dir = tmp_path / "export"
+    export_standalone(state, model, str(export_dir), model_sign="m-0")
+    httpd = make_server(str(tmp_path / "reg"), port=0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        req = urllib.request.Request(
+            f"{url}/models", method="POST",
+            data=json.dumps({"model_sign": "m-0",
+                             "model_uri": str(export_dir)}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30):
+            pass
+        sparse = {"categorical": [[3] * 26, [3] * 26]}
+        req = urllib.request.Request(
+            f"{url}/models/m-0/predict", method="POST",
+            data=json.dumps({"sparse": sparse,
+                             "dense": [[0.0] * 13] * 2}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30):
+            pass
+        sketch.MONITOR.drain()
+        top = dict((h, e) for h, e, _ in
+                   sketch.MONITOR.sketch("categorical").topk())
+        assert top.get(3, 0) >= 52  # 2 rows x 26 fields
+    finally:
+        httpd.shutdown()
+        sketch.MONITOR.reset()
+
+
+def test_periodic_reporter_survives_broken_sink():
+    """Satellite: a raising sink must not kill the reporter thread; failures
+    count in metrics.report_errors and later reports still arrive."""
+    import time as _time
+    calls = {"n": 0}
+
+    def sink(_s):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise BrokenPipeError("gone")
+
+    rep = metrics.PeriodicReporter(0.03, sink=sink, reset=False)
+    with rep:
+        deadline = _time.time() + 5.0
+        while calls["n"] < 3 and _time.time() < deadline:
+            _time.sleep(0.02)
+    assert calls["n"] >= 3  # thread survived the first raise
+    assert metrics.report()["metrics.report_errors"] == 1
+
+
+def test_report_uses_one_hist_snapshot(monkeypatch):
+    """Satellite: report() must derive a histogram's mean AND quantiles from
+    ONE hist_snapshot per accumulator (consistency under concurrent load)."""
+    for v in (1.0, 2.0, 3.0, 4.0):
+        metrics.observe("serving.lat.ms", v, "hist")
+    acc = metrics.Accumulator.get("serving.lat.ms", "hist")
+    calls = {"n": 0}
+    real = type(acc).hist_snapshot
+
+    def counting(self):
+        calls["n"] += 1
+        return real(self)
+
+    monkeypatch.setattr(type(acc), "hist_snapshot", counting)
+    rep = metrics.report()
+    assert calls["n"] == 1
+    assert rep["serving.lat.ms"] == 2.5
+    assert rep["serving.lat.ms.p50"] > 0

@@ -7,6 +7,7 @@ import contextvars
 import json
 import os
 import threading
+import time
 import urllib.request
 
 import numpy as np
@@ -264,12 +265,20 @@ def test_statusz_and_tracez_surfaces(served_model):
     assert "== openembedding_tpu serving /statusz ==" in text
     assert "t-0: step=0 kind=StandaloneModel status=NORMAL" in text
     assert "-- sync subscribers --" in text
+    assert "-- workload skew (hot ids) --" in text
     assert "-- flight recorder" in text
-    # a request id was generated for the statusz request itself
-    with urllib.request.urlopen(f"{base}/tracez?n=8") as resp:
-        tz = json.loads(resp.read())
-    assert any(s["name"] == "http" and s["request_id"]
-               for s in tz["spans"])
+    # a request id was generated for the statusz request itself. The http
+    # span closes (and records) just AFTER the response body is written, so
+    # an immediate /tracez can race it by ~1 ms — poll briefly.
+    deadline = time.time() + 5.0
+    while True:
+        with urllib.request.urlopen(f"{base}/tracez?n=8") as resp:
+            tz = json.loads(resp.read())
+        if any(s["name"] == "http" and s["request_id"]
+               for s in tz["spans"]):
+            break
+        assert time.time() < deadline, tz["spans"]
+        time.sleep(0.01)
 
 
 def test_trainer_phase_histograms_on_metrics(served_model):

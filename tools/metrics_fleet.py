@@ -1,0 +1,82 @@
+"""Fleet-wide /metrics aggregation from the command line.
+
+    python tools/metrics_fleet.py http://trainer:8501 http://replica1:8501 \
+        http://replica2:8501                  # merged exposition on stdout
+    python tools/metrics_fleet.py node1:8501 node2:8501 --summary
+
+Scrapes each node's `GET /metrics` and merges them with
+`utils/metrics.merge_prometheus`: counters and histogram bucket/sum/count
+series SUM across nodes (bucket series are de-cumulated per node and
+re-cumulated on the union `le` grid), gauges keep one series per node with
+an added `instance` label. The same merge backs `GET /fleetz` on any serving
+node started with `--peers` — this tool is the server-less twin for
+operators and cron jobs. Unreachable nodes degrade to a `#` comment line
+(exit stays 0 while at least one node answered).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from openembedding_tpu.utils.metrics import (merge_prometheus,  # noqa: E402
+                                             parse_prometheus)
+
+
+def scrape(node: str, timeout: float) -> str:
+    import urllib.request
+    url = node.rstrip("/")
+    if not url.startswith("http"):
+        url = f"http://{url}"
+    if not url.endswith("/metrics"):
+        url += "/metrics"
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read().decode()
+
+
+def summary(text: str) -> str:
+    """Counter/sum table of the merged exposition (quick fleet health read)."""
+    rows = []
+    for name, labels, value in parse_prometheus(text)["samples"]:
+        if name.endswith(("_total", "_count")):
+            lab = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+            rows.append((f"{name}{{{lab}}}" if lab else name, value))
+    if not rows:
+        return "(no counter series)"
+    width = max(len(k) for k, _ in rows)
+    return "\n".join(f"{k.ljust(width)}  {v:,.0f}" for k, v in sorted(rows))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="scrape N nodes' /metrics and print the merged fleet "
+                    "exposition")
+    ap.add_argument("nodes", nargs="+", help="node base URLs (or host:port)")
+    ap.add_argument("--timeout", type=float, default=10.0)
+    ap.add_argument("--summary", action="store_true",
+                    help="print a counter summary table instead of the full "
+                         "merged exposition")
+    args = ap.parse_args(argv)
+    scrapes, dead = [], []
+    for node in args.nodes:
+        try:
+            scrapes.append((node, scrape(node, args.timeout)))
+        except Exception as e:  # noqa: BLE001 — a dead node degrades, not dies
+            dead.append(f"# fleet: node {node} unreachable: {e}")
+    for line in dead:
+        print(line)
+    if not scrapes:
+        print("# fleet: no node answered", file=sys.stderr)
+        return 1
+    merged = merge_prometheus(scrapes)
+    print(summary(merged) if args.summary else merged, end="")
+    if not args.summary:
+        print()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,129 @@
+"""Workload-skew report: hot ids + shard balance from a node's /metrics.
+
+    python tools/skew_report.py http://node:8501            # live scrape
+    python tools/skew_report.py /tmp/metrics.txt            # saved scrape
+    python tools/skew_report.py http://node:8501 --fleet    # /fleetz merge
+
+Renders the `skew.*` rank-labeled gauges the heavy-hitter sketches publish
+(`utils/sketch.py` — `skew.hot_id{table=,rank=}` / `hot_id_count` /
+`hot_id_error` / `stream_ids`) as a per-table hot-id table with the
+documented `est - err <= true <= est` bound, and the per-shard exchange load
+gauges (`exchange.shard_rows` / `shard_positions` / `bucket_fill`, plus the
+`exchange.shard_imbalance` histogram's mean) as a shard-balance table — the
+two measurements Parallax-style skew-aware sharding decisions need, offline,
+from one scrape.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+from typing import Dict
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from openembedding_tpu.utils.metrics import parse_prometheus  # noqa: E402
+
+
+def fetch(source: str, *, fleet: bool = False, timeout: float = 10.0) -> str:
+    if os.path.exists(source):
+        with open(source) as f:
+            return f.read()
+    import urllib.request
+    url = source.rstrip("/")
+    if not url.startswith("http"):
+        url = f"http://{url}"
+    if not url.endswith(("/metrics", "/fleetz")):
+        url += "/fleetz" if fleet else "/metrics"
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read().decode()
+
+
+def _by_table_rank(samples, name: str) -> Dict[str, Dict[int, float]]:
+    out: Dict[str, Dict[int, float]] = {}
+    for n, labels, value in samples:
+        if n == name and "table" in labels and "rank" in labels:
+            out.setdefault(labels["table"], {})[int(labels["rank"])] = value
+    return out
+
+
+def hot_id_report(samples, top: int) -> str:
+    ids = _by_table_rank(samples, "oetpu_skew_hot_id")
+    counts = _by_table_rank(samples, "oetpu_skew_hot_id_count")
+    errs = _by_table_rank(samples, "oetpu_skew_hot_id_error")
+    totals = {labels.get("table"): value for n, labels, value in samples
+              if n == "oetpu_skew_stream_ids"}
+    if not ids:
+        return "(no skew.* series — node has no id streams observed yet)"
+    lines = []
+    for table in sorted(ids):
+        total = max(totals.get(table, 0.0), 1.0)
+        lines.append(f"table {table}: {totals.get(table, 0):.0f} ids seen "
+                     "(est - err <= true <= est)")
+        lines.append(f"  {'rank':<5}{'id':<22}{'est':<12}{'err<=':<10}share")
+        for rank in sorted(ids[table])[:top]:
+            est = counts.get(table, {}).get(rank, 0.0)
+            err = errs.get(table, {}).get(rank, 0.0)
+            lines.append(f"  #{rank:<4d}{ids[table][rank]:<22.0f}"
+                         f"{est:<12.0f}{err:<10.0f}{est / total:6.2%}")
+    return "\n".join(lines)
+
+
+def shard_balance_report(samples) -> str:
+    stats = ("oetpu_exchange_shard_rows", "oetpu_exchange_shard_positions",
+             "oetpu_exchange_bucket_fill")
+    per: Dict[str, Dict[str, Dict[int, float]]] = {}
+    hist: Dict[str, Dict[str, float]] = {}
+    for n, labels, value in samples:
+        if n in stats and "table" in labels and "shard" in labels:
+            per.setdefault(labels["table"], {}).setdefault(
+                n, {})[int(labels["shard"])] = value
+        if n.startswith("oetpu_exchange_shard_imbalance_") and "table" in labels:
+            hist.setdefault(labels["table"], {})[n.rsplit("_", 1)[-1]] = value
+    if not per:
+        return "(no per-shard exchange stats — sharded trainer nodes only)"
+    lines = []
+    for table in sorted(per):
+        parts = [f"table {table}:"]
+        h = hist.get(table, {})
+        if h.get("count"):
+            parts.append(f"imbalance(max/mean) mean={h['sum'] / h['count']:.3f}"
+                         f" over {h['count']:.0f} steps")
+        lines.append(" ".join(parts))
+        for name in stats:
+            if name not in per[table]:
+                continue
+            vals = per[table][name]
+            row = [vals.get(i, 0.0) for i in range(max(vals) + 1)]
+            fmt = "{:.3f}" if name.endswith("bucket_fill") else "{:.0f}"
+            lines.append(f"  {name.split('oetpu_exchange_')[-1]:<16s} "
+                         + " ".join(fmt.format(v) for v in row))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="hot-id / shard-balance report from a /metrics scrape")
+    ap.add_argument("source", help="node base URL, /metrics URL, or a saved "
+                                   "scrape file")
+    ap.add_argument("--top", type=int, default=10, help="hot ids per table")
+    ap.add_argument("--fleet", action="store_true",
+                    help="scrape GET /fleetz (merged fleet view) instead of "
+                         "the node's own /metrics")
+    ap.add_argument("--timeout", type=float, default=10.0)
+    args = ap.parse_args(argv)
+    parsed = parse_prometheus(
+        fetch(args.source, fleet=args.fleet, timeout=args.timeout))
+    samples = parsed["samples"]
+    print("== hot ids (heavy-hitter sketches) ==")
+    print(hot_id_report(samples, args.top))
+    print()
+    print("== shard balance (exchange load accounting) ==")
+    print(shard_balance_report(samples))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -95,6 +95,10 @@ CASES = [
       "--steps", "24", "--persist-every", "2", "--step-delay-s", "0.2",
       "--lag-bound-steps", "12"],
      {"JAX_PLATFORMS": "cpu"}, 600),
+    # 10. workload-skew telemetry overhead (bench 'skew' case: per-shard
+    #     load accounting on/off + sketch ms/batch). TWO fused-exchange
+    #     compiles at the mesh1 700s allowance each — budget sized for both.
+    ("bench_skew", *bench_case("skew", 1700)),
 ]
 
 
