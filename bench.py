@@ -48,7 +48,7 @@ throughput, not host dispatch latency — the same way production input pipeline
 drive TPUs (the axon tunnel adds ~40 ms per dispatch that would otherwise swamp
 the measurement; see PERF.md "Measurement hygiene").
 
-Env knobs: OETPU_BENCH_CASES=dim9[,dim64][,mesh1][,mesh1f][,pull][,wire][,sync][,skew] (default: all),
+Env knobs: OETPU_BENCH_CASES=dim9[,dim64][,mesh1][,mesh1f][,pull][,wire][,sync][,skew][,hot] (default: all),
 OETPU_BENCH_BUDGET_S (default 540), OETPU_BENCH_SCAN_STEPS / _REPEATS (smoke runs),
 OETPU_BENCH_TOTAL_BUDGET_S / _PROBE_TIMEOUT_S / _PROBE_INTERVAL_S (orchestrator).
 """
@@ -465,6 +465,143 @@ def case_skew():
     return out
 
 
+def case_hot():
+    """Skew-aware hot-row replication (round 10): a TRUNCATED Zipf(1.05) id
+    stream (item-popularity ids over a bounded catalog — no per-field
+    hashing, so the head is genuinely hot and owner shards genuinely skew)
+    through the sharded exchange, replicated hot cache on vs off, plus a
+    uniform-id control. Needs S >= 2 shards for the byte/imbalance wins, so
+    the battery entry runs it on the 8-virtual-device CPU mesh (like
+    tools/wire_microbench.py).
+
+    Methodology: every config runs in exact mode (capacity_factor=0 — drops
+    impossible) and the tuned zero-drop bucket capacity is READ OFF the
+    measured `bucket_fill` stat (max (src,dst) occupancy over the stream,
+    +10% headroom) — hot rows leaving the buckets is what shrinks it.
+    `exchange_bytes_at_fit_capacity` prices the 3-a2a wire at that capacity
+    (`ops/wire.exchange_cost`, production bf16 wire); the acceptance ratio
+    `payload_reduction_pct` compares it cache-on vs cache-off. The hot set's
+    own dense psum is a SEPARATE, bandwidth-friendly collective class
+    (SparCML's point) and is reported beside it as
+    `replicate_bytes_per_step`, never hidden inside the a2a number."""
+    import jax
+    import openembedding_tpu as embed
+    from openembedding_tpu.models import make_deepfm
+    from openembedding_tpu.ops import wire as wire_mod
+    from openembedding_tpu.parallel import MeshTrainer, make_mesh
+
+    WD.stage("hot:init", 240)
+    devs = jax.devices()
+    S = min(8, len(devs))
+    mesh = make_mesh(devs[:S])
+    HOT = int(os.environ.get("OETPU_BENCH_HOT_ROWS", "1024"))
+    alpha = float(os.environ.get("OETPU_BENCH_HOT_ALPHA", "1.05"))
+    vocab = int(os.environ.get("OETPU_BENCH_HOT_VOCAB", str(1 << 13)))
+    cpu = devs[0].platform == "cpu"
+    batch = min(BATCH, 2048) if cpu else BATCH
+    steps = min(SCAN_STEPS, 6) if cpu else min(SCAN_STEPS, 16)
+    fields = 26
+
+    def stream(uniform, seed=11):
+        rng = np.random.default_rng(seed)
+        bs = []
+        a = alpha - 1.0
+        norm = 1.0 - float(vocab) ** (-a)
+        for _ in range(steps):
+            if uniform:
+                ids = rng.integers(0, vocab, (batch, fields))
+            else:
+                # inverse-CDF truncated Zipf(alpha) over [1, vocab]
+                u = rng.random((batch, fields))
+                ids = np.floor((1.0 - u * norm) ** (-1.0 / a)).astype(
+                    np.int64) - 1
+                ids = np.clip(ids, 0, vocab - 1)
+            bs.append({
+                "sparse": {"categorical": ids.astype(np.int32)},
+                "dense": rng.normal(size=(batch, 13)).astype(np.float32),
+                "label": rng.integers(0, 2, (batch,)).astype(np.float32)})
+        return bs
+
+    def top_ids(bs):
+        ids = np.concatenate([b["sparse"]["categorical"].reshape(-1)
+                              for b in bs])
+        uniq, cnt = np.unique(ids, return_counts=True)
+        return uniq[np.argsort(-cnt)][:HOT].astype(np.int64)
+
+    def one_config(name, hot_rows, bs):
+        WD.stage(f"hot:{name}", 420)
+        model = make_deepfm(vocabulary=vocab, dim=9)
+        tr = MeshTrainer(model, embed.Adagrad(learning_rate=0.05), mesh=mesh,
+                         capacity_factor=0.0, hot_rows=hot_rows)
+        state = tr.init(bs[0])
+        if hot_rows and tr.hot_enabled:
+            state = tr.refresh_hot_rows(
+                state, hot_ids={"categorical": top_ids(bs)})
+        step = tr.jit_train_step(bs[0], state)
+        out, times, max_fill = {}, [], 0.0
+        cap_exact = bs[0]["sparse"]["categorical"].size // S
+        for i, b in enumerate(bs):
+            t0 = time.perf_counter()
+            state, m = step(state, b)
+            float(m["loss"])
+            if i:  # first dispatch is compile+warm
+                times.append(time.perf_counter() - t0)
+            stats = {k: np.asarray(v) for k, v in
+                     jax.device_get(m["stats"]).items()}
+            fill = stats.get("categorical/bucket_fill")
+            if fill is not None:
+                max_fill = max(max_fill, float(fill.max()))
+            if i == 0:
+                pos = stats.get("categorical/shard_positions")
+                if pos is not None and pos.mean() > 0:
+                    out["shard_imbalance"] = round(
+                        float(pos.max() / pos.mean()), 3)
+                if "categorical/hot_hits" in stats:
+                    out["hit_ratio"] = round(
+                        float(stats["categorical/hot_hits"])
+                        / float(stats["categorical/pull_indices"]), 4)
+                    out["bytes_saved_per_step"] = int(
+                        stats["categorical/hot_bytes_saved"])
+        out["ms_per_step"] = round(min(times) * 1e3, 2) if times else None
+        cost = dict(tr.last_wire_cost or {})
+        out["replicate_bytes_per_step"] = int(
+            cost.get("hot_replicate_bytes", 0))
+        if S > 1 and max_fill > 0:
+            # zero-drop bucket capacity measured off the exchange's own
+            # occupancy telemetry (+10% headroom), and the 3-a2a wire cost
+            # at it — what a tuned capacity_factor would actually ship
+            fit_cap = int(max_fill * cap_exact * 1.1) + 1
+            out["fit_bucket_capacity"] = fit_cap
+            fit = wire_mod.exchange_cost(
+                [{"dim": 10, "cap": fit_cap, "pair": False,
+                  "id_itemsize": 4}], S, wire_mod.wire_format(None))
+            out["exchange_bytes_at_fit_capacity"] = fit["bytes_per_step"]
+        return out
+
+    out = {"num_shards": S, "hot_rows": HOT, "alpha": alpha, "vocab": vocab,
+           "batch": batch, "wire": None}
+    from openembedding_tpu.ops.wire import wire_format
+    out["wire"] = wire_format(None)
+    zipf = stream(False)
+    out["zipf_off"] = one_config("zipf_off", 0, zipf)
+    out["zipf_on"] = one_config("zipf_on", HOT, zipf)
+    uni = stream(True)
+    out["uniform_off"] = one_config("uniform_off", 0, uni)
+    out["uniform_on"] = one_config("uniform_on", HOT, uni)
+    off_b = out["zipf_off"].get("exchange_bytes_at_fit_capacity")
+    on_b = out["zipf_on"].get("exchange_bytes_at_fit_capacity")
+    if off_b and on_b:
+        out["payload_reduction_pct"] = round((1 - on_b / off_b) * 100, 1)
+        out["net_reduction_with_replicate_pct"] = round(
+            (1 - (on_b + out["zipf_on"]["replicate_bytes_per_step"])
+             / off_b) * 100, 1)
+    # the default path must stay free: hot_rows=0 attaches no cache state and
+    # traces no probe/psum — same program as before the feature existed
+    # (tests/test_hot.py pins the HLO); recorded so the artifact says so
+    out["hot_off_is_baseline_trace"] = True
+    return out
+
+
 def case_pull():
     """Embedding-pull p50 (BASELINE.md metric). A pull = the serving/forward read:
     dedup + row gather for one 4096x26 Zipfian batch against the 2^24-row dim-9
@@ -523,7 +660,7 @@ def main():
 
     cases = os.environ.get(
         "OETPU_BENCH_CASES",
-        "dim9,dim64,mesh1,mesh1f,pull,wire,sync,skew").split(",")
+        "dim9,dim64,mesh1,mesh1f,pull,wire,sync,skew,hot").split(",")
 
     # PRIMARY first: whatever happens later, this number is in the artifact.
     if "dim9" in cases:
@@ -539,7 +676,8 @@ def main():
                  ("pull", case_pull),
                  ("wire", case_wire),
                  ("sync", case_sync),
-                 ("skew", case_skew)]
+                 ("skew", case_skew),
+                 ("hot", case_hot)]
     for name, fn in secondary:
         if name not in cases:
             continue
@@ -581,6 +719,11 @@ def main():
             if "stats_on_examples_per_sec" in out:
                 RESULT["metric"] = "skew_stats_on_examples_per_sec"
                 RESULT["value"] = out["stats_on_examples_per_sec"]
+                break
+            if "zipf_on" in out:
+                RESULT["metric"] = "hot_zipf_on_ms_per_step"
+                RESULT["value"] = out["zipf_on"].get("ms_per_step")
+                RESULT["unit"] = "ms"
                 break
 
     WD.clear()

@@ -30,6 +30,30 @@ from .optimizers import SparseOptimizer, make_optimizer
 from .ops.sparse import lookup_rows, sparse_apply_dense_table
 
 
+class HotRows(struct.PyTreeNode):
+    """Replicated hot-row cache for one table (Parallax-style hybrid placement,
+    `parallel/sharded.py`): a small trace-time-static set of H heavy-hitter rows
+    held IDENTICALLY on every device, so their pulls gather locally (zero
+    exchange bytes, zero owner-shard load) and their gradients reduce over the
+    data axis like dense params. Chosen/refreshed off the hot path from the
+    heavy-hitter sketches (`MeshTrainer.refresh_hot_rows`); persisted never —
+    `hot_sync` writes the rows back into their owner shards at snapshot time so
+    checkpoints/export/sync stay byte-identical to the hot-off world.
+
+    Membership is a mini open-addressing probe table (`tables/hash_table.py`
+    machinery, built host-side by `parallel/sharded.build_hot_identity`):
+    `keys` holds the hot ids in the table's key layout at ~2x load headroom,
+    `rank` maps a probe slot to its compact hot row in [0, H); empty slots
+    carry rank H. `ids` lists the hot ids by rank (padding -1 / PAIR_EMPTY)
+    for writeback/refresh bookkeeping."""
+
+    keys: jax.Array               # (C,) or (C, 2) — probe table, table key layout
+    rank: jax.Array               # (C,) int32 — probe slot -> hot row; H = empty
+    ids: jax.Array                # (H,) or (H, 2) — hot ids by rank
+    weights: jax.Array            # (H, dim) — table dtype
+    slots: Dict[str, jax.Array]   # name -> (H, k) f32 (replicated optimizer state)
+
+
 class EmbeddingTableState(struct.PyTreeNode):
     """One variable's shard-local storage: weights + optimizer slots.
 
@@ -43,6 +67,10 @@ class EmbeddingTableState(struct.PyTreeNode):
     # cumulative count of ids that failed to insert (hash tables only; the static-
     # capacity divergence from the reference's unbounded table must be observable)
     overflow: Optional[jax.Array] = None  # () int32
+    # replicated hot-row cache (MeshTrainer(hot_rows=...); None = off). NOT
+    # serialized: checkpoint/persist/export writers see owner-shard rows only,
+    # after the trainer's hot_sync writeback.
+    hot: Optional[HotRows] = None
 
 
 @dataclasses.dataclass(frozen=True)

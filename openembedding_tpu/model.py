@@ -327,6 +327,18 @@ class Trainer:
             new_tables[name] = ot.state
         return state.replace(tables=new_tables)
 
+    # hot-row replication is a mesh concept (MeshTrainer(hot_rows=...));
+    # the base hooks are identities so persisters/loops drive either trainer
+    # uniformly (see parallel/sharded.py "HOT-ROW REPLICATION")
+    hot_enabled = False
+
+    def hot_sync(self, state: "TrainState") -> "TrainState":
+        """Write replicated hot rows back into their owner shards before any
+        external consumer reads raw table state. No-op off-mesh; MeshTrainer
+        overrides (the persisters call it before every snapshot/delta so
+        on-disk artifacts stay byte-identical to a hot-off run)."""
+        return state
+
     @staticmethod
     def overflow_count(metrics) -> int:
         """Exchange-bucket drops in a step's (or scan window's) metrics.

@@ -77,6 +77,24 @@ def unique_with_counts(ids: jax.Array) -> UniqueResult:
                         seg)
 
 
+def carry_to_unique(uniq: UniqueResult, values: jax.Array,
+                    fill) -> jax.Array:
+    """Propagate a per-POSITION value (n,) to its unique slot (n,), riding the
+    already-paid fused sort: `values[order]` lines up with the ascending
+    segment ids `seg`, so one sorted scatter lands each id's value in its
+    unique slot (duplicate writes to a segment all carry the same value when
+    `values` is a pure function of the id — the caller's contract). Padding
+    slots (>= num_unique) keep `fill`.
+
+    The hot-row membership probe (`parallel/sharded.py`) uses this to turn a
+    per-position hot-slot probe into a per-unique-slot one without a second
+    probe or sort."""
+    n = uniq.order.shape[0]
+    out = jnp.full((n,), fill, values.dtype)
+    return out.at[uniq.seg].set(values[uniq.order], mode="drop",
+                                indices_are_sorted=True)
+
+
 class BucketResult(NamedTuple):
     bucket_ids: jax.Array    # (num_shards, capacity) — ids grouped by owner shard
     bucket_valid: jax.Array  # (num_shards, capacity) bool

@@ -54,6 +54,38 @@ cap = n) can never drop but moves S*n ids per a2a. Tested in
 
 Out-of-vocab ids (array tables) are masked invalid end to end: they pull zeros and
 their gradients are dropped, identical to the single-device path (`ops/sparse.py`).
+
+HOT-ROW REPLICATION (skew-aware hybrid placement, Parallax arXiv:1808.02621):
+under Zipf traffic a few thousand ids absorb a large share of `shard_positions`
+load, and every access pays the 3-a2a round trip while hot-spotting the owner
+shard. When a table carries a replicated hot cache (`EmbeddingTableState.hot`,
+`MeshTrainer(hot_rows=...)`), the client route probes each id against the hot
+set (a mini open-addressing probe riding the SAME fused sort — one extra
+`hash_find` per position, the hot slot carried to unique slots by
+`ops/dedup.carry_to_unique`) and partitions hot/cold:
+
+- HOT positions never enter the buckets (they route like invalid ids, to the
+  pseudo-owner S): zero a2a bytes, zero owner-shard load. Their rows gather
+  LOCALLY from the replicated `hot.weights` and `_reassemble` overlays them.
+- COLD positions flow through the unchanged plan/exchange above.
+- BACKWARD: per-unique grad sums scatter into the compact (H, dim) hot
+  aggregate (SparCML's dense-ified hot payload), reduce across the data axis
+  in fixed source order (`_hot_apply` — bit-matching the cold owner's sorted-
+  segment reduction at fp32 wire), and the optimizer applies the IDENTICAL
+  update on every replica with the replicated `hot.slots`, so replicas never
+  diverge.
+
+Owner-shard copies of hot rows go stale while the cache is active; every read
+routes through the cache, and `hot_writeback` scatters weights+slots back into
+the owner shards (no collective — each shard overwrites the rows it owns) at
+snapshot/refresh time, so checkpoints, export and the sync delta feed stay
+byte-identical to the hot-off world. `hot_gather`/`build_hot_identity` fill the
+cache from the shards (promotion inserts absent hash ids, values copied
+bit-exactly via all_gather + owner select, no float reduction). The hot set is
+trace-time static (H rows, C = 2H probe slots): promote/demote between steps
+(`MeshTrainer.refresh_hot_rows`, fed by the `utils/sketch.py` heavy hitters)
+swaps array CONTENTS, never shapes, so nothing re-jits. S == 1 meshes reject
+hot state loudly (one device owns everything; a second copy could only skew).
 """
 
 from __future__ import annotations
@@ -63,12 +95,17 @@ from typing import Dict, NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from ..embedding import EmbeddingSpec, EmbeddingTableState
+from ..embedding import EmbeddingSpec, EmbeddingTableState, HotRows
 from ..ops.dedup import (BucketResult, UniqueResult, bucket_by_owner,
-                         bucket_validity, unbucket, unique_and_route,
-                         unique_with_counts)
+                         bucket_validity, carry_to_unique, unbucket,
+                         unique_and_route, unique_with_counts)
 from ..ops.sparse import lookup_rows, sparse_apply_dense_table
 from .mesh import DATA_AXIS
+
+# probe budget of the hot-set membership table (C = 2H slots -> load factor
+# <= 0.5, chains stay short); `build_hot_identity` inserts host-side with the
+# SAME budget, so a row the device probe cannot reach is never placed
+HOT_NUM_PROBES = 16
 
 
 class ExchangePlan(NamedTuple):
@@ -81,6 +118,10 @@ class ExchangePlan(NamedTuple):
     recv_ids: jax.Array    # (S, cap) ids this shard must serve
     recv_valid: jax.Array  # (S, cap)
     cap: int
+    # hot-row partition (None/0 when the table has no replicated cache):
+    # per-UNIQUE-slot hot-cache row in [0, hot_rows], hot_rows = cold/miss
+    hot_slot: Optional[jax.Array] = None
+    hot_rows: int = 0
 
 
 def _bucket_capacity(n: int, num_shards: int, capacity_factor: float) -> int:
@@ -136,19 +177,49 @@ def _out_shape(spec: EmbeddingSpec, ids: jax.Array):
     return ids.shape[:-1] if _is_pair_batch(spec, ids) else ids.shape
 
 
+def _hot_probe(hot: HotRows, flat: jax.Array, valid: jax.Array) -> jax.Array:
+    """Per-POSITION hot-set membership probe -> hot row in [0, H] (H = miss).
+    One `hash_find` against the mini probe table; invalid positions probe the
+    EMPTY sentinel and always miss. `flat` must be in the TABLE's key layout
+    (`adapt_batch_ids`) so pair/single-lane matches `hot.keys`; valid array-
+    table ids are < 2^31 by construction, so the dtype cast is lossless."""
+    from ..tables.hash_table import hash_find
+    C = hot.keys.shape[0]
+    H = hot.weights.shape[0]
+    if hot.keys.ndim == 2:
+        from ..ops.id64 import PAIR_EMPTY
+        probe = jnp.where(valid[:, None], flat, PAIR_EMPTY)
+    else:
+        probe = jnp.where(valid, flat, -1).astype(hot.keys.dtype)
+    pslot = hash_find(hot.keys, probe, num_probes=HOT_NUM_PROBES)
+    return jnp.where(pslot < C, hot.rank[jnp.clip(pslot, 0, C - 1)],
+                     jnp.int32(H)).astype(jnp.int32)
+
+
 def make_plan(spec: EmbeddingSpec, ids: jax.Array, *, axis: str = DATA_AXIS,
-              capacity_factor: float = 0.0) -> ExchangePlan:
+              capacity_factor: float = 0.0,
+              hot: Optional[HotRows] = None) -> ExchangePlan:
     """Dedup local ids, bucket by owner, exchange the id buckets (one all_to_all).
 
     Dedup and routing come out of ONE fused sort (`ops/dedup.unique_and_route`).
     `S == 1` is specialized at trace time: every id is local, so the bucket
     scatter and the id all_to_all vanish — the plan serves the unique ids
     directly (the protocol's compute overhead at S=1 is the floor every
-    multi-chip projection sits on; see PERF.md mesh1)."""
+    multi-chip projection sits on; see PERF.md mesh1).
+
+    `hot`: the table's replicated hot-row cache — hot positions are carved out
+    of the exchange (module doc "HOT-ROW REPLICATION") and the plan carries
+    their per-unique-slot cache rows in `hot_slot`."""
     S = jax.lax.axis_size(axis)
     flat = flatten_ids(spec, ids)
     n = flat.shape[0]
     if S == 1:
+        if hot is not None:
+            raise ValueError(
+                "hot-row replication needs S >= 2: on a 1-device mesh the "
+                "shard and the cache are the same memory, and two copies of "
+                "a row can only diverge (MeshTrainer disables hot_rows at "
+                "mesh size 1)")
         uniq = unique_with_counts(flat)
         valid = (uniq.counts > 0) & _id_valid(spec, uniq.unique_ids)
         recv_ids = uniq.unique_ids[None]
@@ -159,52 +230,70 @@ def make_plan(spec: EmbeddingSpec, ids: jax.Array, *, axis: str = DATA_AXIS,
             slot=jnp.arange(n, dtype=jnp.int32),
             overflow=jnp.zeros((), jnp.int32))
         return ExchangePlan(uniq, buckets, recv_ids, recv_valid, n)
-    uniq, buckets, cap = _client_route(spec, flat, S, capacity_factor)
+    uniq, buckets, cap, hot_slot = _client_route(spec, flat, S,
+                                                 capacity_factor, hot)
     # [BOUNDARY: was one RPC per owning server; now ONE ICI all_to_all —
     # empty bucket slots carry the EMPTY sentinel, so the receive side
     # derives validity from the ids and no bool mask rides the wire]
     recv_ids = jax.lax.all_to_all(buckets.bucket_ids, axis, 0, 0)
     recv_valid = bucket_validity(recv_ids)
-    return ExchangePlan(uniq, buckets, recv_ids, recv_valid, cap)
+    return ExchangePlan(uniq, buckets, recv_ids, recv_valid, cap, hot_slot,
+                        0 if hot is None else hot.weights.shape[0])
 
 
 def _client_route(spec: EmbeddingSpec, flat: jax.Array, S: int,
-                  capacity_factor: float):
+                  capacity_factor: float, hot: Optional[HotRows] = None):
     """Per-table client-side dedup + owner routing: the plan minus its id
-    exchange (shared by `make_plan` and the grouped fused exchange)."""
+    exchange (shared by `make_plan` and the grouped fused exchange).
+    -> (uniq, buckets, cap, hot_slot-or-None)."""
     n = flat.shape[0]
     valid = _id_valid(spec, flat)
     cap = _bucket_capacity(n, S, capacity_factor)
-    uniq, buckets = unique_and_route(flat, valid, S, cap)
-    return uniq, buckets, cap
+    if hot is None:
+        uniq, buckets = unique_and_route(flat, valid, S, cap)
+        return uniq, buckets, cap, None
+    H = hot.weights.shape[0]
+    hr = _hot_probe(hot, flat, valid)
+    # hot positions leave the exchange entirely: they route like invalid ids
+    # (pseudo-owner S — no bucket slot, no wire bytes, no owner-shard load)
+    # but keep their unique slots/counts for the local gather + reduced push
+    uniq, buckets = unique_and_route(flat, valid & (hr >= H), S, cap)
+    hot_slot = carry_to_unique(uniq, hr, H)
+    return uniq, buckets, cap, hot_slot
 
 
 def grouped_make_plans(specs, ids_list, *, axis: str = DATA_AXIS,
-                       capacity_factor: float = 0.0):
+                       capacity_factor: float = 0.0, hots=None):
     """Routing plans for a DIM-GROUP of tables with ONE fused id all_to_all.
 
     Per-table dedup/bucketing is identical to `make_plan`; only the wire is
     shared — each table's (S, cap_t) bucket array rides as a fixed capacity
     segment of one concatenated array (`ops/dedup.concat_owner_buckets`), so
     the receive side recovers per-table buckets by slicing. `ids_list` must
-    already be in each table's key layout (`adapt_batch_ids`)."""
+    already be in each table's key layout (`adapt_batch_ids`). `hots`: one
+    Optional[HotRows] per table (hot ids skip the fused wire exactly like the
+    per-table path)."""
     S = jax.lax.axis_size(axis)
+    if hots is None:
+        hots = [None] * len(specs)
     if S == 1:
         return [make_plan(spec, ids, axis=axis,
-                          capacity_factor=capacity_factor)
-                for spec, ids in zip(specs, ids_list)]
+                          capacity_factor=capacity_factor, hot=hot)
+                for spec, ids, hot in zip(specs, ids_list, hots)]
     from ..ops.dedup import concat_owner_buckets, split_owner_buckets
     parts = []
-    for spec, ids in zip(specs, ids_list):
+    for spec, ids, hot in zip(specs, ids_list, hots):
         flat = flatten_ids(spec, ids)
-        parts.append(_client_route(spec, flat, S, capacity_factor))
-    wire_ids = concat_owner_buckets([b.bucket_ids for _, b, _ in parts])
+        parts.append(_client_route(spec, flat, S, capacity_factor, hot))
+    wire_ids = concat_owner_buckets([b.bucket_ids for _, b, _, _ in parts])
     recv = jax.lax.all_to_all(wire_ids, axis, 0, 0)
     templates = [(cap, b.bucket_ids.ndim == 3, b.bucket_ids.dtype)
-                 for _, b, cap in parts]
+                 for _, b, cap, _ in parts]
     segs = split_owner_buckets(recv, templates)
-    return [ExchangePlan(uniq, buckets, seg, bucket_validity(seg), cap)
-            for (uniq, buckets, cap), seg in zip(parts, segs)]
+    return [ExchangePlan(uniq, buckets, seg, bucket_validity(seg), cap, hs,
+                         0 if hot is None else hot.weights.shape[0])
+            for (uniq, buckets, cap, hs), seg, hot
+            in zip(parts, segs, hots)]
 
 
 def _flat_axis_index(axis) -> jax.Array:
@@ -298,16 +387,89 @@ def _serve_rows(spec: EmbeddingSpec, state: EmbeddingTableState,
     return state, rows.reshape(S, plan.cap, spec.output_dim)
 
 
+def _merge_hot_rows(plan: ExchangePlan, uniq_rows: jax.Array,
+                    hot: Optional[HotRows]) -> jax.Array:
+    """Overlay the LOCAL hot-cache gather onto the exchange's unique rows
+    (cold left zeros at hot slots — their pseudo-owner S never unbuckets)."""
+    if hot is None or plan.hot_slot is None:
+        return uniq_rows
+    H = hot.weights.shape[0]
+    hrows = hot.weights.at[plan.hot_slot].get(mode="fill", fill_value=0)
+    return jnp.where((plan.hot_slot < H)[:, None],
+                     hrows.astype(uniq_rows.dtype), uniq_rows)
+
+
+def _hot_pull_stats(spec: EmbeddingSpec, plan: ExchangePlan, flat: jax.Array,
+                    fmt: str) -> Dict[str, jax.Array]:
+    """Per-step hot-cache accounting for the stats dict (psum'd like the rest):
+    `hot_hits` (positions served locally — `metrics.record_step_stats` derives
+    `hot.hit_ratio{table=}` against `pull_indices`), `hot_unique` (rows that
+    skipped the wire), and `hot_bytes_saved` — unique rows x the static
+    per-row wire cost (id lanes + pulled row + pushed grad+counts) the 3-a2a
+    round trip would have charged for them."""
+    from ..ops import wire as wire_mod
+    H = plan.hot_rows
+    hm = (plan.hot_slot < H) & (plan.uniq.counts > 0)
+    hot_unique = jnp.sum(hm).astype(jnp.int32)
+    hot_hits = jnp.sum(jnp.where(hm, plan.uniq.counts, 0)).astype(jnp.int32)
+    w = jnp.dtype(wire_mod.wire_dtype(fmt)).itemsize
+    pair = flat.ndim == 2
+    per_row = (wire_mod.id_wire_itemsize(pair, jnp.dtype(flat.dtype).itemsize)
+               + wire_mod.rows_wire_width(spec.output_dim, fmt) * w
+               + wire_mod.grads_wire_width(spec.output_dim, fmt) * w)
+    return {"hot_unique": hot_unique, "hot_hits": hot_hits,
+            "hot_bytes_saved": hot_unique.astype(jnp.float32)
+            * float(per_row)}
+
+
+def _hot_apply(spec: EmbeddingSpec, optimizer, hot: HotRows,
+               plan: ExchangePlan, g: jax.Array, axis) -> HotRows:
+    """Backward for the hot set: scatter the per-unique grad sums into the
+    compact (H, dim) hot aggregate (SparCML's dense-ified hot payload — the
+    shape collectives handle cheaply), ONE psum across the data axis, then
+    the fused optimizer runs on every replica with the replicated slots. The
+    update is identical everywhere (same reduced inputs, same math), so
+    replicas never diverge; rows with count 0 stay bit-identical
+    (`SparseOptimizer.apply`).
+
+    Parity note: counts are int32 — exact under any reduction order. For the
+    f32 grads, XLA's all-reduce on the CPU backend (the parity suite's 8
+    virtual devices) folds replica partials in source order — exactly the
+    order the cold owner's sorted-segment reduction applies over its
+    source-major (S, cap) receive buffer — so fp32-wire training is
+    bit-exact hot-on vs hot-off there (tests/test_hot.py pins it). A backend
+    whose all-reduce associates differently keeps equality up to
+    reassociation of the S per-replica partials (each partial is itself the
+    bit-exact client pre-sum)."""
+    H = hot.weights.shape[0]
+    hm = plan.hot_slot < H
+    tgt = jnp.where(hm, plan.hot_slot, H)
+    hg = jnp.zeros((H, spec.output_dim), jnp.float32).at[tgt].set(
+        g.astype(jnp.float32), mode="drop", unique_indices=True)
+    hc = jnp.zeros((H,), jnp.int32).at[tgt].set(
+        jnp.where(hm, plan.uniq.counts, 0).astype(jnp.int32),
+        mode="drop", unique_indices=True)
+    tg = jax.lax.psum(hg, axis)
+    tc = jax.lax.psum(hc, axis)
+    new_w, new_s = optimizer.apply(hot.weights.astype(jnp.float32),
+                                   hot.slots, tg, tc)
+    return hot.replace(
+        weights=new_w.astype(hot.weights.dtype),
+        slots={k: new_s[k].astype(hot.slots[k].dtype) for k in hot.slots})
+
+
 def _reassemble(plan: ExchangePlan, rows: jax.Array, out_shape,
-                dim: int, axis: str) -> jax.Array:
-    """Client side: rows back over the a2a, un-bucket, expand duplicates.
-    At S=1 the served rows ARE the unique rows (make_plan's identity plan) —
-    no a2a, no unbucket gather."""
+                dim: int, axis: str,
+                hot: Optional[HotRows] = None) -> jax.Array:
+    """Client side: rows back over the a2a, un-bucket, expand duplicates,
+    overlay the local hot-cache gather. At S=1 the served rows ARE the unique
+    rows (make_plan's identity plan) — no a2a, no unbucket gather."""
     if jax.lax.axis_size(axis) == 1:
         uniq_rows = rows[0]
     else:
         back = jax.lax.all_to_all(rows, axis, 0, 0)
         uniq_rows = unbucket(back, plan.buckets.owner, plan.buckets.slot)
+    uniq_rows = _merge_hot_rows(plan, uniq_rows, hot)
     out = jnp.take(uniq_rows, plan.uniq.inverse, axis=0)
     return out.reshape(out_shape + (dim,))
 
@@ -326,15 +488,21 @@ def sharded_lookup_train(
     `load_stats=False` drops the per-shard skew vectors
     (`exchange_load_stats`) from the stats dict."""
     ids = adapt_batch_ids(spec, state, ids)
-    plan = make_plan(spec, ids, axis=axis, capacity_factor=capacity_factor)
+    plan = make_plan(spec, ids, axis=axis, capacity_factor=capacity_factor,
+                     hot=state.hot)
     state, rows = _serve_rows(spec, state, plan, train=True, axis=axis)
-    out = _reassemble(plan, rows, _out_shape(spec, ids), spec.output_dim, axis)
+    out = _reassemble(plan, rows, _out_shape(spec, ids), spec.output_dim,
+                      axis, hot=state.hot)
     stats = {
         # reference accumulator counts id POSITIONS (lane-count agnostic)
         "pull_indices": jnp.asarray(ids_positions(spec, ids), jnp.int32),
         "pull_unique": plan.uniq.num_unique,                # `pull_unique` counter
         "pull_overflow": plan.buckets.overflow,
     }
+    if plan.hot_slot is not None:
+        # the per-table protocol always ships fp32 payloads
+        stats.update(_hot_pull_stats(spec, plan, flatten_ids(spec, ids),
+                                     "fp32"))
     if load_stats:
         stats.update(exchange_load_stats(plan, axis=axis))
     return state, out, stats, plan
@@ -349,11 +517,14 @@ def sharded_lookup(
     capacity_factor: float = 0.0,
 ) -> jax.Array:
     """Read-only pull (serving/eval; reference `read_only_pull` handler — never
-    inserts, absent hash ids return zeros)."""
+    inserts, absent hash ids return zeros). Hot rows read from the replicated
+    cache — the owner copies are stale while the cache is active."""
     ids = adapt_batch_ids(spec, state, ids)
-    plan = make_plan(spec, ids, axis=axis, capacity_factor=capacity_factor)
+    plan = make_plan(spec, ids, axis=axis, capacity_factor=capacity_factor,
+                     hot=state.hot)
     _, rows = _serve_rows(spec, state, plan, train=False, axis=axis)
-    return _reassemble(plan, rows, _out_shape(spec, ids), spec.output_dim, axis)
+    return _reassemble(plan, rows, _out_shape(spec, ids), spec.output_dim,
+                       axis, hot=state.hot)
 
 
 def sharded_apply_gradients(
@@ -378,7 +549,8 @@ def sharded_apply_gradients(
     S = jax.lax.axis_size(axis)
     if plan is None:
         ids = adapt_batch_ids(spec, state, ids)
-        plan = make_plan(spec, ids, axis=axis, capacity_factor=capacity_factor)
+        plan = make_plan(spec, ids, axis=axis, capacity_factor=capacity_factor,
+                         hot=state.hot)
     gflat = grads.reshape(-1, spec.output_dim)
     n = gflat.shape[0]
     uniq, buckets, cap = plan.uniq, plan.buckets, plan.cap
@@ -386,6 +558,8 @@ def sharded_apply_gradients(
     # sorted-segment path (see UniqueResult.segment_reduce)
     g = uniq.segment_reduce(gflat)
     valid = (uniq.counts > 0) & _id_valid(spec, uniq.unique_ids)
+    new_hot = (None if plan.hot_slot is None or state.hot is None
+               else _hot_apply(spec, optimizer, state.hot, plan, g, axis))
     if S == 1:
         # identity routing (see make_plan): the local unique slots ARE the
         # server's receive buffer — no bucket scatter, no grad/count a2a
@@ -418,8 +592,11 @@ def sharded_apply_gradients(
         rc = jax.lax.bitcast_convert_type(
             tail[:, 0] if lanes == 1 else tail, jnp.int32).reshape(-1)
     stats = {"push_overflow": buckets.overflow}
-    return _apply_unique(spec, state, optimizer, rids, rg, rc, S,
-                         packed=packed), stats
+    new_state = _apply_unique(spec, state, optimizer, rids, rg, rc, S,
+                              packed=packed)
+    if new_hot is not None:
+        new_state = new_state.replace(hot=new_hot)
+    return new_state, stats
 
 
 def _scatter_buckets(payload: jax.Array, buckets: BucketResult, S: int,
@@ -498,20 +675,21 @@ def grouped_lookup_train(
                 f"{spec.name!r} has dim {spec.output_dim}, group has {dim}")
     ids_list = [adapt_batch_ids(spec, state, ids)
                 for spec, state, ids in zip(specs, states, ids_list)]
+    hots = [state.hot for state in states]
     plans = grouped_make_plans(specs, ids_list, axis=axis,
-                               capacity_factor=capacity_factor)
+                               capacity_factor=capacity_factor, hots=hots)
     new_states, rows_list = [], []
     for spec, state, plan in zip(specs, states, plans):
         state, rows = _serve_rows(spec, state, plan, train=True, axis=axis)
         new_states.append(state)
         rows_list.append(rows)
+    fmt = wire_mod.wire_format(wire)
     if S == 1:
         outs = [_reassemble(plan, rows, _out_shape(spec, ids),
                             spec.output_dim, axis)
                 for spec, ids, plan, rows
                 in zip(specs, ids_list, plans, rows_list)]
     else:
-        fmt = wire_mod.wire_format(wire)
         # one encode + ONE all_to_all for the whole group's rows (mixed
         # table dtypes promote at the concat; decode returns f32 and each
         # table casts back to its own dtype — exact for bf16-kept tables)
@@ -522,10 +700,11 @@ def grouped_lookup_train(
         dec = wire_mod.decode_rows(
             back.reshape(-1, enc.shape[-1]), dim, fmt).reshape(S, -1, dim)
         outs, off = [], 0
-        for spec, ids, plan in zip(specs, ids_list, plans):
+        for spec, ids, plan, hot in zip(specs, ids_list, plans, hots):
             seg = dec[:, off:off + plan.cap]
             off += plan.cap
             uniq_rows = unbucket(seg, plan.buckets.owner, plan.buckets.slot)
+            uniq_rows = _merge_hot_rows(plan, uniq_rows, hot)
             out = jnp.take(uniq_rows, plan.uniq.inverse, axis=0)
             outs.append(out.astype(spec.dtype).reshape(
                 _out_shape(spec, ids) + (spec.output_dim,)))
@@ -536,6 +715,9 @@ def grouped_lookup_train(
             "pull_unique": plan.uniq.num_unique,
             "pull_overflow": plan.buckets.overflow,
         }
+        if plan.hot_slot is not None:
+            st.update(_hot_pull_stats(spec, plan, flatten_ids(spec, ids),
+                                      fmt))
         if load_stats:
             st.update(exchange_load_stats(plan, axis=axis))
         stats_list.append(st)
@@ -562,7 +744,8 @@ def grouped_apply_gradients(
         ids_list = [adapt_batch_ids(spec, state, ids)
                     for spec, state, ids in zip(specs, states, ids_list)]
         plans = grouped_make_plans(specs, ids_list, axis=axis,
-                                   capacity_factor=capacity_factor)
+                                   capacity_factor=capacity_factor,
+                                   hots=[state.hot for state in states])
     if packed_list is None:
         packed_list = [None] * len(specs)
     # client side: per-table duplicate pre-sum into the unique slots
@@ -574,6 +757,14 @@ def grouped_apply_gradients(
         gs.append(g)
         counts_list.append(jnp.where(valid, plan.uniq.counts, 0)
                            .astype(jnp.int32))
+    # hot sets: reduced data-parallel, never on the fused wire (_hot_apply)
+    hot_list = [
+        (None if plan.hot_slot is None or state.hot is None
+         else _hot_apply(spec, opt, state.hot, plan, g, axis))
+        for spec, state, opt, plan, g
+        in zip(specs, states, optimizers, plans, gs)]
+    states = [state if hot is None else state.replace(hot=hot)
+              for state, hot in zip(states, hot_list)]
     new_states, stats_list = [], []
     if S == 1:
         for spec, state, opt, plan, g, rc, packed in zip(
@@ -603,6 +794,163 @@ def grouped_apply_gradients(
             packed=packed))
         stats_list.append({"push_overflow": plan.buckets.overflow})
     return new_states, stats_list
+
+
+# ---------------------------------------------------------------------------
+# Hot-set lifecycle: host-side identity construction + device-side
+# writeback/gather (both run inside shard_map; driven off the hot path by
+# MeshTrainer.refresh_hot_rows / hot_sync between steps — shapes are static,
+# so swapping hot sets never re-jits).
+# ---------------------------------------------------------------------------
+
+
+def build_hot_identity(spec: EmbeddingSpec, hot_rows: int, ids64=None, *,
+                       key_template=None) -> dict:
+    """Host-side identity of one table's hot set: the arrays the device probe
+    (`_hot_probe`) and gather (`hot_gather`) consume — `keys` (C = 2H probe
+    slots in the table's key layout, inserted with the device probe's budget
+    so every placed id is reachable), `rank` (probe slot -> compact hot row,
+    H = empty) and `ids` (hot ids by rank, padding EMPTY).
+
+    `ids64`: candidate ids hottest-first (int64 array-like; None/empty -> an
+    all-EMPTY identity). Invalid ids drop (negative; out-of-vocab for array
+    tables); duplicates keep their first (hottest) rank. `key_template`: the
+    table's device key array, pinning pair vs single-lane layout for hash
+    tables."""
+    import numpy as np
+
+    from ..ops.id64 import np_split_ids
+    from ..tables.hash_table import np_fresh_keys, np_hash_insert
+    H = int(hot_rows)
+    C = max(2 * H, 8)
+    if spec.use_hash_table:
+        keys = np_fresh_keys(C, like=(np.asarray(key_template)
+                                      if key_template is not None else None))
+    else:
+        # array tables key the probe by int32 (vocab < 2^31 by the hash
+        # threshold); the device probe casts valid batch ids down losslessly
+        keys = np.full((C,), -1, np.int32)
+    pair = keys.ndim == 2
+    rank = np.full((C,), H, np.int32)
+    if pair:
+        ids_arr = np.full((H, 2), np.uint32(0xFFFFFFFF), np.uint32)
+    else:
+        ids_arr = np.full((H,), -1, keys.dtype)
+    cand = np.asarray([] if ids64 is None else ids64,
+                      np.int64).reshape(-1)
+    cand = cand[cand >= 0]
+    if not spec.use_hash_table:
+        cand = cand[cand < spec.input_dim]
+    _, first = np.unique(cand, return_index=True)  # dedupe, keep hottest rank
+    cand = cand[np.sort(first)][:H]
+    if cand.size:
+        ins = cand if (pair or keys.dtype.itemsize >= 8) \
+            else cand.astype(np.int32)  # host mixer must match device _mix
+        pos = np_hash_insert(keys, ins, 1, num_probes=HOT_NUM_PROBES)
+        placed = pos >= 0
+        kept = cand[placed]
+        rank[pos[placed]] = np.arange(kept.size, dtype=np.int32)
+        if pair:
+            ids_arr[:kept.size] = np_split_ids(kept)
+        else:
+            ids_arr[:kept.size] = kept.astype(keys.dtype)
+    return {"keys": keys, "rank": rank, "ids": ids_arr}
+
+
+def _hot_owner_route(spec: EmbeddingSpec, state: EmbeddingTableState,
+                     ids: jax.Array, axis, insert: bool):
+    """Owner-shard routing of the (replicated) hot id list inside shard_map:
+    -> (new_state, src_row, owner) where `src_row` indexes THIS shard's
+    weights/slots (out of bounds for ids it does not own — gathers fill 0,
+    scatters drop) and `owner` is each id's owning shard index. Hash tables
+    optionally insert absent ids (promotion must leave a row for writeback to
+    land on; the overflow counter advances like `_serve_rows`)."""
+    S = jax.lax.axis_size(axis)
+    if spec.use_hash_table:
+        from ..ops.id64 import pair_mod, pair_valid
+        from ..tables.hash_table import (hash_find, hash_find_or_insert,
+                                         shard_probe)
+        mine, probe = shard_probe(state.keys, ids, axis)
+        if insert:
+            old_overflow = state.overflow
+            new_keys, slot, oflow = hash_find_or_insert(state.keys, probe)
+            delta = jax.lax.psum(oflow, axis)
+            state = state.replace(keys=new_keys,
+                                  overflow=old_overflow + delta)
+        else:
+            slot = hash_find(state.keys, probe)
+        capacity = state.keys.shape[0]
+        src = jnp.where(mine & (slot < capacity), slot, capacity)
+        if ids.ndim == 2:
+            owner = jnp.where(pair_valid(ids),
+                              pair_mod(ids, S).astype(jnp.int32), 0)
+        else:
+            owner = jnp.where(ids >= 0, (ids % S).astype(jnp.int32), 0)
+        return state, src, owner
+    idx = _flat_axis_index(axis)
+    valid = (ids >= 0) & (ids < spec.input_dim)
+    mine = valid & ((ids % S).astype(jnp.int32) == idx)
+    src = jnp.where(mine, (ids // S).astype(jnp.int32),
+                    state.weights.shape[0])
+    owner = jnp.where(valid, (ids % S).astype(jnp.int32), 0)
+    return state, src, owner
+
+
+def hot_writeback(spec: EmbeddingSpec, state: EmbeddingTableState, *,
+                  axis=DATA_AXIS) -> EmbeddingTableState:
+    """Scatter the replicated hot rows (weights AND optimizer slots) back into
+    their owner shards — NO collective: every device holds every hot row, each
+    shard overwrites only the rows it owns. After this the owner copies equal
+    the cache bit for bit, so checkpoint/export/delta readers see exactly what
+    a hot-off run would have written (`MeshTrainer.hot_sync` drives it at
+    snapshot time; `refresh_hot_rows` before demoting). The cache itself stays
+    untouched and live."""
+    hot = state.hot
+    if hot is None:
+        return state
+    state, src, _owner = _hot_owner_route(spec, state, hot.ids, axis,
+                                          insert=spec.use_hash_table)
+    weights = state.weights.at[src].set(
+        hot.weights.astype(state.weights.dtype), mode="drop")
+    slots = {k: state.slots[k].at[src].set(
+        hot.slots[k].astype(state.slots[k].dtype), mode="drop")
+        for k in state.slots}
+    return state.replace(weights=weights, slots=slots)
+
+
+def hot_gather(spec: EmbeddingSpec, state: EmbeddingTableState,
+               identity: dict, *, axis=DATA_AXIS) -> EmbeddingTableState:
+    """Fill the replicated cache for `identity`'s hot set from the owner
+    shards: each shard contributes the rows it owns (zeros elsewhere), ONE
+    all_gather ships the compact (H, dim + slot widths) contributions, and an
+    exact per-id SELECT by owner shard replicates them — no floating-point
+    reduction, promotion copies bits. Hash tables insert absent hot ids (a
+    serving-side heavy hitter the trainer never pulled still gets a row —
+    initializer values, exactly what its first cold pull would have lazily
+    created). Returns the table state with `hot` swapped in (keys/overflow
+    may advance on hash inserts); padding ranks hold zero rows and are
+    masked everywhere by rank/id validity."""
+    ids = identity["ids"]
+    state, src, owner = _hot_owner_route(spec, state, ids, axis, insert=True)
+    w_c = lookup_rows(state.weights, src).astype(jnp.float32)
+    slot_names = sorted(state.slots)
+    cols = [w_c] + [lookup_rows(state.slots[k], src).astype(jnp.float32)
+                    for k in slot_names]
+    widths = [c.shape[1] for c in cols]
+    contrib = jnp.concatenate(cols, axis=1)
+    parts = jax.lax.all_gather(contrib, axis)          # (S, H, W)
+    S = parts.shape[0]
+    sel = parts[jnp.clip(owner, 0, S - 1),
+                jnp.arange(ids.shape[0])]              # (H, W): owner's copy
+    off = widths[0]
+    slots = {}
+    for k, w in zip(slot_names, widths[1:]):
+        slots[k] = sel[:, off:off + w].astype(state.slots[k].dtype)
+        off += w
+    hot = HotRows(keys=identity["keys"], rank=identity["rank"], ids=ids,
+                  weights=sel[:, :widths[0]].astype(state.weights.dtype),
+                  slots=slots)
+    return state.replace(hot=hot)
 
 
 # ---------------------------------------------------------------------------

@@ -22,12 +22,13 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..embedding import EmbeddingSpec, EmbeddingTableState
+from ..embedding import EmbeddingSpec, EmbeddingTableState, HotRows
 from ..model import EmbeddingModel, TrainState, Trainer, init_dense_slots
 from ..optimizers import SparseOptimizer
 from ..utils import metrics as _metrics
 from .mesh import DATA_AXIS, make_mesh
-from .sharded import (sharded_apply_gradients, sharded_lookup,
+from .sharded import (build_hot_identity, hot_gather, hot_writeback,
+                      sharded_apply_gradients, sharded_lookup,
                       sharded_lookup_train)
 
 
@@ -39,7 +40,8 @@ class MeshTrainer(Trainer):
                  on_overflow: str = "count",
                  wire: Optional[str] = None,
                  group_exchange: bool = True,
-                 shard_stats: bool = True):
+                 shard_stats: bool = True,
+                 hot_rows: "int | Dict[str, int]" = 0):
         super().__init__(model, optimizer, seed)
         self.mesh = mesh if mesh is not None else make_mesh()
         self.axis = self.mesh.axis_names[0]
@@ -71,6 +73,16 @@ class MeshTrainer(Trainer):
             raise ValueError(f"on_overflow={on_overflow!r}: expected "
                              "'count', 'grow', or 'raise'")
         self.on_overflow = on_overflow
+        # replicated hot-row cache size per table (int for all PS tables, or
+        # {name: H}; 0 = off — the default path must stay free). Hot sets are
+        # trace-time STATIC: H rows replicated on every device serve the
+        # measured heavy hitters locally (`parallel/sharded.py` "HOT-ROW
+        # REPLICATION"); promote/demote between steps with
+        # `refresh_hot_rows()` (fed by the round-9 sketches), write back into
+        # owner shards with `hot_sync()` (save/persist do it automatically).
+        # Silently inert on 1-device meshes (the shard IS local there).
+        self.hot_rows = hot_rows
+        self._hot_fns: Dict[str, Any] = {}
         self._train_step_fn = None
         self._eval_step_fn = None
 
@@ -125,7 +137,10 @@ class MeshTrainer(Trainer):
         """Per-shard streaming dump (`parallel/checkpoint.py`): each process
         writes only its addressable shards, peak host memory O(chunk) — the
         reference's server-side per-shard dump, `EmbeddingDumpOperator.cpp:36-96`.
-        `Trainer.load` / `MeshTrainer.load` restore it at any mesh size."""
+        `Trainer.load` / `MeshTrainer.load` restore it at any mesh size.
+        Hot-replicated rows write back into their owner shards first
+        (`hot_sync`), so the dump equals a hot-off run's byte for byte."""
+        state = self.hot_sync(state)
         from .checkpoint import save_sharded
         return self._stage_save(
             lambda p: save_sharded(
@@ -133,16 +148,51 @@ class MeshTrainer(Trainer):
                 offload_stores=self.offload_store_snapshots(state), **kw),
             path)
 
+    # -- hot-row replication (skew-aware hybrid placement) -------------------
+
+    def hot_rows_for(self, name: str) -> int:
+        """Replicated hot-cache rows for one table (0 = off). Inert at mesh
+        size 1 and for host-cached tables (their own cache tier governs)."""
+        if self.num_shards <= 1:
+            return 0
+        spec = self.model.specs.get(name)
+        if spec is None or spec.sparse_as_dense \
+                or spec.storage == "host_cached":
+            return 0
+        if isinstance(self.hot_rows, dict):
+            return int(self.hot_rows.get(name, 0))
+        return int(self.hot_rows)
+
+    @property
+    def hot_enabled(self) -> bool:
+        return any(self.hot_rows_for(n) for n in self.model.ps_specs())
+
+    def _hot_specs(self) -> Dict[str, EmbeddingSpec]:
+        return {n: s for n, s in self.model.ps_specs().items()
+                if self.hot_rows_for(n)}
+
     # -- sharding specs ------------------------------------------------------
 
-    def _table_pspec(self, spec: EmbeddingSpec) -> EmbeddingTableState:
-        """PartitionSpec pytree for one table's state."""
+    def _table_pspec(self, spec: EmbeddingSpec,
+                     hot: Optional[bool] = None) -> EmbeddingTableState:
+        """PartitionSpec pytree for one table's state. `hot` overrides whether
+        the replicated hot-cache subtree is included (default: iff the trainer
+        enables it for this table — the managed states always carry it then)."""
+        if hot is None:
+            hot = bool(self.hot_rows_for(spec.name))
+        hot_spec = None
+        if hot:
+            hot_spec = HotRows(
+                keys=P(), rank=P(), ids=P(), weights=P(),
+                slots={k: P() for k in
+                       self.opt_for(spec).slot_shapes(spec.output_dim)})
         return EmbeddingTableState(
             weights=P(self.axis, None),
             slots={k: P(self.axis, None)
                    for k in self.opt_for(spec).slot_shapes(spec.output_dim)},
             keys=P(self.axis) if spec.use_hash_table else None,
             overflow=P() if spec.use_hash_table else None,
+            hot=hot_spec,
         )
 
     def _state_pspec_tree(self, state: TrainState):
@@ -206,10 +256,159 @@ class MeshTrainer(Trainer):
                                            overflow=overflow)
 
             shardings = jax.tree_util.tree_map(
-                lambda p: NamedSharding(mesh, p), self._table_pspec(spec),
+                lambda p: NamedSharding(mesh, p),
+                self._table_pspec(spec, hot=False),
                 is_leaf=lambda x: isinstance(x, P))
-            tables[name] = jax.jit(mk, out_shardings=shardings)()
+            ts = jax.jit(mk, out_shardings=shardings)()
+            H = self.hot_rows_for(name)
+            if H:
+                # start with an all-EMPTY replicated cache (no hot ids until
+                # the first refresh_hot_rows promotes from the sketches)
+                ident = build_hot_identity(spec, H, None, key_template=ts.keys)
+                hot = HotRows(
+                    keys=jnp.asarray(ident["keys"]),
+                    rank=jnp.asarray(ident["rank"]),
+                    ids=jnp.asarray(ident["ids"]),
+                    weights=jnp.zeros((H, spec.output_dim), spec.dtype),
+                    slots=opt.init_slots(H, spec.output_dim))
+                ts = ts.replace(hot=jax.device_put(
+                    hot, NamedSharding(mesh, P())))
+            tables[name] = ts
         return tables
+
+    # -- hot-set lifecycle (writeback / promote / demote off the hot path) ---
+
+    def _hot_jit(self, mode: str):
+        """Jitted shard_map over the hot tables for one lifecycle mode:
+        'sync' (writeback only), 'refresh' (writeback + install new identity +
+        gather), 'fill' (gather into loaded states that carry no cache yet).
+        Shapes are static, so each mode compiles ONCE ever — promote/demote
+        is array-content swaps, never a re-jit."""
+        if mode in self._hot_fns:
+            return self._hot_fns[mode]
+        specs = self._hot_specs()
+        tspec_in = {n: self._table_pspec(s, hot=(mode != "fill"))
+                    for n, s in specs.items()}
+        tspec_out = {n: self._table_pspec(s, hot=True)
+                     for n, s in specs.items()}
+        axis = self.axis
+
+        if mode == "sync":
+            def fn(tables):
+                return {name: hot_writeback(spec, tables[name], axis=axis)
+                        for name, spec in specs.items()}
+            in_specs = (tspec_in,)
+        else:
+            def fn(tables, idents):
+                out = {}
+                for name, spec in specs.items():
+                    ts = tables[name]
+                    if mode == "refresh":
+                        ts = hot_writeback(spec, ts, axis=axis)
+                    out[name] = hot_gather(spec, ts, idents[name], axis=axis)
+                return out
+            in_specs = (tspec_in, {n: P() for n in specs})
+
+        sm = jax.shard_map(fn, mesh=self.mesh, in_specs=in_specs,
+                           out_specs=tspec_out, check_vma=False)
+        self._hot_fns[mode] = jax.jit(sm)
+        return self._hot_fns[mode]
+
+    def _hot_sub(self, state: TrainState, *, need_hot: bool = True):
+        sub = {n: state.tables[n] for n in self._hot_specs()}
+        if need_hot:
+            missing = [n for n, ts in sub.items() if ts.hot is None]
+            if missing:
+                raise ValueError(
+                    f"tables {missing} carry no hot cache — states managed "
+                    "by a hot-enabled MeshTrainer must come from its init()/"
+                    "load()/refresh_hot_rows() (a restored state needs "
+                    "MeshTrainer.load to re-attach the cache)")
+        return sub
+
+    def hot_sync(self, state: TrainState) -> TrainState:
+        """Write every replicated hot row (weights + optimizer slots) back
+        into its owner shard and return the updated state; the cache stays
+        live and authoritative. Call before handing raw table state to
+        anything outside the trainer (export, custom readers) — `save` and
+        the persisters (`persist.py`) call it automatically, which is what
+        keeps checkpoints/exports/sync deltas byte-identical to a hot-off
+        run."""
+        if not self.hot_enabled:
+            return state
+        new = self._hot_jit("sync")(self._hot_sub(state))
+        tables = dict(state.tables)
+        tables.update(new)
+        return state.replace(tables=tables)
+
+    def refresh_hot_rows(self, state: TrainState, hot_ids=None,
+                         monitor=None) -> TrainState:
+        """Promote/demote the hot sets between steps: write the OLD hot rows
+        back to their owner shards, install the new per-table sets, and
+        gather their rows into the replicated cache (bit-copies via owner
+        select — no float math, so promotion never perturbs training).
+
+        New sets come from `hot_ids` ({table: int64 ids, hottest first}) or
+        the heavy-hitter sketches — `monitor`, the trainer's
+        `enable_skew_monitor()` feed, or the global `utils.sketch.MONITOR`.
+        Size `hot_rows` from the measured coverage curve
+        (`tools/skew_report.py` / the /statusz hot-id table); refresh on a
+        coarse cadence (e.g. every few hundred steps) — under
+        `SpaceSaving(decay=...)` the sketch itself rotates with the
+        workload. Static shapes: a refresh NEVER re-jits the step."""
+        if not self.hot_enabled:
+            return state
+        import numpy as np
+        idents = {}
+        for name, spec in self._hot_specs().items():
+            H = self.hot_rows_for(name)
+            if hot_ids is not None and name in hot_ids:
+                cand = np.asarray(hot_ids[name], np.int64)
+            else:
+                mon = monitor if monitor is not None else self._skew
+                if mon is None:
+                    from ..utils import sketch
+                    mon = sketch.MONITOR
+                cand = np.asarray(
+                    [h for h, _est, _err in mon.sketch(name).topk(H)],
+                    np.int64)
+            ident = build_hot_identity(spec, H, cand,
+                                       key_template=state.tables[name].keys)
+            idents[name] = ident
+            _metrics.observe("hot.set_size",
+                             float(int((np.asarray(ident["rank"]) < H).sum())),
+                             "gauge", labels={"table": name})
+        _metrics.observe("hot.refreshes", 1)
+        new = self._hot_jit("refresh")(self._hot_sub(state), idents)
+        tables = dict(state.tables)
+        tables.update(new)
+        return state.replace(tables=tables)
+
+    def load(self, state: TrainState, path: str):
+        """See Trainer.load. With hot replication on, the loaders rebuild
+        plain table states (the cache is never serialized), so this re-attaches
+        the PRE-load hot identity (or an empty one) and re-GATHERS its rows
+        from the loaded shards — the stale pre-load cache values are never
+        written back."""
+        loaded = super().load(state, path)
+        if not self.hot_enabled:
+            return loaded
+        idents = {}
+        for name, spec in self._hot_specs().items():
+            old = state.tables.get(name)
+            old_hot = old.hot if old is not None else None
+            if old_hot is not None:
+                idents[name] = {"keys": old_hot.keys, "rank": old_hot.rank,
+                                "ids": old_hot.ids}
+            else:
+                idents[name] = build_hot_identity(
+                    spec, self.hot_rows_for(name), None,
+                    key_template=loaded.tables[name].keys)
+        sub = {n: loaded.tables[n].replace(hot=None) for n in idents}
+        new = self._hot_jit("fill")(sub, idents)
+        tables = dict(loaded.tables)
+        tables.update(new)
+        return loaded.replace(tables=tables)
 
     # -- per-device hooks (run inside shard_map) -----------------------------
 
@@ -338,6 +537,26 @@ class MeshTrainer(Trainer):
             tables, self.num_shards, fmt, fused=self.group_exchange)
         self.last_wire_cost = cost
         _metrics.observe_exchange_cost(cost)
+        # hot-cache static costs: cache size per table + the per-device wire
+        # bytes of the backward's dense psum (ring-allreduce model,
+        # 2(S-1)/S x the (H, dim) f32 grads + (H,) i32 counts per table) —
+        # the cheap-collective price the replicated hot set pays instead of
+        # riding the a2a (SparCML's dense-ified hot aggregate)
+        hot_bytes = 0
+        S = self.num_shards
+        for name, spec in ps_specs.items():
+            H = self.hot_rows_for(name)
+            if not H:
+                continue
+            _metrics.observe("hot.rows", float(H), "gauge",
+                             labels={"table": name})
+            hot_bytes += int(2 * (S - 1) / S * H * (spec.output_dim * 4 + 4))
+        if hot_bytes:
+            _metrics.observe("hot.replicate_bytes_per_step", float(hot_bytes),
+                             "gauge")
+            cost = dict(cost)
+            cost["hot_replicate_bytes"] = int(hot_bytes)
+            self.last_wire_cost = cost
 
     # packed scan layout: the base `_packed_layouts` gate applies per shard
     # (widths are shard-invariant); the sharded pull auto-slices packed rows
@@ -459,7 +678,8 @@ class SeqMeshTrainer(MeshTrainer):
 
     def __init__(self, model, optimizer=None, *, mesh: Mesh, seed: int = 0,
                  capacity_factor: float = 0.0, wire: Optional[str] = None,
-                 group_exchange: bool = True, shard_stats: bool = True):
+                 group_exchange: bool = True, shard_stats: bool = True,
+                 hot_rows: "int | Dict[str, int]" = 0):
         if len(mesh.axis_names) != 2:
             raise ValueError(
                 f"SeqMeshTrainer needs a 2-D (data, seq) mesh, got axes "
@@ -467,7 +687,7 @@ class SeqMeshTrainer(MeshTrainer):
         super().__init__(model, optimizer, mesh=mesh, seed=seed,
                          capacity_factor=capacity_factor, wire=wire,
                          group_exchange=group_exchange,
-                         shard_stats=shard_stats)
+                         shard_stats=shard_stats, hot_rows=hot_rows)
         self.data_axis, self.seq_axis = mesh.axis_names
         # collectives (sparse exchange, psum, metrics) span the flattened mesh
         self.axis = tuple(mesh.axis_names)

@@ -153,8 +153,13 @@ class AsyncPersister:
 
         Sharded states snapshot per-addressable-shard (each process copies only
         its own shards — a multi-host global table is never gathered; the r1
-        whole-state `device_get` breaks on non-fully-addressable arrays)."""
+        whole-state `device_get` breaks on non-fully-addressable arrays).
+
+        Hot-replicated rows (MeshTrainer(hot_rows=...)) write back into their
+        owner shards first (`trainer.hot_sync`, identity off-mesh), so the
+        persisted bytes equal a hot-off run's."""
         self._raise_pending_error()
+        state = self.trainer.hot_sync(state)
         step = int(state.step)
         if getattr(self.trainer, "offload", None):
             # host-cached tables snapshot their WHOLE host store (a consistent
@@ -669,6 +674,10 @@ class IncrementalPersister(AsyncPersister):
 
     def persist(self, state) -> str:
         self._raise_pending_error()
+        # delta readers pull touched rows straight off the shards — hot-cached
+        # rows must land there first (the full-persist branch syncs again in
+        # super().persist; a second writeback of H identical rows is noise)
+        state = self.trainer.hot_sync(state)
         step = int(state.step)
         touched = self.tracker.take()
         if jax.process_count() > 1:

@@ -236,7 +236,14 @@ def record_step_stats(stats: Dict[str, "object"]) -> None:
     (`exchange.shard_rows{table=,shard=}`), `shard_positions` additionally
     derives the `exchange.shard_imbalance{table=}` histogram (max/mean over
     shards — Parallax's access-skew number), and
-    `pull_unique`/`pull_indices` derive `exchange.unique_ratio{table=}`."""
+    `pull_unique`/`pull_indices` derive `exchange.unique_ratio{table=}`.
+
+    Hot-row replication stats (`{var}/hot_hits` / `hot_unique` /
+    `hot_bytes_saved`, present when `MeshTrainer(hot_rows=...)` is on) derive
+    `hot.hit_ratio{table=}` (positions served from the replicated cache /
+    positions pulled) and `hot.bytes_saved{table=}` in the SAME device_get —
+    no second host sync — and as gauges they survive `report(reset=True)`
+    like the other exchange.* gauges."""
     try:
         import jax
         stats = jax.device_get(dict(stats))
@@ -266,6 +273,12 @@ def record_step_stats(stats: Dict[str, "object"]) -> None:
         if d.get("pull_indices"):
             observe("exchange.unique_ratio",
                     d.get("pull_unique", 0.0) / d["pull_indices"], "gauge",
+                    labels={"table": var})
+            if "hot_hits" in d:
+                observe("hot.hit_ratio", d["hot_hits"] / d["pull_indices"],
+                        "gauge", labels={"table": var})
+        if "hot_bytes_saved" in d:
+            observe("hot.bytes_saved", d["hot_bytes_saved"], "gauge",
                     labels={"table": var})
 
 

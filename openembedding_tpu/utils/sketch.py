@@ -76,6 +76,13 @@ class CountMin:
             np.add.at(self.table[r], self._hash(r, ids), counts)
         self.total += int(counts.sum())
 
+    def scale(self, factor: float) -> None:
+        """Exponential decay: multiply every cell (and the stream total) by
+        `factor` in [0, 1], rounding down — old mass fades geometrically so a
+        drifting workload's NEW heavy hitters can outrank stale ones."""
+        self.table = np.floor(self.table * factor).astype(np.int64)
+        self.total = int(self.total * factor)
+
     def query(self, ids: np.ndarray) -> np.ndarray:
         if ids.size == 0:
             return np.zeros((0,), np.int64)
@@ -88,11 +95,22 @@ class CountMin:
 
 class SpaceSaving:
     """Bounded top-K heavy-hitter summary (see module doc for the merge rule
-    and the `est - err <= true <= est` bound). Thread-safe."""
+    and the `est - err <= true <= est` bound). Thread-safe.
+
+    `decay` (None = off): exponential forgetting — every `update()` batch
+    first scales all counts (summary + count-min + stream total) by `decay`,
+    so estimates approximate an exponentially-weighted window of
+    ~1/(1-decay) batches and a workload shift rotates the top-K instead of
+    being drowned by stale mass (tested in tests/test_skew.py). Under decay
+    the `est - err <= true <= est` bound holds against the DECAYED true
+    count, up to floor-rounding (+-1 per batch per id)."""
 
     def __init__(self, k: int = 64, cm_width: int = 2048, cm_depth: int = 4,
-                 seed: int = 0x5EE1):
+                 seed: int = 0x5EE1, decay: Optional[float] = None):
         self.k = int(k)
+        if decay is not None and not (0.0 < decay <= 1.0):
+            raise ValueError(f"decay={decay}: expected a factor in (0, 1]")
+        self.decay = None if decay in (None, 1.0) else float(decay)
         self.cm = CountMin(cm_width, cm_depth, seed)
         self._ids = np.zeros((0,), np.int64)   # sorted
         self._est = np.zeros((0,), np.int64)
@@ -118,6 +136,10 @@ class SpaceSaving:
         uniq, cnt = np.unique(ids, return_counts=True)
         cnt = cnt.astype(np.int64)
         with self._lock:
+            if self.decay is not None:
+                self.cm.scale(self.decay)
+                self._est = np.floor(self._est * self.decay).astype(np.int64)
+                self._err = np.floor(self._err * self.decay).astype(np.int64)
             self.cm.add(uniq, cnt)
             n = self._ids.shape[0]
             if n:
@@ -154,6 +176,28 @@ class SpaceSaving:
             return [(int(self._ids[i]), int(self._est[i]), int(self._err[i]))
                     for i in order]
 
+    def coverage(self, ks: Optional[List[int]] = None
+                 ) -> List[Tuple[int, float]]:
+        """Coverage curve [(k, cumulative share of the observed stream the
+        top-k tracked ids absorb)] — THE sizing input for
+        `MeshTrainer(hot_rows=...)`: pick the knee where extra rows stop
+        buying traffic. Defaults to powers of two up to the tracked count.
+        Shares use the (possibly over-counted) estimates, so the curve is an
+        upper bound with the same `est` semantics as `topk`."""
+        with self._lock:
+            est = np.sort(self._est)[::-1].astype(np.float64)
+            total = float(max(self.cm.total, 1))
+        cum = np.cumsum(est)
+        if ks is None:
+            ks, k = [], 1
+            while k < est.size:
+                ks.append(k)
+                k *= 2
+            if est.size:
+                ks.append(int(est.size))
+        return [(int(k), float(cum[min(int(k), est.size) - 1] / total))
+                for k in ks if k >= 1 and est.size]
+
 
 class SkewMonitor:
     """Per-table sketch registry fed off the hot path (bounded queue + one
@@ -161,9 +205,10 @@ class SkewMonitor:
     must shed load before it slows the path it measures)."""
 
     def __init__(self, k: int = 64, queue_size: int = 64,
-                 sync: bool = False):
+                 sync: bool = False, decay: Optional[float] = None):
         self.k = k
         self.sync = sync
+        self.decay = decay  # per-batch exponential forgetting (SpaceSaving)
         self._sketches: Dict[str, SpaceSaving] = {}
         self._lock = threading.Lock()
         self._q: "queue.Queue" = queue.Queue(maxsize=queue_size)
@@ -173,7 +218,8 @@ class SkewMonitor:
         with self._lock:
             sk = self._sketches.get(table)
             if sk is None:
-                sk = self._sketches[table] = SpaceSaving(self.k)
+                sk = self._sketches[table] = SpaceSaving(self.k,
+                                                         decay=self.decay)
             return sk
 
     def tables(self) -> List[str]:
@@ -257,6 +303,12 @@ class SkewMonitor:
             for rank, (hid, est, err) in enumerate(sk.topk(top)):
                 lines.append(f"  #{rank:<2d} id={hid:<20d} est={est:<10d} "
                              f"err<={err:<8d} share~{est / total:6.2%}")
+            cov = sk.coverage()
+            if cov:
+                # the hot_rows sizing curve (cumulative traffic share vs
+                # top-K), same numbers tools/skew_report.py prints offline
+                lines.append("  coverage: " + "  ".join(
+                    f"top{k}={share:.1%}" for k, share in cov))
         return "\n".join(lines)
 
 

@@ -82,6 +82,64 @@ def test_space_saving_pair_and_padding_ids():
     assert sk.total == 7  # padding ids never counted
 
 
+def test_space_saving_decay_rotates_topk_under_drift():
+    """`SpaceSaving(decay=...)`: after a distribution shift the NEW heavy
+    hitters must displace the stale ones from the top-K within a bounded
+    number of batches (~the e-folding window 1/(1-decay)), instead of being
+    drowned by accumulated old mass. The no-decay control shows the failure
+    this fixes: old ids keep the top ranks long after the shift."""
+    rng = np.random.default_rng(11)
+    old_hot = np.arange(0, 8, dtype=np.int64)          # phase 1 heavy hitters
+    new_hot = np.arange(1000, 1008, dtype=np.int64)    # phase 2 heavy hitters
+
+    def batch(hot):
+        ids = rng.integers(0, 100_000, 512)
+        ids[: 512 // 2] = hot[rng.integers(0, hot.size, 512 // 2)]
+        return ids
+
+    decayed = SpaceSaving(k=32, decay=0.8)   # window ~5 batches
+    plain = SpaceSaving(k=32)
+    warmup = 40
+    for _ in range(warmup):
+        b = batch(old_hot)
+        decayed.update(b)
+        plain.update(b)
+
+    def top8(sk):
+        return {h for h, _est, _err in sk.topk(8)}
+
+    assert top8(decayed) == set(old_hot.tolist())
+    rotated_at = None
+    shift_batches = 15  # a few e-folding windows; << the 40-batch warmup
+    for i in range(shift_batches):
+        b = batch(new_hot)
+        decayed.update(b)
+        plain.update(b)
+        if rotated_at is None and top8(decayed) == set(new_hot.tolist()):
+            rotated_at = i + 1
+    assert rotated_at is not None and rotated_at <= shift_batches, \
+        f"decayed top-K never rotated: {sorted(top8(decayed))}"
+    # control: without decay the stale warmup mass still holds the top ranks
+    assert top8(plain) == set(old_hot.tolist())
+
+
+def test_space_saving_coverage_curve():
+    """`coverage()` is the hot_rows sizing input: monotone shares in (0, 1],
+    and on a stream the sketch tracks exactly, the top-k share equals the
+    true cumulative traffic fraction."""
+    sk = SpaceSaving(k=16)
+    # 4 ids with counts 40, 30, 20, 10 (total 100): exact coverage known
+    ids = np.repeat(np.array([1, 2, 3, 4], np.int64), [40, 30, 20, 10])
+    sk.update(ids)
+    cov = dict(sk.coverage([1, 2, 4]))
+    assert cov[1] == pytest.approx(0.40)
+    assert cov[2] == pytest.approx(0.70)
+    assert cov[4] == pytest.approx(1.00)
+    ks = [k for k, _ in sk.coverage()]
+    shares = [s for _, s in sk.coverage()]
+    assert ks == sorted(ks) and shares == sorted(shares)  # monotone curve
+
+
 def test_skew_monitor_publishes_rank_labeled_gauges():
     mon = SkewMonitor(k=8, sync=True)
     mon.observe("user", np.array([5, 5, 5, 5, 9, 9, 3]))
@@ -312,6 +370,8 @@ def test_statusz_shows_hot_id_table(tmp_path):
         assert "workload skew (hot ids)" in body
         assert "table categorical" in body
         assert "id=42" in body
+        # hot_rows sizing curve renders next to the hot-id table
+        assert "coverage:" in body and "top1=" in body
     finally:
         ha.shutdown()
         sketch.MONITOR.reset()
@@ -345,6 +405,9 @@ def test_skew_report_tool_renders_scrape(tmp_path, capsys):
     assert sr.main([str(scrape)]) == 0
     out = capsys.readouterr().out
     assert "table categorical" in out and "42" in out
+    # coverage curve from the same scrape (top-1 is 5 of 6 observed ids)
+    assert "coverage curve (hot_rows sizing)" in out
+    assert "top1=83.3%" in out
     sketch.MONITOR.reset()
 
 

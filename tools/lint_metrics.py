@@ -33,6 +33,7 @@ SEGMENT = re.compile(r"^[a-z0-9_]+$")
 KNOWN_GROUPS = {
     "exchange",   # sharded-exchange wire costs + per-shard load/skew gauges
     "fleet",      # /fleetz cross-node scrape health
+    "hot",        # replicated hot-row cache (MeshTrainer(hot_rows=...))
     "metrics",    # the metrics subsystem's own health (report_errors)
     "offload",    # host-cached table cache admission/flush
     "persist",    # async/incremental persistence

@@ -1,4 +1,5 @@
-"""Workload-skew report: hot ids + shard balance from a node's /metrics.
+"""Workload-skew report: hot ids + coverage curve + shard balance from a
+node's /metrics.
 
     python tools/skew_report.py http://node:8501            # live scrape
     python tools/skew_report.py /tmp/metrics.txt            # saved scrape
@@ -7,11 +8,15 @@
 Renders the `skew.*` rank-labeled gauges the heavy-hitter sketches publish
 (`utils/sketch.py` — `skew.hot_id{table=,rank=}` / `hot_id_count` /
 `hot_id_error` / `stream_ids`) as a per-table hot-id table with the
-documented `est - err <= true <= est` bound, and the per-shard exchange load
-gauges (`exchange.shard_rows` / `shard_positions` / `bucket_fill`, plus the
-`exchange.shard_imbalance` histogram's mean) as a shard-balance table — the
-two measurements Parallax-style skew-aware sharding decisions need, offline,
-from one scrape.
+documented `est - err <= true <= est` bound, the COVERAGE CURVE (cumulative
+traffic share vs top-K — the sizing input for `MeshTrainer(hot_rows=...)`:
+read off the K where the curve knees and check `hot.hit_ratio` reproduces it
+live), and the per-shard exchange load gauges (`exchange.shard_rows` /
+`shard_positions` / `bucket_fill`, plus the `exchange.shard_imbalance`
+histogram's mean) as a shard-balance table — the measurements Parallax-style
+skew-aware placement decisions need, offline, from one scrape. The same
+coverage curve renders on the node's own `GET /statusz` next to the hot-id
+table.
 """
 
 from __future__ import annotations
@@ -71,6 +76,35 @@ def hot_id_report(samples, top: int) -> str:
     return "\n".join(lines)
 
 
+def coverage_report(samples) -> str:
+    """Cumulative traffic share vs top-K per table, from the rank-labeled
+    `skew.hot_id_count` gauges + `skew.stream_ids` — bounded by the sketch's
+    tracked set (k), which is exactly the range `hot_rows` can be sized in."""
+    counts = _by_table_rank(samples, "oetpu_skew_hot_id_count")
+    totals = {labels.get("table"): value for n, labels, value in samples
+              if n == "oetpu_skew_stream_ids"}
+    if not counts:
+        return "(no skew.* series — node has no id streams observed yet)"
+    lines = []
+    for table in sorted(counts):
+        total = max(totals.get(table, 0.0), 1.0)
+        est = sorted(counts[table].values(), reverse=True)
+        cum, acc = [], 0.0
+        for v in est:
+            acc += v
+            cum.append(acc / total)
+        ks, k = [], 1
+        while k < len(cum):
+            ks.append(k)
+            k *= 2
+        ks.append(len(cum))
+        lines.append(f"table {table}: top-K traffic share "
+                     f"(size hot_rows at the knee; {len(cum)} tracked)")
+        lines.append("  " + "  ".join(f"top{k}={cum[k - 1]:.1%}"
+                                      for k in ks))
+    return "\n".join(lines)
+
+
 def shard_balance_report(samples) -> str:
     stats = ("oetpu_exchange_shard_rows", "oetpu_exchange_shard_positions",
              "oetpu_exchange_bucket_fill")
@@ -119,6 +153,9 @@ def main(argv=None) -> int:
     samples = parsed["samples"]
     print("== hot ids (heavy-hitter sketches) ==")
     print(hot_id_report(samples, args.top))
+    print()
+    print("== coverage curve (hot_rows sizing) ==")
+    print(coverage_report(samples))
     print()
     print("== shard balance (exchange load accounting) ==")
     print(shard_balance_report(samples))

@@ -99,6 +99,20 @@ CASES = [
     #     load accounting on/off + sketch ms/batch). TWO fused-exchange
     #     compiles at the mesh1 700s allowance each — budget sized for both.
     ("bench_skew", *bench_case("skew", 1700)),
+    # 11. hot-row replication (bench 'hot' case: Zipf vs uniform, cache
+    #     on/off — hit ratio, imbalance drop, min zero-drop capacity + the
+    #     exchange-bytes model at it). The byte/imbalance wins need S >= 2
+    #     shards, so like wire_microbench this entry runs on the 8-virtual-
+    #     device CPU mesh (no relay needed; riding the battery keeps all
+    #     BENCH stanzas in one capture file).
+    ("bench_hot",
+     [sys.executable, os.path.join(REPO, "bench.py")],
+     {"OETPU_BENCH_CASES": "hot",
+      "OETPU_BENCH_BUDGET_S": "900",
+      "OETPU_BENCH_TOTAL_BUDGET_S": "1140",
+      "OETPU_BENCH_PROBE_TIMEOUT_S": "75",
+      "JAX_PLATFORMS": "cpu",
+      "XLA_FLAGS": "--xla_force_host_platform_device_count=8"}, 1200),
 ]
 
 
