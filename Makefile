@@ -13,12 +13,31 @@ PY ?= python
 CPU_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 	XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: ci test dryrun bench-smoke native lint-metrics
+.PHONY: ci test dryrun bench-smoke native lint lint-fast lint-budget \
+	lint-metrics
 
-ci: lint-metrics test dryrun bench-smoke
+ci: lint test dryrun bench-smoke
 
-# metric-name hygiene: every observe()/vtimer()/trace.span() literal call
-# site must follow the documented `group.name` scheme (utils/metrics.py)
+# the full static-analysis + invariant-guard suite (tools/oelint): five
+# passes — trace-hazard (recompile hazards in jit-reachable code), host-sync
+# (device_get discipline in `# oelint: hot-path` fns), hlo-budget (compiled
+# collective counts vs tools/oelint/hlo_budget.json), lockset (`# guarded-by:`
+# lock discipline), metrics (name hygiene). CPU-only, no chip; < 90 s.
+lint:
+	$(CPU_ENV) $(PY) -m tools.oelint
+
+# fast local iteration: lint only files changed vs HEAD (skips the
+# hlo-budget compile unless exchange/trainer/ops paths changed)
+lint-fast:
+	$(CPU_ENV) $(PY) -m tools.oelint --changed-only
+
+# regenerate the pinned HLO collective budget after an INTENTIONAL
+# collective change; commit the resulting json diff
+lint-budget:
+	$(CPU_ENV) $(PY) -m tools.oelint --update-budget
+
+# metric-name hygiene only (back-compat alias; the check is oelint's fifth
+# pass and runs as part of `make lint`)
 lint-metrics:
 	$(PY) tools/lint_metrics.py
 

@@ -417,6 +417,8 @@ class StandaloneModel:
                          jnp.zeros((1, w.shape[1]), w.dtype))
         return rows[:k].reshape(tuple(ids_shape) + (t["dim"],))
 
+    # oelint: hot-path (predict path: inputs convert host-side, the device
+    # output syncs ONCE in the caller — MicroBatcher._run_chunk / REST _json)
     def predict(self, batch: Dict[str, Any]) -> jax.Array:
         """Full forward pass -> logits. Needs the dense module (from the export's
         model_config recipe or passed to load())."""

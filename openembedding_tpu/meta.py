@@ -29,6 +29,7 @@ HASH_VOCABULARY_THRESHOLD = 1 << 63
 class DataType:
     """String-keyed dtype registry (reference: `variable/DataType.h`)."""
 
+    # oelint: disable=lockset -- immutable-by-convention dtype registry, populated once at class definition
     _TABLE = {
         "int8": jnp.int8,
         "int16": jnp.int16,

@@ -491,6 +491,8 @@ class Trainer:
 
     # -- the per-device step (pure; shard_map-able) -------------------------
 
+    # oelint: hot-path device_get=0 (the traced step: zero host syncs; the
+    # ONE allowed per-step device_get lives in metrics.record_step_stats)
     def train_step(self, state: TrainState, batch, *,
                    packed=None) -> Tuple[TrainState, Dict]:
         """One synchronous step: pull -> fwd/bwd -> dense apply + sparse apply.

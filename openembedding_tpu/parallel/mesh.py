@@ -33,8 +33,10 @@ def make_mesh(devices: Optional[Sequence] = None, axis: str = DATA_AXIS) -> Mesh
 
 def table_sharding(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:
     """Embedding tables: rows sharded over the mesh (reference: PS shard placement,
-    `Model.cpp:153-186`)."""
-    return NamedSharding(mesh, P(axis, None))
+    `Model.cpp:153-186`). Trimmed spelling (`P(axis)`, unmentioned dims
+    replicated): matches what jit outputs carry, so committed tables never
+    force a cache-key-mismatch retrace (MeshTrainer._table_pspec)."""
+    return NamedSharding(mesh, P(axis))
 
 
 def keys_sharding(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:

@@ -177,7 +177,7 @@ class ShardedModel:
                 )
 
             pspec = EmbeddingTableState(
-                weights=P(axis, None), slots={},
+                weights=P(axis), slots={},
                 keys=P(axis) if spec.use_hash_table else None,
                 overflow=P() if spec.use_hash_table else None)
             shardings = jax.tree_util.tree_map(
@@ -272,7 +272,7 @@ class ShardedModel:
 
     def _table_pspec(self, spec: EmbeddingSpec):
         return EmbeddingTableState(
-            weights=P(self.axis, None), slots={},
+            weights=P(self.axis), slots={},
             keys=P(self.axis) if spec.use_hash_table else None,
             overflow=P() if spec.use_hash_table else None)
 
@@ -472,6 +472,7 @@ class ShardedModel:
                 ids = ids.astype(jnp.int64)
         return self._lookup_fn(name)(self.tables[name], ids)
 
+    # oelint: hot-path (predict path: device output syncs ONCE in the caller)
     def predict(self, batch: Dict[str, Any]) -> jax.Array:
         """Forward pass -> logits: sparse pulls sharded, dense tower replicated
         over the request batch. Needs the module recipe (model_config.json in

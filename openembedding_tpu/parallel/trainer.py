@@ -186,9 +186,15 @@ class MeshTrainer(Trainer):
                 keys=P(), rank=P(), ids=P(), weights=P(),
                 slots={k: P() for k in
                        self.opt_for(spec).slot_shapes(spec.output_dim)})
+        # row-sharded specs are spelled WITHOUT the trailing None (`P(axis)`,
+        # not `P(axis, None)`): jit outputs carry the trimmed spelling, and
+        # PartitionSpec('data', None) != PartitionSpec('data') as a jit cache
+        # key — the untrimmed spelling on the init-committed tables made the
+        # SECOND train step recompile the whole program (caught by
+        # utils/guards.assert_no_recompile; every placement site must agree)
         return EmbeddingTableState(
-            weights=P(self.axis, None),
-            slots={k: P(self.axis, None)
+            weights=P(self.axis),
+            slots={k: P(self.axis)
                    for k in self.opt_for(spec).slot_shapes(spec.output_dim)},
             keys=P(self.axis) if spec.use_hash_table else None,
             overflow=P() if spec.use_hash_table else None,
@@ -449,6 +455,7 @@ class MeshTrainer(Trainer):
                 for g in self.model.dim_groups()
                 if any(n in ps_specs for n in g)]
 
+    # oelint: hot-path device_get=0
     def tables_pull(self, tables, batch, ps_specs, packed):
         """Fused pull: 1 id a2a + 1 (optionally quantized) row a2a per
         DIM-GROUP instead of per table (`sharded.grouped_lookup_train`).
@@ -477,6 +484,7 @@ class MeshTrainer(Trainer):
                         stats[f"{n}/{k}"] = v
         return pulled_tables, pulled, stats, plans
 
+    # oelint: hot-path device_get=0
     def tables_apply(self, ps_specs, pulled_tables, batch, row_grads, packed,
                      plans):
         """Fused push: 1 grads+counts a2a per DIM-GROUP

@@ -945,9 +945,11 @@ class _StateMeshShim:
         from jax.sharding import PartitionSpec as P
 
         from .embedding import EmbeddingTableState
+        # trimmed spelling (`P(axis)`): must match MeshTrainer._table_pspec —
+        # a `P(axis, None)`-committed restore would re-trace the train step
         return EmbeddingTableState(
-            weights=P(self.axis, None),
-            slots={k: P(self.axis, None)
+            weights=P(self.axis),
+            slots={k: P(self.axis)
                    for k in self._slot_names[spec.name]},
             keys=P(self.axis) if spec.use_hash_table else None,
             overflow=P() if spec.use_hash_table else None,

@@ -220,11 +220,14 @@ class ModelManager:
 
     def __init__(self, registry: ModelRegistry):
         self.registry = registry
-        self._cache: Dict[str, object] = {}
+        # the RCU servable cache: swap/evict/load publish through it, every
+        # predict resolves from it (lock discipline enforced by `make lint`,
+        # tools/oelint lockset pass)
+        self._cache: Dict[str, object] = {}  # guarded-by: self._lock
         self._lock = threading.Lock()
         # per-sign load guards: two first requests racing for the same model
         # must not both run a (device-memory-heavy) sharded load
-        self._loading: Dict[str, threading.Lock] = {}
+        self._loading: Dict[str, threading.Lock] = {}  # guarded-by: self._lock
 
     @staticmethod
     def _load_entry(entry: dict):
@@ -327,9 +330,19 @@ class ModelManager:
 class ServingHandler(BaseHTTPRequestHandler):
     manager: ModelManager = None  # set by make_server
     batcher: "Optional[MicroBatcher]" = None  # set when batching is enabled
+    # model_sign -> publisher/subscriber registries: DELIBERATE class-level
+    # shared state — http.server constructs one handler INSTANCE per request,
+    # so per-server mutable registries must live on the per-server Handler
+    # subclass (make_server assigns fresh dicts; POST publish/sync mutates
+    # them across requests by design)
+    # oelint: disable=lockset -- per-server registry; make_server subclass gets a fresh dict
     publishers: dict = {}   # model_sign -> sync.SyncPublisher (make_server)
+    # oelint: disable=lockset -- per-server registry; make_server subclass gets a fresh dict
     subscribers: dict = {}  # model_sign -> sync.SyncSubscriber (make_server)
-    peers: list = []        # default /fleetz scrape set (make_server/--peers)
+    # read-only defaults: make_server replaces these on the subclass; the
+    # immutable peers tuple means a stray bare-ServingHandler append fails
+    peers: tuple = ()       # default /fleetz scrape set (make_server/--peers)
+    # oelint: disable=lockset -- read-only default; make_server assigns a fresh dict per server
     node_info: dict = {}
     quiet = True
 
@@ -923,7 +936,7 @@ class MicroBatcher:
         self.max_batch = max_batch
         self._lock = threading.Lock()
         self._full = threading.Condition(self._lock)
-        self._groups: Dict[tuple, list] = {}
+        self._groups: Dict[tuple, list] = {}  # guarded-by: self._lock
 
     @staticmethod
     def _group_key(sign: str, batch: dict) -> tuple:
@@ -1005,6 +1018,8 @@ class MicroBatcher:
         if chunk:
             self._run_chunk(model, chunk)
 
+    # oelint: hot-path -- every merged predict runs through here; the single
+    # np.asarray(model.predict(...)) below is the ONE device sync per batch
     def _run_chunk(self, model, group: list) -> None:
         from .utils import metrics
         # window tunability (the `window_ms` knob): how long requests parked

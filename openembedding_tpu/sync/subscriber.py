@@ -90,17 +90,26 @@ class SyncSubscriber:
         self.max_backoff_s = max_backoff_s
         self.timeout = timeout
         self.faults = faults
-        self.state = IDLE
-        self.version: Optional[int] = None
-        self.applied = 0
-        self.last_error: Optional[str] = None
+        # `_mu` guards the machine state the worker thread WRITES and the
+        # serving threads READ (`status()` on GET :syncstate / statusz):
+        # without it a reader can see a half-updated (state, version,
+        # last_degraded_reason) triple mid-transition. The `# guarded-by:`
+        # annotations are enforced by `make lint` (tools/oelint lockset
+        # pass): any write outside `with self._mu:` fails CI.
+        self._mu = threading.Lock()
+        self.state = IDLE                       # guarded-by: self._mu
+        self.version: Optional[int] = None      # guarded-by: self._mu
+        self.applied = 0                        # guarded-by: self._mu
+        self.last_error: Optional[str] = None   # guarded-by: self._mu
         # survives recovery: the reason the machine LAST entered DEGRADED
         # (shown on /statusz and :syncstate — `last_error` clears on the next
         # clean round, this stays for the post-mortem)
+        # guarded-by: self._mu
         self.last_degraded_reason: Optional[str] = None
-        self._backoff = 0.0
-        self._head_times: Dict[int, float] = {}
+        self._backoff = 0.0                     # guarded-by: self._mu
+        self._head_times: Dict[int, float] = {}  # guarded-by: self._mu
         self._stop = threading.Event()
+        # guarded-by: self._mu
         self._thread: Optional[threading.Thread] = None
 
     # -- wire ----------------------------------------------------------------
@@ -154,10 +163,11 @@ class SyncSubscriber:
     # -- state machine -------------------------------------------------------
 
     def _set_state(self, state: str, reason: Optional[str] = None) -> None:
-        prev, self.state = self.state, state
+        with self._mu:
+            prev, self.state = self.state, state
+            if state == DEGRADED and reason:
+                self.last_degraded_reason = reason
         metrics.observe("sync.state", _STATE_CODE[state], "gauge")
-        if state == DEGRADED and reason:
-            self.last_degraded_reason = reason
         if state != prev:
             # discrete transition -> flight recorder (the /statusz tail that
             # explains a DEGRADED spike after the fact)
@@ -187,7 +197,8 @@ class SyncSubscriber:
     def _sync_once(self) -> int:
         servable = self.manager.find_model(self.model_sign)
         if self.version is None:
-            self.version = int(getattr(servable, "step", 0))
+            with self._mu:
+                self.version = int(getattr(servable, "step", 0))
         sign = quote(self.model_sign, safe="")
         q = (f"?after={self.version}&wait_s={self.wait_s}"
              if self.wait_s > 0 else "")
@@ -198,8 +209,9 @@ class SyncSubscriber:
         if feed.get("format") != "oetpu-sync-v1":
             raise SyncError(f"foreign feed format {feed.get('format')!r}")
         head = feed.get("head_step")
-        self._head_times.update(
-            {d["step"]: d["commit_time"] for d in feed.get("deltas", [])})
+        with self._mu:
+            self._head_times.update(
+                {d["step"]: d["commit_time"] for d in feed.get("deltas", [])})
         self._observe_lag(head)
         if head is None or head <= self.version:
             return 0
@@ -238,8 +250,9 @@ class SyncSubscriber:
                 self.manager.swap(self.model_sign, new_servable,
                                   expected=servable)
             servable = new_servable
-            self.version = int(step)
-            self.applied += 1
+            with self._mu:
+                self.version = int(step)
+                self.applied += 1
             applied += 1
             metrics.observe("sync.applied_deltas", 1)
             self._observe_lag(head)
@@ -248,13 +261,15 @@ class SyncSubscriber:
         return applied
 
     def _degrade(self, reason: str) -> None:
-        self.last_error = reason
+        with self._mu:
+            self.last_error = reason
         metrics.observe("sync.rollbacks", 1)
         trace.event("sync", "rollback", model=self.model_sign,
                     version=self.version, reason=reason)
         self._set_state(DEGRADED, reason=reason)
-        self._backoff = min(max(self._backoff * 2, self.interval_s),
-                            self.max_backoff_s)
+        with self._mu:
+            self._backoff = min(max(self._backoff * 2, self.interval_s),
+                                self.max_backoff_s)
 
     def poll(self) -> int:
         """One guarded tick: sync, or record the failure and degrade.
@@ -267,24 +282,31 @@ class SyncSubscriber:
         except Exception as e:  # noqa: BLE001 — a bug must not kill the loop
             self._degrade(f"{type(e).__name__}: {e}")
             return 0
-        self.last_error = None
-        self._backoff = 0.0
+        with self._mu:
+            self.last_error = None
+            self._backoff = 0.0
         return applied
 
     def status(self) -> dict:
-        return {"model_sign": self.model_sign, "feed": self.feed,
-                "state": self.state, "version": self.version,
-                "applied": self.applied, "wire": self.wire,
-                "last_error": self.last_error,
-                "last_degraded_reason": self.last_degraded_reason}
+        # one consistent snapshot: serving threads render this on
+        # :syncstate / statusz while the worker is mid-transition
+        with self._mu:
+            return {"model_sign": self.model_sign, "feed": self.feed,
+                    "state": self.state, "version": self.version,
+                    "applied": self.applied, "wire": self.wire,
+                    "last_error": self.last_error,
+                    "last_degraded_reason": self.last_degraded_reason}
 
     # -- background loop -----------------------------------------------------
 
     def start(self) -> "SyncSubscriber":
-        if self._thread is None:
-            self._stop.clear()
-            self._thread = threading.Thread(target=self._run, daemon=True)
-            self._thread.start()
+        # two racing start()s (CLI + a POST /sync) must not leak a thread
+        with self._mu:
+            if self._thread is None:
+                self._stop.clear()
+                self._thread = threading.Thread(target=self._run,
+                                                daemon=True)
+                self._thread.start()
         return self
 
     def _run(self) -> None:
@@ -296,6 +318,7 @@ class SyncSubscriber:
 
     def stop(self) -> None:
         self._stop.set()
-        t, self._thread = self._thread, None
-        if t is not None:
-            t.join(timeout=10)
+        with self._mu:
+            t, self._thread = self._thread, None
+        if t is not None:  # join OUTSIDE the lock: _run takes no lock, but
+            t.join(timeout=10)  # a slow join must not block status() readers

@@ -302,7 +302,7 @@ class HostOffloadTable:
     store is unbounded (host RAM).
 
     With `mesh`/`axis` the cache is row-sharded over the mesh exactly like a
-    normal `MeshTrainer` hash table (keys `P(axis)`, rows `P(axis, None)`) and
+    normal `MeshTrainer` hash table (keys `P(axis)`, rows `P(axis)`) and
     admission runs under shard_map; the host store stays process-global. The
     reference's analogue selects the PMem-backed table per variable at init
     (`EmbeddingInitOperator.cpp:146-168`) with a DRAM cache in front
@@ -335,8 +335,8 @@ class HostOffloadTable:
             # ONE copy of the mesh table layout (must agree with
             # `MeshTrainer._table_pspec`): init shardings, admit in/out specs
             self._pspec = EmbeddingTableState(
-                weights=P(axis, None),
-                slots={k: P(axis, None)
+                weights=P(axis),
+                slots={k: P(axis)
                        for k in optimizer.slot_shapes(spec.output_dim)},
                 keys=P(axis), overflow=P())
             self._mk_fresh = self._compile_sharded_fresh()

@@ -1,0 +1,158 @@
+"""Runtime invariant guards: the never-re-jit rule as an executable assertion.
+
+The static half of this contract lives in `tools/oelint` (the trace-hazard
+pass flags the Python patterns that cause retraces; the hlo-budget pass pins
+the compiled collective set). This module is the RUNTIME half: tests and the
+soak harness wrap their jitted step functions so that a retrace — a shape
+that drifted, a dtype that flipped, a static arg that changed — raises
+`RecompileError` at the offending call instead of silently recompiling and
+burying seconds of latency in a production step.
+
+Two tools:
+
+- `assert_no_recompile(fn, max_traces=1)` — wrap a function so exceeding the
+  trace budget raises. Accepts either a plain Python callable (it is jitted
+  here, and the budget is enforced AT TRACE TIME — the error points at the
+  exact call that triggered the retrace) or an ALREADY-jitted function (the
+  budget is checked against its compilation-cache size after every call).
+  `max_traces` > 1 covers deliberately multi-mode functions (e.g. the
+  `_hot_jit` lifecycle fns compile once per mode).
+
+- `trace_counter(*jitted_fns)` — context manager observing how many NEW
+  compilations the wrapped block triggered (`.new_traces`), for soak loops
+  that want to assert "N more steps, zero new programs" without adopting the
+  raising wrapper.
+
+Both lean on the jit compilation cache itself (`fn._cache_size()`), so they
+measure what XLA actually did, not what the code intended.
+"""
+
+from __future__ import annotations
+
+import functools
+from contextlib import contextmanager
+from typing import Optional
+
+__all__ = ["RecompileError", "TraceCounter", "assert_no_recompile",
+           "trace_counter"]
+
+
+class RecompileError(RuntimeError):
+    """A guarded jitted function compiled more times than its budget."""
+
+
+class TraceCounter:
+    """Mutable trace count for one guarded function (exposed as
+    `guarded.traces` on `assert_no_recompile` wrappers of plain callables)."""
+
+    def __init__(self, label: str, limit: int):
+        self.label = label
+        self.limit = int(limit)
+        self.traces = 0
+
+    def hit(self) -> None:
+        self.traces += 1
+        if self.traces > self.limit:
+            raise RecompileError(
+                f"{self.label!r} traced {self.traces} times (budget "
+                f"{self.limit}): a shape/dtype/static-arg changed between "
+                "calls — the never-re-jit rule (parallel/sharded.py; "
+                "static shapes, content-only refreshes) is broken at this "
+                "call site")
+
+    def __repr__(self) -> str:
+        return (f"TraceCounter({self.label!r}, traces={self.traces}, "
+                f"limit={self.limit})")
+
+
+def _cache_size(fn) -> Optional[int]:
+    probe = getattr(fn, "_cache_size", None)
+    if probe is None:
+        return None
+    try:
+        return int(probe())
+    except Exception:  # noqa: BLE001 — jax internals; degrade to None
+        return None
+
+
+def assert_no_recompile(fn=None, *, max_traces: int = 1,
+                        label: Optional[str] = None, **jit_kwargs):
+    """Guard `fn` against recompiles. See module doc.
+
+    Plain callable: returns a jitted wrapper; trace #max_traces+1 raises
+    RecompileError from inside tracing (the offending call's stack).
+    Already-jitted callable (`jax.jit` output, e.g. a trainer's step fn):
+    returns a forwarding wrapper that raises when the underlying compilation
+    cache grows past the budget. Usable as a decorator:
+    `@assert_no_recompile` or `@assert_no_recompile(max_traces=2)`.
+    """
+    if fn is None:
+        return functools.partial(assert_no_recompile, max_traces=max_traces,
+                                 label=label, **jit_kwargs)
+    name = label or getattr(fn, "__name__", None) or repr(fn)
+
+    if _cache_size(fn) is not None:
+        if jit_kwargs:
+            raise ValueError(
+                f"{name!r} is already jitted; jit kwargs {sorted(jit_kwargs)}"
+                " cannot be applied — pass the plain function instead")
+
+        @functools.wraps(fn)
+        def guarded(*args, **kwargs):
+            out = fn(*args, **kwargs)
+            n = _cache_size(fn)
+            if n is not None and n > max_traces:
+                raise RecompileError(
+                    f"{name!r} holds {n} compiled programs (budget "
+                    f"{max_traces}): this call triggered a retrace — a "
+                    "shape/dtype/static-arg changed (never-re-jit rule, "
+                    "parallel/sharded.py)")
+            return out
+
+        guarded.trace_count = lambda: _cache_size(fn)
+        return guarded
+
+    import jax
+    counter = TraceCounter(name, max_traces)
+
+    def traced(*args, **kwargs):
+        counter.hit()  # raises at TRACE time: the stack is the bad call's
+        return fn(*args, **kwargs)
+
+    jitted = jax.jit(traced, **jit_kwargs)
+
+    @functools.wraps(fn)
+    def guarded(*args, **kwargs):
+        return jitted(*args, **kwargs)
+
+    guarded.traces = counter
+    guarded.trace_count = lambda: counter.traces
+    return guarded
+
+
+class _TraceDelta:
+    """Live view of new compilations since the `trace_counter` block began."""
+
+    def __init__(self, fns):
+        self._fns = fns
+        self._before = [(_cache_size(f) or 0) for f in fns]
+
+    @property
+    def per_fn(self):
+        return [(_cache_size(f) or 0) - b
+                for f, b in zip(self._fns, self._before)]
+
+    @property
+    def new_traces(self) -> int:
+        return sum(self.per_fn)
+
+
+@contextmanager
+def trace_counter(*jitted_fns):
+    """`with trace_counter(step_fn) as tc:` ... `assert tc.new_traces == 0`.
+
+    Counts NEW jit compilations of the given already-jitted functions inside
+    the block (live: `.new_traces` is current at any point, including after
+    exit). Functions without a compilation cache contribute 0.
+    """
+    yield _TraceDelta(jitted_fns)

@@ -71,10 +71,11 @@ class Accumulator:
         self.labels = dict(labels) if labels else {}
         self.key = name + _label_key(labels)
         self._lock = threading.Lock()
-        self._total = 0.0
-        self._count = 0
-        self._max = float("-inf")
-        self._min = float("inf")
+        self._total = 0.0                      # guarded-by: self._lock
+        self._count = 0                        # guarded-by: self._lock
+        self._max = float("-inf")              # guarded-by: self._lock
+        self._min = float("inf")               # guarded-by: self._lock
+        # guarded-by: self._lock
         self._buckets: List[int] = ([0] * (len(HIST_BOUNDS) + 1)
                                     if kind == "hist" else [])
 
@@ -219,6 +220,8 @@ def observe_sync_cost(cost: Dict[str, "object"]) -> None:
     observe("sync.rows_per_delta", float(cost.get("rows", 0)), "gauge")
 
 
+# oelint: hot-path -- the documented ONE-device_get-per-step call site; the
+# host-sync pass budget (1) makes a second get here fail `make lint`
 def record_step_stats(stats: Dict[str, "object"]) -> None:
     """Fold a train step's device-side stats dict (`{var}/pull_indices`, `.../
     pull_unique`, `.../pull_overflow`, ...) into host accumulators.
@@ -425,13 +428,21 @@ class PeriodicReporter:
         self.sink = sink or (lambda s: print(s, flush=True))
         self.reset = reset
         self._stop = threading.Event()
+        self._lock = threading.Lock()
+        # guarded-by: self._lock
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> "PeriodicReporter":
         if self.interval <= 0:
             return self
-        self._thread = threading.Thread(target=self._run, daemon=True)
-        self._thread.start()
+        # idempotent under racing start()s (e.g. context manager + explicit
+        # call): exactly one reporter thread, never a leaked duplicate
+        with self._lock:
+            if self._thread is None:
+                self._stop.clear()
+                self._thread = threading.Thread(target=self._run,
+                                                daemon=True)
+                self._thread.start()
         return self
 
     def _run(self) -> None:
@@ -445,9 +456,10 @@ class PeriodicReporter:
 
     def stop(self) -> None:
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
-            self._thread = None
+        with self._lock:
+            t, self._thread = self._thread, None
+        if t is not None:  # join outside the lock (_run never takes it)
+            t.join(timeout=5)
 
     def __enter__(self) -> "PeriodicReporter":
         return self.start()

@@ -112,9 +112,12 @@ class SpaceSaving:
             raise ValueError(f"decay={decay}: expected a factor in (0, 1]")
         self.decay = None if decay in (None, 1.0) else float(decay)
         self.cm = CountMin(cm_width, cm_depth, seed)
-        self._ids = np.zeros((0,), np.int64)   # sorted
-        self._est = np.zeros((0,), np.int64)
-        self._err = np.zeros((0,), np.int64)
+        # summary arrays swap atomically under the lock (update() from the
+        # SkewMonitor worker vs topk()/coverage() from serving threads);
+        # `cm` is only ever touched while holding it too
+        self._ids = np.zeros((0,), np.int64)   # sorted; guarded-by: self._lock
+        self._est = np.zeros((0,), np.int64)   # guarded-by: self._lock
+        self._err = np.zeros((0,), np.int64)   # guarded-by: self._lock
         self._lock = threading.Lock()
 
     @property
@@ -209,9 +212,10 @@ class SkewMonitor:
         self.k = k
         self.sync = sync
         self.decay = decay  # per-batch exponential forgetting (SpaceSaving)
-        self._sketches: Dict[str, SpaceSaving] = {}
+        self._sketches: Dict[str, SpaceSaving] = {}  # guarded-by: self._lock
         self._lock = threading.Lock()
         self._q: "queue.Queue" = queue.Queue(maxsize=queue_size)
+        # guarded-by: self._lock
         self._thread: Optional[threading.Thread] = None
 
     def sketch(self, table: str) -> SpaceSaving:

@@ -141,7 +141,7 @@ class FlightRecorder:
 
     def __init__(self, capacity: int = 2048):
         self._lock = threading.Lock()
-        self._buf: deque = deque(maxlen=int(capacity))
+        self._buf: deque = deque(maxlen=int(capacity))  # guarded-by: self._lock
 
     @property
     def capacity(self) -> int:

@@ -1,0 +1,38 @@
+"""oelint corpus: idiomatic code — every pass must report ZERO findings.
+The shapes here mirror the real tree's legal patterns (static-shape
+branches, sorted iteration, one-device_get hot paths, locked writes)."""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+
+
+# oelint: jit-entry
+def clean_jit_fn(x, spec):
+    s = jnp.sum(x)
+    y = jnp.where(s > 0, x, -x)  # data-dependent branch via where
+    if x.shape[0] > 4:  # .shape is static under jit
+        y = y[:4]
+    if spec is None:  # identity test: static Python decision
+        y = y * 2
+    for key in sorted({"b", "a"}):  # sorted set: deterministic order
+        y = y + len(key)
+    u = jnp.unique(x, size=4)  # static output shape via size=
+    return y, u
+
+
+# oelint: hot-path
+def clean_hot_path(stats):
+    host = jax.device_get(dict(stats))  # the ONE allowed per-step get
+    return {k: float(v) for k, v in host.items()}
+
+
+class CleanLocked:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0  # guarded-by: self._lock
+
+    def bump(self):
+        with self._lock:
+            self._n += 1

@@ -1,0 +1,22 @@
+"""oelint corpus: planted host-sync violations in a `# oelint: hot-path`
+function (parsed by the lint pass, never imported)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# oelint: hot-path
+def planted_host_syncs(state, batch):
+    host = jax.device_get(state)  # first get: inside the budget of 1...
+    again = jax.device_get(batch)  # PLANT: second-device-get
+    jnp.sum(batch).block_until_ready()  # PLANT: block-until-ready
+    copied = np.asarray(jnp.mean(batch))  # PLANT: np-asarray-of-device
+    scalar = float(jnp.max(batch))  # PLANT: float-of-device
+    fine = float(host["loss"])  # post-device_get host value: NOT a finding
+    return again, copied, scalar, fine
+
+
+# oelint: hot-path device_get=0
+def planted_zero_budget(state):
+    return jax.device_get(state)  # PLANT: device-get-over-zero-budget

@@ -1,0 +1,34 @@
+"""oelint corpus: planted lockset violations (parsed, never imported)."""
+
+import threading
+
+
+class PlantedLockset:
+    shared_registry = {}  # PLANT: class-mutable-dict
+    shared_list = []  # PLANT: class-mutable-list
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._state = 0  # guarded-by: self._lock
+        self._items = {}  # guarded-by: self._lock
+
+    def good_write(self):
+        with self._lock:
+            self._state = 1
+
+    def good_write_via_condition(self):
+        with self._cond:  # Condition(self._lock) alias: NOT a finding
+            self._state = 2
+
+    def bad_write(self):
+        self._state = 3  # PLANT: unguarded-write
+
+    def bad_subscript_write(self, key):
+        self._items[key] = 1  # PLANT: unguarded-subscript-write
+
+    def bad_tuple_write(self):
+        ok, self._state = True, 4  # PLANT: unguarded-tuple-write
+
+    def bad_augmented(self):
+        self._state += 1  # PLANT: unguarded-augassign

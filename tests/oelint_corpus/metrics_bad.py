@@ -1,0 +1,12 @@
+"""oelint corpus: planted metric-name violations (parsed, never imported)."""
+
+from openembedding_tpu.utils import metrics
+
+
+def planted_metric_names():
+    metrics.observe("skwe.hot_id", 1)  # PLANT: unknown-group-typo
+    metrics.observe("justonename", 1)  # PLANT: not-dotted
+    metrics.observe("exchange.user_table.ms", 1)  # PLANT: instance-in-name
+    metrics.observe("serving.shard3.rows", 1)  # PLANT: instance-number
+    with metrics.vtimer("nosuchgroup", "step"):  # PLANT: unknown-span-group
+        pass

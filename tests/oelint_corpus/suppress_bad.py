@@ -1,0 +1,14 @@
+"""oelint corpus: suppression policy — a reasoned pragma silences the pass;
+a BARE one still silences it but is itself flagged (zero-bare policy)."""
+
+import jax.numpy as jnp
+
+
+# oelint: jit-entry
+def suppressed_hazards(x):
+    s = jnp.sum(x)
+    if s > 0:  # oelint: disable=trace-hazard -- corpus: reasoned pragma, pass must stay silent
+        x = x + 1
+    if s < 0:  # oelint: disable=trace-hazard
+        x = x - 1  # the line above is a BARE suppression: flagged
+    return x

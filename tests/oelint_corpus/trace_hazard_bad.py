@@ -1,0 +1,42 @@
+"""oelint corpus: planted trace-hazard violations (parsed by the lint pass,
+NEVER imported/executed). Each PLANT-marked line must produce a finding —
+tests/test_oelint.py asserts the pass catches every one."""
+
+import jax
+import jax.numpy as jnp
+
+
+def _helper(x, cfg):
+    return x
+
+
+_jitted = jax.jit(_helper, static_argnums=(1,))
+
+
+# oelint: jit-entry
+def planted_trace_hazards(x):
+    s = jnp.sum(x)
+    if s > 0:  # PLANT: if-on-traced
+        x = x + 1
+    t = jnp.mean(x)
+    while t > 0:  # PLANT: while-on-traced
+        t = t - 1
+    n = int(jnp.max(x))  # PLANT: int-on-traced
+    f = float(s)  # PLANT: float-on-traced
+    b = bool(jnp.any(x))  # PLANT: bool-on-traced
+    y = 1 if jnp.any(x) else 0  # PLANT: ternary-on-traced
+    assert jnp.all(x > 0)  # PLANT: assert-on-traced
+    idx = jnp.nonzero(x)  # PLANT: data-dep-no-size
+    k = idx[0].shape  # PLANT: shape-of-data-dep
+    total = 0
+    for key in {"a", "b", "c"}:  # PLANT: set-iteration
+        total += len(key)
+    u = jnp.unique(x, size=4)  # size= given: NOT a finding
+    return n, f, b, y, k, total, u
+
+
+def planted_static_args(x):
+    bad1 = _jitted(x, [1, 2, 3])  # PLANT: unhashable-static-list
+    bad2 = _jitted(x, 0.5)  # PLANT: float-static
+    ok = _jitted(x, 7)  # hashable int: NOT a finding
+    return bad1, bad2, ok

@@ -369,13 +369,16 @@ def test_hot_off_traces_no_extra_collectives():
 def test_refresh_is_static_shapes_no_rejit():
     """Promote/demote swaps array contents, never shapes: the SAME jitted
     step keeps running across refreshes with different hot sets (and the
-    lifecycle fns compile once per mode)."""
+    lifecycle fns compile once per mode). The never-re-jit rule is asserted
+    EXECUTABLY via utils/guards.assert_no_recompile: any retrace raises."""
+    from openembedding_tpu.utils.guards import assert_no_recompile
     rng = np.random.default_rng(7)
     batches = [_batch(rng) for _ in range(3)]
     tr = MeshTrainer(_model(), embed.Adagrad(learning_rate=0.1),
                      mesh=make_mesh(), wire="fp32", hot_rows=32)
     state = tr.init(batches[0])
-    step = tr.jit_train_step(batches[0], state)
+    step = assert_no_recompile(tr.jit_train_step(batches[0], state),
+                               label="hot_train_step")
     state, _ = step(state, batches[0])
     state = tr.refresh_hot_rows(state, hot_ids={"a": np.array([7], np.int64)})
     state, _ = step(state, batches[1])
@@ -384,6 +387,7 @@ def test_refresh_is_static_shapes_no_rejit():
                         "b": _HOT_IDS["b"]})
     state, m = step(state, batches[2])
     assert np.isfinite(float(m["loss"]))
+    assert step.trace_count() == 1  # three steps, two refreshes, ONE program
     assert set(tr._hot_fns) == {"refresh"}  # one compiled refresh, reused
     # demoted id 7 must have been written back: reads still see its training
     rows = _probe(tr, tr.hot_sync(state), "a", np.array([7, 13], np.int32))

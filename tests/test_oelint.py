@@ -1,0 +1,239 @@
+"""oelint + runtime guards acceptance (ISSUE 6).
+
+- every pass catches every `# PLANT:`-marked violation in its corpus file
+  (tests/oelint_corpus/), and reports ZERO findings on the clean corpus;
+- suppression policy: a reasoned pragma silences a pass, a bare one still
+  silences it but is itself flagged;
+- the REAL tree is clean under the file-scanning passes (the triage
+  satellite: fixes landed, false positives carry reasoned pragmas);
+- the hlo-budget pass detects a deliberately added collective and the
+  checked-in budget matches the current tree (fused config compiled live);
+- utils/guards: assert_no_recompile passes on re-invocation with the same
+  shapes, trips on a forced shape change (both plain and pre-jitted forms),
+  and trace_counter counts new compilations.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+from tools.oelint import run_passes  # noqa: E402
+from tools.oelint.core import SourceFile  # noqa: E402
+from tools.oelint.passes import (hlo_budget, host_sync, lockset,  # noqa: E402
+                                 metrics as metrics_pass, trace_hazard)
+
+CORPUS = "tests/oelint_corpus"
+
+
+def corpus_file(name: str) -> SourceFile:
+    return SourceFile(ROOT, f"{CORPUS}/{name}")
+
+
+def plant_lines(sf: SourceFile) -> set:
+    return {i for i, line in enumerate(sf.lines, 1) if "# PLANT:" in line}
+
+
+def assert_catches_all_plants(pass_mod, sf: SourceFile):
+    findings = pass_mod.run([sf], ROOT)
+    hit = {f.line for f in findings}
+    missed = plant_lines(sf) - hit
+    assert not missed, (
+        f"{pass_mod.NAME} missed planted violations at "
+        f"{sorted(missed)}: " + "\n".join(map(str, findings)))
+    assert all(f.pass_name == pass_mod.NAME for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# corpus: every pass fires on its planted violations, none on clean code
+# ---------------------------------------------------------------------------
+
+
+def test_trace_hazard_catches_every_plant():
+    assert_catches_all_plants(trace_hazard, corpus_file("trace_hazard_bad.py"))
+
+
+def test_host_sync_catches_every_plant():
+    assert_catches_all_plants(host_sync, corpus_file("host_sync_bad.py"))
+
+
+def test_lockset_catches_every_plant():
+    assert_catches_all_plants(lockset, corpus_file("lockset_bad.py"))
+
+
+def test_metrics_catches_every_plant():
+    assert_catches_all_plants(metrics_pass, corpus_file("metrics_bad.py"))
+
+
+def test_clean_corpus_is_clean():
+    sf = corpus_file("clean.py")
+    for pass_mod in (trace_hazard, host_sync, lockset, metrics_pass):
+        findings = pass_mod.run([sf], ROOT)
+        assert not findings, (pass_mod.NAME, list(map(str, findings)))
+    assert sf.bare_suppressions() == []
+
+
+def test_suppression_needs_a_reason():
+    sf = corpus_file("suppress_bad.py")
+    # both hazards are suppressed (reasoned or not): the pass stays silent
+    assert trace_hazard.run([sf], ROOT) == []
+    # ...but the reasonless pragma is itself a finding
+    bare = sf.bare_suppressions()
+    assert len(bare) == 1
+    assert "bare suppression" in bare[0].message
+    assert bare[0].pass_name == "suppression"
+
+
+def test_tree_is_clean_under_file_passes():
+    """The triage satellite's regression pin: the real tree stays green
+    under every file-scanning pass (real findings fixed, false positives
+    carry reasoned pragmas — zero bare suppressions anywhere)."""
+    findings, _ = run_passes(["trace-hazard", "host-sync", "lockset",
+                              "metrics"])
+    assert findings == [], "\n".join(map(str, findings))
+
+
+# ---------------------------------------------------------------------------
+# hlo-budget: the compiled collective set is pinned per config
+# ---------------------------------------------------------------------------
+
+
+def test_hlo_budget_compare_reports_readable_diffs():
+    budget = {"configs": {"fused_fp32": {"all_to_all": 3, "all_reduce": 17,
+                                         "wire_bytes_per_step": 32256}}}
+    same = {"fused_fp32": {"all_to_all": 3, "all_reduce": 17,
+                           "wire_bytes_per_step": 32256}}
+    assert hlo_budget.compare(same, budget) == []
+    worse = {"fused_fp32": {"all_to_all": 4, "all_reduce": 17,
+                            "wire_bytes_per_step": 40000}}
+    msgs = [f.message for f in hlo_budget.compare(worse, budget)]
+    assert any("all-to-all" in m and "ADDED" in m for m in msgs)
+    assert any("bytes/step grew" in m for m in msgs)
+    # a missing budget file is itself a finding, not a silent pass
+    assert hlo_budget.compare(same, None)
+    # an unknown config demands a budget regen
+    extra = {"new_cfg": {"all_to_all": 1}}
+    assert any("not in the checked-in budget" in f.message
+               for f in hlo_budget.compare(extra, budget))
+
+
+def test_hlo_budget_matches_tree_and_detects_planted_collective():
+    """Acceptance: the checked-in budget matches the CURRENT tree for the
+    fused config (fresh clone -> `make lint` green), and a deliberately
+    added collective on that pinned path is detected."""
+    import jax
+
+    budget = hlo_budget.load_budget(ROOT)
+    assert budget is not None, "tools/oelint/hlo_budget.json not checked in"
+    cfg = next(c for c in hlo_budget.CONFIGS if c["name"] == "fused_fp32")
+
+    trainer, batch = hlo_budget.make_trainer(cfg)
+    clean = {"fused_fp32": hlo_budget.measure_trainer(trainer, batch)}
+    assert hlo_budget.compare(clean, budget) == [], (
+        "checked-in budget is stale vs the tree: run "
+        "`python -m tools.oelint --update-budget`")
+
+    # plant one extra collective on the pinned path: an extra pmean of the
+    # loss is numerically inert (loss is replicated) but compiles to one
+    # more all-reduce — exactly the regression class the pass exists for
+    planted, batch2 = hlo_budget.make_trainer(cfg)
+    orig = planted.reduce_metrics
+
+    def with_extra_collective(m):
+        out = orig(m)
+        out["loss"] = jax.lax.pmean(out["loss"], planted.axis)
+        return out
+
+    planted.reduce_metrics = with_extra_collective
+    measured = {"fused_fp32": hlo_budget.measure_trainer(planted, batch2)}
+    msgs = [f.message for f in hlo_budget.compare(measured, budget)]
+    assert any("all-reduce" in m and "ADDED" in m for m in msgs), msgs
+
+
+def test_hlo_budget_covers_acceptance_matrix():
+    """The checked-in budget pins per-table, fused-group, hot-on/off and all
+    three wire modes (the ISSUE 6 acceptance list) — by name."""
+    budget = hlo_budget.load_budget(ROOT)
+    names = set(budget["configs"])
+    assert {"per_table_fp32", "fused_fp32", "fused_bf16", "fused_int8",
+            "fused_fp32_hot"} <= names
+    # and the pins are non-degenerate: fused < per-table a2a count, hot adds
+    # all-reduces, quantized wire ships fewer bytes
+    cfgs = budget["configs"]
+    assert cfgs["fused_fp32"]["all_to_all"] < \
+        cfgs["per_table_fp32"]["all_to_all"]
+    assert cfgs["fused_fp32_hot"]["all_reduce"] > \
+        cfgs["fused_fp32"]["all_reduce"]
+    assert cfgs["fused_int8"]["wire_bytes_per_step"] < \
+        cfgs["fused_bf16"]["wire_bytes_per_step"] < \
+        cfgs["fused_fp32"]["wire_bytes_per_step"]
+
+
+# ---------------------------------------------------------------------------
+# utils/guards: the never-re-jit rule as a runtime assertion
+# ---------------------------------------------------------------------------
+
+
+def test_assert_no_recompile_plain_callable():
+    import jax.numpy as jnp
+
+    from openembedding_tpu.utils.guards import (RecompileError,
+                                                assert_no_recompile)
+    calls = []
+
+    @assert_no_recompile
+    def fn(x):
+        calls.append(1)
+        return x * 2
+
+    np.testing.assert_array_equal(fn(jnp.ones((4,))), 2 * np.ones(4))
+    fn(jnp.ones((4,)))  # same shape: cached, no retrace
+    assert fn.trace_count() == 1
+    with pytest.raises(RecompileError, match="traced 2 times"):
+        fn(jnp.ones((5,)))  # forced shape change
+
+
+def test_assert_no_recompile_prejitted_fn():
+    import jax
+    import jax.numpy as jnp
+
+    from openembedding_tpu.utils.guards import (RecompileError,
+                                                assert_no_recompile)
+    step = jax.jit(lambda x: x + 1)
+    guarded = assert_no_recompile(step, label="step")
+    guarded(jnp.ones((2, 3)))
+    guarded(jnp.ones((2, 3)))  # re-invocation, same shapes: fine
+    with pytest.raises(RecompileError, match="compiled programs"):
+        guarded(jnp.ones((2, 4)))
+
+
+def test_assert_no_recompile_multi_mode_budget():
+    import jax.numpy as jnp
+
+    from openembedding_tpu.utils.guards import (RecompileError,
+                                                assert_no_recompile)
+    fn = assert_no_recompile(lambda x: x, max_traces=2)
+    fn(jnp.ones((1,)))
+    fn(jnp.ones((2,)))  # second mode: inside the budget
+    with pytest.raises(RecompileError):
+        fn(jnp.ones((3,)))
+
+
+def test_trace_counter_counts_new_compilations():
+    import jax
+    import jax.numpy as jnp
+
+    from openembedding_tpu.utils.guards import trace_counter
+    fn = jax.jit(lambda x: x - 1)
+    fn(jnp.ones((2,)))  # warmup outside the window
+    with trace_counter(fn) as tc:
+        fn(jnp.ones((2,)))
+        assert tc.new_traces == 0
+        fn(jnp.ones((9,)))
+        assert tc.new_traces == 1
+    assert tc.new_traces == 1  # still readable after exit

@@ -1,129 +1,29 @@
-"""Lint metric names at observe()/vtimer()/trace.span() call sites.
+"""Thin alias for the metric-name lint (back-compat for `make lint-metrics`).
 
-The documented naming scheme (utils/metrics.py module doc): metric names are
-dot-joined lowercase `group.name[.qualifier]` segments matching `[a-z0-9_]+`
-(e.g. `serving.predict.ms`, `sync.rollbacks`); timer/span call sites pass
-group and name as separate lowercase segments. Per-instance dimensions
-(table, model) belong in labels, never in the name — so a name that smuggles
-one in (`pull.user_table.ms`, `exchange.shard3.rows`) reads the same as a
-conforming name and only a human (or this lint) catches it at review time;
-the INSTANCE_DIM rule rejects those shapes mechanically.
-
-Metric GROUPS (the first name segment, and the group argument of
-vtimer/span) are a closed registry: adding a new group is a conscious act
-(extend KNOWN_GROUPS here and document it), not a typo — `skwe.hot_id`
-would otherwise mint a new group silently.
-
-Scans literal string arguments only (f-strings and variables pass through —
-they are composed FROM checked literals). `make lint-metrics` runs this and
-fails CI on any violation.
+The check itself moved into the oelint framework as its fifth pass
+(`tools/oelint/passes/metrics.py` — same rules: dot-joined lowercase
+`group.name` segments, the closed KNOWN_GROUPS registry, no per-instance
+dimensions smuggled into metric NAMES). Run the full suite with `make lint`;
+this entry point runs ONLY the metrics pass so existing workflows keep
+working unchanged.
 """
 
 from __future__ import annotations
 
 import os
-import re
 import sys
-
-NAME = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)+$")
-SEGMENT = re.compile(r"^[a-z0-9_]+$")
-
-# the metric-group registry: every observe() name's first segment and every
-# vtimer()/span() group must be one of these (utils/metrics.py doc scheme)
-KNOWN_GROUPS = {
-    "exchange",   # sharded-exchange wire costs + per-shard load/skew gauges
-    "fleet",      # /fleetz cross-node scrape health
-    "hot",        # replicated hot-row cache (MeshTrainer(hot_rows=...))
-    "metrics",    # the metrics subsystem's own health (report_errors)
-    "offload",    # host-cached table cache admission/flush
-    "persist",    # async/incremental persistence
-    "serving",    # REST predict/pull/batching
-    "skew",       # heavy-hitter sketches (utils/sketch.py)
-    "sync",       # online model sync
-    "train",      # example-loop wall timers
-    "trainer",    # train-step phases + per-table pull stats
-}
-
-# per-instance dimensions embedded in a NAME segment instead of a label:
-# a specific instance (`shard3`, `table_12`) or a smuggled instance name
-# (`user_table`). Generic uses (`shard_rows`, `bucket_fill`) stay legal.
-INSTANCE_DIM = re.compile(
-    r"^(?:(?:table|shard|model|instance)_?\d+"
-    r"|[a-z0-9_]+_(?:table|shard|model|instance))$")
-
-# observe("metric.name", ...) — metrics.observe or bare observe
-OBSERVE = re.compile(r"""(?<![\w.])(?:metrics\.|M\.)?observe\(\s*
-                         (["'])(?P<name>[^"']+)\1""", re.VERBOSE)
-# vtimer("group", "name") / trace.span("group", "name") / span("group", ...)
-TIMER = re.compile(r"""(?<![\w.])(?:metrics\.|M\.|trace\.|_trace\.)?
-                       (?:vtimer|span)\(\s*
-                       (["'])(?P<group>[^"']+)\1\s*,\s*
-                       (["'])(?P<name>[^"']+)\3""", re.VERBOSE)
-
-SCAN_DIRS = ("openembedding_tpu", "examples", "tools")
-SKIP = {os.path.join("tools", "lint_metrics.py")}
-
-
-def lint_file(path: str, rel: str) -> list:
-    with open(path, encoding="utf-8") as f:
-        text = f.read()
-    bad = []
-    for m in OBSERVE.finditer(text):
-        name = m.group("name")
-        line = text.count("\n", 0, m.start()) + 1
-        if not NAME.fullmatch(name):
-            bad.append(f"{rel}:{line}: observe({name!r}) — metric names are "
-                       "dot-joined lowercase group.name segments")
-            continue
-        segments = name.split(".")
-        if segments[0] not in KNOWN_GROUPS:
-            bad.append(f"{rel}:{line}: observe({name!r}) — unknown metric "
-                       f"group {segments[0]!r}; register it in "
-                       "tools/lint_metrics.py KNOWN_GROUPS")
-        for seg in segments:
-            if INSTANCE_DIM.fullmatch(seg):
-                bad.append(f"{rel}:{line}: observe({name!r}) — segment "
-                           f"{seg!r} embeds a per-instance dimension "
-                           "(table/shard/model) in the NAME; put it in "
-                           "labels={...} instead")
-    for m in TIMER.finditer(text):
-        line = text.count("\n", 0, m.start()) + 1
-        for part in (m.group("group"), m.group("name")):
-            if not SEGMENT.fullmatch(part):
-                bad.append(f"{rel}:{line}: timer/span segment {part!r} — "
-                           "group and name are single lowercase "
-                           "[a-z0-9_]+ segments")
-            elif INSTANCE_DIM.fullmatch(part):
-                bad.append(f"{rel}:{line}: timer/span segment {part!r} — "
-                           "embeds a per-instance dimension "
-                           "(table/shard/model); use labels={...}")
-        group = m.group("group")
-        if SEGMENT.fullmatch(group) and group not in KNOWN_GROUPS:
-            bad.append(f"{rel}:{line}: span/vtimer group {group!r} — "
-                       "unknown metric group; register it in "
-                       "tools/lint_metrics.py KNOWN_GROUPS")
-    return bad
 
 
 def main(argv=None) -> int:
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    bad = []
-    for d in SCAN_DIRS:
-        base = os.path.join(root, d)
-        if not os.path.isdir(base):
-            continue
-        for dirpath, _dirs, files in os.walk(base):
-            for fn in sorted(files):
-                if not fn.endswith(".py"):
-                    continue
-                path = os.path.join(dirpath, fn)
-                rel = os.path.relpath(path, root)
-                if rel in SKIP:
-                    continue
-                bad.extend(lint_file(path, rel))
-    if bad:
-        print("\n".join(bad))
-        print(f"\nlint-metrics: {len(bad)} metric name(s) outside the "
+    if root not in sys.path:
+        sys.path.insert(0, root)
+    from tools.oelint import run_passes
+    findings, _ = run_passes(["metrics"], root=root)
+    if findings:
+        for f in findings:
+            print(f)
+        print(f"\nlint-metrics: {len(findings)} metric name(s) outside the "
               "documented group.name scheme (utils/metrics.py)")
         return 1
     print("lint-metrics: all observe()/vtimer()/span() call sites conform")

@@ -1,0 +1,78 @@
+"""oelint: static-analysis + invariant-guard suite for this repo.
+
+Five passes over `openembedding_tpu/` (see each module's doc):
+
+- trace-hazard — recompile/concretization hazards in jit-reachable code
+- host-sync   — device→host sync discipline in `# oelint: hot-path` fns
+- hlo-budget  — per-config collective counts vs tools/oelint/hlo_budget.json
+- lockset     — `# guarded-by:` lock discipline + mutable class-level state
+- metrics     — metric-name hygiene (the former tools/lint_metrics.py)
+
+Run them all with `make lint` / `python -m tools.oelint`; the runtime
+counterpart (executable never-re-jit assertions) is
+`openembedding_tpu/utils/guards.py`.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from .core import (Finding, SourceFile, changed_files, iter_py_files,
+                   load_files, repo_root)
+from .passes import ALL_PASSES, BY_NAME
+
+
+def run_passes(pass_names: Optional[Iterable[str]] = None, *,
+               root: Optional[str] = None,
+               changed_only: bool = False,
+               ) -> Tuple[List[Finding], Dict[str, float]]:
+    """Run the named passes (default: all) over the repo.
+
+    Returns (findings, {pass name: seconds}). Suppressed findings are
+    already filtered by each pass; bare (reasonless) suppressions in any
+    scanned file surface as `suppression` findings. `changed_only` narrows
+    file-scanning passes to files changed vs HEAD and skips the hlo-budget
+    compile unless one of its trigger paths changed.
+    """
+    root = root or repo_root()
+    selected = [BY_NAME[n] for n in (pass_names or BY_NAME)]
+    changed = changed_files(root) if changed_only else None
+
+    findings: List[Finding] = []
+    timings: Dict[str, float] = {}
+    file_cache: Dict[str, SourceFile] = {}
+    suppression_checked: set = set()
+
+    for p in selected:
+        t0 = time.monotonic()
+        if p.NAME == "hlo-budget":
+            if changed is not None and not any(
+                    rel.startswith(p.TRIGGERS) for rel in changed):
+                timings[p.NAME] = 0.0
+                continue
+            findings.extend(p.run([], root))
+            timings[p.NAME] = time.monotonic() - t0
+            continue
+        rels = iter_py_files(root, p.DIRS, skip=getattr(p, "SKIP", ()))
+        if changed is not None:
+            rels = [r for r in rels if r in changed]
+        files = []
+        for rel in rels:
+            sf = file_cache.get(rel)
+            if sf is None:
+                sf = file_cache[rel] = SourceFile(root, rel)
+                if sf.parse_error is not None:
+                    findings.append(Finding(
+                        rel, sf.parse_error.lineno or 1, "parse",
+                        f"syntax error: {sf.parse_error.msg}"))
+            if sf.tree is not None or p.NAME == "metrics":
+                files.append(sf)
+            if rel not in suppression_checked:
+                suppression_checked.add(rel)
+                findings.extend(sf.bare_suppressions())
+        findings.extend(p.run(files, root))
+        timings[p.NAME] = time.monotonic() - t0
+    findings = sorted(set(findings),
+                      key=lambda f: (f.path, f.line, f.pass_name, f.message))
+    return findings, timings

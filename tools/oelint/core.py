@@ -1,0 +1,205 @@
+"""oelint core: findings, source files, suppressions, annotations.
+
+The framework is a multi-pass static analyzer over the repo's Python tree
+(`python -m tools.oelint`, `make lint`). Each pass lives in
+`tools/oelint/passes/` and exports:
+
+    NAME: str            # CLI / suppression name, e.g. "trace-hazard"
+    DIRS: tuple          # repo-relative dirs whose .py files it scans
+    run(files, root)     # -> list[Finding]
+
+Shared conventions every pass honors (this module implements them):
+
+- **Suppressions** are inline, per-line, and REASONED:
+
+      risky_line()  # oelint: disable=trace-hazard -- reason why it is safe
+
+  The comment may sit on the offending line or the line directly above it.
+  `disable=all` silences every pass for that line. A suppression WITHOUT a
+  reason is itself a finding (`suppression` pseudo-pass) — the repo policy
+  is zero bare suppressions; the reason is the review artifact.
+
+- **Annotations** opt code into pass-specific contracts:
+
+      # oelint: jit-entry             (trace-hazard: treat fn as a jit root)
+      # oelint: hot-path              (host-sync: audit fn; 1 device_get ok)
+      # oelint: hot-path device_get=0 (host-sync: override the sync budget)
+      self._x = 0  # guarded-by: self._lock   (lockset: writes need the lock)
+
+  An annotation binds to the `def`/assignment it trails, or to the line
+  above it (decorator lines included for defs).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import subprocess
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+SUPPRESS_RE = re.compile(
+    r"#\s*oelint:\s*disable=([a-zA-Z0-9_,-]+)"
+    r"(?:\s*(?:--|—|–)\s*(\S.*))?")
+JIT_ENTRY_RE = re.compile(r"#\s*oelint:\s*jit-entry\b")
+HOT_PATH_RE = re.compile(
+    r"#\s*oelint:\s*hot-path\b(?:\s+device_get=(\d+))?")
+GUARDED_BY_RE = re.compile(r"#.*?\bguarded-by:\s*([A-Za-z0-9_.]+)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str       # repo-relative
+    line: int
+    pass_name: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.pass_name}] {self.message}"
+
+
+class SourceFile:
+    """One parsed source file: text, AST, and the per-line suppression map."""
+
+    def __init__(self, root: str, rel: str):
+        self.rel = rel.replace(os.sep, "/")
+        self.path = os.path.join(root, rel)
+        with open(self.path, encoding="utf-8") as f:
+            self.text = f.read()
+        self.lines = self.text.splitlines()
+        self.parse_error: Optional[SyntaxError] = None
+        try:
+            self.tree: Optional[ast.AST] = ast.parse(self.text)
+        except SyntaxError as e:  # surfaced as a finding by the runner
+            self.tree = None
+            self.parse_error = e
+        # lineno -> (set of pass names or {"all"}, reason or None)
+        self.suppressions: Dict[int, Tuple[Set[str], Optional[str]]] = {}
+        for i, line in enumerate(self.lines, start=1):
+            m = SUPPRESS_RE.search(line)
+            if m:
+                passes = {p.strip() for p in m.group(1).split(",") if p.strip()}
+                self.suppressions[i] = (passes, m.group(2))
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def suppressed(self, lineno: int, pass_name: str) -> bool:
+        """A finding at `lineno` is suppressed by a disable comment on the
+        same line or in the comment block directly above it (reasonless
+        suppressions still suppress — they are flagged separately so CI
+        stays red until a reason lands)."""
+        for ln in [lineno] + list(self._comment_block_above(lineno)):
+            entry = self.suppressions.get(ln)
+            if entry and (pass_name in entry[0] or "all" in entry[0]):
+                return True
+        return False
+
+    def bare_suppressions(self) -> List[Finding]:
+        out = []
+        for ln, (passes, reason) in sorted(self.suppressions.items()):
+            if not reason:
+                out.append(Finding(
+                    self.rel, ln, "suppression",
+                    f"bare suppression of {','.join(sorted(passes))}: every "
+                    "`# oelint: disable=` needs ` -- <reason>` (repo policy: "
+                    "zero bare suppressions)"))
+        return out
+
+    # -- annotation helpers ---------------------------------------------------
+
+    def _is_comment_line(self, lineno: int) -> bool:
+        return self.line_text(lineno).lstrip().startswith("#")
+
+    def _comment_block_above(self, lineno: int) -> Iterable[int]:
+        """Contiguous comment-ONLY lines directly above `lineno`, nearest
+        first. A trailing comment on a CODE line never leaks onto the next
+        statement — it binds to its own line only."""
+        ln = lineno - 1
+        while ln >= 1 and self._is_comment_line(ln):
+            yield ln
+            ln -= 1
+
+    def _def_marker_lines(self, node: ast.AST) -> Iterable[int]:
+        """Candidate annotation lines for a def: its own line, its decorator
+        lines, and the contiguous comment block above the first of those."""
+        linenos = [node.lineno]
+        for dec in getattr(node, "decorator_list", []):
+            linenos.append(dec.lineno)
+        first = min(linenos)
+        return sorted(set(linenos) | set(self._comment_block_above(first)))
+
+    def def_annotation(self, node: ast.AST, regex: re.Pattern):
+        for ln in self._def_marker_lines(node):
+            m = regex.search(self.line_text(ln))
+            if m:
+                return m
+        return None
+
+    def stmt_annotation(self, node: ast.AST, regex: re.Pattern):
+        """Annotation trailing a (possibly multi-line) statement, or in the
+        comment block directly above it."""
+        end = getattr(node, "end_lineno", node.lineno)
+        lines = [node.lineno, end] + list(
+            self._comment_block_above(node.lineno))
+        for ln in lines:
+            m = regex.search(self.line_text(ln))
+            if m:
+                return m
+        return None
+
+
+def iter_py_files(root: str, dirs: Iterable[str],
+                  skip: Iterable[str] = ()) -> List[str]:
+    """Repo-relative .py paths under `dirs`, sorted; `skip` entries are
+    repo-relative prefixes (files or directories)."""
+    skip = tuple(s.replace(os.sep, "/") for s in skip)
+    out = []
+    for d in dirs:
+        base = os.path.join(root, d)
+        if not os.path.isdir(base):
+            continue
+        for dirpath, dirnames, files in os.walk(base):
+            dirnames[:] = [x for x in dirnames if x != "__pycache__"]
+            for fn in sorted(files):
+                if not fn.endswith(".py"):
+                    continue
+                rel = os.path.relpath(os.path.join(dirpath, fn), root)
+                rel = rel.replace(os.sep, "/")
+                if any(rel == s or rel.startswith(s.rstrip("/") + "/")
+                       for s in skip):
+                    continue
+                out.append(rel)
+    return sorted(set(out))
+
+
+def load_files(root: str, rels: Iterable[str]) -> List[SourceFile]:
+    return [SourceFile(root, rel) for rel in rels]
+
+
+def changed_files(root: str) -> Optional[Set[str]]:
+    """Repo-relative paths changed vs HEAD (worktree + staged + untracked);
+    None when git is unavailable (callers fall back to a full run)."""
+    try:
+        out = subprocess.run(
+            ["git", "-C", root, "status", "--porcelain"],
+            capture_output=True, text=True, timeout=30, check=True).stdout
+    except Exception:  # noqa: BLE001 — no git, no incremental mode
+        return None
+    rels: Set[str] = set()
+    for line in out.splitlines():
+        if len(line) < 4:
+            continue
+        path = line[3:].strip()
+        if " -> " in path:  # rename: take the new side
+            path = path.split(" -> ", 1)[1]
+        rels.add(path.strip('"'))
+    return rels
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,6 @@
+"""oelint pass registry, in documentation order."""
+
+from . import trace_hazard, host_sync, hlo_budget, lockset, metrics
+
+ALL_PASSES = (trace_hazard, host_sync, hlo_budget, lockset, metrics)
+BY_NAME = {p.NAME: p for p in ALL_PASSES}

@@ -1,0 +1,234 @@
+"""hlo-budget pass: pin the collective set of every key entry-point config.
+
+The scattered per-test HLO pins (tests/test_dedup.py, test_wire.py,
+test_hot.py each count all-to-alls for one path) generalize here: this pass
+COMPILES the train step for every key configuration on the 8-virtual-device
+CPU mesh, counts collectives by kind in the optimized HLO, records the
+static wire-bytes model, and compares against the checked-in budget
+(`tools/oelint/hlo_budget.json`). A PR that adds a collective (or grows the
+wire) to a pinned path fails `make lint` with a human-readable diff instead
+of silently costing every future step.
+
+Configurations (the acceptance matrix): the per-table protocol, the fused
+dim-group exchange, hot-row cache on/off, and all three wire formats —
+collective counts AND `exchange.wire_bytes_per_step` are pinned per config.
+
+Regenerate after an intentional change:
+
+    make lint-budget            # python -m tools.oelint --update-budget
+
+and commit the diff — the json IS the review surface for collective changes.
+Runs CPU-only (`JAX_PLATFORMS=cpu`, 8 virtual devices); no chip needed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Dict, List, Optional
+
+from ..core import Finding
+
+NAME = "hlo-budget"
+DIRS = ()  # compiles programs; scans no source files
+BUDGET_REL = "tools/oelint/hlo_budget.json"
+
+# --changed-only reruns this pass only when these paths changed (anything
+# else cannot alter the compiled collective set)
+TRIGGERS = (
+    "openembedding_tpu/parallel/", "openembedding_tpu/ops/",
+    "openembedding_tpu/model.py", "openembedding_tpu/embedding.py",
+    "openembedding_tpu/optimizers.py", "openembedding_tpu/tables/",
+    "tools/oelint/",
+)
+
+COLLECTIVES = {
+    "all_to_all": r" all-to-all(?:-start)?\(",
+    "all_reduce": r" all-reduce(?:-start)?\(",
+    "all_gather": r" all-gather(?:-start)?\(",
+    "reduce_scatter": r" reduce-scatter(?:-start)?\(",
+    "collective_permute": r" collective-permute(?:-start)?\(",
+}
+
+# the acceptance matrix: per-table vs fused, wire formats, hot on/off
+CONFIGS = (
+    {"name": "per_table_fp32", "group_exchange": False, "wire": "fp32",
+     "hot_rows": 0},
+    {"name": "fused_fp32", "group_exchange": True, "wire": "fp32",
+     "hot_rows": 0},
+    {"name": "fused_bf16", "group_exchange": True, "wire": "bf16",
+     "hot_rows": 0},
+    {"name": "fused_int8", "group_exchange": True, "wire": "int8",
+     "hot_rows": 0},
+    {"name": "fused_fp32_hot", "group_exchange": True, "wire": "fp32",
+     "hot_rows": 32},
+)
+
+
+def _ensure_cpu() -> None:
+    """8 virtual CPU devices, never the axon TPU handshake — same contract
+    as the root conftest.py; must run before jax initializes a backend."""
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    # pin the id-key layout the budget compiles under: x64 ON is the repo's
+    # test-suite convention (63-bit hashed id spaces need int64 keys —
+    # tests/conftest.py), and the budget must measure ONE fixed world
+    jax.config.update("jax_enable_x64", True)
+
+
+def count_collectives(hlo_text: str) -> Dict[str, int]:
+    return {kind: len(re.findall(pat, hlo_text))
+            for kind, pat in COLLECTIVES.items()}
+
+
+def _budget_model():
+    """The smallest model that exercises every pinned path: two dim-8 tables
+    (array + hash) in ONE dim-group, duplicate-heavy planted batch — the
+    same shape family the HLO pin tests use."""
+    import numpy as np
+
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    import openembedding_tpu as embed
+    from openembedding_tpu.model import EmbeddingModel
+
+    class Tower(nn.Module):
+        @nn.compact
+        def __call__(self, embedded, dense):
+            bias = self.param("bias", nn.initializers.zeros, (1,),
+                              jnp.float32)
+            out = (jnp.sum(embedded["a"].astype(jnp.float32), axis=(1, 2))
+                   + jnp.sum(embedded["b"].astype(jnp.float32), axis=(1, 2)))
+            return out + bias[0]
+
+    model = EmbeddingModel(Tower(), [
+        embed.Embedding(256, 8, name="a"),
+        embed.Embedding(-1, 8, name="b", capacity=4096),
+    ])
+    rng = np.random.default_rng(0)
+    B = 64
+    a = rng.integers(0, 256, (B, 4)).astype(np.int32)
+    # hash ids < 2^31: the x64-off truncation warning is model.py's to give,
+    # not lint noise (collective counts are id-range-invariant)
+    b = rng.integers(0, 1 << 20, (B, 3)).astype(np.int64)
+    a[:, 0] = np.array([7, 13])[rng.integers(0, 2, B)]
+    batch = {"sparse": {"a": a, "b": b},
+             "label": rng.integers(0, 2, (B,)).astype(np.float32)}
+    return model, batch
+
+
+def make_trainer(config: Dict):
+    """Budget trainer for one config (also the corpus tests' hook — they
+    measure deliberately violated variants through the same plumbing)."""
+    _ensure_cpu()
+    import openembedding_tpu as embed
+    from openembedding_tpu.parallel import MeshTrainer, make_mesh
+
+    model, batch = _budget_model()
+    trainer = MeshTrainer(
+        model, embed.Adagrad(learning_rate=0.1), mesh=make_mesh(),
+        wire=config["wire"], group_exchange=config["group_exchange"],
+        hot_rows=config["hot_rows"])
+    return trainer, batch
+
+
+def measure_trainer(trainer, batch) -> Dict[str, int]:
+    """Compile the train step, count collectives, record the static wire
+    model (`exchange.wire_bytes_per_step` from `trainer.last_wire_cost`)."""
+    state = trainer.init(batch)
+    step = trainer.jit_train_step(batch, state)
+    text = step.lower(state, batch).compile().as_text()
+    counts = count_collectives(text)
+    cost = trainer.last_wire_cost or {}
+    counts["wire_bytes_per_step"] = int(cost.get("bytes_per_step", 0))
+    return counts
+
+
+def measure(configs=CONFIGS) -> Dict[str, Dict[str, int]]:
+    out: Dict[str, Dict[str, int]] = {}
+    for cfg in configs:
+        trainer, batch = make_trainer(cfg)
+        out[cfg["name"]] = measure_trainer(trainer, batch)
+    return out
+
+
+def load_budget(root: str) -> Optional[Dict]:
+    path = os.path.join(root, BUDGET_REL)
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def compare(measured: Dict[str, Dict[str, int]],
+            budget: Optional[Dict]) -> List[Finding]:
+    """Human-readable diff of measured collective counts vs the checked-in
+    budget; empty list == pinned paths unchanged."""
+    out: List[Finding] = []
+    if budget is None or "configs" not in budget:
+        return [Finding(BUDGET_REL, 1, NAME,
+                        "no checked-in HLO budget; generate one with "
+                        "`python -m tools.oelint --update-budget` and "
+                        "commit it")]
+    pinned = budget["configs"]
+    for name, counts in sorted(measured.items()):
+        if name not in pinned:
+            out.append(Finding(
+                BUDGET_REL, 1, NAME,
+                f"config {name!r} is not in the checked-in budget; "
+                "run --update-budget and review the diff"))
+            continue
+        for kind in sorted(set(counts) | set(pinned[name])):
+            got = int(counts.get(kind, 0))
+            want = int(pinned[name].get(kind, 0))
+            if got == want:
+                continue
+            delta = got - want
+            if kind == "wire_bytes_per_step":
+                what = (f"per-device exchange bytes/step "
+                        f"{'grew' if delta > 0 else 'shrank'} "
+                        f"{want} -> {got} ({delta:+d})")
+            else:
+                what = (f"{abs(delta)} {kind.replace('_', '-')} "
+                        f"collective(s) {'ADDED to' if delta > 0 else 'removed from'} "
+                        f"the compiled step ({want} -> {got})")
+            out.append(Finding(
+                BUDGET_REL, 1, NAME,
+                f"config {name!r}: {what}. If intentional, regenerate the "
+                "budget (`python -m tools.oelint --update-budget`) and "
+                "commit the json diff; otherwise a collective/recompile "
+                "crept onto a pinned path"))
+    return out
+
+
+def update_budget(root: str) -> str:
+    _ensure_cpu()
+    import jax
+    path = os.path.join(root, BUDGET_REL)
+    doc = {
+        "_comment": "Pinned collective counts + static wire bytes per "
+                    "compiled train-step config (tools/oelint/passes/"
+                    "hlo_budget.py). Regenerate with `python -m "
+                    "tools.oelint --update-budget`; the diff is the review "
+                    "surface for collective changes.",
+        "jax": jax.__version__,
+        "mesh_devices": 8,
+        "configs": measure(),
+    }
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+    return path
+
+
+def run(files, root: str) -> List[Finding]:
+    return compare(measure(), load_budget(root))

@@ -1,0 +1,110 @@
+"""host-sync pass: device→host synchronization discipline on hot paths.
+
+The repo's one-device_get-per-step rule (`utils/metrics.record_step_stats`
+doc: "ONE jax.device_get of the whole dict — per-key float() on device
+arrays would force one host sync per stat on the hot path") is enforced here
+mechanically. Functions opt in with an annotation on/above their `def`:
+
+    # oelint: hot-path                 (sync budget: 1 jax.device_get)
+    # oelint: hot-path device_get=0    (pure jit code: ZERO host syncs)
+
+Inside an annotated function (nested defs included) the pass flags:
+
+- `jax.device_get(...)` calls beyond the budget (default 1 — the documented
+  one-get-per-step allowance);
+- `.block_until_ready()` / `jax.block_until_ready(...)` — always (a hot path
+  never spins on device completion; the caller's timing wrapper does);
+- `np.asarray(...)` / `np.array(...)` whose argument is a jnp/jax op result
+  — an implicit device→host copy that silently serializes the pipeline;
+- `float()` / `int()` / `bool()` on a jnp/jax op result — the implicit-sync
+  scalar conversion (each one is a hidden blocking transfer).
+
+Host-side numpy math and conversions of already-fetched (post-device_get)
+values are NOT flagged: the argument must syntactically contain a jnp/jax
+call for the implicit-sync rules to fire, which keeps the pass quiet on
+host-only code while catching the real regression class (someone adding
+`float(jnp.mean(...))` to a step loop).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Tuple
+
+from ..core import Finding, HOT_PATH_RE, SourceFile
+from .trace_hazard import _call_chain, _is_jaxish
+
+NAME = "host-sync"
+DIRS = ("openembedding_tpu",)
+
+DEFAULT_DEVICE_GET_BUDGET = 1
+
+
+def _contains_jax_call(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call) and _is_jaxish(sub):
+            return True
+    return False
+
+
+def _hot_path_functions(sf: SourceFile):
+    if sf.tree is None:
+        return
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        m = sf.def_annotation(node, HOT_PATH_RE)
+        if m:
+            budget = (int(m.group(1)) if m.group(1) is not None
+                      else DEFAULT_DEVICE_GET_BUDGET)
+            yield node, budget
+
+
+def _check_function(sf: SourceFile, fn: ast.AST, budget: int
+                    ) -> List[Finding]:
+    out: List[Finding] = []
+    device_gets: List[ast.Call] = []
+
+    def flag(node: ast.AST, message: str) -> None:
+        if not sf.suppressed(node.lineno, NAME):
+            out.append(Finding(sf.rel, node.lineno, NAME,
+                               f"{message} (in hot-path fn `{fn.name}`)"))
+
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = _call_chain(node)
+        tail = chain[-1] if chain else None
+        if tail is None and isinstance(node.func, ast.Attribute):
+            tail = node.func.attr  # method on a non-Name (e.g. a call result)
+        if tail == "device_get":
+            device_gets.append(node)
+        elif tail == "block_until_ready":
+            flag(node, "block_until_ready on a hot path: spins the caller "
+                       "on device completion; time/synchronize outside the "
+                       "hot path")
+        elif chain and chain[0] == "np" and tail in ("asarray", "array") \
+                and node.args and _contains_jax_call(node.args[0]):
+            flag(node, f"np.{tail}() of a device value: implicit blocking "
+                       "device→host copy; batch it into the step's single "
+                       "jax.device_get")
+        elif chain and len(chain) == 1 and tail in ("float", "int", "bool") \
+                and node.args and _contains_jax_call(node.args[0]):
+            flag(node, f"{tail}() of a device value: implicit-sync scalar "
+                       "conversion (one hidden blocking transfer per call); "
+                       "batch it into the step's single jax.device_get")
+    if len(device_gets) > budget:
+        for call in device_gets:
+            flag(call, f"{len(device_gets)} jax.device_get calls on a hot "
+                       f"path with a budget of {budget}: the "
+                       "one-device_get-per-step rule (fetch everything in "
+                       "ONE call, like metrics.record_step_stats)")
+    return out
+
+
+def run(files: List[SourceFile], root: str) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in files:
+        for fn, budget in _hot_path_functions(sf):
+            findings.extend(_check_function(sf, fn, budget))
+    return sorted(findings, key=lambda f: (f.path, f.line))

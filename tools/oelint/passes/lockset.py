@@ -1,0 +1,213 @@
+"""lockset pass: annotation-driven lock discipline for the threaded classes.
+
+The serving/sync/telemetry tier is plain-`threading` code (SyncSubscriber's
+poll loop, MicroBatcher's leader/follower window, SkewMonitor's worker,
+PeriodicReporter, ModelManager's RCU cache, the trace FlightRecorder). The
+invariant that keeps it correct — "this attribute is only written under that
+lock" — lives in heads and docstrings; this pass makes it checkable:
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cache = {}     # guarded-by: self._lock
+
+Every assignment (`self._cache = ...`, `self._cache |= ...`) to a guarded
+attribute anywhere else in the class must then sit lexically inside a
+`with self._lock:` block (or a `with` on a Condition CONSTRUCTED from that
+lock — `threading.Condition(self._lock)` aliases are resolved). `__init__`/
+`__new__` are exempt (the object is not shared yet).
+
+Limitations, by design: the check is lexical and write-only. Mutating calls
+(`self._cache.pop(...)`) and reads are not tracked — flag-worthy races there
+need a human; the pass catches the regression class that actually bites
+(someone adds a fast-path `self.state = X` outside the lock). Cross-function
+discipline ("caller holds the lock") is expressed with a reasoned
+suppression, which is exactly the documentation such code needs anyway.
+
+Second rule, annotation-free: MUTABLE CLASS-LEVEL state (`x = []` / `= {}` /
+`= set()` in a class body) is flagged everywhere — one shared instance
+behind every object of the class is the classic silent-aliasing bug, and in
+this codebase class attributes double as cross-thread state (ServingHandler
+handler classes). Intentional shared state takes a reasoned suppression.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional
+
+from ..core import Finding, GUARDED_BY_RE, SourceFile
+
+NAME = "lockset"
+DIRS = ("openembedding_tpu",)
+
+_EXEMPT_METHODS = {"__init__", "__new__"}
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _with_lock_exprs(stack: List[ast.AST]) -> List[str]:
+    """Unparsed context expressions of every enclosing `with`."""
+    out = []
+    for node in stack:
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                try:
+                    out.append(ast.unparse(item.context_expr))
+                except Exception:  # noqa: BLE001 — unparse is best-effort
+                    pass
+    return out
+
+
+def _condition_aliases(cls: ast.ClassDef) -> Dict[str, str]:
+    """self.Y -> self.X for `self.Y = threading.Condition(self.X)` (holding
+    the Condition holds its underlying lock)."""
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            func = node.value.func
+            if isinstance(func, ast.Attribute) and func.attr == "Condition" \
+                    and node.value.args:
+                try:
+                    lock_src = ast.unparse(node.value.args[0])
+                except Exception:  # noqa: BLE001
+                    continue
+                for tgt in node.targets:
+                    attr = _self_attr(tgt)
+                    if attr is not None:
+                        aliases[f"self.{attr}"] = lock_src
+    return aliases
+
+
+def _guarded_attrs(sf: SourceFile, cls: ast.ClassDef) -> Dict[str, str]:
+    """attr name -> lock expression, from `# guarded-by:` annotations on
+    assignments (typically in __init__) or class-level AnnAssign lines."""
+    guarded: Dict[str, str] = {}
+    for node in ast.walk(cls):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            continue
+        m = sf.stmt_annotation(node, GUARDED_BY_RE)
+        if not m:
+            continue
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        for tgt in targets:
+            attr = _self_attr(tgt)
+            if attr is None and isinstance(tgt, ast.Name):
+                attr = tgt.id  # class-level declaration
+            if attr is not None:
+                guarded[attr] = m.group(1)
+    return guarded
+
+
+def _check_class(sf: SourceFile, cls: ast.ClassDef) -> List[Finding]:
+    out: List[Finding] = []
+    guarded = _guarded_attrs(sf, cls)
+    aliases = _condition_aliases(cls)
+
+    if guarded:
+        for method in cls.body:
+            if not isinstance(method, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                continue
+            if method.name in _EXEMPT_METHODS:
+                continue
+            out.extend(_check_method(sf, cls, method, guarded, aliases))
+
+    # mutable class-level state (annotation-free rule)
+    for node in cls.body:
+        value = None
+        name = None
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            name, value = node.targets[0].id, node.value
+        elif isinstance(node, ast.AnnAssign) and \
+                isinstance(node.target, ast.Name):
+            name, value = node.target.id, node.value
+        if value is None or name is None:
+            continue
+        kind = _mutable_literal(value)
+        if kind and not sf.suppressed(node.lineno, NAME):
+            out.append(Finding(
+                sf.rel, node.lineno, NAME,
+                f"class-level mutable default `{cls.name}.{name} = "
+                f"{kind}`: one shared {kind.rstrip('()')} behind every "
+                "instance (and every thread); initialize per-instance in "
+                "__init__ or use an immutable default"))
+    return out
+
+
+def _mutable_literal(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.List):
+        return "[...]" if node.elts else "[]"
+    if isinstance(node, ast.Dict):
+        return "{...}" if node.keys else "{}"
+    if isinstance(node, ast.Set):
+        return "{...}"
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) and \
+            node.func.id in ("list", "dict", "set") and not node.args and \
+            not node.keywords:
+        return f"{node.func.id}()"
+    return None
+
+
+def _check_method(sf: SourceFile, cls: ast.ClassDef, method: ast.AST,
+                  guarded: Dict[str, str],
+                  aliases: Dict[str, str]) -> List[Finding]:
+    out: List[Finding] = []
+
+    def written_attrs(tgt: ast.AST):
+        """Guardable writes in one assignment target: `self.x = ...`,
+        `self.x[...] = ...` (container rebinds AND keyed stores), and
+        tuple/list unpacking (`a, self.x = ...`)."""
+        attr = _self_attr(tgt)
+        if attr is not None:
+            yield attr, tgt
+        elif isinstance(tgt, ast.Subscript):
+            attr = _self_attr(tgt.value)
+            if attr is not None:
+                yield attr, tgt
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for elt in tgt.elts:
+                yield from written_attrs(elt)
+
+    def walk(node: ast.AST, stack: List[ast.AST]) -> None:
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for top in targets:
+              for attr, tgt in written_attrs(top):
+                if attr not in guarded:
+                    continue
+                lock = guarded[attr]
+                held = _with_lock_exprs(stack)
+                held_resolved = held + [aliases.get(h) for h in held
+                                        if aliases.get(h)]
+                if lock not in held_resolved and \
+                        not sf.suppressed(tgt.lineno, NAME):
+                    out.append(Finding(
+                        sf.rel, tgt.lineno, NAME,
+                        f"write to `self.{attr}` outside `with {lock}:` "
+                        f"(declared guarded-by in {cls.name}; writer: "
+                        f"`{method.name}`) — take the lock or suppress "
+                        "with the cross-function holder as the reason"))
+        for child in ast.iter_child_nodes(node):
+            walk(child, stack + [node])
+
+    walk(method, [])
+    return out
+
+
+def run(files: List[SourceFile], root: str) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in files:
+        if sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ClassDef):
+                findings.extend(_check_class(sf, node))
+    return sorted(findings, key=lambda f: (f.path, f.line))

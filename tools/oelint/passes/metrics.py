@@ -1,0 +1,115 @@
+"""metrics pass: metric-name hygiene at observe()/vtimer()/span() call sites.
+
+The fifth oelint pass — the former standalone `tools/lint_metrics.py`,
+folded into the framework (that script is now a thin alias so
+`make lint-metrics` keeps working). Rules are unchanged:
+
+- metric names are dot-joined lowercase `group.name[.qualifier]` segments of
+  `[a-z0-9_]+` (utils/metrics.py naming scheme); timer/span call sites pass
+  group and name as separate lowercase segments;
+- the GROUP (first name segment / the group argument of vtimer/span) is a
+  closed registry (KNOWN_GROUPS) — a new group is a conscious act, not a
+  typo minting `skwe.hot_id` silently;
+- per-instance dimensions (table/shard/model) belong in labels, never
+  embedded in a NAME segment (`pull.user_table.ms` reads like a conforming
+  name; the INSTANCE_DIM rule rejects it mechanically).
+
+Scans literal string arguments only (f-strings and variables pass through —
+they are composed FROM checked literals). Inline suppression:
+`# oelint: disable=metrics -- <reason>`.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List
+
+from ..core import Finding, SourceFile
+
+NAME = "metrics"
+DIRS = ("openembedding_tpu", "examples", "tools")
+SKIP = ("tools/oelint", "tools/lint_metrics.py")
+
+NAME_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)+$")
+SEGMENT = re.compile(r"^[a-z0-9_]+$")
+
+# the metric-group registry: every observe() name's first segment and every
+# vtimer()/span() group must be one of these (utils/metrics.py doc scheme)
+KNOWN_GROUPS = {
+    "exchange",   # sharded-exchange wire costs + per-shard load/skew gauges
+    "fleet",      # /fleetz cross-node scrape health
+    "hot",        # replicated hot-row cache (MeshTrainer(hot_rows=...))
+    "metrics",    # the metrics subsystem's own health (report_errors)
+    "offload",    # host-cached table cache admission/flush
+    "persist",    # async/incremental persistence
+    "serving",    # REST predict/pull/batching
+    "skew",       # heavy-hitter sketches (utils/sketch.py)
+    "sync",       # online model sync
+    "train",      # example-loop wall timers
+    "trainer",    # train-step phases + per-table pull stats
+}
+
+# per-instance dimensions embedded in a NAME segment instead of a label:
+# a specific instance (`shard3`, `table_12`) or a smuggled instance name
+# (`user_table`). Generic uses (`shard_rows`, `bucket_fill`) stay legal.
+INSTANCE_DIM = re.compile(
+    r"^(?:(?:table|shard|model|instance)_?\d+"
+    r"|[a-z0-9_]+_(?:table|shard|model|instance))$")
+
+# observe("metric.name", ...) — metrics.observe or bare observe
+OBSERVE = re.compile(r"""(?<![\w.])(?:metrics\.|M\.)?observe\(\s*
+                         (["'])(?P<name>[^"']+)\1""", re.VERBOSE)
+# vtimer("group", "name") / trace.span("group", "name") / span("group", ...)
+TIMER = re.compile(r"""(?<![\w.])(?:metrics\.|M\.|trace\.|_trace\.)?
+                       (?:vtimer|span)\(\s*
+                       (["'])(?P<group>[^"']+)\1\s*,\s*
+                       (["'])(?P<name>[^"']+)\3""", re.VERBOSE)
+
+
+def lint_text(sf: SourceFile) -> List[Finding]:
+    text = sf.text
+    bad: List[Finding] = []
+
+    def flag(pos: int, message: str) -> None:
+        line = text.count("\n", 0, pos) + 1
+        if not sf.suppressed(line, NAME):
+            bad.append(Finding(sf.rel, line, NAME, message))
+
+    for m in OBSERVE.finditer(text):
+        name = m.group("name")
+        if not NAME_RE.fullmatch(name):
+            flag(m.start(), f"observe({name!r}) — metric names are "
+                 "dot-joined lowercase group.name segments")
+            continue
+        segments = name.split(".")
+        if segments[0] not in KNOWN_GROUPS:
+            flag(m.start(), f"observe({name!r}) — unknown metric group "
+                 f"{segments[0]!r}; register it in "
+                 "tools/oelint/passes/metrics.py KNOWN_GROUPS")
+        for seg in segments:
+            if INSTANCE_DIM.fullmatch(seg):
+                flag(m.start(), f"observe({name!r}) — segment {seg!r} "
+                     "embeds a per-instance dimension (table/shard/model) "
+                     "in the NAME; put it in labels={...} instead")
+    for m in TIMER.finditer(text):
+        for part in (m.group("group"), m.group("name")):
+            if not SEGMENT.fullmatch(part):
+                flag(m.start(), f"timer/span segment {part!r} — group and "
+                     "name are single lowercase [a-z0-9_]+ segments")
+            elif INSTANCE_DIM.fullmatch(part):
+                flag(m.start(), f"timer/span segment {part!r} — embeds a "
+                     "per-instance dimension (table/shard/model); use "
+                     "labels={...}")
+        group = m.group("group")
+        if SEGMENT.fullmatch(group) and group not in KNOWN_GROUPS:
+            flag(m.start(), f"span/vtimer group {group!r} — unknown metric "
+                 "group; register it in tools/oelint/passes/metrics.py "
+                 "KNOWN_GROUPS")
+    return bad
+
+
+def run(files: List[SourceFile], root: str) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in files:
+        findings.extend(lint_text(sf))
+    return sorted(findings, key=lambda f: (f.path, f.line))

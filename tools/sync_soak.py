@@ -63,7 +63,12 @@ def run(*, steps=24, persist_every=2, interval_s=0.05, workdir="/tmp/oetpu_sync_
     batches = list(synthetic_criteo(batch, id_space=vocab, steps=steps,
                                     seed=1))
     state = trainer.init(batches[0])
-    step_fn = trainer.jit_train_step()
+    # the soak's paced trainer must never re-jit across the run: identical
+    # batch shapes -> one compiled program, asserted at every step
+    # (utils/guards — the executable half of the never-re-jit rule)
+    from openembedding_tpu.utils.guards import assert_no_recompile
+    step_fn = assert_no_recompile(trainer.jit_train_step(),
+                                  label="soak_train_step")
 
     persister = IncrementalPersister(
         trainer, model, root, window=2,
