@@ -602,6 +602,134 @@ def case_hot():
     return out
 
 
+def case_placement():
+    """Self-driving placement (round 12): drifting-Zipf traffic (a planted
+    heavy pool homed on ONE shard, rotated to a different shard mid-run)
+    through the sharded exchange, `placement.PlacementController` on vs off
+    — the controller sees ONLY a replicated-byte budget and must size the
+    hot cache, pace refreshes and re-shard the cold tail itself.
+
+    Reported per config: steady-state shard imbalance BEFORE and AFTER the
+    drift (mean of each phase's last third — the controller's recovery is
+    the product), hot hit ratio, refresh/migration counts, the off-hot-path
+    migration traffic (2 all_gathers of the (M, W) annex per migrate call),
+    and ms/step (controller on-step overhead rides the number). Needs
+    S >= 2; the battery entry runs the 8-virtual-device CPU mesh."""
+    import jax
+    import openembedding_tpu as embed
+    from openembedding_tpu.models import make_deepfm
+    from openembedding_tpu.parallel import MeshTrainer, make_mesh
+    from openembedding_tpu.placement import (PlacementController,
+                                             PlacementPolicy)
+    from openembedding_tpu.placement.policy import row_bytes
+    from openembedding_tpu.utils import metrics as metrics_mod
+    from openembedding_tpu.utils.sketch import SkewMonitor
+
+    WD.stage("placement:init", 240)
+    devs = jax.devices()
+    S = min(8, len(devs))
+    mesh = make_mesh(devs[:S])
+    vocab = int(os.environ.get("OETPU_BENCH_PLACEMENT_VOCAB", str(1 << 13)))
+    cpu = devs[0].platform == "cpu"
+    batch = min(BATCH, 2048) if cpu else BATCH
+    steps_per_phase = 16 if cpu else 30
+    fields = 26
+    POOL, HOT_SHARE = 64, 0.6
+    budget = int(os.environ.get("OETPU_BENCH_PLACEMENT_BUDGET",
+                                str(32 * row_bytes(9 + 1))))
+
+    def pools():
+        # heavy pool homed entirely on one shard pre-drift, another after
+        return ((np.arange(POOL) * S + S - 1).astype(np.int64),
+                (np.arange(POOL) * S + 1).astype(np.int64))
+
+    def stream(seed=13):
+        rng = np.random.default_rng(seed)
+        pre, post = pools()
+        w = 1.0 / (np.arange(POOL) + 1.0)
+        w /= w.sum()
+        bs = []
+        for i in range(2 * steps_per_phase):
+            pool = pre if i < steps_per_phase else post
+            ids = rng.integers(0, vocab, (batch, fields))
+            mask = rng.random((batch, fields)) < HOT_SHARE
+            ids[mask] = pool[rng.choice(POOL, size=int(mask.sum()), p=w)]
+            bs.append({
+                "sparse": {"categorical": ids.astype(np.int32)},
+                "dense": rng.normal(size=(batch, 13)).astype(np.float32),
+                "label": rng.integers(0, 2, (batch,)).astype(np.float32)})
+        return bs
+
+    def one_config(name, on, bs):
+        WD.stage(f"placement:{name}", 600)
+        metrics_mod._REGISTRY.clear()
+        model = make_deepfm(vocabulary=vocab, dim=9)
+        tr = MeshTrainer(model, embed.Adagrad(learning_rate=0.05),
+                         mesh=mesh, capacity_factor=0.0)
+        ctrl = None
+        mon = SkewMonitor(k=256, sync=True, decay=0.9)
+        if on:
+            policy = PlacementPolicy(budget, mig_rows=POOL,
+                                     refresh_cooldown_steps=3,
+                                     imbalance_target=1.05)
+            ctrl = PlacementController(tr, policy, monitor=mon,
+                                       interval_steps=3)
+            for b in bs[:3]:
+                mon.observe("categorical", b["sparse"]["categorical"])
+        state = tr.init(bs[0])
+        if ctrl is not None:
+            state = ctrl.prime(state)
+        step = tr.jit_train_step(bs[0], state)
+        out, times, imbs, hits = {}, [], [], []
+        for i, b in enumerate(bs):
+            if ctrl is not None:
+                mon.observe("categorical", b["sparse"]["categorical"])
+            t0 = time.perf_counter()
+            state, m = step(state, b)
+            float(m["loss"])
+            if i:
+                times.append(time.perf_counter() - t0)
+            stats = {k: np.asarray(v) for k, v in
+                     jax.device_get(m["stats"]).items()}
+            pos = stats.get("categorical/shard_positions")
+            if pos is not None and pos.mean() > 0:
+                imbs.append(float(pos.max() / pos.mean()))
+            if "categorical/hot_hits" in stats:
+                hits.append(float(stats["categorical/hot_hits"])
+                            / float(stats["categorical/pull_indices"]))
+            metrics_mod.record_step_stats(m["stats"])
+            if ctrl is not None:
+                state = ctrl.on_step(state, step=i + 1)
+        third = max(steps_per_phase // 3, 1)
+        out["imbalance_pre_drift"] = round(
+            float(np.mean(imbs[steps_per_phase - third:steps_per_phase])), 3)
+        out["imbalance_post_drift"] = round(float(np.mean(imbs[-third:])), 3)
+        out["ms_per_step"] = round(min(times) * 1e3, 2) if times else None
+        if hits:
+            out["hit_ratio_final"] = round(float(np.mean(hits[-third:])), 4)
+        if ctrl is not None:
+            st = ctrl.status()
+            migrations = st["migrations_applied"]
+            rep = metrics_mod.report()
+            out["refreshes"] = rep.get("placement.refreshes", 0)
+            out["migrations"] = migrations
+            out["hot_rows"] = st["hot_rows"]
+            # off-hot-path annex traffic: 2 all_gathers of (M, W) per
+            # migrate call, W = fp32 weights + slots
+            W = row_bytes(9 + 1)
+            out["migration_bytes_total"] = int(
+                2 * (S - 1) * POOL * W * max(migrations, 0))
+        return out
+
+    bs = stream()
+    out = {"num_shards": S, "vocab": vocab, "batch": batch,
+           "steps_per_phase": steps_per_phase,
+           "hot_budget_bytes": budget}
+    out["controller_off"] = one_config("off", False, bs)
+    out["controller_on"] = one_config("on", True, bs)
+    return out
+
+
 def case_pull():
     """Embedding-pull p50 (BASELINE.md metric). A pull = the serving/forward read:
     dedup + row gather for one 4096x26 Zipfian batch against the 2^24-row dim-9
@@ -660,7 +788,7 @@ def main():
 
     cases = os.environ.get(
         "OETPU_BENCH_CASES",
-        "dim9,dim64,mesh1,mesh1f,pull,wire,sync,skew,hot").split(",")
+        "dim9,dim64,mesh1,mesh1f,pull,wire,sync,skew,hot,placement").split(",")
 
     # PRIMARY first: whatever happens later, this number is in the artifact.
     if "dim9" in cases:
@@ -677,7 +805,8 @@ def main():
                  ("wire", case_wire),
                  ("sync", case_sync),
                  ("skew", case_skew),
-                 ("hot", case_hot)]
+                 ("hot", case_hot),
+                 ("placement", case_placement)]
     for name, fn in secondary:
         if name not in cases:
             continue
@@ -724,6 +853,12 @@ def main():
                 RESULT["metric"] = "hot_zipf_on_ms_per_step"
                 RESULT["value"] = out["zipf_on"].get("ms_per_step")
                 RESULT["unit"] = "ms"
+                break
+            if "controller_on" in out:
+                RESULT["metric"] = "placement_on_imbalance_post_drift"
+                RESULT["value"] = out["controller_on"].get(
+                    "imbalance_post_drift")
+                RESULT["unit"] = "max/mean"
                 break
 
     WD.clear()

@@ -54,6 +54,36 @@ class HotRows(struct.PyTreeNode):
     slots: Dict[str, jax.Array]   # name -> (H, k) f32 (replicated optimizer state)
 
 
+class MigRows(struct.PyTreeNode):
+    """Cold-tail re-sharding state for one table (the other half of Parallax-
+    style hybrid placement, `parallel/sharded.py` "COLD-TAIL RE-SHARDING"):
+    a trace-time-static set of M measured-heavy COLD rows whose owner shard is
+    overridden away from the `id % S` hash home, so a hot home shard sheds
+    load it cannot shed through replication alone. Unlike `HotRows` the rows
+    are NOT replicated — each keeps exactly one owner; only the id -> owner
+    DIRECTORY is replicated so every client routes identically.
+
+    The directory is a mini open-addressing probe table (same machinery as
+    the hot probe, built host-side by `parallel/sharded.build_mig_identity`):
+    `keys` holds the migrated ids at ~2x load headroom, `rank` maps a probe
+    slot to the id's compact migration rank in [0, M) (M = empty), `ids` /
+    `owners` list the migrated ids and their assigned owner shard by rank.
+    `weights`/`slots` are each shard's ANNEX — M spare rows per shard; only
+    the assigned owner's copy of a rank is live (the home-shard main-table
+    row goes stale while migrated, exactly like a hot row's). `mig_writeback`
+    restores the home copies at snapshot/refresh time so checkpoints, export
+    and the sync delta feed stay byte-identical to an unmigrated run.
+    Chosen/refreshed off the hot path by `MeshTrainer.migrate_rows` (driven
+    by `placement.PlacementController`); persisted never."""
+
+    keys: jax.Array               # (C,) or (C, 2) — directory probe, replicated
+    rank: jax.Array               # (C,) int32 — probe slot -> rank; M = empty
+    ids: jax.Array                # (M,) or (M, 2) — migrated ids by rank
+    owners: jax.Array             # (M,) int32 — assigned owner shard; -1 = pad
+    weights: jax.Array            # (M, dim) per shard — the annex (SHARDED)
+    slots: Dict[str, jax.Array]   # name -> (M, k) per shard (SHARDED)
+
+
 class EmbeddingTableState(struct.PyTreeNode):
     """One variable's shard-local storage: weights + optimizer slots.
 
@@ -71,6 +101,10 @@ class EmbeddingTableState(struct.PyTreeNode):
     # serialized: checkpoint/persist/export writers see owner-shard rows only,
     # after the trainer's hot_sync writeback.
     hot: Optional[HotRows] = None
+    # cold-tail re-sharding directory + annex (MeshTrainer(mig_rows=...);
+    # None = off). NOT serialized either: `hot_sync` writes migrated rows
+    # back into their home shards before any snapshot/export/delta reader.
+    mig: Optional[MigRows] = None
 
 
 @dataclasses.dataclass(frozen=True)

@@ -153,7 +153,7 @@ def bucket_by_owner(ids: jax.Array, valid: jax.Array, num_shards: int,
 
 
 def unique_and_route(ids: jax.Array, valid: jax.Array, num_shards: int,
-                     capacity: int) -> tuple:
+                     capacity: int, owner=None) -> tuple:
     """Fused dedup + owner routing: ONE multi-key sort where
     `unique_with_counts` + `bucket_by_owner` pay two argsorts plus a
     searchsorted (the S-invariant protocol compute the mesh1 bench surfaces —
@@ -170,19 +170,27 @@ def unique_and_route(ids: jax.Array, valid: jax.Array, num_shards: int,
 
     `valid` masks per-INPUT-id (invalid ids sort into a trailing pseudo-owner
     `num_shards` and never reach a bucket). `owner = id % num_shards` exactly
-    like the split implementation."""
+    like the split implementation — unless the caller passes an explicit
+    per-position `owner` array ((n,) int32 in [0, num_shards]; the owner-
+    assignment INDIRECTION of cold-tail re-sharding, `parallel/sharded.py`
+    "COLD-TAIL RE-SHARDING"). A passed owner must be a pure function of the
+    id (duplicates of one id must agree) and is still masked by `valid`."""
     n = ids.shape[0]
     S = num_shards
     iota = jnp.arange(n, dtype=jnp.int32)
     if ids.ndim == 2:  # split-pair layout
         from .id64 import pair_mod
-        owner_in = jnp.where(valid, pair_mod(ids, S).astype(jnp.int32), S)
+        owner_in = (pair_mod(ids, S).astype(jnp.int32) if owner is None
+                    else owner.astype(jnp.int32))
+        owner_in = jnp.where(valid, owner_in, S)
         so, s_hi, s_lo, order = jax.lax.sort(
             (owner_in, ids[:, 0], ids[:, 1], iota), num_keys=3)
         sorted_ids = jnp.stack([s_hi, s_lo], axis=-1)
         id_change = (s_hi[1:] != s_hi[:-1]) | (s_lo[1:] != s_lo[:-1])
     else:
-        owner_in = jnp.where(valid, (ids % S).astype(jnp.int32), S)
+        owner_in = ((ids % S).astype(jnp.int32) if owner is None
+                    else owner.astype(jnp.int32))
+        owner_in = jnp.where(valid, owner_in, S)
         so, sorted_ids, order = jax.lax.sort((owner_in, ids, iota), num_keys=2)
         id_change = sorted_ids[1:] != sorted_ids[:-1]
     is_new = jnp.concatenate(

@@ -86,6 +86,38 @@ trace-time static (H rows, C = 2H probe slots): promote/demote between steps
 (`MeshTrainer.refresh_hot_rows`, fed by the `utils/sketch.py` heavy hitters)
 swaps array CONTENTS, never shapes, so nothing re-jits. S == 1 meshes reject
 hot state loudly (one device owns everything; a second copy could only skew).
+
+COLD-TAIL RE-SHARDING (owner-assignment indirection, the second half of
+Parallax hybrid placement): replication fits only the very head of the Zipf
+curve — below it sit ids too cold to replicate but hot enough that hash
+placement (`owner = id % S`) leaves their home shards measurably overloaded
+(`exchange.shard_imbalance` stays above 1 after the head leaves). When a
+table carries a migration directory (`EmbeddingTableState.mig`,
+`MeshTrainer(mig_rows=...)`), the client route probes each id against it (a
+second mini open-addressing probe riding the SAME fused sort as the hot
+probe) and overrides the owner for the M migrated ids — `unique_and_route`
+takes the precomputed per-position owner, so the indirection costs one
+`hash_find` and changes NOTHING else about the 3-a2a exchange: no extra
+collective, no extra wire bytes, identical bucket shapes.
+
+- The DIRECTORY (keys/rank/ids/owners) is replicated so every source routes
+  a migrated id to the same assigned owner.
+- Each shard carries an M-row ANNEX (`mig.weights`/`mig.slots`, sharded);
+  the assigned owner serves a migrated id from annex row `rank` and applies
+  its gradients there (the received grads take the exact same source-major
+  reduction path as a home row's, so fp32-wire training is bit-exact vs an
+  unmigrated run — tests/test_placement.py pins it). The home shard's main-
+  table copy goes stale while migrated; the server probe masks migrated ids
+  out of the main table so hash tables never re-insert them.
+- Lifecycle off the hot path, static shapes, never re-jits: `mig_gather`
+  installs a directory and fills the annex from the home shards (one
+  all_gather + exact home select — bit copies), `mig_writeback` restores the
+  home copies from the assigned owners' annexes (one all_gather + owner
+  select), and `MeshTrainer.migrate_rows` composes them. `hot_sync` runs the
+  writeback before every checkpoint/export/sync-delta snapshot, so on-disk
+  artifacts stay byte-identical to an unmigrated run. Hot and migrated sets
+  are DISJOINT by construction (the trainer filters each against the other);
+  S == 1 meshes reject migration state exactly like hot state.
 """
 
 from __future__ import annotations
@@ -95,7 +127,7 @@ from typing import Dict, NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from ..embedding import EmbeddingSpec, EmbeddingTableState, HotRows
+from ..embedding import EmbeddingSpec, EmbeddingTableState, HotRows, MigRows
 from ..ops.dedup import (BucketResult, UniqueResult, bucket_by_owner,
                          bucket_validity, carry_to_unique, unbucket,
                          unique_and_route, unique_with_counts)
@@ -122,6 +154,10 @@ class ExchangePlan(NamedTuple):
     # per-UNIQUE-slot hot-cache row in [0, hot_rows], hot_rows = cold/miss
     hot_slot: Optional[jax.Array] = None
     hot_rows: int = 0
+    # per-UNIQUE-slot 1 where the migration directory re-routed the id off
+    # its hash home (None when the table has no directory) — pure accounting,
+    # folded into the step stats as `mig_unique`/`mig_hits`
+    mig_moved: Optional[jax.Array] = None
 
 
 def _bucket_capacity(n: int, num_shards: int, capacity_factor: float) -> int:
@@ -196,9 +232,48 @@ def _hot_probe(hot: HotRows, flat: jax.Array, valid: jax.Array) -> jax.Array:
                      jnp.int32(H)).astype(jnp.int32)
 
 
+def _mig_find(mig: MigRows, flat: jax.Array, valid: jax.Array):
+    """Per-POSITION directory probe -> (found, rank, owner). One `hash_find`
+    against the replicated migration directory; invalid positions probe the
+    EMPTY sentinel and always miss. `flat` must be in the TABLE's key layout
+    (same contract as `_hot_probe`)."""
+    from ..tables.hash_table import hash_find
+    C = mig.keys.shape[0]
+    M = mig.ids.shape[0]
+    if mig.keys.ndim == 2:
+        from ..ops.id64 import PAIR_EMPTY
+        probe = jnp.where(valid[:, None], flat, PAIR_EMPTY)
+    else:
+        probe = jnp.where(valid, flat, -1).astype(mig.keys.dtype)
+    pslot = hash_find(mig.keys, probe, num_probes=HOT_NUM_PROBES)
+    idx = jnp.clip(pslot, 0, C - 1)
+    rank = jnp.where(pslot < C, mig.rank[idx], jnp.int32(M)).astype(jnp.int32)
+    found = rank < M
+    owner = jnp.where(found, mig.owners[jnp.clip(rank, 0, M - 1)],
+                      jnp.int32(-1)).astype(jnp.int32)
+    return found, rank, owner
+
+
+def _route_owner(mig: MigRows, flat: jax.Array, valid: jax.Array,
+                 S: int):
+    """Per-position owner under the assignment indirection: the directory's
+    assigned owner where it hits, the `id % S` hash home everywhere else.
+    -> (owner (n,) int32 in [0, S], moved (n,) bool)."""
+    if flat.ndim == 2:
+        from ..ops.id64 import pair_mod
+        home = pair_mod(flat, S).astype(jnp.int32)
+    else:
+        home = (flat % S).astype(jnp.int32)
+    found, _rank, own = _mig_find(mig, flat, valid)
+    moved = found & (own != home)
+    owner = jnp.where(valid & found, own, jnp.where(valid, home, S))
+    return owner, moved
+
+
 def make_plan(spec: EmbeddingSpec, ids: jax.Array, *, axis: str = DATA_AXIS,
               capacity_factor: float = 0.0,
-              hot: Optional[HotRows] = None) -> ExchangePlan:
+              hot: Optional[HotRows] = None,
+              mig: Optional[MigRows] = None) -> ExchangePlan:
     """Dedup local ids, bucket by owner, exchange the id buckets (one all_to_all).
 
     Dedup and routing come out of ONE fused sort (`ops/dedup.unique_and_route`).
@@ -209,7 +284,9 @@ def make_plan(spec: EmbeddingSpec, ids: jax.Array, *, axis: str = DATA_AXIS,
 
     `hot`: the table's replicated hot-row cache — hot positions are carved out
     of the exchange (module doc "HOT-ROW REPLICATION") and the plan carries
-    their per-unique-slot cache rows in `hot_slot`."""
+    their per-unique-slot cache rows in `hot_slot`. `mig`: the table's
+    migration directory — cold positions route to their ASSIGNED owner
+    instead of the `id % S` home (module doc "COLD-TAIL RE-SHARDING")."""
     S = jax.lax.axis_size(axis)
     flat = flatten_ids(spec, ids)
     n = flat.shape[0]
@@ -220,6 +297,11 @@ def make_plan(spec: EmbeddingSpec, ids: jax.Array, *, axis: str = DATA_AXIS,
                 "shard and the cache are the same memory, and two copies of "
                 "a row can only diverge (MeshTrainer disables hot_rows at "
                 "mesh size 1)")
+        if mig is not None:
+            raise ValueError(
+                "cold-tail re-sharding needs S >= 2: on a 1-device mesh "
+                "there is nowhere to migrate a row to (MeshTrainer disables "
+                "mig_rows at mesh size 1)")
         uniq = unique_with_counts(flat)
         valid = (uniq.counts > 0) & _id_valid(spec, uniq.unique_ids)
         recv_ids = uniq.unique_ids[None]
@@ -230,40 +312,54 @@ def make_plan(spec: EmbeddingSpec, ids: jax.Array, *, axis: str = DATA_AXIS,
             slot=jnp.arange(n, dtype=jnp.int32),
             overflow=jnp.zeros((), jnp.int32))
         return ExchangePlan(uniq, buckets, recv_ids, recv_valid, n)
-    uniq, buckets, cap, hot_slot = _client_route(spec, flat, S,
-                                                 capacity_factor, hot)
+    uniq, buckets, cap, hot_slot, moved = _client_route(spec, flat, S,
+                                                        capacity_factor, hot,
+                                                        mig)
     # [BOUNDARY: was one RPC per owning server; now ONE ICI all_to_all —
     # empty bucket slots carry the EMPTY sentinel, so the receive side
     # derives validity from the ids and no bool mask rides the wire]
     recv_ids = jax.lax.all_to_all(buckets.bucket_ids, axis, 0, 0)
     recv_valid = bucket_validity(recv_ids)
     return ExchangePlan(uniq, buckets, recv_ids, recv_valid, cap, hot_slot,
-                        0 if hot is None else hot.weights.shape[0])
+                        0 if hot is None else hot.weights.shape[0], moved)
 
 
 def _client_route(spec: EmbeddingSpec, flat: jax.Array, S: int,
-                  capacity_factor: float, hot: Optional[HotRows] = None):
+                  capacity_factor: float, hot: Optional[HotRows] = None,
+                  mig: Optional[MigRows] = None):
     """Per-table client-side dedup + owner routing: the plan minus its id
     exchange (shared by `make_plan` and the grouped fused exchange).
-    -> (uniq, buckets, cap, hot_slot-or-None)."""
+    -> (uniq, buckets, cap, hot_slot-or-None, mig_moved-or-None)."""
     n = flat.shape[0]
     valid = _id_valid(spec, flat)
     cap = _bucket_capacity(n, S, capacity_factor)
-    if hot is None:
+    if hot is None and mig is None:
         uniq, buckets = unique_and_route(flat, valid, S, cap)
-        return uniq, buckets, cap, None
+        return uniq, buckets, cap, None, None
+    # owner-assignment indirection (None keeps the plain `id % S` routing so
+    # the mig-off program stays byte-identical to the pre-feature trace)
+    owner = moved = None
+    if mig is not None:
+        owner, moved = _route_owner(mig, flat, valid, S)
+    if hot is None:
+        uniq, buckets = unique_and_route(flat, valid, S, cap, owner=owner)
+        return uniq, buckets, cap, None, \
+            carry_to_unique(uniq, moved.astype(jnp.int32), 0)
     H = hot.weights.shape[0]
     hr = _hot_probe(hot, flat, valid)
     # hot positions leave the exchange entirely: they route like invalid ids
     # (pseudo-owner S — no bucket slot, no wire bytes, no owner-shard load)
     # but keep their unique slots/counts for the local gather + reduced push
-    uniq, buckets = unique_and_route(flat, valid & (hr >= H), S, cap)
+    uniq, buckets = unique_and_route(flat, valid & (hr >= H), S, cap,
+                                     owner=owner)
     hot_slot = carry_to_unique(uniq, hr, H)
-    return uniq, buckets, cap, hot_slot
+    mig_moved = None if moved is None else \
+        carry_to_unique(uniq, (moved & (hr >= H)).astype(jnp.int32), 0)
+    return uniq, buckets, cap, hot_slot, mig_moved
 
 
 def grouped_make_plans(specs, ids_list, *, axis: str = DATA_AXIS,
-                       capacity_factor: float = 0.0, hots=None):
+                       capacity_factor: float = 0.0, hots=None, migs=None):
     """Routing plans for a DIM-GROUP of tables with ONE fused id all_to_all.
 
     Per-table dedup/bucketing is identical to `make_plan`; only the wire is
@@ -272,27 +368,30 @@ def grouped_make_plans(specs, ids_list, *, axis: str = DATA_AXIS,
     the receive side recovers per-table buckets by slicing. `ids_list` must
     already be in each table's key layout (`adapt_batch_ids`). `hots`: one
     Optional[HotRows] per table (hot ids skip the fused wire exactly like the
-    per-table path)."""
+    per-table path). `migs`: one Optional[MigRows] per table (the owner-
+    assignment indirection rides each table's own route)."""
     S = jax.lax.axis_size(axis)
     if hots is None:
         hots = [None] * len(specs)
+    if migs is None:
+        migs = [None] * len(specs)
     if S == 1:
         return [make_plan(spec, ids, axis=axis,
-                          capacity_factor=capacity_factor, hot=hot)
-                for spec, ids, hot in zip(specs, ids_list, hots)]
+                          capacity_factor=capacity_factor, hot=hot, mig=mig)
+                for spec, ids, hot, mig in zip(specs, ids_list, hots, migs)]
     from ..ops.dedup import concat_owner_buckets, split_owner_buckets
     parts = []
-    for spec, ids, hot in zip(specs, ids_list, hots):
+    for spec, ids, hot, mig in zip(specs, ids_list, hots, migs):
         flat = flatten_ids(spec, ids)
-        parts.append(_client_route(spec, flat, S, capacity_factor, hot))
-    wire_ids = concat_owner_buckets([b.bucket_ids for _, b, _, _ in parts])
+        parts.append(_client_route(spec, flat, S, capacity_factor, hot, mig))
+    wire_ids = concat_owner_buckets([b.bucket_ids for _, b, _, _, _ in parts])
     recv = jax.lax.all_to_all(wire_ids, axis, 0, 0)
     templates = [(cap, b.bucket_ids.ndim == 3, b.bucket_ids.dtype)
-                 for _, b, cap, _ in parts]
+                 for _, b, cap, _, _ in parts]
     segs = split_owner_buckets(recv, templates)
     return [ExchangePlan(uniq, buckets, seg, bucket_validity(seg), cap, hs,
-                         0 if hot is None else hot.weights.shape[0])
-            for (uniq, buckets, cap, hs), seg, hot
+                         0 if hot is None else hot.weights.shape[0], mv)
+            for (uniq, buckets, cap, hs, mv), seg, hot
             in zip(parts, segs, hots)]
 
 
@@ -352,18 +451,29 @@ def exchange_load_stats(plan: ExchangePlan, *, axis: str = DATA_AXIS
 def _serve_rows(spec: EmbeddingSpec, state: EmbeddingTableState,
                 plan: ExchangePlan, *, train: bool, axis: str
                 ) -> Tuple[EmbeddingTableState, jax.Array]:
-    """Server side of a pull: gather this shard's rows for the received ids."""
+    """Server side of a pull: gather this shard's rows for the received ids.
+    With a migration directory, received MIGRATED ids (the indirection routed
+    them here because this shard is their assigned owner) read from the annex
+    instead of the main table — and are masked out of the main-table probe,
+    so a hash table never lazily re-inserts a row that lives in the annex."""
     S = jax.lax.axis_size(axis)
     pair = plan.recv_ids.ndim == 3  # (S, cap, 2) split-pair buckets
     flat_recv = (plan.recv_ids.reshape(-1, 2) if pair
                  else plan.recv_ids.reshape(-1))
     flat_valid = plan.recv_valid.reshape(-1)
+    mig = state.mig
+    m_found = None
+    if mig is not None:
+        m_found, m_rank, _ = _mig_find(mig, flat_recv, flat_valid)
+        main_valid = flat_valid & ~m_found
+    else:
+        main_valid = flat_valid
     if spec.use_hash_table:
         if pair:
             from ..ops.id64 import PAIR_EMPTY
-            probe = jnp.where(flat_valid[:, None], flat_recv, PAIR_EMPTY)
+            probe = jnp.where(main_valid[:, None], flat_recv, PAIR_EMPTY)
         else:
-            probe = jnp.where(flat_valid, flat_recv, -1)
+            probe = jnp.where(main_valid, flat_recv, -1)
         if train:
             from ..tables.hash_table import hash_lookup_train
             old_overflow = state.overflow
@@ -376,7 +486,7 @@ def _serve_rows(spec: EmbeddingSpec, state: EmbeddingTableState,
             from ..tables.hash_table import hash_lookup
             rows = hash_lookup(state, probe)
     else:
-        local_rows = jnp.where(flat_valid, flat_recv // S, -1)
+        local_rows = jnp.where(main_valid, flat_recv // S, -1)
         rows = lookup_rows(state.weights, local_rows)
         if rows.shape[1] != spec.output_dim:
             # packed weights+slots layout inside train_many's scan
@@ -384,6 +494,10 @@ def _serve_rows(spec: EmbeddingSpec, state: EmbeddingTableState,
             # the gathered packed rows — the gather is latency-bound, the
             # slot bytes ride free
             rows = rows[:, :spec.output_dim]
+    if m_found is not None:
+        M = mig.weights.shape[0]
+        arows = lookup_rows(mig.weights, jnp.where(m_found, m_rank, M))
+        rows = jnp.where(m_found[:, None], arows.astype(rows.dtype), rows)
     return state, rows.reshape(S, plan.cap, spec.output_dim)
 
 
@@ -420,6 +534,17 @@ def _hot_pull_stats(spec: EmbeddingSpec, plan: ExchangePlan, flat: jax.Array,
     return {"hot_unique": hot_unique, "hot_hits": hot_hits,
             "hot_bytes_saved": hot_unique.astype(jnp.float32)
             * float(per_row)}
+
+
+def _mig_pull_stats(plan: ExchangePlan) -> Dict[str, jax.Array]:
+    """Per-step re-sharding accounting (psum'd like the rest): `mig_unique`
+    (rows the directory routed off their hash home this step) and `mig_hits`
+    (duplicate-weighted positions those rows absorbed) —
+    `metrics.record_step_stats` derives `placement.moved_ratio{table=}`."""
+    mm = (plan.mig_moved > 0) & (plan.uniq.counts > 0)
+    return {"mig_unique": jnp.sum(mm).astype(jnp.int32),
+            "mig_hits": jnp.sum(jnp.where(mm, plan.uniq.counts, 0))
+            .astype(jnp.int32)}
 
 
 # oelint: hot-path device_get=0
@@ -495,7 +620,7 @@ def sharded_lookup_train(
     (`exchange_load_stats`) from the stats dict."""
     ids = adapt_batch_ids(spec, state, ids)
     plan = make_plan(spec, ids, axis=axis, capacity_factor=capacity_factor,
-                     hot=state.hot)
+                     hot=state.hot, mig=state.mig)
     state, rows = _serve_rows(spec, state, plan, train=True, axis=axis)
     out = _reassemble(plan, rows, _out_shape(spec, ids), spec.output_dim,
                       axis, hot=state.hot)
@@ -509,6 +634,8 @@ def sharded_lookup_train(
         # the per-table protocol always ships fp32 payloads
         stats.update(_hot_pull_stats(spec, plan, flatten_ids(spec, ids),
                                      "fp32"))
+    if plan.mig_moved is not None:
+        stats.update(_mig_pull_stats(plan))
     if load_stats:
         stats.update(exchange_load_stats(plan, axis=axis))
     return state, out, stats, plan
@@ -525,10 +652,11 @@ def sharded_lookup(
 ) -> jax.Array:
     """Read-only pull (serving/eval; reference `read_only_pull` handler — never
     inserts, absent hash ids return zeros). Hot rows read from the replicated
-    cache — the owner copies are stale while the cache is active."""
+    cache, migrated rows from their assigned owner's annex — the home copies
+    are stale while either placement is active."""
     ids = adapt_batch_ids(spec, state, ids)
     plan = make_plan(spec, ids, axis=axis, capacity_factor=capacity_factor,
-                     hot=state.hot)
+                     hot=state.hot, mig=state.mig)
     _, rows = _serve_rows(spec, state, plan, train=False, axis=axis)
     return _reassemble(plan, rows, _out_shape(spec, ids), spec.output_dim,
                        axis, hot=state.hot)
@@ -558,7 +686,7 @@ def sharded_apply_gradients(
     if plan is None:
         ids = adapt_batch_ids(spec, state, ids)
         plan = make_plan(spec, ids, axis=axis, capacity_factor=capacity_factor,
-                         hot=state.hot)
+                         hot=state.hot, mig=state.mig)
     gflat = grads.reshape(-1, spec.output_dim)
     n = gflat.shape[0]
     uniq, buckets, cap = plan.uniq, plan.buckets, plan.cap
@@ -624,7 +752,24 @@ def _apply_unique(spec: EmbeddingSpec, state: EmbeddingTableState, optimizer,
     """Server-side tail of a push: cross-source re-dedup (the MPSC reducer,
     `MpscGradientReducer.h`) + ONE fused optimizer apply per unique row.
     `rids`/`rg`/`rc` are the received flat ids, grads and exact duplicate
-    counts (count 0 = empty/invalid slot)."""
+    counts (count 0 = empty/invalid slot). Received MIGRATED ids apply into
+    the annex (this shard is their assigned owner) through the identical
+    sparse-apply machinery — the received buffer keeps its source-major
+    order, so the per-row reduction is bit-identical to the home shard's."""
+    mig = state.mig
+    if mig is not None:
+        m_found, m_rank, _ = _mig_find(mig, rids, rc > 0)
+        M = mig.weights.shape[0]
+        mweights, mslots = sparse_apply_dense_table(
+            optimizer, mig.weights, mig.slots,
+            jnp.where(m_found, m_rank, M), rg,
+            pre_counts=jnp.where(m_found, rc, 0))
+        state = state.replace(mig=mig.replace(weights=mweights,
+                                              slots=mslots))
+        # migrated ids are ANNEX rows: drop them from the main-table apply
+        # (count 0 leaves a row bit-identical — SparseOptimizer.apply) so an
+        # array table never scatters into the alien row `id // S` points at
+        rc = jnp.where(m_found, 0, rc)
     pair = rids.ndim == 2
     if spec.use_hash_table:
         from ..tables.hash_table import hash_find
@@ -686,7 +831,8 @@ def grouped_lookup_train(
                 for spec, state, ids in zip(specs, states, ids_list)]
     hots = [state.hot for state in states]
     plans = grouped_make_plans(specs, ids_list, axis=axis,
-                               capacity_factor=capacity_factor, hots=hots)
+                               capacity_factor=capacity_factor, hots=hots,
+                               migs=[state.mig for state in states])
     new_states, rows_list = [], []
     for spec, state, plan in zip(specs, states, plans):
         state, rows = _serve_rows(spec, state, plan, train=True, axis=axis)
@@ -727,6 +873,8 @@ def grouped_lookup_train(
         if plan.hot_slot is not None:
             st.update(_hot_pull_stats(spec, plan, flatten_ids(spec, ids),
                                       fmt))
+        if plan.mig_moved is not None:
+            st.update(_mig_pull_stats(plan))
         if load_stats:
             st.update(exchange_load_stats(plan, axis=axis))
         stats_list.append(st)
@@ -755,7 +903,8 @@ def grouped_apply_gradients(
                     for spec, state, ids in zip(specs, states, ids_list)]
         plans = grouped_make_plans(specs, ids_list, axis=axis,
                                    capacity_factor=capacity_factor,
-                                   hots=[state.hot for state in states])
+                                   hots=[state.hot for state in states],
+                                   migs=[state.mig for state in states])
     if packed_list is None:
         packed_list = [None] * len(specs)
     # client side: per-table duplicate pre-sum into the unique slots
@@ -963,6 +1112,156 @@ def hot_gather(spec: EmbeddingSpec, state: EmbeddingTableState,
                   weights=sel[:, :widths[0]].astype(state.weights.dtype),
                   slots=slots)
     return state.replace(hot=hot)
+
+
+# ---------------------------------------------------------------------------
+# Cold-tail re-sharding lifecycle: host-side directory construction + device-
+# side annex fill/writeback (inside shard_map; driven off the hot path by
+# MeshTrainer.migrate_rows / hot_sync between steps — static shapes, so
+# swapping directories never re-jits).
+# ---------------------------------------------------------------------------
+
+
+def build_mig_identity(spec: EmbeddingSpec, mig_rows: int, ids64=None,
+                       owners=None, *, num_shards: int,
+                       key_template=None) -> dict:
+    """Host-side identity of one table's migration set: the replicated
+    directory arrays `_mig_find` consumes — `keys` (C = 2M probe slots in the
+    table's key layout), `rank` (probe slot -> migration rank, M = empty),
+    `ids` (migrated ids by rank, padding EMPTY) and `owners` (assigned owner
+    shard by rank, padding -1).
+
+    `ids64`/`owners`: parallel arrays of candidate moves (int64 ids,
+    heaviest first; None/empty -> an all-EMPTY directory that routes nothing
+    off home). Invalid ids drop (negative; out-of-vocab for array tables),
+    as do moves whose owner falls outside [0, num_shards); duplicates keep
+    their first (heaviest) rank. Same probe-budget discipline as
+    `build_hot_identity`: an id the device probe cannot reach is never
+    placed."""
+    import numpy as np
+
+    from ..ops.id64 import np_split_ids
+    from ..tables.hash_table import np_fresh_keys, np_hash_insert
+    M = int(mig_rows)
+    C = max(2 * M, 8)
+    if spec.use_hash_table:
+        keys = np_fresh_keys(C, like=(np.asarray(key_template)
+                                      if key_template is not None else None))
+    else:
+        keys = np.full((C,), -1, np.int32)
+    pair = keys.ndim == 2
+    rank = np.full((C,), M, np.int32)
+    own_arr = np.full((M,), -1, np.int32)
+    if pair:
+        ids_arr = np.full((M, 2), np.uint32(0xFFFFFFFF), np.uint32)
+    else:
+        ids_arr = np.full((M,), -1, keys.dtype)
+    cand = np.asarray([] if ids64 is None else ids64, np.int64).reshape(-1)
+    cown = np.asarray([] if owners is None else owners,
+                      np.int64).reshape(-1)[:cand.size]
+    keep = (cand >= 0) & (cown >= 0) & (cown < num_shards)
+    if not spec.use_hash_table:
+        keep &= cand < spec.input_dim
+    cand, cown = cand[keep], cown[keep]
+    _, first = np.unique(cand, return_index=True)  # dedupe, keep heaviest
+    sel = np.sort(first)[:M]
+    cand, cown = cand[sel], cown[sel]
+    if cand.size:
+        ins = cand if (pair or keys.dtype.itemsize >= 8) \
+            else cand.astype(np.int32)  # host mixer must match device _mix
+        pos = np_hash_insert(keys, ins, 1, num_probes=HOT_NUM_PROBES)
+        placed = pos >= 0
+        kept, kown = cand[placed], cown[placed]
+        rank[pos[placed]] = np.arange(kept.size, dtype=np.int32)
+        own_arr[:kept.size] = kown.astype(np.int32)
+        if pair:
+            ids_arr[:kept.size] = np_split_ids(kept)
+        else:
+            ids_arr[:kept.size] = kept.astype(keys.dtype)
+    return {"keys": keys, "rank": rank, "ids": ids_arr, "owners": own_arr}
+
+
+def _mig_live_select(mig: MigRows, axis):
+    """All_gather every shard's annex and select each rank's LIVE copy (the
+    assigned owner's) -> (live (M, W) f32, slot column layout). The one
+    collective of the writeback path; pure bit movement, no float math."""
+    slot_names = sorted(mig.slots)
+    cols = [mig.weights.astype(jnp.float32)] + \
+        [mig.slots[k].astype(jnp.float32) for k in slot_names]
+    widths = [c.shape[1] for c in cols]
+    parts = jax.lax.all_gather(jnp.concatenate(cols, axis=1), axis)
+    S = parts.shape[0]
+    M = mig.ids.shape[0]
+    live = parts[jnp.clip(mig.owners, 0, S - 1), jnp.arange(M)]
+    return live, slot_names, widths
+
+
+# oelint: hot-path device_get=0
+def mig_writeback(spec: EmbeddingSpec, state: EmbeddingTableState, *,
+                  axis=DATA_AXIS) -> EmbeddingTableState:
+    """Restore the HOME-shard copies of every migrated row (weights AND
+    optimizer slots): ONE all_gather ships each shard's (M, W) annex, every
+    shard selects the assigned owner's live copy per rank, and each home
+    shard overwrites only the rows it natively owns (hash homes insert absent
+    ids so a row promoted straight into the annex still lands). After this
+    the main tables equal an unmigrated run bit for bit, so checkpoint/
+    export/delta readers see exactly what they would have without the
+    directory (`MeshTrainer.hot_sync` drives it at snapshot time;
+    `migrate_rows` before installing a new directory). The directory and
+    annex stay live."""
+    mig = state.mig
+    if mig is None:
+        return state
+    live, slot_names, widths = _mig_live_select(mig, axis)
+    state, src, _home = _hot_owner_route(spec, state, mig.ids, axis,
+                                         insert=spec.use_hash_table)
+    weights = state.weights.at[src].set(
+        live[:, :widths[0]].astype(state.weights.dtype), mode="drop")
+    off = widths[0]
+    slots = dict(state.slots)
+    for k, w in zip(slot_names, widths[1:]):
+        slots[k] = state.slots[k].at[src].set(
+            live[:, off:off + w].astype(state.slots[k].dtype), mode="drop")
+        off += w
+    return state.replace(weights=weights, slots=slots)
+
+
+# oelint: hot-path device_get=0
+def mig_gather(spec: EmbeddingSpec, state: EmbeddingTableState,
+               identity: dict, *, axis=DATA_AXIS) -> EmbeddingTableState:
+    """Install `identity`'s migration directory and fill the annex from the
+    HOME shards: each shard contributes the rows it natively owns (zeros
+    elsewhere), ONE all_gather ships the compact (M, W) contributions, and an
+    exact per-id select by home shard lands them — no floating-point
+    reduction, migration copies bits. Hash homes insert absent ids (same
+    rationale as `hot_gather`: a measured-heavy id the trainer never pulled
+    still gets a row, and `mig_writeback` always has a home slot to restore).
+    Every shard's annex starts with identical content; copies diverge as each
+    assigned owner trains its rows, and the owner-select in `mig_writeback`
+    is what makes that safe. Callers must writeback the OLD directory first
+    (`mig_writeback`) or its in-flight updates are lost."""
+    ids = identity["ids"]
+    state, src, home = _hot_owner_route(spec, state, ids, axis, insert=True)
+    w_c = lookup_rows(state.weights, src).astype(jnp.float32)
+    slot_names = sorted(state.slots)
+    cols = [w_c] + [lookup_rows(state.slots[k], src).astype(jnp.float32)
+                    for k in slot_names]
+    widths = [c.shape[1] for c in cols]
+    contrib = jnp.concatenate(cols, axis=1)
+    parts = jax.lax.all_gather(contrib, axis)          # (S, M, W)
+    S = parts.shape[0]
+    sel = parts[jnp.clip(home, 0, S - 1),
+                jnp.arange(ids.shape[0])]              # (M, W): home's copy
+    off = widths[0]
+    slots = {}
+    for k, w in zip(slot_names, widths[1:]):
+        slots[k] = sel[:, off:off + w].astype(state.slots[k].dtype)
+        off += w
+    mig = MigRows(keys=identity["keys"], rank=identity["rank"], ids=ids,
+                  owners=identity["owners"],
+                  weights=sel[:, :widths[0]].astype(state.weights.dtype),
+                  slots=slots)
+    return state.replace(mig=mig)
 
 
 # ---------------------------------------------------------------------------

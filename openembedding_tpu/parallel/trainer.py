@@ -22,12 +22,13 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..embedding import EmbeddingSpec, EmbeddingTableState, HotRows
+from ..embedding import EmbeddingSpec, EmbeddingTableState, HotRows, MigRows
 from ..model import EmbeddingModel, TrainState, Trainer, init_dense_slots
 from ..optimizers import SparseOptimizer
 from ..utils import metrics as _metrics
 from .mesh import DATA_AXIS, make_mesh
-from .sharded import (build_hot_identity, hot_gather, hot_writeback,
+from .sharded import (build_hot_identity, build_mig_identity, hot_gather,
+                      hot_writeback, mig_gather, mig_writeback,
                       sharded_apply_gradients, sharded_lookup,
                       sharded_lookup_train)
 
@@ -41,7 +42,8 @@ class MeshTrainer(Trainer):
                  wire: Optional[str] = None,
                  group_exchange: bool = True,
                  shard_stats: bool = True,
-                 hot_rows: "int | Dict[str, int]" = 0):
+                 hot_rows: "int | Dict[str, int]" = 0,
+                 mig_rows: "int | Dict[str, int]" = 0):
         super().__init__(model, optimizer, seed)
         self.mesh = mesh if mesh is not None else make_mesh()
         self.axis = self.mesh.axis_names[0]
@@ -82,7 +84,17 @@ class MeshTrainer(Trainer):
         # owner shards with `hot_sync()` (save/persist do it automatically).
         # Silently inert on 1-device meshes (the shard IS local there).
         self.hot_rows = hot_rows
+        # cold-tail migration annex capacity per table (int or {name: M};
+        # 0 = off). M spare rows per shard plus a replicated id -> owner
+        # directory let `migrate_rows` re-home up to M measured-heavy COLD
+        # rows per table off their `id % S` hash shard (`parallel/sharded.py`
+        # "COLD-TAIL RE-SHARDING") — contents swap between steps, shapes
+        # never, so a migration never re-jits. Silently inert on 1-device
+        # meshes, like hot_rows. Driven autonomously by
+        # `placement.PlacementController`.
+        self.mig_rows = mig_rows
         self._hot_fns: Dict[str, Any] = {}
+        self._mig_fns: Dict[str, Any] = {}
         self._train_step_fn = None
         self._eval_step_fn = None
 
@@ -171,20 +183,56 @@ class MeshTrainer(Trainer):
         return {n: s for n, s in self.model.ps_specs().items()
                 if self.hot_rows_for(n)}
 
+    # -- cold-tail re-sharding (owner-assignment indirection) ----------------
+
+    def mig_rows_for(self, name: str) -> int:
+        """Migration annex rows for one table (0 = off). Inert at mesh size 1
+        and for host-cached tables, same gates as `hot_rows_for`."""
+        if self.num_shards <= 1:
+            return 0
+        spec = self.model.specs.get(name)
+        if spec is None or spec.sparse_as_dense \
+                or spec.storage == "host_cached":
+            return 0
+        if isinstance(self.mig_rows, dict):
+            return int(self.mig_rows.get(name, 0))
+        return int(self.mig_rows)
+
+    @property
+    def mig_enabled(self) -> bool:
+        return any(self.mig_rows_for(n) for n in self.model.ps_specs())
+
+    def _mig_specs(self) -> Dict[str, EmbeddingSpec]:
+        return {n: s for n, s in self.model.ps_specs().items()
+                if self.mig_rows_for(n)}
+
     # -- sharding specs ------------------------------------------------------
 
     def _table_pspec(self, spec: EmbeddingSpec,
-                     hot: Optional[bool] = None) -> EmbeddingTableState:
-        """PartitionSpec pytree for one table's state. `hot` overrides whether
-        the replicated hot-cache subtree is included (default: iff the trainer
-        enables it for this table — the managed states always carry it then)."""
+                     hot: Optional[bool] = None,
+                     mig: Optional[bool] = None) -> EmbeddingTableState:
+        """PartitionSpec pytree for one table's state. `hot`/`mig` override
+        whether the hot-cache / migration subtrees are included (default: iff
+        the trainer enables them for this table — the managed states always
+        carry them then)."""
         if hot is None:
             hot = bool(self.hot_rows_for(spec.name))
+        if mig is None:
+            mig = bool(self.mig_rows_for(spec.name))
         hot_spec = None
         if hot:
             hot_spec = HotRows(
                 keys=P(), rank=P(), ids=P(), weights=P(),
                 slots={k: P() for k in
+                       self.opt_for(spec).slot_shapes(spec.output_dim)})
+        mig_spec = None
+        if mig:
+            # directory replicated (every source must route identically);
+            # annex SHARDED — each shard's M spare rows are its own
+            mig_spec = MigRows(
+                keys=P(), rank=P(), ids=P(), owners=P(),
+                weights=P(self.axis),
+                slots={k: P(self.axis) for k in
                        self.opt_for(spec).slot_shapes(spec.output_dim)})
         # row-sharded specs are spelled WITHOUT the trailing None (`P(axis)`,
         # not `P(axis, None)`): jit outputs carry the trimmed spelling, and
@@ -199,6 +247,7 @@ class MeshTrainer(Trainer):
             keys=P(self.axis) if spec.use_hash_table else None,
             overflow=P() if spec.use_hash_table else None,
             hot=hot_spec,
+            mig=mig_spec,
         )
 
     def _state_pspec_tree(self, state: TrainState):
@@ -263,7 +312,7 @@ class MeshTrainer(Trainer):
 
             shardings = jax.tree_util.tree_map(
                 lambda p: NamedSharding(mesh, p),
-                self._table_pspec(spec, hot=False),
+                self._table_pspec(spec, hot=False, mig=False),
                 is_leaf=lambda x: isinstance(x, P))
             ts = jax.jit(mk, out_shardings=shardings)()
             H = self.hot_rows_for(name)
@@ -279,23 +328,51 @@ class MeshTrainer(Trainer):
                     slots=opt.init_slots(H, spec.output_dim))
                 ts = ts.replace(hot=jax.device_put(
                     hot, NamedSharding(mesh, P())))
+            M = self.mig_rows_for(name)
+            if M:
+                # all-EMPTY directory (routes nothing off home) + zeroed
+                # annex; migrate_rows installs real moves later
+                ts = ts.replace(mig=self._empty_mig(spec, ts, M))
             tables[name] = ts
         return tables
+
+    def _empty_mig(self, spec: EmbeddingSpec, ts: EmbeddingTableState,
+                   M: int) -> MigRows:
+        mesh = self.mesh
+        ident = build_mig_identity(spec, M, num_shards=self.num_shards,
+                                   key_template=ts.keys)
+        rep = NamedSharding(mesh, P())
+        shd = NamedSharding(mesh, P(self.axis))
+        opt = self.opt_for(spec)
+        return MigRows(
+            keys=jax.device_put(jnp.asarray(ident["keys"]), rep),
+            rank=jax.device_put(jnp.asarray(ident["rank"]), rep),
+            ids=jax.device_put(jnp.asarray(ident["ids"]), rep),
+            owners=jax.device_put(jnp.asarray(ident["owners"]), rep),
+            weights=jax.device_put(
+                jnp.zeros((M * self.num_shards, spec.output_dim),
+                          spec.dtype), shd),
+            slots={k: jax.device_put(v, shd) for k, v in
+                   opt.init_slots(M * self.num_shards,
+                                  spec.output_dim).items()})
 
     # -- hot-set lifecycle (writeback / promote / demote off the hot path) ---
 
     def _hot_jit(self, mode: str):
         """Jitted shard_map over the hot tables for one lifecycle mode:
         'sync' (writeback only), 'refresh' (writeback + install new identity +
-        gather), 'fill' (gather into loaded states that carry no cache yet).
+        gather), 'fill' (gather into states that carry no cache yet).
         Shapes are static, so each mode compiles ONCE ever — promote/demote
-        is array-content swaps, never a re-jit."""
+        is array-content swaps, never a re-jit. Operates on tables with the
+        migration subtree STRIPPED (hot ops never touch it; callers reattach
+        it unchanged) so the compiled fns are placement-combination
+        agnostic."""
         if mode in self._hot_fns:
             return self._hot_fns[mode]
         specs = self._hot_specs()
-        tspec_in = {n: self._table_pspec(s, hot=(mode != "fill"))
+        tspec_in = {n: self._table_pspec(s, hot=(mode != "fill"), mig=False)
                     for n, s in specs.items()}
-        tspec_out = {n: self._table_pspec(s, hot=True)
+        tspec_out = {n: self._table_pspec(s, hot=True, mig=False)
                      for n, s in specs.items()}
         axis = self.axis
 
@@ -332,19 +409,141 @@ class MeshTrainer(Trainer):
                     "MeshTrainer.load to re-attach the cache)")
         return sub
 
+    @staticmethod
+    def _run_stripped(fn, sub, field, *extra):
+        """Run a lifecycle jit over `sub` with the OTHER placement subtree
+        (`field`: 'hot' or 'mig') stripped, reattaching it unchanged after —
+        hot ops never touch migration state and vice versa, so each compiled
+        fn stays agnostic to the other feature's on/off."""
+        kept = {n: getattr(ts, field) for n, ts in sub.items()}
+        stripped = {n: ts.replace(**{field: None}) for n, ts in sub.items()}
+        new = fn(stripped, *extra) if extra else fn(stripped)
+        return {n: ts.replace(**{field: kept[n]}) for n, ts in new.items()}
+
     def hot_sync(self, state: TrainState) -> TrainState:
-        """Write every replicated hot row (weights + optimizer slots) back
-        into its owner shard and return the updated state; the cache stays
-        live and authoritative. Call before handing raw table state to
-        anything outside the trainer (export, custom readers) — `save` and
-        the persisters (`persist.py`) call it automatically, which is what
-        keeps checkpoints/exports/sync deltas byte-identical to a hot-off
+        """The placement writeback hook: restore every row the placement
+        layer serves from somewhere other than its home shard — replicated
+        HOT rows scatter back into their owner shards, MIGRATED rows copy
+        back from their assigned owner's annex (one all_gather) — and return
+        the updated state; cache, directory and annex stay live and
+        authoritative. Call before handing raw table state to anything
+        outside the trainer (export, custom readers) — `save` and the
+        persisters (`persist.py`) call it automatically, which is what keeps
+        checkpoints/exports/sync deltas byte-identical to a placement-off
         run."""
-        if not self.hot_enabled:
+        if not self.hot_enabled and not self.mig_enabled:
             return state
-        new = self._hot_jit("sync")(self._hot_sub(state))
         tables = dict(state.tables)
-        tables.update(new)
+        if self.hot_enabled:
+            tables.update(self._run_stripped(
+                self._hot_jit("sync"), self._hot_sub(state), "mig"))
+        if self.mig_enabled:
+            sub = {n: tables[n] for n in self._mig_specs()
+                   if tables[n].mig is not None}
+            if sub:
+                tables.update(self._run_stripped(
+                    self._mig_jit("sync"), sub, "hot"))
+        return state.replace(tables=tables)
+
+    # -- cold-tail migration lifecycle ---------------------------------------
+
+    def _mig_jit(self, mode: str, names=None):
+        """Jitted shard_map over (a subset of) the migration tables for one
+        lifecycle mode: 'sync' (home writeback only), 'migrate' (writeback +
+        install new directory + fill annex), 'fill' (install into states
+        carrying no directory yet — load/attach). Compiles once per
+        (mode, table subset); directory swaps are content-only, never a
+        re-jit. Operates with the hot subtree STRIPPED (see `_hot_jit`)."""
+        specs = self._mig_specs()
+        if names is not None:
+            specs = {n: specs[n] for n in names}
+        key = (mode, tuple(sorted(specs)))
+        if key in self._mig_fns:
+            return self._mig_fns[key]
+        tspec_in = {n: self._table_pspec(s, hot=False, mig=(mode != "fill"))
+                    for n, s in specs.items()}
+        tspec_out = {n: self._table_pspec(s, hot=False, mig=True)
+                     for n, s in specs.items()}
+        axis = self.axis
+
+        if mode == "sync":
+            def fn(tables):
+                return {name: mig_writeback(spec, tables[name], axis=axis)
+                        for name, spec in specs.items()}
+            in_specs = (tspec_in,)
+        else:
+            def fn(tables, idents):
+                out = {}
+                for name, spec in specs.items():
+                    ts = tables[name]
+                    if mode == "migrate":
+                        ts = mig_writeback(spec, ts, axis=axis)
+                    out[name] = mig_gather(spec, ts, idents[name], axis=axis)
+                return out
+            in_specs = (tspec_in, {n: P() for n in specs})
+
+        sm = jax.shard_map(fn, mesh=self.mesh, in_specs=in_specs,
+                           out_specs=tspec_out, check_vma=False)
+        self._mig_fns[key] = jax.jit(sm)
+        return self._mig_fns[key]
+
+    @staticmethod
+    def _np_id_list(arr) -> "Any":
+        """Device id array ((M,) int or (M, 2) pair) -> valid int64 host ids."""
+        import numpy as np
+
+        from ..ops.id64 import HI_INVALID, np_join_ids
+        a = np.asarray(arr)
+        if a.ndim == 2:
+            return np_join_ids(a[a[:, 0] < HI_INVALID])
+        return a[a >= 0].astype(np.int64)
+
+    def migrate_rows(self, state: TrainState, moves=None) -> TrainState:
+        """Re-home up to `mig_rows` measured-heavy COLD rows per table
+        between steps: write the OLD migrated rows back to their home shards,
+        install the new directory, and fill the annex from the homes (bit
+        copies both ways — a migration never perturbs training values).
+
+        `moves`: {table: (ids, owners)} — parallel arrays, heaviest first
+        (`placement.plan_migration` produces them from the sketches + the
+        per-shard load vectors). Missing tables / None install an all-EMPTY
+        directory (= de-migrate everything). Ids currently in a table's HOT
+        set are dropped: hot and migrated sets stay disjoint — a replicated
+        row has no single owner to migrate. Static shapes: a migration NEVER
+        re-jits the step."""
+        if not self.mig_enabled:
+            return state
+        import numpy as np
+        moves = moves or {}
+        idents, fill, migrate = {}, [], []
+        for name, spec in self._mig_specs().items():
+            M = self.mig_rows_for(name)
+            ids, owners = moves.get(name) or (None, None)
+            ts = state.tables[name]
+            if ids is not None and ts.hot is not None:
+                hot_now = set(self._np_id_list(ts.hot.ids).tolist())
+                ids = np.asarray(ids, np.int64).reshape(-1)
+                owners = np.asarray(owners, np.int64).reshape(-1)[:ids.size]
+                keep = np.asarray([i not in hot_now for i in ids.tolist()],
+                                  bool) if hot_now else np.ones(ids.shape,
+                                                                bool)
+                ids, owners = ids[keep], owners[keep]
+            ident = build_mig_identity(spec, M, ids, owners,
+                                       num_shards=self.num_shards,
+                                       key_template=ts.keys)
+            idents[name] = ident
+            placed = int((np.asarray(ident["rank"]) < M).sum())
+            _metrics.observe("placement.migrated_rows", float(placed),
+                             "gauge", labels={"table": name})
+            (migrate if ts.mig is not None else fill).append(name)
+        _metrics.observe("placement.migrations", 1)
+        tables = dict(state.tables)
+        for mode, names in (("migrate", migrate), ("fill", fill)):
+            if names:
+                sub = {n: tables[n] for n in names}
+                tables.update(self._run_stripped(
+                    self._mig_jit(mode, names), sub, "hot",
+                    {n: idents[n] for n in names}))
         return state.replace(tables=tables)
 
     def refresh_hot_rows(self, state: TrainState, hot_ids=None,
@@ -361,7 +560,14 @@ class MeshTrainer(Trainer):
         (`tools/skew_report.py` / the /statusz hot-id table); refresh on a
         coarse cadence (e.g. every few hundred steps) — under
         `SpaceSaving(decay=...)` the sketch itself rotates with the
-        workload. Static shapes: a refresh NEVER re-jits the step."""
+        workload. Static shapes: a refresh NEVER re-jits the step.
+
+        Candidates currently in a table's MIGRATION directory are skipped
+        (hot and migrated sets stay disjoint — de-migrate via `migrate_rows`
+        first to promote one; `placement.PlacementController` orders the two
+        that way). Tables whose state carries no cache yet (hot_rows enabled
+        after init) are filled in place — same machinery as `load`'s
+        re-attach."""
         if not self.hot_enabled:
             return state
         import numpy as np
@@ -378,14 +584,26 @@ class MeshTrainer(Trainer):
                 cand = np.asarray(
                     [h for h, _est, _err in mon.sketch(name).topk(H)],
                     np.int64)
+            ts = state.tables[name]
+            if ts.mig is not None and cand.size:
+                migrated = set(self._np_id_list(ts.mig.ids).tolist())
+                if migrated:
+                    cand = np.asarray(
+                        [i for i in cand.reshape(-1).tolist()
+                         if i not in migrated], np.int64)
             ident = build_hot_identity(spec, H, cand,
-                                       key_template=state.tables[name].keys)
+                                       key_template=ts.keys)
             idents[name] = ident
             _metrics.observe("hot.set_size",
                              float(int((np.asarray(ident["rank"]) < H).sum())),
                              "gauge", labels={"table": name})
         _metrics.observe("hot.refreshes", 1)
-        new = self._hot_jit("refresh")(self._hot_sub(state), idents)
+        sub = self._hot_sub(state, need_hot=False)
+        missing = [n for n, ts in sub.items() if ts.hot is None]
+        if missing and len(missing) != len(sub):
+            self._hot_sub(state)  # raises with the managed-state message
+        mode = "fill" if missing else "refresh"
+        new = self._run_stripped(self._hot_jit(mode), sub, "mig", idents)
         tables = dict(state.tables)
         tables.update(new)
         return state.replace(tables=tables)
@@ -395,26 +613,52 @@ class MeshTrainer(Trainer):
         plain table states (the cache is never serialized), so this re-attaches
         the PRE-load hot identity (or an empty one) and re-GATHERS its rows
         from the loaded shards — the stale pre-load cache values are never
-        written back."""
+        written back. Migration directories re-attach the same way: the
+        PRE-load id -> owner assignment is re-installed and the annex
+        re-fills from the loaded home shards (which the checkpoint holds in
+        their written-back, authoritative form)."""
         loaded = super().load(state, path)
-        if not self.hot_enabled:
-            return loaded
-        idents = {}
-        for name, spec in self._hot_specs().items():
-            old = state.tables.get(name)
-            old_hot = old.hot if old is not None else None
-            if old_hot is not None:
-                idents[name] = {"keys": old_hot.keys, "rank": old_hot.rank,
-                                "ids": old_hot.ids}
-            else:
-                idents[name] = build_hot_identity(
-                    spec, self.hot_rows_for(name), None,
-                    key_template=loaded.tables[name].keys)
-        sub = {n: loaded.tables[n].replace(hot=None) for n in idents}
-        new = self._hot_jit("fill")(sub, idents)
-        tables = dict(loaded.tables)
-        tables.update(new)
-        return loaded.replace(tables=tables)
+        if self.hot_enabled:
+            idents = {}
+            for name, spec in self._hot_specs().items():
+                old = state.tables.get(name)
+                old_hot = old.hot if old is not None else None
+                if old_hot is not None:
+                    idents[name] = {"keys": old_hot.keys,
+                                    "rank": old_hot.rank,
+                                    "ids": old_hot.ids}
+                else:
+                    idents[name] = build_hot_identity(
+                        spec, self.hot_rows_for(name), None,
+                        key_template=loaded.tables[name].keys)
+            sub = {n: loaded.tables[n].replace(hot=None) for n in idents}
+            new = self._run_stripped(self._hot_jit("fill"), sub, "mig",
+                                     idents)
+            tables = dict(loaded.tables)
+            tables.update(new)
+            loaded = loaded.replace(tables=tables)
+        if self.mig_enabled:
+            idents = {}
+            for name, spec in self._mig_specs().items():
+                old = state.tables.get(name)
+                old_mig = old.mig if old is not None else None
+                if old_mig is not None:
+                    idents[name] = {"keys": old_mig.keys,
+                                    "rank": old_mig.rank,
+                                    "ids": old_mig.ids,
+                                    "owners": old_mig.owners}
+                else:
+                    idents[name] = build_mig_identity(
+                        spec, self.mig_rows_for(name),
+                        num_shards=self.num_shards,
+                        key_template=loaded.tables[name].keys)
+            sub = {n: loaded.tables[n].replace(mig=None) for n in idents}
+            new = self._run_stripped(
+                self._mig_jit("fill", sorted(idents)), sub, "hot", idents)
+            tables = dict(loaded.tables)
+            tables.update(new)
+            loaded = loaded.replace(tables=tables)
+        return loaded
 
     # -- per-device hooks (run inside shard_map) -----------------------------
 
@@ -538,6 +782,14 @@ class MeshTrainer(Trainer):
                              labels={"table": name})
             _metrics.observe("exchange.bucket_capacity", float(cap), "gauge",
                              labels={"table": name})
+            # row dim per table: lets offline consumers (tools/skew_report.py
+            # --recommend) price hot/migrated rows from one /metrics scrape
+            _metrics.observe("exchange.row_dim", float(spec.output_dim),
+                             "gauge", labels={"table": name})
+            M = self.mig_rows_for(name)
+            if M:
+                _metrics.observe("placement.mig_rows", float(M), "gauge",
+                                 labels={"table": name})
         # the per-table fallback protocol always ships fp32 payloads
         fmt = (wire_mod.wire_format(self.wire) if self.group_exchange
                else "fp32")
@@ -687,7 +939,8 @@ class SeqMeshTrainer(MeshTrainer):
     def __init__(self, model, optimizer=None, *, mesh: Mesh, seed: int = 0,
                  capacity_factor: float = 0.0, wire: Optional[str] = None,
                  group_exchange: bool = True, shard_stats: bool = True,
-                 hot_rows: "int | Dict[str, int]" = 0):
+                 hot_rows: "int | Dict[str, int]" = 0,
+                 mig_rows: "int | Dict[str, int]" = 0):
         if len(mesh.axis_names) != 2:
             raise ValueError(
                 f"SeqMeshTrainer needs a 2-D (data, seq) mesh, got axes "
@@ -695,7 +948,8 @@ class SeqMeshTrainer(MeshTrainer):
         super().__init__(model, optimizer, mesh=mesh, seed=seed,
                          capacity_factor=capacity_factor, wire=wire,
                          group_exchange=group_exchange,
-                         shard_stats=shard_stats, hot_rows=hot_rows)
+                         shard_stats=shard_stats, hot_rows=hot_rows,
+                         mig_rows=mig_rows)
         self.data_axis, self.seq_axis = mesh.axis_names
         # collectives (sparse exchange, psum, metrics) span the flattened mesh
         self.axis = tuple(mesh.axis_names)

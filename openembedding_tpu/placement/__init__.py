@@ -1,0 +1,38 @@
+"""Self-driving placement: the control plane that closes the telemetry ->
+placement loop (ROADMAP "Self-driving placement").
+
+Rounds 9-10 built the measurement half of Parallax-style hybrid placement
+(arXiv:1808.02621): heavy-hitter sketches with coverage curves
+(`utils/sketch.py`), per-shard load vectors computed inside the jitted
+exchange (`parallel/sharded.exchange_load_stats`), and the mechanisms —
+`MeshTrainer(hot_rows=...)` replication plus the round-11 cold-tail
+migration directory (`mig_rows=...`). This package removes the operator
+from the loop:
+
+- `PlacementPolicy` (policy.py) — pure numpy decision math: sizes each
+  table's hot set from its coverage curve against ONE replicated-byte
+  budget (budget flows to the most skewed tables), gates refreshes on
+  predicted hit-ratio gain with hysteresis + cooldown, and decides when
+  the cold tail needs re-sharding.
+- `plan_migration` (migration.py) — the balancer: turns measured per-shard
+  load vectors + heavy-but-not-hot ids into an explicit id -> owner move
+  list that flattens `exchange.shard_imbalance` toward 1.0.
+- `PlacementController` (controller.py) — the driver: watches the sketches
+  (optionally on a background thread), applies refreshes via
+  `MeshTrainer.refresh_hot_rows` and migrations via
+  `MeshTrainer.migrate_rows` between steps, and exports `placement.*`
+  gauges + flight-recorder events for every decision. `/statusz` renders
+  its status; `tools/skew_report.py --recommend` runs the same policy
+  dry-run offline from any /metrics scrape.
+
+Everything here runs OFF the hot path; the applied mechanisms are
+content-swaps of trace-time-static arrays, so the steady-state jitted step
+never recompiles (tests/test_placement.py pins it with `trace_counter`).
+"""
+
+from .controller import PlacementController, render_status
+from .migration import plan_migration
+from .policy import PlacementPolicy, TableTelemetry
+
+__all__ = ["PlacementController", "PlacementPolicy", "TableTelemetry",
+           "plan_migration", "render_status"]

@@ -1,0 +1,451 @@
+"""PlacementController: drives hot-cache sizing, refresh pacing and
+cold-tail migration with no operator in the loop.
+
+Division of labour (nothing here touches the jitted step):
+
+- A background WATCHER thread (optional, `start()`) snapshots the sketches
+  + metrics on a wall-clock cadence, runs the policy, and parks the
+  resulting `PlacementDecision`. It never touches trainer state — JAX
+  state threading is functional, so only the training loop may swap it.
+- The training loop calls `on_step(state, step)` between steps (cheap: an
+  int compare off-cadence). On the decision cadence — or when the watcher
+  parked a decision — it applies refreshes via
+  `MeshTrainer.refresh_hot_rows` and migrations via
+  `MeshTrainer.migrate_rows`, both content-swaps of trace-time-static
+  arrays: the steady-state step NEVER recompiles.
+- `prime(state)` runs once before the step is jitted: it sizes each
+  table's static hot capacity (and the migration annex) from the policy's
+  byte budget and attaches the placement state. Sizing changes shapes, so
+  this is the ONE moment re-jitting is allowed — prime before
+  `jit_train_step`, or accept one recompile when enabling placement on a
+  live trainer.
+
+Every decision exports `placement.*` gauges and a flight-recorder event
+(`utils/trace.py`), and `render_status()` feeds the `/statusz` placement
+panel, so "why did the controller refresh at step 1200?" is answerable
+from the node itself.
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..utils import metrics as _metrics
+from ..utils import trace as _trace
+from .migration import candidate_weights, plan_migration
+from .policy import PlacementDecision, PlacementPolicy, TableDecision, \
+    TableTelemetry
+
+# live controllers for the /statusz panel (weakrefs: a controller's
+# lifetime belongs to its owner, not to the status page)
+_CONTROLLERS: "List[weakref.ref]" = []
+_CONTROLLERS_LOCK = threading.Lock()
+
+
+def _controllers() -> List["PlacementController"]:
+    with _CONTROLLERS_LOCK:
+        alive = [r() for r in _CONTROLLERS]
+        _CONTROLLERS[:] = [r for r, c in zip(_CONTROLLERS, alive)
+                           if c is not None]
+        return [c for c in alive if c is not None]
+
+
+def render_status() -> str:
+    """The /statusz placement panel: one block per live controller."""
+    ctrls = _controllers()
+    if not ctrls:
+        return "(no placement controllers)"
+    return "\n".join(c.render_text() for c in ctrls)
+
+
+class PlacementController:
+    """Autonomous placement driver for one `MeshTrainer`.
+
+    `monitor`: the `SkewMonitor` feeding the decisions (defaults to the
+    trainer's `enable_skew_monitor()` feed, falling back to the global
+    `utils.sketch.MONITOR`). Give it `decay=` so a drifting workload
+    rotates the sketches — the controller only ever sees what the sketches
+    see. `interval_steps`: decision cadence for the inline `on_step` path.
+    """
+
+    def __init__(self, trainer, policy: PlacementPolicy, *,
+                 monitor=None, interval_steps: int = 50):
+        self.trainer = trainer
+        self.policy = policy
+        self._monitor = monitor
+        self.interval_steps = int(interval_steps)
+        self._lock = threading.Lock()
+        # guarded-by: self._lock
+        self._pending: Optional[PlacementDecision] = None
+        # guarded-by: self._lock
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._primed = False
+        # decision history for /statusz (guarded-by: self._lock)
+        self._last_refresh_step: Dict[str, int] = {}
+        self._last_refresh_reason: Dict[str, str] = {}
+        self._hot_target: Dict[str, int] = {}
+        self._predicted_hit: Dict[str, float] = {}
+        self._migrations_applied = 0
+        self._migrated_rows: Dict[str, int] = {}
+        self._decisions = 0
+        self._step = 0
+        with _CONTROLLERS_LOCK:
+            _CONTROLLERS.append(weakref.ref(self))
+
+    # -- telemetry -----------------------------------------------------------
+
+    @property
+    def monitor(self):
+        if self._monitor is not None:
+            return self._monitor
+        mon = getattr(self.trainer, "_skew", None)
+        if mon is not None:
+            return mon
+        from ..utils import sketch
+        return sketch.MONITOR
+
+    def _managed_tables(self) -> Dict[str, object]:
+        return {n: s for n, s in self.trainer.model.ps_specs().items()
+                if not s.sparse_as_dense and s.storage != "host_cached"}
+
+    def _shard_positions(self, name: str) -> Optional[np.ndarray]:
+        """Measured per-shard load from the published gauges (the loop's
+        `metrics.record_step_stats` keeps them fresh each step)."""
+        import re
+        rep = _metrics.report()
+        pat = re.compile(
+            r'^exchange\.shard_positions\{shard="(\d+)",table="%s"\}$'
+            % re.escape(name))
+        vals = {}
+        for key, v in rep.items():
+            m = pat.match(key)
+            if m:
+                vals[int(m.group(1))] = v
+        if not vals:
+            return None
+        return np.asarray([vals.get(i, 0.0)
+                           for i in range(max(vals) + 1)], np.float64)
+
+    def telemetry(self) -> List[TableTelemetry]:
+        mon = self.monitor
+        out = []
+        for name, spec in self._managed_tables().items():
+            sk = mon.sketch(name)
+            # optimizer-slot floats per row, as weight-column multiples
+            # (Adagrad: one accumulator column per weight column -> 1)
+            widths = self.trainer.opt_for(spec).slot_shapes(spec.output_dim)
+            slot_cols = int(round(sum(int(v) for v in widths.values())
+                                  / max(spec.output_dim, 1)))
+            out.append(TableTelemetry(
+                name=name, dim=spec.output_dim,
+                coverage=sk.coverage(),
+                total=float(sk.total),
+                top_ids=[(i, e) for i, e, _err in sk.topk()],
+                shard_positions=self._shard_positions(name),
+                slot_cols=slot_cols))
+        return out
+
+    # -- sizing / prime ------------------------------------------------------
+
+    def prime(self, state):
+        """Size the static placement capacities from the byte budget and
+        attach placement state — call ONCE, before jitting the step (the
+        only shape-changing moment; everything after is content swaps).
+        Needs warm sketches: feed the monitor a few batches first (or let
+        the first training window run placement-off and prime at its end).
+        Returns the state with hot caches + migration directories
+        attached."""
+        tel = self.telemetry()
+        sizes = self.policy.size_hot(tel)
+        hot_rows = {n: int(h) for n, h in sizes.items() if h > 0}
+        mig_rows = {n: self.policy.mig_rows for n in self._managed_tables()}
+        tr = self.trainer
+        changed = False
+        for attr, val in (("hot_rows", hot_rows), ("mig_rows", mig_rows)):
+            cur = getattr(tr, attr)
+            cur_map = {n: (cur.get(n, 0) if isinstance(cur, dict)
+                           else int(cur)) for n in self._managed_tables()}
+            new_map = {n: val.get(n, 0) for n in self._managed_tables()}
+            if cur_map != new_map:
+                setattr(tr, attr, val)
+                changed = True
+        if changed:
+            # capacities are trace-time shapes: drop compiled artifacts so
+            # the NEXT jit builds the placement-enabled program (this is the
+            # documented one-time re-jit; prime before jit_train_step and
+            # it is the only compile at all)
+            tr._train_step_fn = None
+            tr._eval_step_fn = None
+            tr._train_many_fn = None
+            tr._hot_fns = {}
+            tr._mig_fns = {}
+        self._hot_target = dict(hot_rows)
+        for n, h in hot_rows.items():
+            _metrics.observe("placement.hot_rows", float(h), "gauge",
+                             labels={"table": n})
+        _trace.event("placement", "prime",
+                     hot_rows=dict(hot_rows),
+                     mig_rows=self.policy.mig_rows,
+                     budget_bytes=self.policy.hot_budget_bytes)
+        if tr.mig_enabled:
+            state = tr.migrate_rows(state)  # attach empty directories
+        if tr.hot_enabled:
+            state = tr.refresh_hot_rows(state, monitor=self.monitor)
+            with self._lock:
+                for n in hot_rows:
+                    self._last_refresh_step[n] = self._step
+                    self._last_refresh_reason[n] = "prime"
+        self._primed = True
+        return state
+
+    # -- decide --------------------------------------------------------------
+
+    def decide(self, state=None) -> PlacementDecision:
+        """Run the policy over current telemetry -> a decision (no state
+        mutation; `apply` installs it). `state` supplies the installed hot
+        sets for churn/gain math; without it the installed set is assumed
+        empty (dry-run mode — what skew_report --recommend prints)."""
+        tel = self.telemetry()
+        sizes = dict(self._hot_target) or self.policy.size_hot(tel)
+        tables: Dict[str, TableDecision] = {}
+        refresh = migrate = False
+        reasons = []
+        for t in tel:
+            H = int(sizes.get(t.name, 0))
+            installed = np.zeros((0,), np.int64)
+            mig_installed = None
+            if state is not None:
+                ts = state.tables.get(t.name)
+                if ts is not None and ts.hot is not None:
+                    installed = self.trainer._np_id_list(ts.hot.ids)
+                if ts is not None and ts.mig is not None:
+                    mig_installed = self.trainer._np_id_list(ts.mig.ids)
+            with self._lock:
+                since = self._step - self._last_refresh_step.get(
+                    t.name, -10**9)
+            due, reason, gain = self.policy.refresh_due(
+                t, installed, H, since)
+            churn = self.policy.churn(installed, t.top_ids[:H])
+            _metrics.observe("placement.churn", churn, "gauge",
+                             labels={"table": t.name})
+            _metrics.observe("placement.predicted_hit_gain", gain, "gauge",
+                             labels={"table": t.name})
+            hot_ids = np.asarray([i for i, _e in t.top_ids[:H]], np.int64)
+            mig_due, mig_reason = self.policy.migration_due(t)
+            moves = (np.zeros((0,), np.int64), np.zeros((0,), np.int64))
+            if mig_due and t.shard_positions is not None:
+                # Plan the FULL directory from the sketch-derived EXPECTED
+                # load, not the measured snapshot. The measured vector
+                # already reflects the active directory (a fresh plan from
+                # it would find nothing and installing that would de-
+                # migrate the rows doing the balancing), and any one step's
+                # sample is noisy enough that planning against it churns
+                # assignments every cycle. Instead build the un-migrated
+                # picture the sketch predicts — per-candidate load
+                # `est/cold_total` of the measured cold positions on its
+                # hash home, the un-tracked tail uniform — and solve that.
+                # Deterministic given the sketch: when converged the plan
+                # reproduces the current assignment (install skipped), a
+                # drifted-out id stops being a candidate (evicted, its
+                # annex slot freed for the new head), and a past move
+                # whose owner has become the hot spot is re-assigned
+                # rather than pinned forever. The measured vector stays
+                # the TRIGGER (`migration_due`); the model is the plan.
+                S = self.trainer.num_shards
+                cur = {}
+                if state is not None:
+                    ts = state.tables.get(t.name)
+                    if ts is not None and ts.mig is not None:
+                        cur_ids = self.trainer._np_id_list(ts.mig.ids)
+                        cur_own = np.asarray(ts.mig.owners)[:cur_ids.size]
+                        cur = {i: int(o) for i, o in
+                               zip(cur_ids.tolist(), cur_own.tolist())
+                               if int(o) >= 0}
+                cands = candidate_weights(t.top_ids, hot_ids)
+                step_load = float(np.asarray(t.shard_positions,
+                                             np.float64).sum())
+                hot_set = set(hot_ids.tolist())
+                hot_est = sum(float(e) for i, e in t.top_ids
+                              if int(i) in hot_set)
+                cold_tot = max(t.total - hot_est, 1.0)
+                w_steps = [max(float(w), 0.0) / cold_tot * step_load
+                           for _i, w in cands]
+                tail = max(step_load - sum(w_steps), 0.0)
+                base = np.full((S,), tail / S, np.float64)
+                for (i, _w), ws in zip(cands, w_steps):
+                    base[int(i) % S] += ws
+                ids, owners, proj = plan_migration(
+                    base, cands, num_shards=S,
+                    max_moves=self.policy.mig_rows,
+                    target=self.policy.imbalance_target,
+                    total=cold_tot, exclude=hot_ids)
+                moves = (ids, owners)
+                if dict(zip(ids.tolist(), owners.tolist())) == cur:
+                    # converged: the plan reproduces the active directory —
+                    # skip the install rather than churning the annex
+                    moves = None
+                    mig_due = False
+                    mig_reason += " (plan unchanged)"
+                else:
+                    mig_reason += (f"; {ids.size} moves, projected "
+                                   f"imbalance {proj:.3f}")
+            elif mig_installed is not None and mig_installed.size \
+                    and not mig_due:
+                # keep the current directory: re-planning to empty would
+                # de-migrate a balanced steady state
+                moves = None
+            tables[t.name] = TableDecision(
+                hot_rows=H,
+                predicted_hit=t.share_at(H),
+                hot_ids=hot_ids,
+                moves=moves,
+                reason=f"refresh: {reason}; migrate: {mig_reason}")
+            refresh |= due
+            migrate |= mig_due
+            reasons.append(f"{t.name}: {tables[t.name].reason}")
+        with self._lock:
+            self._decisions += 1
+        _metrics.observe("placement.decisions", 1)
+        return PlacementDecision(tables=tables, refresh=refresh,
+                                 migrate=migrate, reason=" | ".join(reasons))
+
+    # -- apply ---------------------------------------------------------------
+
+    def apply(self, state, decision: PlacementDecision):
+        """Install a decision between steps (content swaps only)."""
+        tr = self.trainer
+        if decision.migrate and tr.mig_enabled:
+            moves = {n: d.moves for n, d in decision.tables.items()
+                     if d.moves is not None and d.moves[0].size}
+            if moves:
+                state = tr.migrate_rows(state, moves)
+                with self._lock:
+                    self._migrations_applied += 1
+                    for n, (ids, _o) in moves.items():
+                        self._migrated_rows[n] = int(ids.size)
+                _trace.event("placement", "migrate", step=self._step,
+                             rows={n: int(m[0].size)
+                                   for n, m in moves.items()})
+        if decision.refresh and tr.hot_enabled:
+            hot_ids = {n: d.hot_ids[:d.hot_rows]
+                       for n, d in decision.tables.items() if d.hot_rows}
+            state = tr.refresh_hot_rows(state, hot_ids=hot_ids)
+            with self._lock:
+                for n, d in decision.tables.items():
+                    self._last_refresh_step[n] = self._step
+                    self._last_refresh_reason[n] = d.reason
+                    self._predicted_hit[n] = d.predicted_hit
+            for n, d in decision.tables.items():
+                _metrics.observe("placement.predicted_hit",
+                                 d.predicted_hit, "gauge",
+                                 labels={"table": n})
+            _metrics.observe("placement.refreshes", 1)
+            _trace.event("placement", "refresh", step=self._step,
+                         reason=decision.reason[:200])
+        return state
+
+    # -- loop hooks ----------------------------------------------------------
+
+    def on_step(self, state, step: Optional[int] = None):
+        """Call between training steps. Off-cadence this is an int compare;
+        on cadence (or when the watcher parked a decision) it decides +
+        applies. Returns the (possibly updated) state."""
+        self._step = int(step) if step is not None else self._step + 1
+        with self._lock:
+            pending, self._pending = self._pending, None
+        if pending is None:
+            if self.interval_steps <= 0 or \
+                    self._step % self.interval_steps != 0:
+                return state
+            pending = self.decide(state)
+        return self.apply(state, pending)
+
+    # -- background watcher --------------------------------------------------
+
+    def start(self, interval_s: float = 5.0) -> None:
+        """Start the watcher thread: computes decisions off the training
+        thread on a wall-clock cadence and parks them for the next
+        `on_step` to apply. Idempotent."""
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._watch, args=(float(interval_s),), daemon=True,
+                name="oetpu-placement-controller")
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._lock:
+            t = self._thread
+        if t is not None:
+            t.join(timeout=10)
+
+    def _watch(self, interval_s: float) -> None:
+        while not self._stop.wait(interval_s):
+            try:
+                # watcher decides WITHOUT state (installed sets unknown ->
+                # gain is an upper bound); on_step applies under the real
+                # cooldown bookkeeping
+                decision = self.decide()
+                if decision.refresh or decision.migrate:
+                    with self._lock:
+                        self._pending = decision
+            except Exception:  # noqa: BLE001 — telemetry must never crash
+                _metrics.observe("placement.watch_errors", 1)
+
+    # -- operator surface ----------------------------------------------------
+
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                "primed": self._primed,
+                "step": self._step,
+                "interval_steps": self.interval_steps,
+                "hot_budget_bytes": self.policy.hot_budget_bytes,
+                "hot_rows": dict(self._hot_target),
+                "predicted_hit": dict(self._predicted_hit),
+                "last_refresh_step": dict(self._last_refresh_step),
+                "last_refresh_reason": dict(self._last_refresh_reason),
+                "migrations_applied": self._migrations_applied,
+                "migrated_rows": dict(self._migrated_rows),
+                "decisions": self._decisions,
+                "imbalance_target": self.policy.imbalance_target,
+            }
+
+    def render_text(self) -> str:
+        st = self.status()
+        lines = [f"controller: step={st['step']} primed={st['primed']} "
+                 f"decisions={st['decisions']} "
+                 f"budget={st['hot_budget_bytes']}B "
+                 f"imbalance_target={st['imbalance_target']}"]
+        import re
+        rep = _metrics.report()
+        for name in sorted(self._managed_tables()):
+            h = st["hot_rows"].get(name, 0)
+            imb = rep.get(f'exchange.shard_imbalance{{table="{name}"}}')
+            hit = rep.get(f'hot.hit_ratio{{table="{name}"}}')
+            parts = [f"table {name}: hot_rows={h}"]
+            if st["predicted_hit"].get(name) is not None:
+                parts.append(
+                    f"predicted_hit={st['predicted_hit'][name]:.3f}")
+            if hit is not None:
+                parts.append(f"live_hit={hit:.3f}")
+            if st["last_refresh_step"].get(name) is not None:
+                reason = re.sub(r"\s+", " ", st["last_refresh_reason"]
+                                .get(name, ""))[:120]
+                parts.append(f"last_refresh=step {st['last_refresh_step'][name]}"
+                             f" ({reason})")
+            if st["migrated_rows"].get(name):
+                parts.append(f"migrated_rows={st['migrated_rows'][name]}")
+            if imb is not None:
+                parts.append(f"imbalance={imb:.3f}")
+            lines.append("  " + " ".join(parts))
+        lines.append(f"  migrations_applied={st['migrations_applied']}")
+        return "\n".join(lines)

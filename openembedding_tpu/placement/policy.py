@@ -1,0 +1,220 @@
+"""Placement decision math — pure numpy, no jax, no side effects.
+
+Everything here maps MEASURED telemetry (sketch coverage curves, per-shard
+load vectors, the live hot-cache hit ratio) to placement decisions. The
+functions are deliberately free of trainer/serving dependencies so the same
+policy runs three ways: live inside `PlacementController`, dry-run from a
+/metrics scrape (`tools/skew_report.py --recommend`), and in unit tests with
+synthetic curves.
+
+Budget semantics: `hot_budget_bytes` bounds the PER-DEVICE bytes of
+replicated hot-row payload — sum over tables of H_t rows x row_bytes_t
+(fp32 weights + fp32 optimizer-slot columns; the thing every device carries
+a copy of AND the backward's dense psum ships every step). The solver walks
+each table's coverage curve and spends the budget on the segments with the
+highest traffic-per-byte — the knee of a heavily skewed table beats the
+head of a flat one, which is exactly "budget flows to the most skewed
+tables".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def row_bytes(dim: int, slot_cols: int = 1) -> int:
+    """Replicated bytes one cached row costs: fp32 weights + fp32 optimizer
+    slot columns (Adagrad: one accumulator column per weight column)."""
+    return 4 * dim * (1 + slot_cols)
+
+
+@dataclasses.dataclass
+class TableTelemetry:
+    """One table's measured inputs to the policy (built from live sketches
+    by the controller, or from a /metrics scrape by skew_report)."""
+
+    name: str
+    dim: int
+    # coverage curve [(k, cumulative traffic share of the top-k ids)] —
+    # `SpaceSaving.coverage()`; monotone, <= 1.0
+    coverage: List[Tuple[int, float]]
+    total: float = 0.0                 # ids observed (the share denominator)
+    # heavy hitters [(id, est)] hottest-first (the promotion candidates)
+    top_ids: List[Tuple[int, int]] = dataclasses.field(default_factory=list)
+    # per-shard duplicate-weighted load (exchange.shard_positions); None
+    # until the trainer has published a step's stats
+    shard_positions: Optional[np.ndarray] = None
+    slot_cols: int = 1
+
+    def share_at(self, k: int) -> float:
+        """Interpolated cumulative traffic share of the top-k ids."""
+        if k <= 0 or not self.coverage:
+            return 0.0
+        pts = [(0, 0.0)] + [(int(a), float(b)) for a, b in self.coverage]
+        for (k0, s0), (k1, s1) in zip(pts, pts[1:]):
+            if k <= k1:
+                if k1 == k0:
+                    return s1
+                return s0 + (s1 - s0) * (k - k0) / (k1 - k0)
+        return pts[-1][1]
+
+
+@dataclasses.dataclass
+class TableDecision:
+    hot_rows: int                     # ids to install in the hot cache
+    predicted_hit: float              # sketch-predicted hit ratio at that H
+    hot_ids: np.ndarray               # the ids, hottest first
+    moves: Tuple[np.ndarray, np.ndarray]  # (ids, owners) for migrate_rows
+    reason: str = ""
+
+
+@dataclasses.dataclass
+class PlacementDecision:
+    tables: Dict[str, TableDecision]
+    refresh: bool                     # install the hot sets this cycle?
+    migrate: bool                     # install the move lists this cycle?
+    reason: str = ""
+
+
+class PlacementPolicy:
+    """Sizing + hysteresis rules. Stateless: every method is a pure function
+    of its telemetry arguments, so the controller (and the dry-run tool) own
+    all bookkeeping.
+
+    - `hot_budget_bytes`: the ONE knob operators must set — per-device
+      replicated-cache byte budget (see module doc).
+    - `mig_rows`: migration annex capacity per table (static; contents
+      rotate freely). The annex costs `mig_rows x row_bytes` per shard
+      per table — cheap next to the hot budget, so it is a default, not a
+      budget term.
+    - `refresh_min_gain`: predicted hit-ratio gain (new top-H coverage minus
+      installed-set coverage) a refresh must clear — the hysteresis band
+      that stops the controller chasing sketch noise.
+    - `refresh_cooldown_steps`: hard floor between refreshes, whatever the
+      predicted gain says.
+    - `imbalance_target`: migrate only while max/mean `shard_positions`
+      exceeds this (1.0 = perfectly flat; the E2E gate accepts <= 1.15).
+    """
+
+    def __init__(self, hot_budget_bytes: int, *, mig_rows: int = 64,
+                 refresh_min_gain: float = 0.02,
+                 refresh_cooldown_steps: int = 50,
+                 imbalance_target: float = 1.05,
+                 min_hot_rows: int = 0):
+        if hot_budget_bytes < 0:
+            raise ValueError(f"hot_budget_bytes={hot_budget_bytes} < 0")
+        self.hot_budget_bytes = int(hot_budget_bytes)
+        self.mig_rows = int(mig_rows)
+        self.refresh_min_gain = float(refresh_min_gain)
+        self.refresh_cooldown_steps = int(refresh_cooldown_steps)
+        self.imbalance_target = float(imbalance_target)
+        self.min_hot_rows = int(min_hot_rows)
+
+    # -- auto-sizing ---------------------------------------------------------
+
+    def size_hot(self, tables: Sequence[TableTelemetry]) -> Dict[str, int]:
+        """Solve per-table H against the byte budget: greedy
+        traffic-per-byte over every table's coverage-curve segments.
+
+        Each segment (k0 -> k1) of a table's curve buys
+        `(share(k1) - share(k0)) * total` absolute traffic for
+        `(k1 - k0) * row_bytes(dim)` replicated bytes; segments are taken
+        best-rate first (a curve's own segments stay in order — coverage is
+        concave in practice, and out-of-order picks are impossible anyway
+        because a later segment's rate only falls). Partial segments
+        allocate proportionally, so small budgets still split sensibly."""
+        segs = []  # (rate, table_idx, seg_idx, k0, k1, bytes_per_row)
+        for ti, t in enumerate(tables):
+            bpr = row_bytes(t.dim, t.slot_cols)
+            pts = [(0, 0.0)] + [(int(k), float(s)) for k, s in t.coverage]
+            for si, ((k0, s0), (k1, s1)) in enumerate(zip(pts, pts[1:])):
+                if k1 <= k0:
+                    continue
+                gain = max(s1 - s0, 0.0) * max(t.total, 1.0)
+                rate = gain / ((k1 - k0) * bpr)
+                segs.append((rate, ti, si, k0, k1, bpr))
+        segs.sort(key=lambda x: -x[0])
+        alloc = {t.name: 0 for t in tables}
+        done_upto = {t.name: 0 for t in tables}
+        budget = float(self.hot_budget_bytes)
+        # multi-pass: a segment can only extend its table's allocated
+        # prefix, and float jitter in the rates can order two equal-rate
+        # segments against curve order — sweep until a pass allocates
+        # nothing so no affordable segment is ever skipped permanently
+        progress = True
+        while progress and budget > 0:
+            progress = False
+            for rate, ti, _si, k0, k1, bpr in segs:
+                t = tables[ti]
+                done = done_upto[t.name]
+                if rate <= 0 or budget < bpr or done < k0 or done >= k1:
+                    continue
+                rows = min(k1 - done, int(budget // bpr))
+                if rows <= 0:
+                    continue
+                alloc[t.name] += rows
+                done_upto[t.name] = done + rows
+                budget -= rows * bpr
+                progress = True
+        for t in tables:
+            if self.min_hot_rows and t.coverage:
+                alloc[t.name] = max(alloc[t.name], self.min_hot_rows)
+        return alloc
+
+    # -- refresh hysteresis --------------------------------------------------
+
+    @staticmethod
+    def churn(installed_ids, top_ids) -> float:
+        """Top-K rotation rate: share of the current sketch top-H missing
+        from the installed hot set (0 = identical, 1 = fully rotated)."""
+        top = [i for i, _ in top_ids]
+        if not top:
+            return 0.0
+        inst = set(int(i) for i in np.asarray(
+            installed_ids, np.int64).reshape(-1).tolist())
+        missing = sum(1 for i in top if int(i) not in inst)
+        return missing / len(top)
+
+    def refresh_due(self, t: TableTelemetry, installed_ids, H: int,
+                    steps_since: int) -> Tuple[bool, str, float]:
+        """Hysteresis gate for one table -> (due, reason, predicted_gain).
+        Predicted gain = coverage of the sketch's CURRENT top-H minus the
+        coverage the INSTALLED set still commands (est mass of installed ids
+        over the stream total) — i.e. the hit-ratio points a refresh is
+        expected to buy. Never fires inside the cooldown."""
+        if H <= 0 or not t.top_ids:
+            return False, "no hot budget or no sketch data", 0.0
+        if steps_since < self.refresh_cooldown_steps:
+            return False, f"cooldown ({steps_since} < " \
+                f"{self.refresh_cooldown_steps} steps)", 0.0
+        inst = set(int(i) for i in np.asarray(
+            installed_ids, np.int64).reshape(-1).tolist())
+        total = max(t.total, 1.0)
+        est = {int(i): float(e) for i, e in t.top_ids}
+        cov_installed = sum(est.get(i, 0.0) for i in inst) / total
+        cov_new = sum(float(e) for _i, e in t.top_ids[:H]) / total
+        gain = cov_new - cov_installed
+        if not inst:
+            return True, "initial promotion", gain
+        if gain >= self.refresh_min_gain:
+            return True, (f"predicted hit gain {gain:.3f} >= "
+                          f"{self.refresh_min_gain}"), gain
+        return False, f"predicted gain {gain:.3f} below threshold", gain
+
+    # -- cold-tail migration gate --------------------------------------------
+
+    def migration_due(self, t: TableTelemetry) -> Tuple[bool, str]:
+        if t.shard_positions is None:
+            return False, "no shard load vector yet"
+        load = np.asarray(t.shard_positions, np.float64)
+        mean = load.mean()
+        if mean <= 0:
+            return False, "no measured load"
+        imb = float(load.max() / mean)
+        if imb > self.imbalance_target:
+            return True, (f"imbalance {imb:.3f} > target "
+                          f"{self.imbalance_target}")
+        return False, f"imbalance {imb:.3f} within target"

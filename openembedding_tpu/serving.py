@@ -513,6 +513,13 @@ class ServingHandler(BaseHTTPRequestHandler):
             top=int(self.query.get("top", 8)) if hasattr(self, "query")
             else 8))
         lines.append("")
+        lines.append("-- placement (self-driving) --")
+        try:
+            from .placement.controller import render_status
+            lines.append(render_status())
+        except Exception as e:  # noqa: BLE001 — statusz must render regardless
+            lines.append(f"(placement status unavailable: {e})")
+        lines.append("")
         n = int(self.query.get("n", 40)) if hasattr(self, "query") else 40
         lines.append(f"-- flight recorder (last {n}) --")
         lines.append(trace.RECORDER.render_text(n))

@@ -280,6 +280,12 @@ def record_step_stats(stats: Dict[str, "object"]) -> None:
             if "hot_hits" in d:
                 observe("hot.hit_ratio", d["hot_hits"] / d["pull_indices"],
                         "gauge", labels={"table": var})
+            if "mig_hits" in d:
+                # share of pulled positions the migration directory re-homed
+                # (cold-tail re-sharding; `parallel/sharded._mig_pull_stats`)
+                observe("placement.moved_ratio",
+                        d["mig_hits"] / d["pull_indices"], "gauge",
+                        labels={"table": var})
         if "hot_bytes_saved" in d:
             observe("hot.bytes_saved", d["hot_bytes_saved"], "gauge",
                     labels={"table": var})

@@ -186,11 +186,18 @@ class SpaceSaving:
         `MeshTrainer(hot_rows=...)`: pick the knee where extra rows stop
         buying traffic. Defaults to powers of two up to the tracked count.
         Shares use the (possibly over-counted) estimates, so the curve is an
-        upper bound with the same `est` semantics as `topk`."""
+        upper bound with the same `est` semantics as `topk` — CLAMPED to
+        [0, 1]: count-min over-counts (and `scale()`'s floor-rounding can
+        shrink the stream total faster than the tracked estimates), so the
+        raw cumulative sum can exceed the total; a share above 1.0 is
+        meaningless to a sizing consumer and a decayed-to-zero total must
+        not divide. The curve is monotone non-decreasing by construction
+        (cumsum of non-negative estimates, preserved by the clamp)."""
         with self._lock:
             est = np.sort(self._est)[::-1].astype(np.float64)
             total = float(max(self.cm.total, 1))
-        cum = np.cumsum(est)
+        est = np.maximum(est, 0.0)
+        cum = np.minimum(np.cumsum(est) / total, 1.0)
         if ks is None:
             ks, k = [], 1
             while k < est.size:
@@ -198,7 +205,7 @@ class SpaceSaving:
                 k *= 2
             if est.size:
                 ks.append(int(est.size))
-        return [(int(k), float(cum[min(int(k), est.size) - 1] / total))
+        return [(int(k), float(cum[min(int(k), est.size) - 1]))
                 for k in ks if k >= 1 and est.size]
 
 

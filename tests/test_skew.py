@@ -140,6 +140,32 @@ def test_space_saving_coverage_curve():
     assert ks == sorted(ks) and shares == sorted(shares)  # monotone curve
 
 
+def test_coverage_stays_bounded_and_monotone_after_decay():
+    """Regression (round 12): `scale()`'s floor-rounding shrinks the stream
+    total faster than the tracked estimates (and count-min over-counts), so
+    the raw cumulative share could exceed 1.0 after decay — and a total
+    decayed to zero must not divide. The curve is clamped to [0, 1] and
+    stays monotone; the placement policy sizes hot caches from it."""
+    rng = np.random.default_rng(0)
+    sk = SpaceSaving(k=32, decay=0.5)
+    for _ in range(30):
+        # heavy head + noisy tail: count-min over-counts the tail admits
+        ids = np.concatenate([np.repeat(np.arange(8, dtype=np.int64), 40),
+                              rng.integers(0, 1 << 20, 200)])
+        sk.update(ids)
+    for cov in (sk.coverage(), sk.coverage([1, 2, 7, 31, 10**6])):
+        shares = [s for _k, s in cov]
+        assert all(0.0 <= s <= 1.0 for s in shares), cov
+        assert shares == sorted(shares), cov
+    # decay the stream total all the way to zero: tracked estimates may
+    # still be positive, and the share must stay defined and bounded
+    with sk._lock:
+        sk.cm.scale(0.0)
+    cov0 = sk.coverage()
+    assert cov0, "curve vanished"
+    assert all(0.0 <= s <= 1.0 for _k, s in cov0), cov0
+
+
 def test_skew_monitor_publishes_rank_labeled_gauges():
     mon = SkewMonitor(k=8, sync=True)
     mon.observe("user", np.array([5, 5, 5, 5, 9, 9, 3]))

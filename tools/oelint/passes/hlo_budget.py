@@ -51,7 +51,14 @@ COLLECTIVES = {
     "collective_permute": r" collective-permute(?:-start)?\(",
 }
 
-# the acceptance matrix: per-table vs fused, wire formats, hot on/off
+# the acceptance matrix: per-table vs fused, wire formats, hot on/off, and
+# full placement (hot cache + cold-tail migration directory) — the
+# `fused_fp32_placement` steady-state step must pin the IDENTICAL
+# exchange-collective set as `fused_fp32_hot` (3 a2a, 0 all-gather, same
+# wire bytes): the owner-assignment indirection is pure local math (two
+# extra hash probes riding the fused sort), never a wire collective. The
+# only delta is +4 scalar all-reduces — the `mig_unique`/`mig_hits` stats
+# riding the existing per-key stats psum (2 stats x 2 tables).
 CONFIGS = (
     {"name": "per_table_fp32", "group_exchange": False, "wire": "fp32",
      "hot_rows": 0},
@@ -63,6 +70,8 @@ CONFIGS = (
      "hot_rows": 0},
     {"name": "fused_fp32_hot", "group_exchange": True, "wire": "fp32",
      "hot_rows": 32},
+    {"name": "fused_fp32_placement", "group_exchange": True, "wire": "fp32",
+     "hot_rows": 32, "mig_rows": 32},
 )
 
 
@@ -135,7 +144,7 @@ def make_trainer(config: Dict):
     trainer = MeshTrainer(
         model, embed.Adagrad(learning_rate=0.1), mesh=make_mesh(),
         wire=config["wire"], group_exchange=config["group_exchange"],
-        hot_rows=config["hot_rows"])
+        hot_rows=config["hot_rows"], mig_rows=config.get("mig_rows", 0))
     return trainer, batch
 
 

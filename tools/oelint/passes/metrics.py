@@ -42,6 +42,7 @@ KNOWN_GROUPS = {
     "metrics",    # the metrics subsystem's own health (report_errors)
     "offload",    # host-cached table cache admission/flush
     "persist",    # async/incremental persistence
+    "placement",  # self-driving placement controller + cold-tail migration
     "serving",    # REST predict/pull/batching
     "skew",       # heavy-hitter sketches (utils/sketch.py)
     "sync",       # online model sync

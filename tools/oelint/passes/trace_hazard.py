@@ -50,7 +50,7 @@ DIRS = ("openembedding_tpu",)
 DEFAULT_ROOTS = {
     "sharded_lookup_train", "grouped_lookup_train", "sharded_lookup",
     "sharded_apply_gradients", "grouped_apply_gradients",
-    "hot_writeback", "hot_gather",
+    "hot_writeback", "hot_gather", "mig_writeback", "mig_gather",
     "train_step", "train_many", "eval_step",
 }
 

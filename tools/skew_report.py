@@ -4,6 +4,7 @@ node's /metrics.
     python tools/skew_report.py http://node:8501            # live scrape
     python tools/skew_report.py /tmp/metrics.txt            # saved scrape
     python tools/skew_report.py http://node:8501 --fleet    # /fleetz merge
+    python tools/skew_report.py http://node:8501 --recommend  # policy dry run
 
 Renders the `skew.*` rank-labeled gauges the heavy-hitter sketches publish
 (`utils/sketch.py` — `skew.hot_id{table=,rank=}` / `hot_id_count` /
@@ -137,6 +138,90 @@ def shard_balance_report(samples) -> str:
     return "\n".join(lines)
 
 
+def telemetry_from_samples(samples, *, default_dim: int = 16):
+    """Rebuild per-table `placement.TableTelemetry` from scrape samples —
+    the same inputs the live `PlacementController` reads from its sketches,
+    reconstructed from the rank-labeled gauges so the policy dry-runs
+    offline against exactly what the node measured."""
+    import numpy as np
+
+    from openembedding_tpu.placement.policy import TableTelemetry
+    ids = _by_table_rank(samples, "oetpu_skew_hot_id")
+    counts = _by_table_rank(samples, "oetpu_skew_hot_id_count")
+    totals = {labels.get("table"): value for n, labels, value in samples
+              if n == "oetpu_skew_stream_ids"}
+    dims = {labels.get("table"): value for n, labels, value in samples
+            if n == "oetpu_exchange_row_dim"}
+    pos: Dict[str, Dict[int, float]] = {}
+    for n, labels, value in samples:
+        if n == "oetpu_exchange_shard_positions" and "table" in labels \
+                and "shard" in labels:
+            pos.setdefault(labels["table"], {})[int(labels["shard"])] = value
+    out = []
+    for table in sorted(ids):
+        total = max(totals.get(table, 0.0), 1.0)
+        top = [(int(ids[table][r]), counts.get(table, {}).get(r, 0.0))
+               for r in sorted(ids[table])]
+        top.sort(key=lambda x: -x[1])
+        cum, acc, cov = [], 0.0, []
+        for k, (_i, e) in enumerate(top):
+            acc += e
+            cov.append((k + 1, min(acc / total, 1.0)))
+        sp = None
+        if table in pos:
+            sp = np.asarray([pos[table].get(i, 0.0)
+                             for i in range(max(pos[table]) + 1)])
+        out.append(TableTelemetry(
+            name=table, dim=int(dims.get(table, default_dim)),
+            coverage=cov, total=total, top_ids=top, shard_positions=sp))
+    return out
+
+
+def recommend_report(samples, *, budget_bytes: int, mig_rows: int,
+                     imbalance_target: float,
+                     default_dim: int = 16) -> str:
+    """The --recommend dry run: what the self-driving controller WOULD do
+    with this scrape — per-table hot-cache size against the byte budget,
+    the predicted hit ratio at that size, and the migration plan — so an
+    operator can audit the policy before enabling
+    `placement.PlacementController` on the trainer."""
+    from openembedding_tpu.placement.migration import (candidate_weights,
+                                                       plan_migration)
+    from openembedding_tpu.placement.policy import PlacementPolicy, row_bytes
+    tel = telemetry_from_samples(samples, default_dim=default_dim)
+    if not tel:
+        return "(no skew.* series — node has no id streams observed yet)"
+    policy = PlacementPolicy(budget_bytes, mig_rows=mig_rows,
+                             imbalance_target=imbalance_target)
+    sizes = policy.size_hot(tel)
+    lines = [f"policy: hot_budget={budget_bytes}B mig_rows={mig_rows} "
+             f"imbalance_target={imbalance_target}"]
+    for t in tel:
+        H = sizes.get(t.name, 0)
+        hot_ids = [i for i, _e in t.top_ids[:H]]
+        line = (f"table {t.name}: hot_rows={H} "
+                f"({H * row_bytes(t.dim, t.slot_cols)}B replicated) "
+                f"predicted_hit={t.share_at(H):.3f}")
+        if t.shard_positions is not None and t.shard_positions.sum() > 0:
+            load = t.shard_positions
+            imb = float(load.max() / load.mean())
+            mids, mown, proj = plan_migration(
+                load, candidate_weights(t.top_ids, hot_ids),
+                num_shards=load.size, max_moves=mig_rows,
+                target=imbalance_target, total=t.total, exclude=hot_ids)
+            line += (f" imbalance={imb:.3f} migration_plan={mids.size} rows"
+                     f" -> projected {proj:.3f}")
+            lines.append(line)
+            for i, o in list(zip(mids.tolist(), mown.tolist()))[:10]:
+                lines.append(f"    move id={i} shard {i % load.size} -> {o}")
+            if mids.size > 10:
+                lines.append(f"    ... {mids.size - 10} more")
+        else:
+            line += " (no shard load vector — trainer nodes only)"
+            lines.append(line)
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="hot-id / shard-balance report from a /metrics scrape")
@@ -147,6 +232,18 @@ def main(argv=None) -> int:
                     help="scrape GET /fleetz (merged fleet view) instead of "
                          "the node's own /metrics")
     ap.add_argument("--timeout", type=float, default=10.0)
+    ap.add_argument("--recommend", action="store_true",
+                    help="dry-run the self-driving placement policy on this "
+                         "scrape: per-table hot_rows vs the byte budget, "
+                         "predicted hit ratio, migration plan")
+    ap.add_argument("--hot-budget-kb", type=float, default=64.0,
+                    help="--recommend: replicated hot-cache byte budget")
+    ap.add_argument("--mig-rows", type=int, default=64,
+                    help="--recommend: migration annex capacity per table")
+    ap.add_argument("--imbalance-target", type=float, default=1.05)
+    ap.add_argument("--dim", type=int, default=16,
+                    help="--recommend: row dim fallback when the scrape "
+                         "carries no oetpu_exchange_row_dim gauge")
     args = ap.parse_args(argv)
     parsed = parse_prometheus(
         fetch(args.source, fleet=args.fleet, timeout=args.timeout))
@@ -159,6 +256,14 @@ def main(argv=None) -> int:
     print()
     print("== shard balance (exchange load accounting) ==")
     print(shard_balance_report(samples))
+    if args.recommend:
+        print()
+        print("== placement recommendation (policy dry run) ==")
+        print(recommend_report(
+            samples, budget_bytes=int(args.hot_budget_kb * 1024),
+            mig_rows=args.mig_rows,
+            imbalance_target=args.imbalance_target,
+            default_dim=args.dim))
     return 0
 
 

@@ -113,6 +113,19 @@ CASES = [
       "OETPU_BENCH_PROBE_TIMEOUT_S": "75",
       "JAX_PLATFORMS": "cpu",
       "XLA_FLAGS": "--xla_force_host_platform_device_count=8"}, 1200),
+    # 12. self-driving placement (bench 'placement' case: drifting-Zipf hot
+    #     set rotated mid-run, PlacementController on/off — pre/post-drift
+    #     steady imbalance, hit ratio, refresh + migration counts, annex
+    #     all_gather bytes). Like bench_hot this needs S >= 2, so it rides
+    #     the 8-virtual-device CPU mesh; the controller itself is host-side.
+    ("bench_placement",
+     [sys.executable, os.path.join(REPO, "bench.py")],
+     {"OETPU_BENCH_CASES": "placement",
+      "OETPU_BENCH_BUDGET_S": "1100",
+      "OETPU_BENCH_TOTAL_BUDGET_S": "1340",
+      "OETPU_BENCH_PROBE_TIMEOUT_S": "75",
+      "JAX_PLATFORMS": "cpu",
+      "XLA_FLAGS": "--xla_force_host_platform_device_count=8"}, 1400),
 ]
 
 
