@@ -48,7 +48,7 @@ throughput, not host dispatch latency — the same way production input pipeline
 drive TPUs (the axon tunnel adds ~40 ms per dispatch that would otherwise swamp
 the measurement; see PERF.md "Measurement hygiene").
 
-Env knobs: OETPU_BENCH_CASES=dim9[,dim64][,mesh1][,mesh1f][,pull][,wire][,sync][,skew][,hot] (default: all),
+Env knobs: OETPU_BENCH_CASES=dim9[,dim64][,mesh1][,mesh1f][,pull][,wire][,wire_inband][,sync][,skew][,hot] (default: all),
 OETPU_BENCH_BUDGET_S (default 540), OETPU_BENCH_SCAN_STEPS / _REPEATS (smoke runs),
 OETPU_BENCH_TOTAL_BUDGET_S / _PROBE_TIMEOUT_S / _PROBE_INTERVAL_S (orchestrator).
 """
@@ -333,6 +333,61 @@ def case_wire():
         out[f"{fmt}_roundtrip_ms"] = round(best * 1e3, 3)
         # bytes touched: read f32 + write f32 (the wire array in between)
         out[f"{fmt}_gbps"] = round(rows.size * 4 * 2 / best / 1e9, 1)
+    return out
+
+
+def case_wire_inband():
+    """Round-13 in-collective codec on-device: jitted pack_inband/unpack_inband
+    round-trip of a (26*4096, 64) f32 payload — dim 64 = 2 scale blocks, so
+    the in-band scale lanes and per-block int8 quantization do real work —
+    for bf16 and int8, int8 additionally with stochastic rounding (the
+    training-push mode). The EF columns price the owner-side error-feedback
+    serve (encode q(w+ef), ef <- (w+ef) - deq(q)) against the plain int8
+    encode: `ef_overhead_ms` is what the residual update adds per serve.
+    Byte savings need S >= 2 and are HLO-measured in tools/wire_microbench.py
+    and pinned by the oelint hlo-budget pass; this case bounds compute."""
+    import jax
+    from openembedding_tpu.ops import wire as wire_mod
+
+    WD.stage("wire_inband:init", 120)
+    rng = np.random.default_rng(0)
+    dim = 64
+    rows = jax.device_put(
+        rng.standard_normal((26 * 4096, dim)).astype(np.float32))
+    ef = jax.device_put(
+        (rng.standard_normal((26 * 4096, dim)) * 1e-3).astype(np.float32))
+    out = {"dim": dim, "scale_blocks": int(wire_mod.scale_blocks(dim))}
+
+    def timed(label, fn, *args):
+        jfn = jax.jit(fn)
+        WD.stage(f"wire_inband:{label}", 180)
+        jax.block_until_ready(jfn(*args))
+        times = []
+        for _ in range(max(REPEATS, 5)):
+            t0 = time.perf_counter()
+            jax.block_until_ready(jfn(*args))
+            times.append(time.perf_counter() - t0)
+        best = min(times)
+        out[f"{label}_ms"] = round(best * 1e3, 3)
+        return best
+
+    for fmt in ("bf16", "int8"):
+        best = timed(f"{fmt}_inband", lambda x, fmt=fmt: wire_mod.unpack_inband(
+            wire_mod.pack_inband(x, fmt), dim, fmt), rows)
+        # bytes touched: read f32 + write f32 (the wire array in between)
+        out[f"{fmt}_inband_gbps"] = round(rows.size * 4 * 2 / best / 1e9, 1)
+    timed("int8_sr_inband", lambda x: wire_mod.unpack_inband(
+        wire_mod.pack_inband(x, "int8", stochastic=True), dim, "int8"), rows)
+
+    def ef_serve(w, e):
+        wire = wire_mod.pack_inband(w + e, "int8")
+        return wire, (w + e) - wire_mod.unpack_inband(wire, dim, "int8")
+
+    plain = timed("int8_encode", lambda x: wire_mod.pack_inband(x, "int8"),
+                  rows)
+    withef = timed("int8_ef_serve", ef_serve, rows, ef)
+    out["ef_overhead_ms"] = round((withef - plain) * 1e3, 3)
+    out["ef_overhead_x"] = round(withef / max(plain, 1e-9), 3)
     return out
 
 
@@ -788,7 +843,8 @@ def main():
 
     cases = os.environ.get(
         "OETPU_BENCH_CASES",
-        "dim9,dim64,mesh1,mesh1f,pull,wire,sync,skew,hot,placement").split(",")
+        "dim9,dim64,mesh1,mesh1f,pull,wire,wire_inband,sync,skew,hot,"
+        "placement").split(",")
 
     # PRIMARY first: whatever happens later, this number is in the artifact.
     if "dim9" in cases:
@@ -803,6 +859,7 @@ def main():
                                                name="mesh1f")),
                  ("pull", case_pull),
                  ("wire", case_wire),
+                 ("wire_inband", case_wire_inband),
                  ("sync", case_sync),
                  ("skew", case_skew),
                  ("hot", case_hot),
@@ -838,6 +895,11 @@ def main():
             if "bf16_roundtrip_ms" in out:
                 RESULT["metric"] = "wire_bf16_roundtrip_ms"
                 RESULT["value"] = out["bf16_roundtrip_ms"]
+                RESULT["unit"] = "ms"
+                break
+            if "int8_inband_ms" in out:
+                RESULT["metric"] = "wire_int8_inband_ms"
+                RESULT["value"] = out["int8_inband_ms"]
                 RESULT["unit"] = "ms"
                 break
             if "fp32_ms_per_delta" in out:

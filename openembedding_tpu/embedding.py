@@ -105,6 +105,13 @@ class EmbeddingTableState(struct.PyTreeNode):
     # None = off). NOT serialized either: `hot_sync` writes migrated rows
     # back into their home shards before any snapshot/export/delta reader.
     mig: Optional[MigRows] = None
+    # per-row error-feedback residuals for the quantized pull wire
+    # (MeshTrainer(error_feedback=...); None = off). Sharded and laid out
+    # exactly like `weights`, SERIALIZED like an optimizer slot (reserved
+    # slot name "__ef__" in sharded checkpoints and persist deltas): the
+    # residual is training state — dropping it at restore would re-bias the
+    # int8 wire for every row mid-stream.
+    ef: Optional[jax.Array] = None        # (rows, dim) f32
 
 
 @dataclasses.dataclass(frozen=True)
@@ -236,10 +243,13 @@ class EmbeddingSpec:
 
 def init_table_state(spec: EmbeddingSpec, optimizer: SparseOptimizer,
                      seed: int = 0, num_shards: int = 1,
-                     shard_id: int = 0) -> EmbeddingTableState:
+                     shard_id: int = 0,
+                     error_feedback: bool = False) -> EmbeddingTableState:
     """Materialize one shard's table (reference: lazy `_new_weights` init on first pull,
     `EmbeddingOptimizerVariable.h:242-266`; we init rows eagerly — deterministic per
-    (seed, shard), documented divergence: RNG stream differs from lazy order)."""
+    (seed, shard), documented divergence: RNG stream differs from lazy order).
+    `error_feedback` adds the zero-initialized per-row residual array the
+    quantized pull wire accumulates into (`parallel/sharded._serve_rows`)."""
     rows = spec.rows_per_shard(num_shards)
     # fold_in needs uint32 data; the unassigned sentinel (-1, specs built
     # outside an EmbeddingModel, e.g. a bare EmbeddingVariable) maps to a slot
@@ -258,8 +268,10 @@ def init_table_state(spec: EmbeddingSpec, optimizer: SparseOptimizer,
         from .tables.hash_table import fresh_keys
         keys = fresh_keys(rows)
         overflow = jnp.zeros((), jnp.int32)
+    ef = (jnp.zeros((rows, spec.output_dim), jnp.float32)
+          if error_feedback else None)
     return EmbeddingTableState(weights=weights, slots=slots, keys=keys,
-                               overflow=overflow)
+                               overflow=overflow, ef=ef)
 
 
 def _flat_ids(spec: EmbeddingSpec, ids: jax.Array):

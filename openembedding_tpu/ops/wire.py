@@ -7,6 +7,12 @@ reduced, dequantized at the receiving edge. SparCML (arxiv 1802.08021) and
 EQuARX (arxiv 2506.17615) both show sparse/quantized collectives recovering
 2-4x wire bandwidth in exactly this regime.
 
+Since round 13 the narrow payloads go THROUGH the collectives: rows are
+encoded at the owner edge (before the pull all_to_all) and grads at the
+client edge (before the push all_to_all), so the compiled a2a operands are
+int8/bf16 — verified per config against the compiled HLO by the oelint
+hlo-budget pass, not just by this module's analytic model.
+
 Formats (`OETPU_WIRE`, default bf16; trainers can override explicitly):
 
 - ``fp32``: payloads travel in their native float dtype (bit-exact; the
@@ -15,15 +21,25 @@ Formats (`OETPU_WIRE`, default bf16; trainers can override explicitly):
   lossy formats explicitly.
 - ``bf16``: rows and grads truncate to bfloat16 on the wire (2x fewer payload
   bytes vs fp32; ~3 decimal digits, plenty for embedding pulls and grads).
-- ``int8``: rows and grads quantize to int8 with ONE fp32 scale per row
-  (max-abs / 127), the scale riding as 4 bitcast int8 lanes beside the
-  payload (~4x fewer payload bytes; opt-in).
+- ``int8``: rows and grads quantize to int8 with one fp32 scale per
+  `INBAND_BLOCK`-wide block of the row (max-abs / 127), the scales riding
+  IN-BAND as 4 bitcast int8 lanes per block beside the payload in the same
+  a2a buffer (~4x fewer payload bytes; opt-in). All shapes are static in
+  (dim, fmt), so switching nothing re-jits. For dim <= INBAND_BLOCK this
+  degenerates to the round-6 single per-row scale bit-for-bit.
 
 Duplicate COUNTS (the push's second payload) must survive the wire EXACTLY —
 they divide/weight optimizer updates — so they always ride as raw int32 bits
 BITCAST into wire lanes (1 fp32 lane, 2 bf16 lanes, or 4 int8 lanes), never
 quantized. Empty bucket slots are zero-filled: zero bits decode to grad 0,
 scale 0, count 0 in every format, so no validity mask rides the wire.
+
+Stochastic rounding (``pack_inband(..., stochastic=True)``): int8 grad
+pushes round with a deterministic hash dither derived from the value bits
+and lane position (key-free, replica-reproducible) instead of
+round-to-nearest, removing the systematic rounding bias that would otherwise
+accumulate over training steps. Row pulls keep round-to-nearest (their bias
+is handled by the pull-side error-feedback residuals, `EmbeddingTableState.ef`).
 """
 
 from __future__ import annotations
@@ -41,8 +57,11 @@ FORMATS = ("fp32", "bf16", "int8")
 _ALIASES = {"float32": "fp32", "f32": "fp32", "bfloat16": "bf16",
             "i8": "int8"}
 
-# int8 payloads carry one fp32 per-row scale as 4 bitcast int8 lanes
+# int8 payloads carry one fp32 scale per block as 4 bitcast int8 lanes
 _SCALE_LANES = 4
+# columns sharing one in-band scale; dim <= INBAND_BLOCK keeps the round-6
+# one-scale-per-row layout (and its wire width) exactly
+INBAND_BLOCK = 32
 
 
 def wire_format(override: Optional[str] = None) -> str:
@@ -57,8 +76,20 @@ def wire_format(override: Optional[str] = None) -> str:
 
 
 def wire_dtype(fmt: str):
-    """The array dtype payloads travel in (fp32 keeps the native float)."""
+    """The VALUE dtype payloads are encoded in (fp32 keeps the native
+    float). Sizing authority for every cost model — itemsize 4/2/1."""
     return {"fp32": jnp.float32, "bf16": jnp.bfloat16,
+            "int8": jnp.int8}[fmt]
+
+
+def wire_carrier_dtype(fmt: str):
+    """The array dtype the a2a BUFFERS actually travel in. bf16 ships its
+    bit pattern as uint16: XLA:CPU's float-normalization pass legalizes
+    bf16 ops — collectives included — to f32 with converts, which would
+    silently double the compiled payload on the backend the hlo-budget
+    world measures; an integer carrier is 2 bytes/lane on every backend
+    (and matches the numpy codec, which represents bf16 as uint16)."""
+    return {"fp32": jnp.float32, "bf16": jnp.uint16,
             "int8": jnp.int8}[fmt]
 
 
@@ -67,15 +98,21 @@ def count_lanes(fmt: str) -> int:
     return 4 // jnp.dtype(wire_dtype(fmt)).itemsize
 
 
+def scale_blocks(dim: int) -> int:
+    """In-band fp32 scales an int8-encoded (n, dim) payload carries per row."""
+    return -(-dim // INBAND_BLOCK)
+
+
 # ---------------------------------------------------------------------------
 # Exact int32 <-> wire-lane bitcasts (duplicate counts).
 # ---------------------------------------------------------------------------
 
 
 def counts_to_lanes(counts: jax.Array, fmt: str) -> jax.Array:
-    """(n,) int32 -> (n, count_lanes(fmt)) in the wire dtype, bit-exact."""
+    """(n,) int32 -> (n, count_lanes(fmt)) in the wire CARRIER dtype,
+    bit-exact."""
     lanes = jax.lax.bitcast_convert_type(counts.astype(jnp.int32),
-                                         wire_dtype(fmt))
+                                         wire_carrier_dtype(fmt))
     return lanes.reshape(counts.shape[0], -1)
 
 
@@ -92,45 +129,101 @@ def lanes_to_counts(lanes: jax.Array) -> jax.Array:
 
 
 def rows_wire_width(dim: int, fmt: str) -> int:
-    """Wire columns for a (n, dim) float row payload."""
-    return dim + _SCALE_LANES if fmt == "int8" else dim
+    """Wire columns for a (n, dim) float row payload (int8: + the in-band
+    scale lanes, 4 per INBAND_BLOCK-wide block)."""
+    return dim + _SCALE_LANES * scale_blocks(dim) if fmt == "int8" else dim
 
 
-def _quantize_int8(x32: jax.Array) -> jax.Array:
-    """(n, d) f32 -> (n, d + 4) int8: symmetric per-row max-abs scaling with
-    the fp32 scale bitcast into the trailing 4 lanes. All-zero rows get scale
-    0 and decode to exact zeros."""
-    amax = jnp.max(jnp.abs(x32), axis=1)
+def _dither(x32: jax.Array) -> jax.Array:
+    """Deterministic stochastic-rounding dither in [0, 1): a key-free hash of
+    the value bits xor'd with the lane position (so equal values in different
+    lanes dither differently), mixed with two xorshift-multiply rounds. Pure
+    function of the input — identical on every replica, never a PRNG key to
+    thread through the exchange."""
+    bits = jax.lax.bitcast_convert_type(x32.astype(jnp.float32), jnp.uint32)
+    lane = (jnp.arange(x32.shape[-1], dtype=jnp.uint32)
+            * jnp.uint32(2654435761))
+    h = bits ^ lane
+    h = (h ^ (h >> 16)) * jnp.uint32(0x7FEB352D)
+    h = (h ^ (h >> 15)) * jnp.uint32(0x846CA68B)
+    h = h ^ (h >> 16)
+    return (h >> 8).astype(jnp.float32) * jnp.float32(1.0 / (1 << 24))
+
+
+def _quantize_int8(x32: jax.Array, stochastic: bool = False) -> jax.Array:
+    """(n, d) f32 -> (n, rows_wire_width(d, 'int8')) int8: symmetric max-abs
+    scaling per INBAND_BLOCK-wide block, the fp32 scales bitcast into the
+    trailing 4*blocks lanes (in-band — the scales ride the same a2a buffer).
+    All-zero blocks get scale 0 and decode to exact zeros."""
+    n, dim = x32.shape
+    nb = scale_blocks(dim)
+    pad = nb * INBAND_BLOCK - dim
+    xb = jnp.pad(x32, ((0, 0), (0, pad))) if pad else x32
+    xb = xb.reshape(n, nb, INBAND_BLOCK)
+    amax = jnp.max(jnp.abs(xb), axis=2)
     scale = amax / 127.0
     inv = jnp.where(scale > 0, 1.0 / scale, 0.0)
-    q = jnp.clip(jnp.round(x32 * inv[:, None]), -127, 127).astype(jnp.int8)
+    scaled = xb * inv[:, :, None]
+    if stochastic:
+        qf = jnp.floor(scaled + _dither(xb))
+    else:
+        qf = jnp.round(scaled)
+    q = jnp.clip(qf, -127, 127).astype(jnp.int8)
+    q = q.reshape(n, nb * INBAND_BLOCK)[:, :dim]
     scale_lanes = jax.lax.bitcast_convert_type(
-        scale.astype(jnp.float32), jnp.int8).reshape(-1, _SCALE_LANES)
+        scale.astype(jnp.float32), jnp.int8).reshape(n, nb * _SCALE_LANES)
     return jnp.concatenate([q, scale_lanes], axis=1)
 
 
 def _dequantize_int8(wire: jax.Array, dim: int) -> jax.Array:
-    """(n, dim + 4) int8 -> (n, dim) f32."""
+    """(n, rows_wire_width(dim, 'int8')) int8 -> (n, dim) f32."""
+    n = wire.shape[0]
+    nb = scale_blocks(dim)
     scale = jax.lax.bitcast_convert_type(
-        wire[:, dim:dim + _SCALE_LANES], jnp.float32).reshape(-1)
-    return wire[:, :dim].astype(jnp.float32) * scale[:, None]
+        wire[:, dim:dim + _SCALE_LANES * nb].reshape(n, nb, _SCALE_LANES),
+        jnp.float32)
+    pad = nb * INBAND_BLOCK - dim
+    q = wire[:, :dim].astype(jnp.float32)
+    qb = jnp.pad(q, ((0, 0), (0, pad))) if pad else q
+    out = qb.reshape(n, nb, INBAND_BLOCK) * scale[:, :, None]
+    return out.reshape(n, nb * INBAND_BLOCK)[:, :dim]
 
 
-def encode_rows(rows: jax.Array, fmt: str) -> jax.Array:
-    """(n, d) float rows -> wire payload (n, rows_wire_width(d, fmt))."""
+def pack_inband(rows: jax.Array, fmt: str, *,
+                stochastic: bool = False) -> jax.Array:
+    """(n, d) float rows -> wire payload (n, rows_wire_width(d, fmt)) with
+    any scales packed in-band. Static shapes in (d, fmt): switching the wire
+    format never re-jits a fixed-format program. `stochastic` selects
+    hash-dithered stochastic rounding (int8 only; fp32/bf16 ignore it)."""
     if fmt == "fp32":
         return rows
     if fmt == "bf16":
-        return rows.astype(jnp.bfloat16)
-    return _quantize_int8(rows.astype(jnp.float32))
+        # uint16 carrier — see wire_carrier_dtype for why not bf16 itself
+        return jax.lax.bitcast_convert_type(
+            rows.astype(jnp.bfloat16), jnp.uint16)
+    return _quantize_int8(rows.astype(jnp.float32), stochastic=stochastic)
 
 
-def decode_rows(wire: jax.Array, dim: int, fmt: str) -> jax.Array:
-    """Inverse of encode_rows -> (n, d) float32 (callers cast to their
+def unpack_inband(wire: jax.Array, dim: int, fmt: str) -> jax.Array:
+    """Inverse of pack_inband -> (n, d) float32 (callers cast to their
     compute/table dtype — exact for bf16-kept tables)."""
     if fmt == "int8":
         return _dequantize_int8(wire, dim)
+    if fmt == "bf16":
+        return jax.lax.bitcast_convert_type(
+            wire, jnp.bfloat16).astype(jnp.float32)
     return wire.astype(jnp.float32)
+
+
+def encode_rows(rows: jax.Array, fmt: str) -> jax.Array:
+    """(n, d) float rows -> wire payload (round-to-nearest alias of
+    pack_inband, kept as the stable codec entry point)."""
+    return pack_inband(rows, fmt)
+
+
+def decode_rows(wire: jax.Array, dim: int, fmt: str) -> jax.Array:
+    """Inverse of encode_rows -> (n, d) float32."""
+    return unpack_inband(wire, dim, fmt)
 
 
 # ---------------------------------------------------------------------------
@@ -140,9 +233,10 @@ def decode_rows(wire: jax.Array, dim: int, fmt: str) -> jax.Array:
 # neither edge wants a device round-trip just to (de)quantize, so the same
 # three formats get a pure-numpy implementation. Semantics match the jnp
 # codecs above: bf16 truncates with round-to-nearest-even (what
-# `astype(bfloat16)` does in XLA), int8 is symmetric per-row max-abs with the
-# fp32 scale riding as 4 bitcast lanes. bf16 payloads are REPRESENTED as
-# uint16 (numpy has no native bfloat16); `fmt` travels beside the payload.
+# `astype(bfloat16)` does in XLA), int8 is symmetric per-block max-abs with
+# the fp32 scales riding as 4 bitcast lanes per block. bf16 payloads are
+# REPRESENTED as uint16 (numpy has no native bfloat16); `fmt` travels beside
+# the payload.
 # ---------------------------------------------------------------------------
 
 
@@ -161,13 +255,19 @@ def np_encode_rows(rows: np.ndarray, fmt: str) -> np.ndarray:
         # round-to-nearest-even truncation to the high 16 bits
         bias = np.uint32(0x7FFF) + ((u >> np.uint32(16)) & np.uint32(1))
         return ((u + bias) >> np.uint32(16)).astype(np.uint16)
-    amax = np.max(np.abs(rows), axis=1) if rows.shape[1] else \
-        np.zeros((rows.shape[0],), np.float32)
+    n, dim = rows.shape
+    nb = scale_blocks(dim)
+    pad = nb * INBAND_BLOCK - dim
+    xb = (np.pad(rows, ((0, 0), (0, pad))) if pad else rows) \
+        .reshape(n, nb, INBAND_BLOCK)
+    amax = np.max(np.abs(xb), axis=2)
     scale = (amax / 127.0).astype(np.float32)
     inv = np.zeros_like(scale)
     np.divide(np.float32(1.0), scale, out=inv, where=scale > 0)
-    q = np.clip(np.rint(rows * inv[:, None]), -127, 127).astype(np.int8)
-    scale_lanes = np.ascontiguousarray(scale.reshape(-1, 1)).view(np.int8)
+    q = np.clip(np.rint(xb * inv[:, :, None]), -127, 127).astype(np.int8)
+    q = q.reshape(n, nb * INBAND_BLOCK)[:, :dim]
+    scale_lanes = np.ascontiguousarray(scale).view(np.int8) \
+        .reshape(n, nb * _SCALE_LANES)
     return np.concatenate([q, scale_lanes], axis=1)
 
 
@@ -179,9 +279,16 @@ def np_decode_rows(wire: np.ndarray, dim: int, fmt: str) -> np.ndarray:
         u16 = np.ascontiguousarray(wire, dtype=np.uint16)
         return (u16.astype(np.uint32) << np.uint32(16)).view(np.float32)
     w = np.ascontiguousarray(wire, dtype=np.int8)
+    n = w.shape[0]
+    nb = scale_blocks(dim)
     scale = np.ascontiguousarray(
-        w[:, dim:dim + _SCALE_LANES]).view(np.float32).reshape(-1)
-    return w[:, :dim].astype(np.float32) * scale[:, None]
+        w[:, dim:dim + _SCALE_LANES * nb]).view(np.float32) \
+        .reshape(n, nb)
+    pad = nb * INBAND_BLOCK - dim
+    q = w[:, :dim].astype(np.float32)
+    qb = np.pad(q, ((0, 0), (0, pad))) if pad else q
+    out = qb.reshape(n, nb, INBAND_BLOCK) * scale[:, :, None]
+    return np.ascontiguousarray(out.reshape(n, nb * INBAND_BLOCK)[:, :dim])
 
 
 def sync_delta_cost(tables: Dict[str, Tuple[int, int]], fmt: str) -> dict:
@@ -189,17 +296,22 @@ def sync_delta_cost(tables: Dict[str, Tuple[int, int]], fmt: str) -> dict:
     (`sync/publisher.py` serves it, `utils/metrics.observe_sync_cost` gauges
     it): per table {name: (touched_rows, dim)}, ids travel as exact int64
     (8 B/row — never quantized, like the exchange's id lanes) and rows as the
-    chosen wire format. Optimizer slots never ride this wire at all — the
-    serving feed is weights-only, so even fp32 sync ships ~half the bytes the
-    delta holds on disk."""
-    bytes_ids = bytes_rows = rows_total = 0
+    chosen wire format, in-band scale lanes included (`bytes_scales` breaks
+    them out). Optimizer slots never ride this wire at all — the serving feed
+    is weights-only, so even fp32 sync ships ~half the bytes the delta holds
+    on disk."""
+    bytes_ids = bytes_rows = bytes_scales = rows_total = 0
     w = np.dtype(np_wire_dtype(fmt)).itemsize
     for _name, (n, dim) in tables.items():
         bytes_ids += n * 8
         bytes_rows += n * rows_wire_width(dim, fmt) * w
+        if fmt == "int8":
+            bytes_scales += n * _SCALE_LANES * scale_blocks(dim) * w
         rows_total += n
     return {"format": fmt, "rows": int(rows_total),
+            "wire_dtype": str(np.dtype(np_wire_dtype(fmt))),
             "bytes_ids": int(bytes_ids), "bytes_rows": int(bytes_rows),
+            "bytes_scales": int(bytes_scales),
             "bytes_total": int(bytes_ids + bytes_rows)}
 
 
@@ -213,28 +325,29 @@ def grads_wire_width(dim: int, fmt: str) -> int:
     return rows_wire_width(dim, fmt) + count_lanes(fmt)
 
 
-def encode_grads(grads: jax.Array, counts: jax.Array, fmt: str) -> jax.Array:
+def encode_grads(grads: jax.Array, counts: jax.Array, fmt: str, *,
+                 stochastic: bool = False) -> jax.Array:
     """(n, d) float grads + (n,) int32 counts -> (n, grads_wire_width) wire
-    rows. Counts ride bit-exact; grads quantize like rows."""
-    if fmt == "fp32":
-        g = grads.astype(jnp.float32)
-    elif fmt == "bf16":
-        g = grads.astype(jnp.bfloat16)
-    else:
-        g = _quantize_int8(grads.astype(jnp.float32))
+    rows. Counts ride bit-exact; grads quantize like rows (`stochastic`
+    selects the int8 hash-dither rounding the training push uses)."""
+    g = pack_inband(grads.astype(jnp.float32) if fmt != "bf16" else grads,
+                    fmt, stochastic=stochastic)
     return jnp.concatenate([g, counts_to_lanes(counts, fmt)], axis=1)
 
 
 def decode_grads(wire: jax.Array, dim: int, fmt: str):
     """-> ((n, d) float32 grads, (n,) int32 counts)."""
     body = rows_wire_width(dim, fmt)
-    return decode_rows(wire[:, :body], dim, fmt), lanes_to_counts(
+    return unpack_inband(wire[:, :body], dim, fmt), lanes_to_counts(
         wire[:, body:])
 
 
 # ---------------------------------------------------------------------------
 # Static wire-cost model (bytes/step, collectives/step) — what the metrics
-# gauges, PERF.md and tools/wire_microbench.py report.
+# gauges, PERF.md and tools/wire_microbench.py report. The model prices the
+# a2a RESULT buffers (S * cap slots per table, self-shard included), which is
+# exactly what the oelint hlo-budget pass counts out of the compiled HLO —
+# `wire_model_delta` in tools/oelint/hlo_budget.json pins model == HLO.
 # ---------------------------------------------------------------------------
 
 
@@ -252,14 +365,17 @@ def exchange_cost(tables, num_shards: int, fmt: str,
     PS table, `cap` the per-(src,dst) bucket capacity of ITS batch. Tables
     sharing `dim` form one dim-group; `fused=False` prices the pre-round-6
     per-table protocol for comparison. Bytes are what ONE device ships
-    through the three all_to_alls (recv volume is symmetric).
+    through the three all_to_alls (recv volume is symmetric). `bytes_scales`
+    breaks out the in-band scale lanes (int8 only) already included in the
+    row/grad totals — the honest price of the in-collective format.
     """
     S = num_shards
     groups = {}
     for t in tables:
         groups.setdefault(t["dim"], []).append(t)
     n_units = len(groups) if fused else len(tables)
-    bytes_ids = bytes_rows = bytes_grads = 0
+    w = jnp.dtype(wire_dtype(fmt)).itemsize
+    bytes_ids = bytes_rows = bytes_grads = bytes_scales = 0
     for dim, members in groups.items():
         # fused groups widen mixed-layout ids to the common wire layout;
         # a uniform group keeps its native layout (see dedup.concat_owner_buckets)
@@ -270,13 +386,56 @@ def exchange_cost(tables, num_shards: int, fmt: str,
             per_id = (id_wire_itemsize(pair_wire, iid) if fused
                       else id_wire_itemsize(m["pair"], m["id_itemsize"]))
             bytes_ids += S * cap * per_id
-            w = jnp.dtype(wire_dtype(fmt)).itemsize
             bytes_rows += S * cap * rows_wire_width(dim, fmt) * w
             bytes_grads += S * cap * grads_wire_width(dim, fmt) * w
+            if fmt == "int8":
+                # one set of scale lanes in the row payload, one in the grads
+                bytes_scales += S * cap * _SCALE_LANES * scale_blocks(dim) \
+                    * w * 2
     total = bytes_ids + bytes_rows + bytes_grads
     return {"format": fmt, "num_shards": S, "fused": fused,
             "dim_groups": len(groups), "tables": len(tables),
+            "wire_dtype": str(jnp.dtype(wire_dtype(fmt))),
+            "wire_itemsize": int(w),
             "collectives_per_step": 3 * n_units if S > 1 else 0,
             "bytes_ids": int(bytes_ids), "bytes_rows": int(bytes_rows),
             "bytes_grads": int(bytes_grads),
+            "bytes_scales": int(bytes_scales) if S > 1 else 0,
             "bytes_per_step": int(total) if S > 1 else 0}
+
+
+def hot_reduce_cost(hot_rows_by_table, num_shards: int, fmt: str) -> dict:
+    """Static per-device cost model of the hot-row gradient reduction
+    (`parallel/sharded._hot_apply`), per hot format:
+
+    - fp32 / bf16: one ring all-reduce of the dense (H, dim) aggregate,
+      ~2*(S-1)/S * H * dim * itemsize bytes per device;
+    - int8: the two-stage quantized reduce — an all_to_all of the encoded
+      (Hp, W) buffer plus an all_gather of the re-encoded partial sums, each
+      a full Hp * W int8 result buffer (Hp = H padded to a multiple of S,
+      W = rows_wire_width(dim, 'int8')) — `a2a_bytes` / `all_gather_bytes`
+      are what the hlo-budget counter sees for those collectives.
+
+    `hot_rows_by_table`: list of dicts {dim, hot} (hot = H, rows cached).
+    The exact int32 count psum (H * 4 bytes) rides in `bytes` for every
+    format.
+    """
+    S = num_shards
+    ring = 2 * (S - 1) / S if S > 1 else 0
+    total = a2a = ag = 0
+    for t in hot_rows_by_table:
+        H, dim = t["hot"], t["dim"]
+        if H <= 0 or S <= 1:
+            continue
+        total += int(ring * H * 4)  # exact int32 counts psum
+        if fmt == "int8":
+            Hp = -(-H // S) * S
+            W = rows_wire_width(dim, "int8")
+            a2a += Hp * W
+            ag += Hp * W
+            total += 2 * Hp * W
+        else:
+            w = jnp.dtype(wire_dtype(fmt)).itemsize
+            total += int(ring * H * dim * w)
+    return {"format": fmt, "bytes": int(total),
+            "a2a_bytes": int(a2a), "all_gather_bytes": int(ag)}

@@ -159,6 +159,7 @@ def snapshot_addressable(state, num_shards: int):
             slots={k: snap_rows(v) for k, v in ts.slots.items()},
             keys=snap_rows(ts.keys),
             overflow=None if ts.overflow is None else np.asarray(ts.overflow),
+            ef=snap_rows(ts.ef),
         )
     return TrainState(
         step=np.asarray(state.step),
@@ -221,6 +222,11 @@ def save_sharded(state, model, path: str, *, num_shards: int,
                     np.save(os.path.join(sdir, f"slot_{slot_name}.npy"), arr)
             continue
         ts = state.tables[name]
+        if include_optimizer and getattr(ts, "ef", None) is not None:
+            # error-feedback residuals stream under the reserved slot name
+            # "__ef__" (same sharding/layout as any optimizer slot) so the
+            # quantized-wire training state round-trips bit-exactly
+            ts = ts.replace(slots={**ts.slots, "__ef__": ts.ef}, ef=None)
         w_shards = dict(_row_shards(ts.weights, num_shards))
         slot_shards = {k: dict(_row_shards(v, num_shards))
                        for k, v in ts.slots.items()} if include_optimizer else {}
@@ -441,6 +447,13 @@ def load_sharded(state, model, path: str, *, num_shards: int = 1,
             ot.load_store(ids, w, slots)
             new_tables[name] = ot.state
             continue
+        # ef residuals load through the slot path under the reserved name
+        # "__ef__"; checkpoints written before round 13 simply lack the file
+        # and the target's zero template survives the round trip
+        ef_template = getattr(ts, "ef", None)
+        if ef_template is not None:
+            ts = ts.replace(slots={**ts.slots, "__ef__": ef_template},
+                            ef=None)
         dim = spec.output_dim
         sharded_target = (isinstance(ts.weights, jax.Array)
                           and T > 1)
@@ -560,6 +573,12 @@ def load_sharded(state, model, path: str, *, num_shards: int = 1,
                         ts.slots[k])
                 new_tables[name] = ts.replace(weights=_put_like(w, ts.weights),
                                               slots=slots)
+        if ef_template is not None:
+            # hoist the reserved slot back out into the ef leaf
+            nt = new_tables[name]
+            slots = dict(nt.slots)
+            ef = slots.pop("__ef__", ef_template)
+            new_tables[name] = nt.replace(slots=slots, ef=ef)
 
     return state.replace(
         step=jnp.asarray(extra.get("step", 0), jnp.int32),

@@ -32,9 +32,14 @@ occupies a fixed capacity segment of one concatenated wire array (the table
 index is position-encoded — see `ops/dedup.concat_owner_buckets`), so a
 T-table model with G dim-groups launches 3*G collectives instead of 3*T.
 Row/grad payloads optionally travel quantized (bf16 default / int8 opt-in,
-`ops/wire.py`, `OETPU_WIRE`); id buckets and duplicate-count lanes are always
-exact. `S == 1` specializes to identity routing (no collectives, no bucket
-scatters, no wire quantization).
+`ops/wire.py`, `OETPU_WIRE`) — and since round 13 the encode runs BEFORE the
+collective (rows at the owner edge in `_serve_rows`, grads at the client
+edge), so the compiled a2a operands themselves are int8/bf16 with the scales
+in-band; int8 training adds pull-side error-feedback residuals
+(`EmbeddingTableState.ef`, served rows ship q(w+ef)) and stochastic rounding
+on the grad push so AUC holds fp32 parity. Id buckets and duplicate-count
+lanes are always exact. `S == 1` specializes to identity routing (no
+collectives, no bucket scatters, no wire quantization).
 
 Static capacity: each (src, dst) bucket holds `capacity` ids. `capacity == n` is exact
 but moves S*n ids; real workloads set a capacity_factor so capacity ~ factor * n / S
@@ -449,18 +454,31 @@ def exchange_load_stats(plan: ExchangePlan, *, axis: str = DATA_AXIS
 
 
 def _serve_rows(spec: EmbeddingSpec, state: EmbeddingTableState,
-                plan: ExchangePlan, *, train: bool, axis: str
-                ) -> Tuple[EmbeddingTableState, jax.Array]:
+                plan: ExchangePlan, *, train: bool, axis: str,
+                fmt: str = "fp32") -> Tuple[EmbeddingTableState, jax.Array]:
     """Server side of a pull: gather this shard's rows for the received ids.
     With a migration directory, received MIGRATED ids (the indirection routed
     them here because this shard is their assigned owner) read from the annex
     instead of the main table — and are masked out of the main-table probe,
-    so a hash table never lazily re-inserts a row that lives in the annex."""
+    so a hash table never lazily re-inserts a row that lives in the annex.
+
+    `fmt` is the wire format of the RETURNED buffer. "fp32" returns the raw
+    (S, cap, dim) rows — the pre-round-13 contract, trace-identical. A
+    narrow format encodes HERE, at the owner edge, so the pull all_to_all
+    moves int8/bf16 with the scales in-band (`ops/wire.pack_inband`) — and
+    when the table carries error-feedback residuals (`state.ef`), each
+    served row ships q(w + ef) and the shard keeps ef' = (w + ef) - deq(q):
+    server-side compression EF (dist-EF-SGD), sharded like the slots so the
+    residual follows its row through checkpoints. Annex (migrated) rows
+    quantize WITHOUT a residual — their owner is the assigned shard, not
+    the hash home the ef array is laid out for."""
     S = jax.lax.axis_size(axis)
     pair = plan.recv_ids.ndim == 3  # (S, cap, 2) split-pair buckets
     flat_recv = (plan.recv_ids.reshape(-1, 2) if pair
                  else plan.recv_ids.reshape(-1))
     flat_valid = plan.recv_valid.reshape(-1)
+    need_ef = train and fmt != "fp32" and state.ef is not None
+    ef_idx = None
     mig = state.mig
     m_found = None
     if mig is not None:
@@ -482,6 +500,13 @@ def _serve_rows(spec: EmbeddingSpec, state: EmbeddingTableState,
             # overflow is replicated table-level state: psum the per-shard increment
             delta = jax.lax.psum(state.overflow - old_overflow, axis)
             state = state.replace(overflow=old_overflow + delta)
+            if need_ef:
+                # post-insert probe: the residual lives at the row's slot
+                # (invalid/annex positions probe EMPTY -> miss -> OOB index)
+                from ..tables.hash_table import hash_find
+                capacity = state.keys.shape[0]
+                slot = hash_find(state.keys, probe)
+                ef_idx = jnp.where(slot < capacity, slot, capacity)
         else:
             from ..tables.hash_table import hash_lookup
             rows = hash_lookup(state, probe)
@@ -494,11 +519,30 @@ def _serve_rows(spec: EmbeddingSpec, state: EmbeddingTableState,
             # the gathered packed rows — the gather is latency-bound, the
             # slot bytes ride free
             rows = rows[:, :spec.output_dim]
+        if need_ef:
+            ef_idx = jnp.where(main_valid, flat_recv // S,
+                               state.ef.shape[0]).astype(jnp.int32)
     if m_found is not None:
         M = mig.weights.shape[0]
         arows = lookup_rows(mig.weights, jnp.where(m_found, m_rank, M))
         rows = jnp.where(m_found[:, None], arows.astype(rows.dtype), rows)
-    return state, rows.reshape(S, plan.cap, spec.output_dim)
+    if fmt == "fp32":
+        return state, rows.reshape(S, plan.cap, spec.output_dim)
+    # owner-edge encode: the pull a2a operand is already int8/bf16
+    from ..ops import wire as wire_mod
+    x = rows.astype(jnp.float32)
+    if need_ef:
+        # invalid/annex slots index OOB: the gather fills 0, the scatter
+        # drops. Duplicate recv slots (one id requested by several sources)
+        # gather the same w+ef and write the same residual — deterministic.
+        x = x + state.ef.at[ef_idx].get(mode="fill", fill_value=0)
+        enc = wire_mod.pack_inband(x, fmt)
+        ef_new = x - wire_mod.unpack_inband(enc, spec.output_dim, fmt)
+        state = state.replace(ef=state.ef.at[ef_idx].set(
+            ef_new.astype(state.ef.dtype), mode="drop"))
+    else:
+        enc = wire_mod.pack_inband(x, fmt)
+    return state, enc.reshape(S, plan.cap, -1)
 
 
 def _merge_hot_rows(plan: ExchangePlan, uniq_rows: jax.Array,
@@ -549,7 +593,8 @@ def _mig_pull_stats(plan: ExchangePlan) -> Dict[str, jax.Array]:
 
 # oelint: hot-path device_get=0
 def _hot_apply(spec: EmbeddingSpec, optimizer, hot: HotRows,
-               plan: ExchangePlan, g: jax.Array, axis) -> HotRows:
+               plan: ExchangePlan, g: jax.Array, axis,
+               fmt: str = "fp32") -> HotRows:
     """Backward for the hot set: scatter the per-unique grad sums into the
     compact (H, dim) hot aggregate (SparCML's dense-ified hot payload — the
     shape collectives handle cheaply), ONE psum across the data axis, then
@@ -566,7 +611,16 @@ def _hot_apply(spec: EmbeddingSpec, optimizer, hot: HotRows,
     bit-exact hot-on vs hot-off there (tests/test_hot.py pins it). A backend
     whose all-reduce associates differently keeps equality up to
     reassociation of the S per-replica partials (each partial is itself the
-    bit-exact client pre-sum)."""
+    bit-exact client pre-sum).
+
+    `fmt` narrows the dense grad reduction (`MeshTrainer(hot_wire=...)`):
+    bf16 runs the same one-psum plan on a bf16 aggregate; int8 runs the
+    two-stage quantized reduce (EQuARX's in-collective scheme) — encode the
+    padded (Hp, W) aggregate, all_to_all so shard r holds every replica's
+    rows [r*Hp/S, (r+1)*Hp/S), decode + fp32-sum, re-encode the partial
+    sums, all_gather(tiled) the (Hp/S, W) results back to everyone. Every
+    replica decodes the SAME gathered bits, so the replicated slots still
+    never diverge. Counts stay an exact int32 psum in every format."""
     H = hot.weights.shape[0]
     hm = plan.hot_slot < H
     tgt = jnp.where(hm, plan.hot_slot, H)
@@ -575,7 +629,26 @@ def _hot_apply(spec: EmbeddingSpec, optimizer, hot: HotRows,
     hc = jnp.zeros((H,), jnp.int32).at[tgt].set(
         jnp.where(hm, plan.uniq.counts, 0).astype(jnp.int32),
         mode="drop", unique_indices=True)
-    tg = jax.lax.psum(hg, axis)
+    if fmt == "fp32":
+        tg = jax.lax.psum(hg, axis)
+    elif fmt == "bf16":
+        tg = jax.lax.psum(hg.astype(jnp.bfloat16), axis).astype(jnp.float32)
+    else:
+        from ..ops import wire as wire_mod
+        S = jax.lax.axis_size(axis)
+        Hp = -(-H // S) * S
+        hp = (jnp.zeros((Hp, spec.output_dim), jnp.float32).at[:H].set(hg)
+              if Hp != H else hg)
+        enc = wire_mod.pack_inband(hp, "int8")              # (Hp, W)
+        W = enc.shape[1]
+        parts = jax.lax.all_to_all(enc.reshape(S, Hp // S, W), axis, 0, 0)
+        dec = wire_mod.unpack_inband(
+            parts.reshape(-1, W), spec.output_dim,
+            "int8").reshape(S, Hp // S, spec.output_dim)
+        partial = jnp.sum(dec, axis=0)                      # this shard's rows
+        enc2 = wire_mod.pack_inband(partial, "int8")        # (Hp/S, W)
+        full = jax.lax.all_gather(enc2, axis, tiled=True)   # (Hp, W)
+        tg = wire_mod.unpack_inband(full, spec.output_dim, "int8")[:H]
     tc = jax.lax.psum(hc, axis)
     new_w, new_s = optimizer.apply(hot.weights.astype(jnp.float32),
                                    hot.slots, tg, tc)
@@ -586,14 +659,23 @@ def _hot_apply(spec: EmbeddingSpec, optimizer, hot: HotRows,
 
 def _reassemble(plan: ExchangePlan, rows: jax.Array, out_shape,
                 dim: int, axis: str,
-                hot: Optional[HotRows] = None) -> jax.Array:
+                hot: Optional[HotRows] = None,
+                fmt: str = "fp32") -> jax.Array:
     """Client side: rows back over the a2a, un-bucket, expand duplicates,
     overlay the local hot-cache gather. At S=1 the served rows ARE the unique
-    rows (make_plan's identity plan) — no a2a, no unbucket gather."""
+    rows (make_plan's identity plan) — no a2a, no unbucket gather. A narrow
+    `fmt` means `rows` is the owner-edge ENCODED buffer (`_serve_rows`): the
+    all_to_all moves it as-is — int8/bf16 through the collective — and the
+    decode runs here, at the client edge."""
     if jax.lax.axis_size(axis) == 1:
         uniq_rows = rows[0]
     else:
         back = jax.lax.all_to_all(rows, axis, 0, 0)
+        if fmt != "fp32":
+            from ..ops import wire as wire_mod
+            back = wire_mod.unpack_inband(
+                back.reshape(-1, back.shape[-1]), dim,
+                fmt).reshape(back.shape[0], -1, dim)
         uniq_rows = unbucket(back, plan.buckets.owner, plan.buckets.slot)
     uniq_rows = _merge_hot_rows(plan, uniq_rows, hot)
     out = jnp.take(uniq_rows, plan.uniq.inverse, axis=0)
@@ -613,17 +695,26 @@ def sharded_lookup_train(
     axis: str = DATA_AXIS,
     capacity_factor: float = 0.0,
     load_stats: bool = True,
+    wire: Optional[str] = "fp32",
 ) -> Tuple[EmbeddingTableState, jax.Array, Dict[str, jax.Array], ExchangePlan]:
     """Training pull inside shard_map. Returns (new_shard_state, rows, stats, plan);
     feed the plan to `sharded_apply_gradients` for the same batch.
     `load_stats=False` drops the per-shard skew vectors
-    (`exchange_load_stats`) from the stats dict."""
+    (`exchange_load_stats`) from the stats dict. `wire` selects the pull
+    a2a's payload format (default fp32, the bit-exact pre-round-13 wire;
+    None resolves $OETPU_WIRE like the fused path)."""
+    from ..ops import wire as wire_mod
     ids = adapt_batch_ids(spec, state, ids)
     plan = make_plan(spec, ids, axis=axis, capacity_factor=capacity_factor,
                      hot=state.hot, mig=state.mig)
-    state, rows = _serve_rows(spec, state, plan, train=True, axis=axis)
+    fmt = (wire_mod.wire_format(wire)
+           if jax.lax.axis_size(axis) > 1 else "fp32")
+    state, rows = _serve_rows(spec, state, plan, train=True, axis=axis,
+                              fmt=fmt)
     out = _reassemble(plan, rows, _out_shape(spec, ids), spec.output_dim,
-                      axis, hot=state.hot)
+                      axis, hot=state.hot, fmt=fmt)
+    if fmt != "fp32":
+        out = out.astype(spec.dtype)
     stats = {
         # reference accumulator counts id POSITIONS (lane-count agnostic)
         "pull_indices": jnp.asarray(ids_positions(spec, ids), jnp.int32),
@@ -631,9 +722,8 @@ def sharded_lookup_train(
         "pull_overflow": plan.buckets.overflow,
     }
     if plan.hot_slot is not None:
-        # the per-table protocol always ships fp32 payloads
         stats.update(_hot_pull_stats(spec, plan, flatten_ids(spec, ids),
-                                     "fp32"))
+                                     fmt))
     if plan.mig_moved is not None:
         stats.update(_mig_pull_stats(plan))
     if load_stats:
@@ -674,6 +764,8 @@ def sharded_apply_gradients(
     capacity_factor: float = 0.0,
     plan: Optional[ExchangePlan] = None,
     packed=None,
+    wire: Optional[str] = "fp32",
+    hot_wire: Optional[str] = None,
 ) -> Tuple[EmbeddingTableState, Dict[str, jax.Array]]:
     """Push + fused update inside shard_map. Pass the pull's `plan` to skip the
     duplicate dedup/bucketing and id exchange.
@@ -681,8 +773,15 @@ def sharded_apply_gradients(
     `packed`: the column layout when the shard state holds the packed
     weights+slots array (`ops/sparse.packed_layout`, inside
     `Trainer.train_many`'s scan) — the update then pays one gather/scatter
-    pair per shard instead of one per array."""
+    pair per shard instead of one per array. `wire` selects the push a2a's
+    payload format (int8 grads round stochastically — the hash dither of
+    `ops/wire._dither`); `hot_wire` the hot-row reduction's (defaults to
+    `wire`)."""
+    from ..ops import wire as wire_mod
     S = jax.lax.axis_size(axis)
+    fmt = wire_mod.wire_format(wire) if S > 1 else "fp32"
+    hot_fmt = (wire_mod.wire_format(hot_wire) if hot_wire is not None
+               else fmt)
     if plan is None:
         ids = adapt_batch_ids(spec, state, ids)
         plan = make_plan(spec, ids, axis=axis, capacity_factor=capacity_factor,
@@ -695,14 +794,15 @@ def sharded_apply_gradients(
     g = uniq.segment_reduce(gflat)
     valid = (uniq.counts > 0) & _id_valid(spec, uniq.unique_ids)
     new_hot = (None if plan.hot_slot is None or state.hot is None
-               else _hot_apply(spec, optimizer, state.hot, plan, g, axis))
+               else _hot_apply(spec, optimizer, state.hot, plan, g, axis,
+                               fmt=hot_fmt))
     if S == 1:
         # identity routing (see make_plan): the local unique slots ARE the
         # server's receive buffer — no bucket scatter, no grad/count a2a
         rids = uniq.unique_ids
         rg = g
         rc = jnp.where(valid, uniq.counts, 0)
-    else:
+    elif fmt == "fp32":
         # scatter grads into the plan's bucket positions (payload follows its
         # id), with the duplicate COUNT riding as extra payload lanes — the
         # raw int32 bits BITCAST into the grad dtype (exact for any count, no
@@ -727,6 +827,22 @@ def sharded_apply_gradients(
         tail = flat[:, spec.output_dim:]
         rc = jax.lax.bitcast_convert_type(
             tail[:, 0] if lanes == 1 else tail, jnp.int32).reshape(-1)
+    else:
+        # narrow push: client-edge encode so the a2a operand is int8/bf16
+        # (counts still bit-exact in the trailing lanes; empty slots are
+        # zero bits -> grad 0, scale 0, count 0). int8 grads round with the
+        # deterministic hash dither — unbiased pushes, no residual needed
+        # on the client (the pull-side ef handles the row direction).
+        counts_i32 = jnp.where(valid, uniq.counts, 0).astype(jnp.int32)
+        payload = wire_mod.encode_grads(g, counts_i32, fmt,
+                                        stochastic=(fmt == "int8"))
+        g_buckets = _scatter_buckets(payload, buckets, S, cap)
+        recv = jax.lax.all_to_all(g_buckets, axis, 0, 0)
+        rids = (plan.recv_ids.reshape(-1, 2) if plan.recv_ids.ndim == 3
+                else plan.recv_ids.reshape(-1))
+        rg32, rc = wire_mod.decode_grads(
+            recv.reshape(-1, recv.shape[-1]), spec.output_dim, fmt)
+        rg = rg32.astype(g.dtype)
     stats = {"push_overflow": buckets.overflow}
     new_state = _apply_unique(spec, state, optimizer, rids, rg, rc, S,
                               packed=packed)
@@ -833,27 +949,40 @@ def grouped_lookup_train(
     plans = grouped_make_plans(specs, ids_list, axis=axis,
                                capacity_factor=capacity_factor, hots=hots,
                                migs=[state.mig for state in states])
+    fmt = wire_mod.wire_format(wire) if S > 1 else "fp32"
     new_states, rows_list = [], []
     for spec, state, plan in zip(specs, states, plans):
-        state, rows = _serve_rows(spec, state, plan, train=True, axis=axis)
+        # narrow formats encode PER TABLE at the owner edge (`_serve_rows`)
+        # so each table's error-feedback residuals see their own rows; the
+        # encoded widths are uniform across the dim-group, so the concat
+        # below still fuses ONE a2a
+        state, rows = _serve_rows(spec, state, plan, train=True, axis=axis,
+                                  fmt=fmt)
         new_states.append(state)
         rows_list.append(rows)
-    fmt = wire_mod.wire_format(wire)
     if S == 1:
         outs = [_reassemble(plan, rows, _out_shape(spec, ids),
                             spec.output_dim, axis)
                 for spec, ids, plan, rows
                 in zip(specs, ids_list, plans, rows_list)]
     else:
-        # one encode + ONE all_to_all for the whole group's rows (mixed
-        # table dtypes promote at the concat; decode returns f32 and each
-        # table casts back to its own dtype — exact for bf16-kept tables)
+        # ONE all_to_all for the whole group's rows. fp32 keeps the round-6
+        # flow (mixed table dtypes promote at the concat); narrow formats
+        # ship the already-encoded int8/bf16 buffers straight through the
+        # collective — decode returns f32 and each table casts back to its
+        # own dtype (exact for bf16-kept tables)
         stacked = jnp.concatenate(rows_list, axis=1)
-        enc = wire_mod.encode_rows(stacked.reshape(-1, dim), fmt)
-        back = jax.lax.all_to_all(
-            enc.reshape(S, -1, enc.shape[-1]), axis, 0, 0)
-        dec = wire_mod.decode_rows(
-            back.reshape(-1, enc.shape[-1]), dim, fmt).reshape(S, -1, dim)
+        if fmt == "fp32":
+            enc = wire_mod.encode_rows(stacked.reshape(-1, dim), fmt)
+            back = jax.lax.all_to_all(
+                enc.reshape(S, -1, enc.shape[-1]), axis, 0, 0)
+            dec = wire_mod.decode_rows(
+                back.reshape(-1, enc.shape[-1]), dim, fmt).reshape(S, -1, dim)
+        else:
+            back = jax.lax.all_to_all(stacked, axis, 0, 0)
+            dec = wire_mod.unpack_inband(
+                back.reshape(-1, stacked.shape[-1]), dim,
+                fmt).reshape(S, -1, dim)
         outs, off = [], 0
         for spec, ids, plan, hot in zip(specs, ids_list, plans, hots):
             seg = dec[:, off:off + plan.cap]
@@ -889,15 +1018,21 @@ def grouped_apply_gradients(
     plans=None,
     packed_list=None,
     wire: Optional[str] = None,
+    hot_wire: Optional[str] = None,
 ):
     """Fused push + update for one dim-group: ONE all_to_all carries every
     table's grads+counts (counts bit-exact in wire lanes, grads optionally
-    quantized — dequantized here at the receiving edge, so the fused
-    optimizer apply and table storage keep their full-precision dtypes).
+    quantized — int8 with stochastic rounding and in-band scales, dequantized
+    here at the receiving edge, so the fused optimizer apply and table
+    storage keep their full-precision dtypes). `hot_wire` selects the
+    hot-row reduction's format separately (defaults to `wire`).
     Returns (new_states, stats_list)."""
     from ..ops import wire as wire_mod
     S = jax.lax.axis_size(axis)
     dim = specs[0].output_dim
+    fmt = wire_mod.wire_format(wire) if S > 1 else "fp32"
+    hot_fmt = (wire_mod.wire_format(hot_wire) if hot_wire is not None
+               else fmt)
     if plans is None:
         ids_list = [adapt_batch_ids(spec, state, ids)
                     for spec, state, ids in zip(specs, states, ids_list)]
@@ -919,7 +1054,7 @@ def grouped_apply_gradients(
     # hot sets: reduced data-parallel, never on the fused wire (_hot_apply)
     hot_list = [
         (None if plan.hot_slot is None or state.hot is None
-         else _hot_apply(spec, opt, state.hot, plan, g, axis))
+         else _hot_apply(spec, opt, state.hot, plan, g, axis, fmt=hot_fmt))
         for spec, state, opt, plan, g
         in zip(specs, states, optimizers, plans, gs)]
     states = [state if hot is None else state.replace(hot=hot)
@@ -934,9 +1069,9 @@ def grouped_apply_gradients(
                 packed=packed))
             stats_list.append({"push_overflow": plan.buckets.overflow})
         return new_states, stats_list
-    fmt = wire_mod.wire_format(wire)
-    payloads = [_scatter_buckets(wire_mod.encode_grads(g, rc, fmt),
-                                 plan.buckets, S, plan.cap)
+    payloads = [_scatter_buckets(
+        wire_mod.encode_grads(g, rc, fmt, stochastic=(fmt == "int8")),
+        plan.buckets, S, plan.cap)
                 for plan, g, rc in zip(plans, gs, counts_list)]
     recv = jax.lax.all_to_all(jnp.concatenate(payloads, axis=1), axis, 0, 0)
     width = recv.shape[-1]

@@ -43,19 +43,33 @@ class MeshTrainer(Trainer):
                  group_exchange: bool = True,
                  shard_stats: bool = True,
                  hot_rows: "int | Dict[str, int]" = 0,
-                 mig_rows: "int | Dict[str, int]" = 0):
+                 mig_rows: "int | Dict[str, int]" = 0,
+                 hot_wire: Optional[str] = None,
+                 error_feedback: Optional[bool] = None):
         super().__init__(model, optimizer, seed)
         self.mesh = mesh if mesh is not None else make_mesh()
         self.axis = self.mesh.axis_names[0]
         self.num_shards = self.mesh.devices.size  # overrides Trainer.num_shards
         # per-(src,dst) bucket headroom for the a2a exchange; 0 = exact (capacity = n)
         self.capacity_factor = capacity_factor
-        # wire payload format for the fused exchange: None -> $OETPU_WIRE ->
-        # bf16 (ops/wire.py; "fp32" opts out of quantization entirely)
+        # wire payload format for the exchange a2as: None -> $OETPU_WIRE ->
+        # bf16 (ops/wire.py; "fp32" opts out of quantization entirely).
+        # Since round 13 the encode runs INSIDE the protocol (owner/client
+        # edge), so the compiled a2a operands carry this format — both the
+        # fused and the per-table paths.
         self.wire = wire
+        # wire format of the hot-row backward's dense (H, dim) reduction:
+        # None -> follow `wire` (fp32 keeps the round-10 one-psum plan; int8
+        # runs the two-stage a2a + all_gather reduce, `sharded._hot_apply`)
+        self.hot_wire = hot_wire
+        # per-row error-feedback residuals for the lossy pull wire
+        # (`EmbeddingTableState.ef`): None -> on exactly when the resolved
+        # wire format is int8 on a real mesh (bf16 truncation is unbiased
+        # enough for AUC parity; int8 is not — PERF.md round 13)
+        self.error_feedback = error_feedback
         # group_exchange=False falls back to the pre-round-6 per-table
-        # protocol (3 all_to_alls per TABLE, always-fp32 wire) — the
-        # comparison baseline tools/wire_microbench.py measures against
+        # protocol (3 all_to_alls per TABLE) — the comparison baseline
+        # tools/wire_microbench.py measures against
         self.group_exchange = group_exchange
         # static wire-cost model of the last traced step (set at trace time;
         # also published as exchange.* gauges — utils/metrics.py)
@@ -206,19 +220,40 @@ class MeshTrainer(Trainer):
         return {n: s for n, s in self.model.ps_specs().items()
                 if self.mig_rows_for(n)}
 
+    # -- error feedback (lossy-pull residuals) -------------------------------
+
+    def ef_for(self, name: str) -> bool:
+        """Whether this table carries the per-row error-feedback residual
+        (`EmbeddingTableState.ef`). Inert at mesh size 1 (no wire) and for
+        dense-mirrored / host-cached tables (they never ride the exchange);
+        default = on iff the resolved wire format is int8."""
+        if self.num_shards <= 1:
+            return False
+        spec = self.model.specs.get(name)
+        if spec is None or spec.sparse_as_dense \
+                or spec.storage == "host_cached":
+            return False
+        if self.error_feedback is not None:
+            return bool(self.error_feedback)
+        from ..ops import wire as wire_mod
+        return wire_mod.wire_format(self.wire) == "int8"
+
     # -- sharding specs ------------------------------------------------------
 
     def _table_pspec(self, spec: EmbeddingSpec,
                      hot: Optional[bool] = None,
-                     mig: Optional[bool] = None) -> EmbeddingTableState:
-        """PartitionSpec pytree for one table's state. `hot`/`mig` override
-        whether the hot-cache / migration subtrees are included (default: iff
-        the trainer enables them for this table — the managed states always
-        carry them then)."""
+                     mig: Optional[bool] = None,
+                     ef: Optional[bool] = None) -> EmbeddingTableState:
+        """PartitionSpec pytree for one table's state. `hot`/`mig`/`ef`
+        override whether the hot-cache / migration / error-feedback subtrees
+        are included (default: iff the trainer enables them for this table —
+        the managed states always carry them then)."""
         if hot is None:
             hot = bool(self.hot_rows_for(spec.name))
         if mig is None:
             mig = bool(self.mig_rows_for(spec.name))
+        if ef is None:
+            ef = self.ef_for(spec.name)
         hot_spec = None
         if hot:
             hot_spec = HotRows(
@@ -248,6 +283,7 @@ class MeshTrainer(Trainer):
             overflow=P() if spec.use_hash_table else None,
             hot=hot_spec,
             mig=mig_spec,
+            ef=P(self.axis) if ef else None,  # residuals shard like weights
         )
 
     def _state_pspec_tree(self, state: TrainState):
@@ -298,7 +334,9 @@ class MeshTrainer(Trainer):
             opt = self.opt_for(spec)
             rows = spec.rows_per_shard(self.num_shards) * self.num_shards
 
-            def mk(spec=spec, opt=opt, rows=rows):
+            need_ef = self.ef_for(name)
+
+            def mk(spec=spec, opt=opt, rows=rows, need_ef=need_ef):
                 from ..tables.hash_table import fresh_keys
                 key = jax.random.fold_in(jax.random.PRNGKey(self.seed),
                                          spec.variable_id * 131071)
@@ -307,8 +345,10 @@ class MeshTrainer(Trainer):
                 keys = fresh_keys(rows) if spec.use_hash_table else None
                 overflow = (jnp.zeros((), jnp.int32)
                             if spec.use_hash_table else None)
+                ef = (jnp.zeros((rows, spec.output_dim), jnp.float32)
+                      if need_ef else None)
                 return EmbeddingTableState(weights=weights, slots=slots, keys=keys,
-                                           overflow=overflow)
+                                           overflow=overflow, ef=ef)
 
             shardings = jax.tree_util.tree_map(
                 lambda p: NamedSharding(mesh, p),
@@ -748,7 +788,8 @@ class MeshTrainer(Trainer):
                 [row_grads[n] for n in names], axis=self.axis,
                 capacity_factor=self.capacity_factor,
                 plans=[plans[n] for n in names],
-                packed_list=[packed.get(n) for n in names], wire=self.wire)
+                packed_list=[packed.get(n) for n in names], wire=self.wire,
+                hot_wire=self.hot_wire)
             for n, ts, st in zip(names, states, stats_list):
                 new_tables[n] = ts
                 for k, v in st.items():
@@ -766,14 +807,18 @@ class MeshTrainer(Trainer):
             # `batch` is the per-device shard here (tables_pull runs inside
             # shard_map), so ids.size IS the per-device position count
             ids = jnp.asarray(batch["sparse"][spec.feature_name])
-            pair = spec.use_hash_table and is_pair(ids)
-            n = ids.size // 2 if pair else ids.size
+            pair_batch = spec.use_hash_table and is_pair(ids)
+            n = ids.size // 2 if pair_batch else ids.size
             cap = _bucket_capacity(max(n, 1), self.num_shards,
                                    self.capacity_factor)
             tables.append({
                 "dim": spec.output_dim,
                 "cap": cap,
-                "pair": pair,
+                # hash ids ride the wire in the TABLE's key layout —
+                # `adapt_batch_ids` widens single-lane batches to split-pair
+                # at the protocol entry — so their wire slot is 8 B whatever
+                # the batch dtype; array tables ship the batch dtype as-is
+                "pair": spec.use_hash_table,
                 "id_itemsize": jnp.dtype(ids.dtype).itemsize})
             # per-table pull sizes, LABELED by table: the per-table skew
             # (Parallax: sparse behavior is dominated by it) reads straight
@@ -790,32 +835,44 @@ class MeshTrainer(Trainer):
             if M:
                 _metrics.observe("placement.mig_rows", float(M), "gauge",
                                  labels={"table": name})
-        # the per-table fallback protocol always ships fp32 payloads
-        fmt = (wire_mod.wire_format(self.wire) if self.group_exchange
-               else "fp32")
+        # since round 13 BOTH exchange protocols put the resolved wire format
+        # through the compiled a2as (in-band scales); the model prices the
+        # a2a RESULT buffers, the same thing oelint's hlo-budget counts
+        fmt = wire_mod.wire_format(self.wire)
         cost = wire_mod.exchange_cost(
             tables, self.num_shards, fmt, fused=self.group_exchange)
         self.last_wire_cost = cost
         _metrics.observe_exchange_cost(cost)
-        # hot-cache static costs: cache size per table + the per-device wire
-        # bytes of the backward's dense psum (ring-allreduce model,
-        # 2(S-1)/S x the (H, dim) f32 grads + (H,) i32 counts per table) —
-        # the cheap-collective price the replicated hot set pays instead of
-        # riding the a2a (SparCML's dense-ified hot aggregate)
-        hot_bytes = 0
-        S = self.num_shards
+        for name in ps_specs:
+            _metrics.observe("exchange.wire_dtype",
+                             float(cost.get("wire_itemsize", 4)), "gauge",
+                             labels={"table": name})
+        # hot-cache static costs: cache size per table + the wire bytes of
+        # the backward's dense hot reduce, priced by hot_reduce_cost for the
+        # resolved hot format (ring allreduce for fp32/bf16, the two-stage
+        # a2a+all_gather exchange for int8) — the cheap-collective price the
+        # replicated hot set pays instead of riding the a2a (SparCML's
+        # dense-ified hot aggregate)
+        hot_fmt = (wire_mod.wire_format(self.hot_wire)
+                   if self.hot_wire is not None else fmt)
+        hot_tables = []
         for name, spec in ps_specs.items():
             H = self.hot_rows_for(name)
             if not H:
                 continue
             _metrics.observe("hot.rows", float(H), "gauge",
                              labels={"table": name})
-            hot_bytes += int(2 * (S - 1) / S * H * (spec.output_dim * 4 + 4))
-        if hot_bytes:
-            _metrics.observe("hot.replicate_bytes_per_step", float(hot_bytes),
-                             "gauge")
+            hot_tables.append({"dim": spec.output_dim, "hot": H})
+        if hot_tables:
+            hcost = wire_mod.hot_reduce_cost(hot_tables, self.num_shards,
+                                             hot_fmt)
+            _metrics.observe("hot.replicate_bytes_per_step",
+                             float(hcost["bytes"]), "gauge")
             cost = dict(cost)
-            cost["hot_replicate_bytes"] = int(hot_bytes)
+            cost["hot_replicate_bytes"] = int(hcost["bytes"])
+            cost["hot_a2a_bytes"] = int(hcost["a2a_bytes"])
+            cost["hot_all_gather_bytes"] = int(hcost["all_gather_bytes"])
+            cost["hot_wire_format"] = hcost["format"]
             self.last_wire_cost = cost
 
     # packed scan layout: the base `_packed_layouts` gate applies per shard
@@ -829,18 +886,20 @@ class MeshTrainer(Trainer):
     def _packed_apply(self, spec, table, ids, grads, layout, plan=None):
         return sharded_apply_gradients(
             spec, table, self.opt_for(spec), ids, grads, axis=self.axis,
-            capacity_factor=self.capacity_factor, plan=plan, packed=layout)
+            capacity_factor=self.capacity_factor, plan=plan, packed=layout,
+            wire=self.wire, hot_wire=self.hot_wire)
 
     def table_pull(self, spec, table, ids):
         return sharded_lookup_train(
             spec, table, ids, axis=self.axis,
             capacity_factor=self.capacity_factor,
-            load_stats=self.shard_stats)
+            load_stats=self.shard_stats, wire=self.wire)
 
     def table_apply(self, spec, table, ids, grads, plan=None):
         return sharded_apply_gradients(
             spec, table, self.opt_for(spec), ids, grads, axis=self.axis,
-            capacity_factor=self.capacity_factor, plan=plan)
+            capacity_factor=self.capacity_factor, plan=plan,
+            wire=self.wire, hot_wire=self.hot_wire)
 
     def table_lookup(self, spec, table, ids):
         return sharded_lookup(spec, table, ids, axis=self.axis,
@@ -940,7 +999,9 @@ class SeqMeshTrainer(MeshTrainer):
                  capacity_factor: float = 0.0, wire: Optional[str] = None,
                  group_exchange: bool = True, shard_stats: bool = True,
                  hot_rows: "int | Dict[str, int]" = 0,
-                 mig_rows: "int | Dict[str, int]" = 0):
+                 mig_rows: "int | Dict[str, int]" = 0,
+                 hot_wire: Optional[str] = None,
+                 error_feedback: Optional[bool] = None):
         if len(mesh.axis_names) != 2:
             raise ValueError(
                 f"SeqMeshTrainer needs a 2-D (data, seq) mesh, got axes "
@@ -949,7 +1010,8 @@ class SeqMeshTrainer(MeshTrainer):
                          capacity_factor=capacity_factor, wire=wire,
                          group_exchange=group_exchange,
                          shard_stats=shard_stats, hot_rows=hot_rows,
-                         mig_rows=mig_rows)
+                         mig_rows=mig_rows, hot_wire=hot_wire,
+                         error_feedback=error_feedback)
         self.data_axis, self.seq_axis = mesh.axis_names
         # collectives (sparse exchange, psum, metrics) span the flattened mesh
         self.axis = tuple(mesh.axis_names)

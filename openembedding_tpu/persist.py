@@ -465,6 +465,16 @@ def _read_rows(spec, num_shards: int, ts, ids):
     return found, w, s
 
 
+def _ef_as_slot(ts):
+    """ts (or a pspec pytree) with the error-feedback leaf riding the slot
+    dict under the reserved name "__ef__" — the same trick the sharded
+    checkpoint uses, so every slot-generic reader/writer below persists ef
+    without knowing about it. None-safe identity."""
+    if getattr(ts, "ef", None) is None:
+        return ts
+    return ts.replace(slots={**ts.slots, "__ef__": ts.ef}, ef=None)
+
+
 def _make_mesh_row_reader(mesh, axis, state_pspec):
     """shard_map'd touched-row read for a row-sharded HASH table: each shard
     probes its local key range for the ids it owns (same ownership/probe
@@ -605,14 +615,15 @@ class IncrementalPersister(AsyncPersister):
         if key not in self._readers:
             S = self.trainer.num_shards
             if jax.process_count() > 1:
+                # ef injection must mirror _read_touched's _ef_as_slot
                 self._readers[key] = _make_shard_row_reader(
                     self.trainer.mesh, self.trainer.axis,
-                    self.trainer._table_pspec(spec),
+                    _ef_as_slot(self.trainer._table_pspec(spec)),
                     spec.use_hash_table, spec.input_dim)
             elif spec.use_hash_table and S > 1:
                 self._readers[key] = _make_mesh_row_reader(
                     self.trainer.mesh, self.trainer.axis,
-                    self.trainer._table_pspec(spec))
+                    _ef_as_slot(self.trainer._table_pspec(spec)))
             else:
                 self._readers[key] = jax.jit(
                     lambda ts, ids: _read_rows(spec, S, ts, ids))
@@ -623,7 +634,7 @@ class IncrementalPersister(AsyncPersister):
         exist in the table (overflow-dropped ids have no row to persist)."""
         from .ops.id64 import np_split_ids
         spec = self.model.specs[name]
-        ts = state.tables[name]
+        ts = _ef_as_slot(state.tables[name])
         n = ids64.size
         padded = _ceil_pow2(max(1, n))
         pad = np.full((padded - n,), -1, np.int64)
@@ -806,6 +817,25 @@ def _apply_delta(state, model, path: str, *, trainer=None, _cache=None):
         ids64, w, slots = _load_delta_table(path, name)
         if ids64.size == 0:
             continue
+        # ef residuals ride the delta as the reserved slot "__ef__"
+        # (emitted by _read_touched's slot loop); inject the live ef into
+        # the slot dict so the scatter kernels stay slot-generic, hoist it
+        # back out after. A delta carrying residuals into an ef-less state
+        # (or vice versa) degrades gracefully: the extra column is dropped /
+        # the live residuals are left as they are.
+        inject_ef = "__ef__" in slots and getattr(ts, "ef", None) is not None
+        if inject_ef:
+            ts = _ef_as_slot(ts)
+        else:
+            slots.pop("__ef__", None)
+
+        def _hoist(nt, inject=inject_ef):
+            if not inject:
+                return nt
+            sl = dict(nt.slots)
+            ef = sl.pop("__ef__")
+            return nt.replace(slots=sl, ef=ef)
+
         n = ids64.size
         padded = _ceil_pow2(n)
         ids_p = np.concatenate(
@@ -825,13 +855,15 @@ def _apply_delta(state, model, path: str, *, trainer=None, _cache=None):
                 # sentinel-padded ids carry known=False and never insert)
                 if ("admit", name) not in cache:
                     from .tables.host_offload import _make_mesh_admit
+                    pspec = trainer._table_pspec(spec)
+                    if inject_ef:  # pspec injection must mirror the ts's
+                        pspec = _ef_as_slot(pspec)
                     cache[("admit", name)] = _make_mesh_admit(
-                        trainer.mesh, trainer.axis,
-                        trainer._table_pspec(spec), list(ts.slots))
+                        trainer.mesh, trainer.axis, pspec, list(ts.slots))
                 known = jnp.asarray(np.arange(padded) < n)
                 new_ts, _ = cache[("admit", name)](ts, ids_dev, w_dev, s_dev,
                                                    known)
-                new_tables[name] = new_ts
+                new_tables[name] = _hoist(new_ts)
                 continue
 
             if ("hash", name) not in cache:
@@ -851,8 +883,8 @@ def _apply_delta(state, model, path: str, *, trainer=None, _cache=None):
                                       overflow=ts.overflow + overflow)
 
                 cache[("hash", name)] = jax.jit(write, donate_argnums=(0,))
-            new_tables[name] = cache[("hash", name)](
-                ts, ids_dev, w_dev, s_dev)
+            new_tables[name] = _hoist(cache[("hash", name)](
+                ts, ids_dev, w_dev, s_dev))
         else:
             if ("array", name) not in cache:
 
@@ -869,8 +901,8 @@ def _apply_delta(state, model, path: str, *, trainer=None, _cache=None):
                     return ts.replace(weights=weights, slots=new_slots)
 
                 cache[("array", name)] = jax.jit(write, donate_argnums=(0,))
-            new_tables[name] = cache[("array", name)](
-                ts, jnp.asarray(ids_p.astype(np.int32)), w_dev, s_dev)
+            new_tables[name] = _hoist(cache[("array", name)](
+                ts, jnp.asarray(ids_p.astype(np.int32)), w_dev, s_dev))
 
     with np.load(os.path.join(path, "dense.npz")) as z:
         from .checkpoint import _unflatten_params
@@ -940,6 +972,8 @@ class _StateMeshShim:
         self.num_shards = int(self.mesh.shape[self.axis])
         self._slot_names = {name: list(ts.slots)
                             for name, ts in state.tables.items()}
+        self._has_ef = {name: getattr(ts, "ef", None) is not None
+                        for name, ts in state.tables.items()}
 
     def _table_pspec(self, spec):
         from jax.sharding import PartitionSpec as P
@@ -953,6 +987,7 @@ class _StateMeshShim:
                    for k in self._slot_names[spec.name]},
             keys=P(self.axis) if spec.use_hash_table else None,
             overflow=P() if spec.use_hash_table else None,
+            ef=P(self.axis) if self._has_ef[spec.name] else None,
         )
 
 

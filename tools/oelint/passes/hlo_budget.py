@@ -51,6 +51,14 @@ COLLECTIVES = {
     "collective_permute": r" collective-permute(?:-start)?\(",
 }
 
+# result-buffer tensor types on a collective's definition line, e.g.
+# `%all-to-all.1 = s8[8,56,16]{2,1,0} all-to-all(...)`
+_TYPE_RE = re.compile(
+    r"(pred|bf16|f16|f32|f64|s8|u8|s16|u16|s32|u32|s64|u64)\[([0-9,]*)\]")
+_ITEMSIZE = {"pred": 1, "s8": 1, "u8": 1, "bf16": 2, "f16": 2, "s16": 2,
+             "u16": 2, "f32": 4, "s32": 4, "u32": 4, "f64": 8, "s64": 8,
+             "u64": 8}
+
 # the acceptance matrix: per-table vs fused, wire formats, hot on/off, and
 # full placement (hot cache + cold-tail migration directory) — the
 # `fused_fp32_placement` steady-state step must pin the IDENTICAL
@@ -72,6 +80,19 @@ CONFIGS = (
      "hot_rows": 32},
     {"name": "fused_fp32_placement", "group_exchange": True, "wire": "fp32",
      "hot_rows": 32, "mig_rows": 32},
+    # round-13 in-collective configs: the compiled a2a operands must carry
+    # the narrow dtype — `forbid_a2a_dtypes` turns a silent fall-back to
+    # fp32-through-the-a2a into a lint failure even when the budget matches
+    # (a fresh --update-budget would otherwise just pin the regression).
+    # fused_int8_inband also runs error feedback (the default for int8) and
+    # the two-stage s8 hot reduce; fused_fp32_hot_int8 isolates the hot
+    # reduce's format from the exchange's.
+    {"name": "fused_bf16_inband", "group_exchange": True, "wire": "bf16",
+     "hot_rows": 32, "forbid_a2a_dtypes": ("f32",)},
+    {"name": "fused_int8_inband", "group_exchange": True, "wire": "int8",
+     "hot_rows": 32, "forbid_a2a_dtypes": ("f32", "bf16", "u16")},
+    {"name": "fused_fp32_hot_int8", "group_exchange": True, "wire": "fp32",
+     "hot_rows": 32, "hot_wire": "int8"},
 )
 
 
@@ -94,6 +115,32 @@ def _ensure_cpu() -> None:
 def count_collectives(hlo_text: str) -> Dict[str, int]:
     return {kind: len(re.findall(pat, hlo_text))
             for kind, pat in COLLECTIVES.items()}
+
+
+def collective_payloads(hlo_text: str,
+                        kinds=("all_to_all", "all_gather")):
+    """[(kind, dtype, result_bytes)] per matching collective in the compiled
+    HLO — one entry per tensor in the op's RESULT type (tuple results
+    contribute one entry each). This is the measured counterpart of
+    `ops/wire.exchange_cost`, which prices exactly these result buffers."""
+    out = []
+    for line in hlo_text.splitlines():
+        for kind in kinds:
+            m = re.search(COLLECTIVES[kind], line)
+            if not m:
+                continue
+            head = line[:m.start()]
+            eq = head.find("= ")
+            if eq < 0:
+                continue
+            for dt, dims in _TYPE_RE.findall(head[eq + 2:]):
+                n = 1
+                for d in dims.split(","):
+                    if d.strip():
+                        n *= int(d)
+                out.append((kind, dt, n * _ITEMSIZE[dt]))
+            break
+    return out
 
 
 def _budget_model():
@@ -144,19 +191,32 @@ def make_trainer(config: Dict):
     trainer = MeshTrainer(
         model, embed.Adagrad(learning_rate=0.1), mesh=make_mesh(),
         wire=config["wire"], group_exchange=config["group_exchange"],
-        hot_rows=config["hot_rows"], mig_rows=config.get("mig_rows", 0))
+        hot_rows=config["hot_rows"], mig_rows=config.get("mig_rows", 0),
+        hot_wire=config.get("hot_wire"))
     return trainer, batch
 
 
 def measure_trainer(trainer, batch) -> Dict[str, int]:
     """Compile the train step, count collectives, record the static wire
-    model (`exchange.wire_bytes_per_step` from `trainer.last_wire_cost`)."""
+    model (`exchange.wire_bytes_per_step` from `trainer.last_wire_cost`)
+    AND the measured truth: per-collective payload bytes/dtypes read off
+    the compiled HLO, plus `wire_model_delta` = measured minus modeled a2a
+    bytes (0 == the cost model prices the compiled program exactly)."""
     state = trainer.init(batch)
     step = trainer.jit_train_step(batch, state)
     text = step.lower(state, batch).compile().as_text()
     counts = count_collectives(text)
     cost = trainer.last_wire_cost or {}
     counts["wire_bytes_per_step"] = int(cost.get("bytes_per_step", 0))
+    pay = collective_payloads(text)
+    a2a = [(d, b) for k, d, b in pay if k == "all_to_all"]
+    ag = [(d, b) for k, d, b in pay if k == "all_gather"]
+    counts["hlo_a2a_bytes"] = sum(b for _, b in a2a)
+    counts["hlo_all_gather_bytes"] = sum(b for _, b in ag)
+    counts["hlo_a2a_dtypes"] = ",".join(sorted({d for d, _ in a2a}))
+    model_a2a = (int(cost.get("bytes_per_step", 0))
+                 + int(cost.get("hot_a2a_bytes", 0)))
+    counts["wire_model_delta"] = counts["hlo_a2a_bytes"] - model_a2a
     return counts
 
 
@@ -195,13 +255,32 @@ def compare(measured: Dict[str, Dict[str, int]],
                 "run --update-budget and review the diff"))
             continue
         for kind in sorted(set(counts) | set(pinned[name])):
-            got = int(counts.get(kind, 0))
-            want = int(pinned[name].get(kind, 0))
+            got_raw = counts.get(kind, 0)
+            want_raw = pinned[name].get(kind, 0)
+            if isinstance(got_raw, str) or isinstance(want_raw, str):
+                # string-valued pins (hlo_a2a_dtypes): equality, not deltas
+                if str(got_raw) == str(want_raw):
+                    continue
+                out.append(Finding(
+                    BUDGET_REL, 1, NAME,
+                    f"config {name!r}: {kind} changed "
+                    f"{want_raw!r} -> {got_raw!r}. If intentional, "
+                    "regenerate the budget (`python -m tools.oelint "
+                    "--update-budget`) and commit the json diff; otherwise "
+                    "a collective payload silently changed dtype"))
+                continue
+            got = int(got_raw)
+            want = int(want_raw)
             if got == want:
                 continue
             delta = got - want
             if kind == "wire_bytes_per_step":
                 what = (f"per-device exchange bytes/step "
+                        f"{'grew' if delta > 0 else 'shrank'} "
+                        f"{want} -> {got} ({delta:+d})")
+            elif kind in ("hlo_a2a_bytes", "hlo_all_gather_bytes",
+                          "wire_model_delta"):
+                what = (f"compiled-HLO {kind} "
                         f"{'grew' if delta > 0 else 'shrank'} "
                         f"{want} -> {got} ({delta:+d})")
             else:
@@ -214,6 +293,31 @@ def compare(measured: Dict[str, Dict[str, int]],
                 "budget (`python -m tools.oelint --update-budget`) and "
                 "commit the json diff; otherwise a collective/recompile "
                 "crept onto a pinned path"))
+    return out
+
+
+def forbidden_dtype_findings(measured: Dict[str, Dict],
+                             configs=CONFIGS) -> List[Finding]:
+    """Budget-independent dtype policy: configs declaring
+    `forbid_a2a_dtypes` fail when the compiled all-to-alls carry a forbidden
+    payload dtype — a silent fp32 fall-back in a quantized wire mode is a
+    lint failure even straight after --update-budget."""
+    out: List[Finding] = []
+    by_name = {c["name"]: c for c in configs}
+    for name, counts in sorted(measured.items()):
+        forbid = by_name.get(name, {}).get("forbid_a2a_dtypes", ())
+        if not forbid:
+            continue
+        got = {d for d in
+               str(counts.get("hlo_a2a_dtypes", "")).split(",") if d}
+        bad = sorted(got & set(forbid))
+        if bad:
+            out.append(Finding(
+                BUDGET_REL, 1, NAME,
+                f"config {name!r}: compiled all-to-all payload dtype(s) "
+                f"{', '.join(bad)} are forbidden for this wire mode — the "
+                "quantized exchange fell back to a wide payload (measured "
+                f"a2a dtypes: {counts.get('hlo_a2a_dtypes')!r})"))
     return out
 
 
@@ -240,4 +344,6 @@ def update_budget(root: str) -> str:
 
 
 def run(files, root: str) -> List[Finding]:
-    return compare(measure(), load_budget(root))
+    measured = measure()
+    return (compare(measured, load_budget(root))
+            + forbidden_dtype_findings(measured))

@@ -81,6 +81,10 @@ CASES = [
     #    whose stanza is committed here too — it needs no relay, but riding
     #    the battery keeps all BENCH stanzas in one capture file)
     ("bench_wire", *bench_case("wire", 300)),
+    # 8b. round-13 in-collective codec (bench 'wire_inband' case: in-band
+    #     scale pack/unpack, stochastic rounding, and the error-feedback
+    #     serve overhead — the compute the quantized a2as add on-chip)
+    ("bench_wire_inband", *bench_case("wire_inband", 300)),
     ("wire_microbench",
      [sys.executable, os.path.join(REPO, "tools", "wire_microbench.py")],
      {"JAX_PLATFORMS": "cpu"}, 600),
