@@ -48,7 +48,7 @@ throughput, not host dispatch latency — the same way production input pipeline
 drive TPUs (the axon tunnel adds ~40 ms per dispatch that would otherwise swamp
 the measurement; see PERF.md "Measurement hygiene").
 
-Env knobs: OETPU_BENCH_CASES=dim9[,dim64][,mesh1][,mesh1f][,pull][,wire][,wire_inband][,sync][,skew][,hot] (default: all),
+Env knobs: OETPU_BENCH_CASES=dim9[,dim64][,mesh1][,mesh1f][,pull][,wire][,wire_inband][,sync][,skew][,hot][,placement][,zero][,offload_pipe] (default: all),
 OETPU_BENCH_BUDGET_S (default 540), OETPU_BENCH_SCAN_STEPS / _REPEATS (smoke runs),
 OETPU_BENCH_TOTAL_BUDGET_S / _PROBE_TIMEOUT_S / _PROBE_INTERVAL_S (orchestrator).
 """
@@ -785,6 +785,165 @@ def case_placement():
     return out
 
 
+def case_zero():
+    """ZeRO dense-state sharding (round 14): `MeshTrainer(dense_shard=True)`
+    vs the replicated baseline on the same batches — optimizer-state bytes
+    per replica (the S-fold win), ms/step (reduce_scatter + 1/S-chunk update
+    + all_gather vs psum + full update), and the pinned bit-parity of the
+    final loss. Needs S >= 2; the battery entry runs the 8-virtual-device
+    CPU mesh."""
+    import jax
+    import openembedding_tpu as embed
+    from openembedding_tpu.models import make_deepfm
+    from openembedding_tpu.parallel import MeshTrainer, make_mesh
+    from openembedding_tpu.utils import metrics as metrics_mod
+
+    WD.stage("zero:init", 240)
+    devs = jax.devices()
+    S = min(8, len(devs))
+    mesh = make_mesh(devs[:S])
+    cpu = devs[0].platform == "cpu"
+    vocab = int(os.environ.get("OETPU_BENCH_ZERO_VOCAB", str(1 << 13)))
+    batch = min(BATCH, 1024) if cpu else BATCH
+    steps = 12 if cpu else 30
+
+    def stream(seed=17):
+        rng = np.random.default_rng(seed)
+        return [{"sparse": {"categorical":
+                            rng.integers(0, vocab, (batch, 26)).astype(
+                                np.int32)},
+                 "dense": rng.normal(size=(batch, 13)).astype(np.float32),
+                 "label": rng.integers(0, 2, (batch,)).astype(np.float32)}
+                for _ in range(steps)]
+
+    def one_config(name, dense_shard, bs):
+        WD.stage(f"zero:{name}", 600)
+        metrics_mod._REGISTRY.clear()
+        model = make_deepfm(vocabulary=vocab, dim=9)
+        # Adam: two vector slots + scalar beta powers — the heavy opt state
+        tr = MeshTrainer(model, embed.Adam(learning_rate=0.001), mesh=mesh,
+                         capacity_factor=0.0, dense_shard=dense_shard)
+        state = tr.init(bs[0])
+        step = tr.jit_train_step(bs[0], state)
+        times, loss = [], None
+        for i, b in enumerate(bs):
+            t0 = time.perf_counter()
+            state, m = step(state, b)
+            loss = float(m["loss"])
+            if i:
+                times.append(time.perf_counter() - t0)
+        rep = metrics_mod.report()
+        out = {"ms_per_step": round(min(times) * 1e3, 2),
+               "loss_final": loss}
+        if dense_shard:
+            out["params_total"] = int(rep.get("dense.params_total", 0))
+            out["opt_state_bytes_per_replica"] = int(
+                rep.get("dense.opt_state_bytes_per_replica", 0))
+            out["reduce_scatter_bytes"] = int(
+                rep.get("dense.reduce_scatter_bytes", 0))
+            out["all_gather_bytes"] = int(
+                rep.get("dense.all_gather_bytes", 0))
+        else:
+            # replicated baseline: every replica holds full vector slots
+            from openembedding_tpu.parallel import zero as zero_mod
+            plan = zero_mod.build_plan(
+                tr._dense_trainable(state), tr.optimizer, S)
+            out["params_total"] = plan.total
+            out["opt_state_bytes_per_replica"] = int(
+                len(plan.vector_slots) * plan.total * 4
+                + len(plan.scalar_slots) * 4)
+        return out
+
+    bs = stream()
+    out = {"num_shards": S, "vocab": vocab, "batch": batch, "steps": steps}
+    out["replicated"] = one_config("replicated", False, bs)
+    out["sharded"] = one_config("sharded", True, bs)
+    rep_b, sh_b = (out["replicated"]["opt_state_bytes_per_replica"],
+                   out["sharded"]["opt_state_bytes_per_replica"])
+    if sh_b:
+        out["opt_state_reduction"] = round(rep_b / sh_b, 2)
+    # fp32 bit-parity rides every bench run, not just the test suite
+    out["loss_bit_equal"] = (out["replicated"]["loss_final"]
+                             == out["sharded"]["loss_final"])
+    return out
+
+
+def case_offload_pipe():
+    """Host-offload staging pipeline + densified flush (round 14): the
+    two-tier cache under churn — pipeline on/off x densify K in {1,4,16}.
+    Reported per config: ms/round of the prepare+train loop (the staging
+    thread hides the host lookup), pipeline occupancy (staged-batch hit
+    ratio), and drained rows per densified merge. Host-side work; runs on
+    any platform."""
+    import jax.numpy as jnp
+    import openembedding_tpu as embed
+    from openembedding_tpu.embedding import (EmbeddingSpec, apply_gradients,
+                                             lookup_train)
+    from openembedding_tpu.initializers import Constant
+    from openembedding_tpu.tables.host_offload import HostOffloadTable
+    from openembedding_tpu.utils import metrics as metrics_mod
+
+    WD.stage("offload_pipe:init", 240)
+    dim = 16
+    capacity = int(os.environ.get("OETPU_BENCH_OFFLOAD_CAP", str(1 << 13)))
+    per_round = capacity // 2        # heavy admission pressure every round
+    rounds = 20
+    spec = EmbeddingSpec(name="t", input_dim=-1, output_dim=dim,
+                         capacity=capacity, variable_id=0,
+                         initializer=Constant(0.0))
+    rng = np.random.default_rng(23)
+    batches = [rng.integers(0, 1 << 22, size=per_round).astype(np.int64)
+               for _ in range(rounds)]
+    grads = [np.asarray(rng.standard_normal((per_round, dim)), np.float32)
+             for _ in range(rounds)]
+
+    import jax
+
+    def one_config(name, pipeline, densify_k):
+        WD.stage(f"offload_pipe:{name}", 420)
+        metrics_mod._REGISTRY.clear()
+        opt = embed.Adagrad(learning_rate=0.1)
+        off = HostOffloadTable(spec, opt, high_water=0.8,
+                               pipeline=pipeline, densify_k=densify_k)
+        times = []
+        if pipeline:
+            off.stage(batches[0])
+        for r, ids in enumerate(batches):
+            t0 = time.perf_counter()
+            off.prepare(ids)
+            if pipeline and r + 1 < rounds:
+                off.stage(batches[r + 1])
+            st, _ = lookup_train(spec, off.state, jnp.asarray(ids))
+            off.state = apply_gradients(spec, st, opt, jnp.asarray(ids),
+                                        jnp.asarray(grads[r]))
+            jax.block_until_ready(off.state)  # fence: device work is real
+            if r:
+                times.append(time.perf_counter() - t0)
+        rep = metrics_mod.report()
+        out = {"ms_per_round": round(float(np.mean(times)) * 1e3, 2)}
+        if pipeline:
+            out["pipeline_occupancy"] = round(
+                float(rep.get("offload.pipeline_occupancy", 0.0)), 3)
+        if densify_k > 1:
+            out["densified_merges"] = int(
+                rep.get("offload.densified_merges", 0))
+            out["drained_rows"] = int(rep.get("offload.drained_rows", 0))
+        return out
+
+    out = {"capacity": capacity, "ids_per_round": per_round,
+           "rounds": rounds, "dim": dim,
+           "platform": jax.devices()[0].platform}
+    out["sync_k1"] = one_config("sync_k1", False, 1)
+    out["pipe_k1"] = one_config("pipe_k1", True, 1)
+    out["pipe_k4"] = one_config("pipe_k4", True, 4)
+    out["pipe_k16"] = one_config("pipe_k16", True, 16)
+    base = out["sync_k1"]["ms_per_round"]
+    if base:
+        out["pipe_k1_speedup"] = round(
+            base / out["pipe_k1"]["ms_per_round"], 3)
+    return out
+
+
 def case_pull():
     """Embedding-pull p50 (BASELINE.md metric). A pull = the serving/forward read:
     dedup + row gather for one 4096x26 Zipfian batch against the 2^24-row dim-9
@@ -844,7 +1003,7 @@ def main():
     cases = os.environ.get(
         "OETPU_BENCH_CASES",
         "dim9,dim64,mesh1,mesh1f,pull,wire,wire_inband,sync,skew,hot,"
-        "placement").split(",")
+        "placement,zero,offload_pipe").split(",")
 
     # PRIMARY first: whatever happens later, this number is in the artifact.
     if "dim9" in cases:
@@ -863,7 +1022,9 @@ def main():
                  ("sync", case_sync),
                  ("skew", case_skew),
                  ("hot", case_hot),
-                 ("placement", case_placement)]
+                 ("placement", case_placement),
+                 ("zero", case_zero),
+                 ("offload_pipe", case_offload_pipe)]
     for name, fn in secondary:
         if name not in cases:
             continue
@@ -921,6 +1082,16 @@ def main():
                 RESULT["value"] = out["controller_on"].get(
                     "imbalance_post_drift")
                 RESULT["unit"] = "max/mean"
+                break
+            if "sharded" in out:
+                RESULT["metric"] = "zero_sharded_ms_per_step"
+                RESULT["value"] = out["sharded"].get("ms_per_step")
+                RESULT["unit"] = "ms"
+                break
+            if "pipe_k1" in out:
+                RESULT["metric"] = "offload_pipe_k1_ms_per_round"
+                RESULT["value"] = out["pipe_k1"].get("ms_per_round")
+                RESULT["unit"] = "ms"
                 break
 
     WD.clear()

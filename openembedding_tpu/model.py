@@ -195,10 +195,17 @@ class Trainer:
     num_shards = 1  # MeshTrainer overrides with the mesh size
 
     def __init__(self, model: EmbeddingModel,
-                 optimizer: Optional[SparseOptimizer] = None, seed: int = 0):
+                 optimizer: Optional[SparseOptimizer] = None, seed: int = 0,
+                 *, offload_pipeline: bool = False, offload_densify: int = 1):
         self.model = model
         self.optimizer = optimizer or Adagrad()
         self.seed = seed
+        # host_cached pipeline knobs (tables/host_offload.py): pipeline=True
+        # double-buffers the next batch's host lookup + admit upload on a
+        # background thread (drive it via `offload_stage`); densify K>1
+        # accumulates evict/flush writebacks and merges once per K batches
+        self.offload_pipeline = bool(offload_pipeline)
+        self.offload_densify = int(offload_densify)
         # storage="host_cached" variables (tables/host_offload.py), filled by
         # init_tables; empty when every table lives fully in HBM
         self.offload: Dict[str, Any] = {}
@@ -315,6 +322,27 @@ class Trainer:
         self._offload_prepared = True  # train_many's trace-time guard
         return state.replace(tables=new_tables)
 
+    def offload_stage(self, batch) -> None:
+        """Kick off the background host lookup + upload for a FUTURE batch
+        while the device is busy with the current step (no-op unless the
+        trainer was built with offload_pipeline=True). Pipelined loop shape:
+
+            trainer.offload_stage(batches[0])
+            for i, batch in enumerate(batches):
+                state = trainer.offload_prepare(state, batch)  # consumes stage
+                if i + 1 < len(batches):
+                    trainer.offload_stage(batches[i + 1])      # overlaps step
+                state, m = step(state, batch)
+
+        Staging is a hint: `offload_prepare` verifies the staged ids match and
+        falls back to the synchronous path when they don't."""
+        if not self.offload:
+            return
+        if self.model.batch_transform is not None:
+            batch = self.model.batch_transform(batch)
+        for name, ot in self.offload.items():
+            ot.stage(batch["sparse"][self.model.specs[name].feature_name])
+
     def offload_flush(self, state: "TrainState") -> "TrainState":
         """Write every resident row back to the host store and reset the
         caches (end of training / before handing tables elsewhere)."""
@@ -338,6 +366,16 @@ class Trainer:
         overrides (the persisters call it before every snapshot/delta so
         on-disk artifacts stay byte-identical to a hot-off run)."""
         return state
+
+    def externalize(self, state: "TrainState") -> "TrainState":
+        """Return the state in its CANONICAL external layout: hot/migrated
+        rows written home (`hot_sync`) and — under MeshTrainer(dense_shard=
+        True) — the flat sharded dense optimizer state unsharded back to the
+        per-leaf baseline form. Checkpoint/persist/export writers go through
+        this hook, which is what keeps their artifacts byte-identical to a
+        placement-off, ZeRO-off run. The returned state is for EXTERNAL
+        readers; keep training on the original."""
+        return self.hot_sync(state)
 
     @staticmethod
     def overflow_count(metrics) -> int:
@@ -465,7 +503,9 @@ class Trainer:
         for name, spec in self.model.ps_specs().items():
             if spec.storage == "host_cached":
                 from .tables.host_offload import HostOffloadTable
-                ot = HostOffloadTable(spec, self.opt_for(spec), seed=self.seed)
+                ot = HostOffloadTable(spec, self.opt_for(spec), seed=self.seed,
+                                      pipeline=self.offload_pipeline,
+                                      densify_k=self.offload_densify)
                 self.offload[name] = ot
                 tables[name] = ot.state
             else:
@@ -574,9 +614,11 @@ class Trainer:
             dense_grads = self.reduce_dense_grads(dense_grads)
 
         with _trace.span("trainer", "apply"):
-            # DENSE apply (reference: Keras optimizer after Horovod allreduce)
-            new_params, new_slots = dense_apply(
-                self.optimizer, tr0, state.dense_slots, dense_grads)
+            # DENSE apply (reference: Keras optimizer after Horovod allreduce;
+            # MeshTrainer(dense_shard=True) overrides with the ZeRO-sharded
+            # reduce_scatter -> chunk update -> all_gather path)
+            new_params, new_slots = self.dense_update(
+                tr0, state.dense_slots, dense_grads)
             if split is not None:
                 fr = fr_new if fr_new is not None else fr0
                 new_params = model.module.merge_params(
@@ -638,6 +680,12 @@ class Trainer:
 
     def reduce_dense_grads(self, grads):
         return grads
+
+    def dense_update(self, params, slots, grads):
+        """Apply the dense optimizer update. `grads` arrive already reduced
+        by `reduce_dense_grads`. MeshTrainer(dense_shard=True) overrides with
+        the ZeRO-sharded update (parallel/zero.py)."""
+        return dense_apply(self.optimizer, params, slots, grads)
 
     def reduce_module_state(self, fr):
         """Frozen-state updates from the training forward pass. On meshes the

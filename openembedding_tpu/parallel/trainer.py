@@ -45,8 +45,13 @@ class MeshTrainer(Trainer):
                  hot_rows: "int | Dict[str, int]" = 0,
                  mig_rows: "int | Dict[str, int]" = 0,
                  hot_wire: Optional[str] = None,
-                 error_feedback: Optional[bool] = None):
-        super().__init__(model, optimizer, seed)
+                 error_feedback: Optional[bool] = None,
+                 dense_shard: bool = False,
+                 offload_pipeline: bool = False,
+                 offload_densify: int = 1):
+        super().__init__(model, optimizer, seed,
+                         offload_pipeline=offload_pipeline,
+                         offload_densify=offload_densify)
         self.mesh = mesh if mesh is not None else make_mesh()
         self.axis = self.mesh.axis_names[0]
         self.num_shards = self.mesh.devices.size  # overrides Trainer.num_shards
@@ -107,6 +112,19 @@ class MeshTrainer(Trainer):
         # meshes, like hot_rows. Driven autonomously by
         # `placement.PlacementController`.
         self.mig_rows = mig_rows
+        # ZeRO-style dense-state sharding (parallel/zero.py, arXiv:2004.13336):
+        # keep dense params replicated but give each replica a 1/S shard of
+        # the flattened dense optimizer state — the dense-grad psum becomes
+        # reduce_scatter -> chunk update -> all_gather (same wire bytes; a
+        # ring all-reduce IS those two collectives), so dense optimizer
+        # memory and update FLOPs stop scaling with replica count. fp32
+        # training is bit-exact vs replicated and checkpoints/exports/deltas
+        # byte-identical (tests/test_zero.py pins both). Inert on 1-device
+        # meshes and off by default — ZeRO-off compiles byte-identical HLO
+        # (oelint hlo-budget delta 0).
+        self.dense_shard = bool(dense_shard)
+        self._zero_plan = None
+        self._zero_fns: Dict[str, Any] = {}
         self._hot_fns: Dict[str, Any] = {}
         self._mig_fns: Dict[str, Any] = {}
         self._train_step_fn = None
@@ -164,9 +182,10 @@ class MeshTrainer(Trainer):
         writes only its addressable shards, peak host memory O(chunk) — the
         reference's server-side per-shard dump, `EmbeddingDumpOperator.cpp:36-96`.
         `Trainer.load` / `MeshTrainer.load` restore it at any mesh size.
-        Hot-replicated rows write back into their owner shards first
-        (`hot_sync`), so the dump equals a hot-off run's byte for byte."""
-        state = self.hot_sync(state)
+        Hot-replicated rows write back into their owner shards first and
+        ZeRO dense slots unshard (`externalize`), so the dump equals a
+        hot-off, ZeRO-off run's byte for byte."""
+        state = self.externalize(state)
         from .checkpoint import save_sharded
         return self._stage_save(
             lambda p: save_sharded(
@@ -238,6 +257,75 @@ class MeshTrainer(Trainer):
         from ..ops import wire as wire_mod
         return wire_mod.wire_format(self.wire) == "int8"
 
+    # -- ZeRO dense-state sharding (parallel/zero.py) ------------------------
+
+    @property
+    def zero_enabled(self) -> bool:
+        """Whether the dense update runs sharded. Inert at mesh size 1 (the
+        chunk IS the whole vector there — nothing to save)."""
+        return self.dense_shard and self.num_shards > 1
+
+    def _dense_trainable(self, state: TrainState):
+        """The trainable dense subtree (what dense_slots covers — modules
+        with frozen state split it out, see Trainer.init)."""
+        split = getattr(self.model.module, "split_params", None)
+        return (split(state.dense_params)[0] if split is not None
+                else state.dense_params)
+
+    def _zero_plan_for(self, params):
+        """The (cached) flat layout for the trainable subtree. Shapes are
+        model statics, so one plan serves trace time and the host-side
+        conversions alike."""
+        if self._zero_plan is None:
+            from . import zero
+            self._zero_plan = zero.build_plan(params, self.optimizer,
+                                              self.num_shards)
+        return self._zero_plan
+
+    def dense_to_sharded(self, state: TrainState) -> TrainState:
+        """Baseline per-leaf dense_slots -> the flat sharded form (no-op when
+        ZeRO is off or the state is already sharded). Pure concats — a
+        round trip through `dense_to_replicated` is byte-identical."""
+        if not self.zero_enabled:
+            return state
+        from . import zero
+        if zero.is_sharded_slots(state.dense_slots):
+            return state
+        plan = self._zero_plan_for(self._dense_trainable(state))
+        if plan.total == 0:
+            return state
+        zero.check_scalar_slots_equal(plan, state.dense_slots)
+        if "shard" not in self._zero_fns:
+            out_sh = {zero.ZERO_KEY: {
+                k: NamedSharding(self.mesh,
+                                 P(None, self.axis) if k in plan.vector_slots
+                                 else P())
+                for k in (*plan.vector_slots, *plan.scalar_slots)}}
+            self._zero_fns["shard"] = jax.jit(
+                lambda slots: {zero.ZERO_KEY: zero.shard_slots(plan, slots)},
+                out_shardings=out_sh)
+        return state.replace(
+            dense_slots=self._zero_fns["shard"](state.dense_slots))
+
+    def dense_to_replicated(self, state: TrainState) -> TrainState:
+        """The flat sharded dense_slots -> the baseline per-leaf form (no-op
+        when not sharded). This is the external layout: checkpoint / persist
+        / export writers see exactly what a ZeRO-off run holds."""
+        from . import zero
+        if not zero.is_sharded_slots(state.dense_slots):
+            return state
+        plan = self._zero_plan_for(self._dense_trainable(state))
+        if "unshard" not in self._zero_fns:
+            self._zero_fns["unshard"] = jax.jit(
+                lambda fs: zero.unshard_slots(plan, fs),
+                out_shardings=NamedSharding(self.mesh, P()))
+        return state.replace(dense_slots=self._zero_fns["unshard"](
+            state.dense_slots[zero.ZERO_KEY]))
+
+    def externalize(self, state: TrainState) -> TrainState:
+        """See Trainer.externalize: placement writeback + dense unshard."""
+        return self.dense_to_replicated(self.hot_sync(state))
+
     # -- sharding specs ------------------------------------------------------
 
     def _table_pspec(self, spec: EmbeddingSpec,
@@ -286,14 +374,26 @@ class MeshTrainer(Trainer):
             ef=P(self.axis) if ef else None,  # residuals shard like weights
         )
 
+    def _dense_slots_pspec(self, slots):
+        """Replicated per-leaf baseline, or — the flat ZeRO form — vector
+        slots sharded on their padded axis (each replica holds the (1, C)
+        chunk it updates) with the shared scalar slots replicated."""
+        from . import zero
+        if zero.is_sharded_slots(slots):
+            return {zero.ZERO_KEY: {
+                k: P() if v.shape[1] == 1 else P(None, self.axis)
+                for k, v in slots[zero.ZERO_KEY].items()}}
+        return jax.tree_util.tree_map(lambda _: P(), slots)
+
     def _state_pspec_tree(self, state: TrainState):
-        """Full-pytree spec: replicated everywhere except the tables."""
+        """Full-pytree spec: replicated everywhere except the tables (and
+        the ZeRO dense_slots, when sharded)."""
         table_specs = {name: self._table_pspec(spec)
                        for name, spec in self.model.ps_specs().items()}
         return TrainState(
             step=P(),
             dense_params=jax.tree_util.tree_map(lambda _: P(), state.dense_params),
-            dense_slots=jax.tree_util.tree_map(lambda _: P(), state.dense_slots),
+            dense_slots=self._dense_slots_pspec(state.dense_slots),
             tables=table_specs,
             model_version=P(),
         )
@@ -311,13 +411,13 @@ class MeshTrainer(Trainer):
         (jit + out_shardings — a full table never materializes on one device)."""
         base = super().init(sample_batch)
         rep = NamedSharding(self.mesh, P())
-        return TrainState(
+        return self.dense_to_sharded(TrainState(
             step=jax.device_put(base.step, rep),
             dense_params=jax.device_put(base.dense_params, rep),
             dense_slots=jax.device_put(base.dense_slots, rep),
             tables=base.tables,  # already sharded by init_tables below
             model_version=jax.device_put(base.model_version, rep),
-        )
+        ))
 
     def init_tables(self):
         self._check_num_shards()
@@ -327,7 +427,9 @@ class MeshTrainer(Trainer):
             if spec.storage == "host_cached":
                 from ..tables.host_offload import HostOffloadTable
                 ot = HostOffloadTable(spec, self.opt_for(spec), seed=self.seed,
-                                      mesh=mesh, axis=self.axis)
+                                      mesh=mesh, axis=self.axis,
+                                      pipeline=self.offload_pipeline,
+                                      densify_k=self.offload_densify)
                 self.offload[name] = ot
                 tables[name] = ot.state
                 continue
@@ -656,7 +758,9 @@ class MeshTrainer(Trainer):
         written back. Migration directories re-attach the same way: the
         PRE-load id -> owner assignment is re-installed and the annex
         re-fills from the loaded home shards (which the checkpoint holds in
-        their written-back, authoritative form)."""
+        their written-back, authoritative form). ZeRO dense slots load in
+        their serialized baseline form and re-shard on the way out."""
+        state = self.dense_to_replicated(state)
         loaded = super().load(state, path)
         if self.hot_enabled:
             idents = {}
@@ -698,7 +802,7 @@ class MeshTrainer(Trainer):
             tables = dict(loaded.tables)
             tables.update(new)
             loaded = loaded.replace(tables=tables)
-        return loaded
+        return self.dense_to_sharded(loaded)
 
     # -- per-device hooks (run inside shard_map) -----------------------------
 
@@ -718,8 +822,59 @@ class MeshTrainer(Trainer):
     def reduce_dense_grads(self, grads):
         # reference parity: Horovod allreduce op=Sum (NOT average) — effective dense
         # lr scales with worker count exactly like the reference's examples
+        if self.zero_enabled:
+            # the sum folds into dense_update's psum_scatter: one
+            # reduce-scatter replaces the all-reduce (same ring wire bytes),
+            # and psum_scatter == psum-then-slice bit for bit
+            return grads
         return jax.tree_util.tree_map(
             lambda g: jax.lax.psum(g, self.axis), grads)
+
+    # oelint: hot-path device_get=0
+    def dense_update(self, params, slots, grads):
+        """The ZeRO-sharded dense apply (runs inside shard_map; see
+        parallel/zero.py for the layout and the bit-exactness argument):
+        reduce_scatter the un-psum'd grads, update this replica's 1/S chunk,
+        all_gather the new weights."""
+        if not self.zero_enabled:
+            return super().dense_update(params, slots, grads)
+        from ..utils import trace as _trace
+        from . import zero
+        plan = self._zero_plan_for(params)
+        if plan.total == 0:
+            return super().dense_update(params, slots, grads)
+        flat_slots = slots[zero.ZERO_KEY]
+        _metrics.observe("dense.params_total", float(plan.total), "gauge")
+        _metrics.observe("dense.zero_shards", float(plan.num_shards), "gauge")
+        _metrics.observe("dense.shard_elems", float(plan.chunk), "gauge")
+        _metrics.observe(
+            "dense.opt_state_bytes_per_replica",
+            float(len(plan.vector_slots) * plan.chunk * 4
+                  + len(plan.scalar_slots) * 4), "gauge")
+        # both collectives move padded f32 elements (ring-equivalent halves
+        # of the baseline's all-reduce)
+        _metrics.observe("dense.reduce_scatter_bytes", float(plan.padded * 4),
+                         "gauge")
+        _metrics.observe("dense.all_gather_bytes", float(plan.padded * 4),
+                         "gauge")
+        with _trace.span("trainer", "dense_reduce_scatter",
+                         bytes=plan.padded * 4):
+            flat_g = zero.flatten_tree(plan, grads)
+            g_local = jax.lax.psum_scatter(flat_g, self.axis,
+                                           scatter_dimension=0, tiled=True)
+        with _trace.span("trainer", "dense_update", elems=plan.chunk):
+            flat_w = zero.flatten_tree(plan, params)
+            i = jax.lax.axis_index(self.axis)
+            w_local = jax.lax.dynamic_slice(flat_w, (i * plan.chunk,),
+                                            (plan.chunk,))
+            new_w_local, new_flat_slots = self.optimizer.apply(
+                w_local.reshape(1, -1), flat_slots,
+                g_local.reshape(1, -1), jnp.ones((1,), jnp.int32))
+        with _trace.span("trainer", "dense_gather", bytes=plan.padded * 4):
+            flat_new = jax.lax.all_gather(new_w_local.reshape(-1), self.axis,
+                                          tiled=True)
+            new_params = zero.unflatten_tree(plan, flat_new, params)
+        return new_params, {zero.ZERO_KEY: new_flat_slots}
 
     def _reduce_loss(self, loss):
         return jax.lax.pmean(loss, self.axis)

@@ -1,0 +1,187 @@
+"""ZeRO-style dense-state sharding: the flat layout + conversions.
+
+The dense tower replicates its weights on every replica (data parallelism
+needs them all for the forward pass), but nothing forces the OPTIMIZER state
+— or the update FLOPs — to replicate too. Following arXiv:2004.13336 (ZeRO
+stage 1/2), `MeshTrainer(dense_shard=True)` keeps dense params replicated
+and gives each of the S replicas a 1/S slice of the flattened dense state:
+
+    grads  --reduce_scatter-->  per-replica grad chunk      (1 collective)
+    chunk update: optimizer.apply on 1/S of the elements    (FLOPs / S)
+    new weights  --all_gather-->  replicated params again   (1 collective)
+
+Same wire bytes as the baseline's psum (a ring all-reduce IS a
+reduce-scatter + all-gather), S-fold less optimizer memory and update math.
+
+Layout
+------
+The trainable dense subtree (incl. the `__embeddings__` sad tables — the
+bulk of the dense bytes) flattens leaf-by-leaf in `tree_flatten` order into
+ONE f32 vector padded with zeros to `S*C`, `C = ceil(total/S)`. Optimizer
+slots split by width (`SparseOptimizer.slot_shapes`):
+
+- vector slots (width == dim: Adagrad accum, Adam m/v, ...) become ONE
+  (1, S*C) array sharded `P(None, axis)` — each replica holds its (1, C)
+  chunk, exactly the elements it updates;
+- scalar slots (width == 1: Adam/Adamax beta powers, the test optimizer's
+  flip state) stay ONE replicated (1, 1) array shared by every leaf. Sound
+  because every dense leaf updates on every step, so the baseline's
+  per-leaf scalars hold identical values (asserted at conversion), and all
+  repo optimizers advance them independently of the gradient.
+
+Bit-exactness vs the replicated baseline (fp32): the repo's optimizers are
+elementwise along the dim axis given the (n, 1)-broadcast scalar slots, so
+updating a chunk equals slicing the full-vector update; `psum_scatter` and
+`psum`-then-slice produce bit-identical sums on the mesh (pinned by
+tests/test_zero.py); padding elements carry zero weights/grads and inert
+slot-init values, so they never feed back into real elements. The
+conversions below are pure slices/concats — a shard/unshard round trip is
+byte-identical, which is what keeps checkpoints, exports, and sync deltas
+equal to a ZeRO-off run's.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..optimizers import SparseOptimizer
+
+# reserved key marking a dense_slots pytree as the flat sharded form
+ZERO_KEY = "__zero__"
+
+
+def is_sharded_slots(slots) -> bool:
+    return isinstance(slots, dict) and ZERO_KEY in slots
+
+
+@dataclasses.dataclass(frozen=True)
+class DenseShardPlan:
+    """Static description of the flat layout for one trainable subtree."""
+
+    treedef: Any
+    shapes: Tuple[Tuple[int, ...], ...]
+    dtypes: Tuple[Any, ...]
+    sizes: Tuple[int, ...]
+    offsets: Tuple[int, ...]
+    total: int
+    num_shards: int
+    chunk: int          # C = ceil(total / S)
+    padded: int         # S * C
+    vector_slots: Tuple[str, ...]
+    scalar_slots: Tuple[str, ...]
+    slot_init: Dict[str, float]
+
+
+def build_plan(params, optimizer: SparseOptimizer,
+               num_shards: int) -> DenseShardPlan:
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    for leaf in leaves:
+        if jnp.dtype(leaf.dtype).itemsize > 4:
+            raise ValueError(
+                "dense_shard supports <=32-bit dense params (the flat shard "
+                f"buffer is f32); got a {leaf.dtype} leaf — the replicated "
+                "baseline's own optimizer math runs in f32 anyway")
+    sizes = tuple(int(leaf.size) for leaf in leaves)
+    offsets, off = [], 0
+    for s in sizes:
+        offsets.append(off)
+        off += s
+    total = off
+    S = max(1, int(num_shards))
+    chunk = -(-total // S) if total else 0
+    # width classification via a probe dim that cannot collide with 1
+    widths = optimizer.slot_shapes(2)
+    vector = tuple(k for k, w in widths.items() if w != 1)
+    scalar = tuple(k for k, w in widths.items() if w == 1)
+    return DenseShardPlan(
+        treedef=treedef,
+        shapes=tuple(tuple(leaf.shape) for leaf in leaves),
+        dtypes=tuple(leaf.dtype for leaf in leaves),
+        sizes=sizes, offsets=tuple(offsets), total=total, num_shards=S,
+        chunk=chunk, padded=S * chunk,
+        vector_slots=vector, scalar_slots=scalar,
+        slot_init={k: float(optimizer.slot_init(k)) for k in widths})
+
+
+def flatten_tree(plan: DenseShardPlan, tree) -> jax.Array:
+    """Trainable subtree -> (padded,) f32 vector (zero-padded tail)."""
+    leaves = plan.treedef.flatten_up_to(tree)
+    parts = [jnp.reshape(leaf, (-1,)).astype(jnp.float32)
+             for leaf in leaves]
+    if plan.padded > plan.total:
+        parts.append(jnp.zeros((plan.padded - plan.total,), jnp.float32))
+    return jnp.concatenate(parts) if parts else jnp.zeros((0,), jnp.float32)
+
+
+def unflatten_tree(plan: DenseShardPlan, flat: jax.Array, template):
+    """(padded,) f32 vector -> subtree with the template's shapes/dtypes."""
+    leaves = plan.treedef.flatten_up_to(template)
+    out = []
+    for leaf, shape, dtype, size, off in zip(
+            leaves, plan.shapes, plan.dtypes, plan.sizes, plan.offsets):
+        del leaf
+        out.append(jax.lax.slice(flat, (off,), (off + size,))
+                   .reshape(shape).astype(dtype))
+    return jax.tree_util.tree_unflatten(plan.treedef, out)
+
+
+def shard_slots(plan: DenseShardPlan, slots_tree) -> Dict[str, jax.Array]:
+    """Baseline per-leaf dense_slots -> the flat slot dict {name: (1, padded)
+    vector | (1, 1) scalar}. Pure concat/select — bitwise lossless."""
+    slot_dicts = plan.treedef.flatten_up_to(slots_tree)
+    out: Dict[str, jax.Array] = {}
+    for name in plan.vector_slots:
+        parts = [jnp.reshape(d[name], (-1,)) for d in slot_dicts]
+        if plan.padded > plan.total:
+            parts.append(jnp.full((plan.padded - plan.total,),
+                                  plan.slot_init[name], jnp.float32))
+        flat = (jnp.concatenate(parts) if parts
+                else jnp.zeros((0,), jnp.float32))
+        out[name] = flat.reshape(1, -1).astype(jnp.float32)
+    for name in plan.scalar_slots:
+        if slot_dicts:
+            out[name] = slot_dicts[0][name].reshape(1, 1).astype(jnp.float32)
+        else:
+            out[name] = jnp.full((1, 1), plan.slot_init[name], jnp.float32)
+    return out
+
+
+def unshard_slots(plan: DenseShardPlan, flat_slots: Dict[str, jax.Array]):
+    """Flat slot dict -> the baseline per-leaf dense_slots tree: vector
+    slots slice back per leaf, shared scalars broadcast to every leaf."""
+    out = []
+    for size, off in zip(plan.sizes, plan.offsets):
+        d = {}
+        for name in plan.vector_slots:
+            d[name] = jax.lax.slice(
+                flat_slots[name], (0, off), (1, off + size))
+        for name in plan.scalar_slots:
+            d[name] = flat_slots[name].reshape(1, 1)
+        out.append(d)
+    return jax.tree_util.tree_unflatten(plan.treedef, out)
+
+
+def check_scalar_slots_equal(plan: DenseShardPlan, slots_tree) -> None:
+    """Sharing one scalar slot across leaves is only lossless when every
+    leaf already holds the same value (always true for states trained by
+    this repo: every dense leaf updates on every step). Host-side check at
+    conversion time — a foreign checkpoint that violates it must fail loud
+    rather than silently rewrite optimizer state."""
+    import numpy as np
+    if not plan.scalar_slots:
+        return
+    slot_dicts = plan.treedef.flatten_up_to(slots_tree)
+    for name in plan.scalar_slots:
+        vals = [np.asarray(jax.device_get(d[name])).reshape(-1)
+                for d in slot_dicts]
+        for v in vals[1:]:
+            if not (v.view(np.uint8) == vals[0].view(np.uint8)).all():
+                raise ValueError(
+                    f"dense_shard: scalar optimizer slot {name!r} differs "
+                    "across dense leaves — this state was not produced by "
+                    "whole-tree dense training and cannot be sharded "
+                    "losslessly (load it with dense_shard off)")

@@ -157,9 +157,10 @@ class AsyncPersister:
 
         Hot-replicated rows (MeshTrainer(hot_rows=...)) write back into their
         owner shards first (`trainer.hot_sync`, identity off-mesh), so the
-        persisted bytes equal a hot-off run's."""
+        persisted bytes equal a hot-off run's. ZeRO-sharded dense slots
+        unshard the same way (`trainer.externalize` folds both)."""
         self._raise_pending_error()
-        state = self.trainer.hot_sync(state)
+        state = self.trainer.externalize(state)
         step = int(state.step)
         if getattr(self.trainer, "offload", None):
             # host-cached tables snapshot their WHOLE host store (a consistent
@@ -686,9 +687,10 @@ class IncrementalPersister(AsyncPersister):
     def persist(self, state) -> str:
         self._raise_pending_error()
         # delta readers pull touched rows straight off the shards — hot-cached
-        # rows must land there first (the full-persist branch syncs again in
-        # super().persist; a second writeback of H identical rows is noise)
-        state = self.trainer.hot_sync(state)
+        # rows must land there first, and the delta's dense payload reads
+        # dense_slots in the baseline layout (the full-persist branch syncs
+        # again in super().persist; a second writeback is noise)
+        state = self.trainer.externalize(state)
         step = int(state.step)
         touched = self.tracker.take()
         if jax.process_count() > 1:
@@ -1017,6 +1019,12 @@ def restore_server_model(state, model, root: str, *, trainer=None):
         drv = _StateMeshShim(state, model)
     num_shards = drv.num_shards if drv is not None else 1
     offload = getattr(drv, "offload", None) or None
+    # ZeRO template states carry flat sharded dense_slots; on disk the slots
+    # are always the baseline per-leaf layout — restore in that layout and
+    # re-shard at the end (identities when ZeRO is off / trainerless)
+    zero_on = trainer is not None and getattr(trainer, "zero_enabled", False)
+    if zero_on:
+        state = trainer.dense_to_replicated(state)
     from .parallel.checkpoint import checkpoint_layout, load_sharded
     if checkpoint_layout(path) == "sharded":
         state = load_sharded(state, model, path, num_shards=num_shards,
@@ -1028,6 +1036,8 @@ def restore_server_model(state, model, root: str, *, trainer=None):
     cache: Dict = {}
     for d in deltas:
         state = _apply_delta(state, model, d, trainer=drv, _cache=cache)
+    if zero_on:
+        state = trainer.dense_to_sharded(state)
     return state
 
 

@@ -33,10 +33,26 @@ evict/admit, so training with a small cache equals training with an infinite tab
 whenever the initializer is slot-independent (e.g. Constant) — tested in
 `tests/test_host_offload.py`. With slot-position-dependent random init, first-touch
 values differ (the documented init-on-slot divergence of `tables/hash_table.py`).
+
+Pipelining (round 14, arXiv:1905.04035): with `pipeline=True` a one-worker
+staging thread double-buffers the NEXT batch's host lookup + device upload
+(`stage(ids)`, driven by `Trainer.offload_stage`) while the current step
+computes; the matching `prepare(ids)` consumes the payload and pays only the
+jitted scatter. Staging is a HINT — an epoch counter bumped on every
+residency/store mutation invalidates stale payloads, and mismatches fall
+back to the synchronous path, so correctness never depends on the loop shape.
+Admit shapes pad to powers of two (like the eviction pads), so the pipelined
+path compiles a bounded program set and `assert_no_recompile` enforces it.
+`densify_k=K` batches the evict/flush writebacks: K rounds append into
+compact pending chunks and fold last-wins into ONE sorted merge
+(`HostStore.defer`/`drain`), with lookups overlaying pending chunks so reads
+stay exact mid-accumulation.
 """
 
 from __future__ import annotations
 
+import threading
+import time
 import warnings
 from typing import Dict, Optional, Tuple
 
@@ -50,32 +66,88 @@ from ..utils import metrics
 
 
 class HostStore:
-    """Id-sorted host arrays (weights + slots) with merge-update."""
+    """Id-sorted host arrays (weights + slots) with merge-update.
+
+    Thread-safe (one RLock around every read/write): the pipelined staging
+    worker reads via `lookup` while the training thread writes via
+    `merge`/`defer`. Writebacks can be DEFERRED (`defer` + `drain`, the
+    arXiv:1905.04035 densified accumulation): K eviction rounds append
+    pending chunks instead of paying K sorted merges, and `drain` folds them
+    last-wins into ONE merge. `lookup` overlays pending chunks, so a
+    deferred row reads back correctly before the drain — callers never see
+    the batching."""
 
     def __init__(self, dim: int, slot_widths: Dict[str, int]):
         self.ids = np.empty((0,), np.int64)
         self.weights = np.empty((0, dim), np.float32)
         self.slots = {k: np.empty((0, w), np.float32)
                       for k, w in slot_widths.items()}
+        self._lock = threading.RLock()
+        # deferred writeback chunks, oldest first: [(sorted ids, w, slots)]
+        self._pending = []
 
     def __len__(self) -> int:
         return len(self.ids)
 
     def lookup(self, ids: np.ndarray) -> Tuple[np.ndarray, np.ndarray, Dict]:
         """-> (hit mask, weight rows, slot rows) for `ids` (unknown ids return
-        zero rows and hit=False)."""
-        if len(self.ids) == 0:
-            return (np.zeros((len(ids),), bool),
-                    np.zeros((len(ids),) + self.weights.shape[1:], np.float32),
-                    {k: np.zeros((len(ids),) + v.shape[1:], np.float32)
-                     for k, v in self.slots.items()})
-        pos = np.searchsorted(self.ids, ids)
-        pos_c = np.clip(pos, 0, len(self.ids) - 1)
-        hit = self.ids[pos_c] == ids
-        w = np.where(hit[:, None], self.weights[pos_c], 0.0)
-        s = {k: np.where(hit[:, None], v[pos_c], 0.0)
-             for k, v in self.slots.items()}
-        return hit, w, s
+        zero rows and hit=False). Pending deferred chunks overlay the base
+        arrays newest-wins, so reads are exact mid-densification."""
+        with self._lock:
+            if len(self.ids) == 0:
+                hit = np.zeros((len(ids),), bool)
+                w = np.zeros((len(ids),) + self.weights.shape[1:], np.float32)
+                s = {k: np.zeros((len(ids),) + v.shape[1:], np.float32)
+                     for k, v in self.slots.items()}
+            else:
+                pos = np.searchsorted(self.ids, ids)
+                pos_c = np.clip(pos, 0, len(self.ids) - 1)
+                hit = self.ids[pos_c] == ids
+                w = np.where(hit[:, None], self.weights[pos_c], 0.0)
+                s = {k: np.where(hit[:, None], v[pos_c], 0.0)
+                     for k, v in self.slots.items()}
+            for pids, pw, ps in self._pending:  # oldest -> newest: last wins
+                pos = np.searchsorted(pids, ids)
+                pos_c = np.clip(pos, 0, len(pids) - 1)
+                h = pids[pos_c] == ids
+                if h.any():
+                    hit = hit | h
+                    w[h] = pw[pos_c[h]]
+                    for k in s:
+                        s[k][h] = ps[k][pos_c[h]]
+            return hit, w, s
+
+    def defer(self, ids: np.ndarray, weights: np.ndarray,
+              slots: Dict[str, np.ndarray]) -> None:
+        """Queue an upsert for the next `drain` (ids unique within the call)."""
+        if len(ids) == 0:
+            return
+        order = np.argsort(ids, kind="stable")
+        with self._lock:
+            self._pending.append((
+                np.asarray(ids)[order].astype(np.int64),
+                np.asarray(weights)[order].astype(np.float32),
+                {k: np.asarray(v)[order].astype(np.float32)
+                 for k, v in slots.items()}))
+
+    def drain(self) -> int:
+        """Fold every pending chunk into the base arrays with ONE merge
+        (last write per id wins, matching the per-call merge order). Returns
+        the number of rows merged."""
+        with self._lock:
+            if not self._pending:
+                return 0
+            ids = np.concatenate([c[0] for c in self._pending])
+            w = np.concatenate([c[1] for c in self._pending])
+            s = {k: np.concatenate([c[2][k] for c in self._pending])
+                 for k in self._pending[0][2]}
+            self._pending = []
+            # keep the LAST occurrence of each id: unique() on the reversed
+            # array marks each id's first-from-the-end position
+            _, ridx = np.unique(ids[::-1], return_index=True)
+            keep = len(ids) - 1 - ridx
+            self.merge(ids[keep], w[keep], {k: v[keep] for k, v in s.items()})
+            return int(keep.size)
 
     def merge(self, ids: np.ndarray, weights: np.ndarray,
               slots: Dict[str, np.ndarray]) -> None:
@@ -85,51 +157,66 @@ class HostStore:
         order = np.argsort(ids, kind="stable")
         ids, weights = ids[order], weights[order]
         slots = {k: v[order] for k, v in slots.items()}
-        if len(self.ids) == 0:
-            exists = np.zeros((len(ids),), bool)
-            pos_c = np.zeros((len(ids),), np.int64)
-        else:
-            pos = np.searchsorted(self.ids, ids)
-            pos_c = np.clip(pos, 0, len(self.ids) - 1)
-            exists = self.ids[pos_c] == ids
-        # update existing in place
-        if exists.any():
-            self.weights[pos_c[exists]] = weights[exists]
-            for k in self.slots:
-                self.slots[k][pos_c[exists]] = slots[k][exists]
-        # insert the rest (merge two sorted runs)
-        new = ~exists
-        if new.any():
-            self.ids = np.concatenate([self.ids, ids[new]])
-            self.weights = np.concatenate([self.weights, weights[new]])
-            for k in self.slots:
-                self.slots[k] = np.concatenate([self.slots[k], slots[k][new]])
-            order = np.argsort(self.ids, kind="stable")
-            self.ids = self.ids[order]
-            self.weights = self.weights[order]
-            for k in self.slots:
-                self.slots[k] = self.slots[k][order]
+        with self._lock:
+            if len(self.ids) == 0:
+                exists = np.zeros((len(ids),), bool)
+                pos_c = np.zeros((len(ids),), np.int64)
+            else:
+                pos = np.searchsorted(self.ids, ids)
+                pos_c = np.clip(pos, 0, len(self.ids) - 1)
+                exists = self.ids[pos_c] == ids
+            # update existing in place
+            if exists.any():
+                self.weights[pos_c[exists]] = weights[exists]
+                for k in self.slots:
+                    self.slots[k][pos_c[exists]] = slots[k][exists]
+            # insert the rest (merge two sorted runs)
+            new = ~exists
+            if new.any():
+                self.ids = np.concatenate([self.ids, ids[new]])
+                self.weights = np.concatenate([self.weights, weights[new]])
+                for k in self.slots:
+                    self.slots[k] = np.concatenate([self.slots[k],
+                                                    slots[k][new]])
+                order = np.argsort(self.ids, kind="stable")
+                self.ids = self.ids[order]
+                self.weights = self.weights[order]
+                for k in self.slots:
+                    self.slots[k] = self.slots[k][order]
 
     def nbytes(self) -> int:
-        return (self.ids.nbytes + self.weights.nbytes
-                + sum(v.nbytes for v in self.slots.values()))
+        with self._lock:
+            return (self.ids.nbytes + self.weights.nbytes
+                    + sum(v.nbytes for v in self.slots.values())
+                    + sum(c[0].nbytes + c[1].nbytes
+                          + sum(v.nbytes for v in c[2].values())
+                          for c in self._pending))
 
     def snapshot(self) -> "HostStore":
         """Copy for async writers: `merge` mutates rows in place, so a store
-        handed to a persist worker thread must be decoupled from later flushes."""
-        out = HostStore.__new__(HostStore)
-        out.ids = self.ids.copy()
-        out.weights = self.weights.copy()
-        out.slots = {k: v.copy() for k, v in self.slots.items()}
-        return out
+        handed to a persist worker thread must be decoupled from later flushes.
+        Pending deferred chunks drain first — a snapshot is always fully
+        merged."""
+        with self._lock:
+            self.drain()
+            out = HostStore.__new__(HostStore)
+            out.ids = self.ids.copy()
+            out.weights = self.weights.copy()
+            out.slots = {k: v.copy() for k, v in self.slots.items()}
+            out._lock = threading.RLock()
+            out._pending = []
+            return out
 
     def replace_all(self, ids: np.ndarray, weights: np.ndarray,
                     slots: Dict[str, np.ndarray]) -> None:
         """Wholesale replacement (checkpoint load); ids must be unique."""
         order = np.argsort(ids, kind="stable")
-        self.ids = ids[order].astype(np.int64)
-        self.weights = weights[order].astype(np.float32)
-        self.slots = {k: v[order].astype(np.float32) for k, v in slots.items()}
+        with self._lock:
+            self._pending = []  # stale by definition: the store they patched is gone
+            self.ids = ids[order].astype(np.int64)
+            self.weights = weights[order].astype(np.float32)
+            self.slots = {k: v[order].astype(np.float32)
+                          for k, v in slots.items()}
 
 
 def _admit_fn(state: EmbeddingTableState, ids, w_rows, s_rows, known):
@@ -313,7 +400,8 @@ class HostOffloadTable:
 
     def __init__(self, spec: EmbeddingSpec, optimizer: SparseOptimizer, *,
                  seed: int = 0, high_water: float = 0.6,
-                 mesh=None, axis=None, eviction: str = "clock"):
+                 mesh=None, axis=None, eviction: str = "clock",
+                 pipeline: bool = False, densify_k: int = 1):
         if not spec.use_hash_table:
             raise ValueError("host offload needs a hash-table spec "
                              "(input_dim=-1 + capacity)")
@@ -321,6 +409,8 @@ class HostOffloadTable:
             raise ValueError("high_water in (0, 1]")
         if eviction not in ("clock", "flush"):
             raise ValueError("eviction must be 'clock' or 'flush'")
+        if int(densify_k) < 1:
+            raise ValueError("densify_k >= 1 (1 = merge every writeback)")
         self.spec = spec
         self.optimizer = optimizer
         self.seed = seed
@@ -371,6 +461,35 @@ class HostOffloadTable:
         else:
             self._admit = jax.jit(_admit_fn, donate_argnums=(0,))
             self._evict = jax.jit(_evict_fn, donate_argnums=(0,))
+        # densified writeback (arXiv:1905.04035): evict/lost rows defer into
+        # the store's pending chunks and fold last-wins into ONE merge every
+        # `densify_k` writebacks (snapshot/sync paths drain first, so
+        # externally-visible store content never lags)
+        self.densify_k = int(densify_k)
+        self._defer_count = 0
+        # pipelined staging (double buffer): `stage(ids)` runs the NEXT
+        # batch's host lookup + device upload on this worker while the
+        # current step computes; `prepare(ids)` consumes the staged payload
+        # when the batch matches and nothing invalidated it (`_epoch` bumps
+        # on every residency/store mutation), else falls back to the
+        # synchronous path. Admit shapes pad to powers of two, so the
+        # pipelined path never re-jits (`assert_no_recompile` below).
+        self.pipeline = bool(pipeline)
+        self._epoch = 0
+        self._staged = None  # (raw ids copy, epoch at stage, Future)
+        self._pipe_hits = 0
+        self._pipe_misses = 0
+        self._stage_pool = None
+        if self.pipeline:
+            from concurrent.futures import ThreadPoolExecutor
+            self._stage_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix=f"oetpu-stage-{spec.name}")
+            from ..utils.guards import assert_no_recompile
+            # one program per pow2 admit size up to capacity (+1 for the
+            # sub-1 edge): any retrace beyond that is a pipeline bug
+            self._admit = assert_no_recompile(
+                self._admit, max_traces=self.capacity.bit_length() + 2,
+                label=f"offload.admit[{spec.name}]")
 
     def _compile_sharded_fresh(self):
         """Compiled fresh-state builder for the sharded cache (same recipe as
@@ -429,61 +548,50 @@ class HostOffloadTable:
             new_ids % self.num_shards, minlength=self.num_shards)
         return bool((counts > self.high_water * self.rows_per_shard).any())
 
-    def prepare(self, ids) -> None:
-        """Make the cache ready for a batch: evict/flush if needed, re-admit
-        evicted ids (split-pair batches are joined to int64 host-side — the
-        residency set, the store, and the shard accounting all speak int64).
-        Call BEFORE the train step; rebind `self.state` after it.
+    @staticmethod
+    def _split_batch(flat: np.ndarray, resident: np.ndarray):
+        """Partition a unique sorted id batch against a residency snapshot:
+        -> (clipped positions, hit mask, the non-resident ids)."""
+        if resident.size:
+            pos = np.searchsorted(resident, flat)
+            pos_c = np.minimum(pos, resident.size - 1)
+            hit = resident[pos_c] == flat
+            return pos_c, hit, flat[~hit]
+        return (np.zeros((0,), np.int64), np.zeros((flat.size,), bool), flat)
 
-        Over high-water with `eviction="clock"` (default): cold residents
-        (untouched since the last eviction round) move to the store, hot rows
-        stay ON DEVICE (`evict_cold`) — falling back to the whole-cache flush
-        only when the hot set itself leaves no room."""
-        from ..ops.id64 import np_ids_as_int64
-        flat = np.unique(np_ids_as_int64(ids))
-        flat = flat[flat >= 0]
-        if self._resident_sorted.size:
-            pos = np.searchsorted(self._resident_sorted, flat)
-            pos_c = np.minimum(pos, self._resident_sorted.size - 1)
-            hit = self._resident_sorted[pos_c] == flat
-            # second-chance bit: this batch's residents are HOT
-            self._ref[pos_c[hit]] = True
-            new = flat[~hit]
-        else:
-            new = flat
-        if new.size == 0:
-            return
-        if self._would_exceed(new):
-            if self.eviction == "clock":
-                self.evict_cold()
-            if self.eviction != "clock" or self._would_exceed(new):
-                self.flush()
-                # The flush just evicted the batch's previously-resident ids
-                # too; admit the WHOLE batch back or the train step would
-                # reinsert those ids with initializer values, losing their
-                # weights/slots.
-                new = flat
-            per_shard = self._shard_counts + np.bincount(
-                new % self.num_shards, minlength=self.num_shards)
-            if per_shard.max(initial=0) > self.rows_per_shard:
-                warnings.warn(
-                    f"batch puts {int(per_shard.max())} unique ids on one "
-                    f"shard (> {self.rows_per_shard} slots); the device cache "
-                    "cannot hold one batch and some rows will overflow — "
-                    "raise `capacity` or shrink the batch", RuntimeWarning)
+    def _staged_payload(self, new: np.ndarray):
+        """Host store lookup + pow2-padded device upload for `new` ids (the
+        work `stage` moves off the training thread). Padded tail ids are -1:
+        `hash_find_or_insert` claims no slot for them and `known`=False
+        writes no row — the same inertness the eviction pads lean on."""
         known_hit, w, s = self.store.lookup(new)
-        # the host store is int64 numpy; the device cache may be split-pair
-        if self.state.keys.ndim == 2:
-            from ..ops.id64 import np_split_ids
-            ids_dev = jnp.asarray(np_split_ids(new))
-        else:
-            ids_dev = jnp.asarray(new)
-        with metrics.vtimer("offload", "admit"):
-            self.state, admitted = self._admit(
-                self.state, ids_dev, jnp.asarray(w),
+        n = int(new.size)
+        pad = (1 << max(0, (n - 1).bit_length())) - n
+        if pad:
+            new = np.concatenate([new, np.full((pad,), -1, np.int64)])
+            known_hit = np.concatenate([known_hit, np.zeros((pad,), bool)])
+            w = np.concatenate([w, np.zeros((pad,) + w.shape[1:],
+                                            np.float32)])
+            s = {k: np.concatenate([v, np.zeros((pad,) + v.shape[1:],
+                                                np.float32)])
+                 for k, v in s.items()}
+        staged_bytes = (w.nbytes + sum(v.nbytes for v in s.values())
+                        + new.nbytes)
+        metrics.observe("offload.staged_bytes", float(staged_bytes))
+        return (self._ids_to_device(new), jnp.asarray(w),
                 {k: jnp.asarray(v) for k, v in s.items()},
                 jnp.asarray(known_hit))
-        admitted = np.asarray(admitted)
+
+    def _admit_ids(self, new: np.ndarray, payload, *,
+                   stage_s: float = 0.0) -> None:
+        """Run the admit jit on a (padded) payload and account residency for
+        the `new` ids it covers."""
+        t0 = time.perf_counter()
+        ids_dev, w_dev, s_dev, known_dev = payload
+        with metrics.vtimer("offload", "admit"):
+            self.state, admitted = self._admit(
+                self.state, ids_dev, w_dev, s_dev, known_dev)
+        admitted = np.asarray(admitted)[:new.size]
         got = new[admitted]
         # O(n+m) sorted merge (got is sorted: a subset of np.unique output)
         at = np.searchsorted(self._resident_sorted, got)
@@ -495,13 +603,159 @@ class HostOffloadTable:
         self._ref = np.insert(self._ref, at, False)
         self._shard_counts += np.bincount(got % self.num_shards,
                                           minlength=self.num_shards)
+        self._epoch += 1  # residency changed: staged lookups are stale
         metrics.observe("offload.admitted", int(admitted.sum()))
+        if stage_s:
+            # how much of the staging work ran in the shadow of the step:
+            # 1.0 = the admit found everything uploaded, 0.5 = stage cost as
+            # much as the admit it fed
+            admit_s = time.perf_counter() - t0
+            metrics.observe("offload.overlap_ratio",
+                            stage_s / (stage_s + admit_s + 1e-12), "gauge")
+
+    def stage(self, ids) -> None:
+        """Pipelined double-buffer: run the NEXT batch's host lookup +
+        device upload on the staging worker while the current step computes.
+        No-op unless built with pipeline=True. The matching `prepare(ids)`
+        consumes the payload; any intervening residency/store change
+        (`_epoch`) or a different batch falls back to the sync path, so
+        staging is only ever a hint — never a correctness dependency."""
+        if not self.pipeline:
+            return
+        raw = np.array(ids, copy=True)
+        epoch = self._epoch
+        resident = self._resident_sorted  # replaced-not-mutated: safe to share
+
+        def work():
+            from ..ops.id64 import np_ids_as_int64
+            t0 = time.perf_counter()
+            with metrics.vtimer("offload", "stage"):
+                flat = np.unique(np_ids_as_int64(raw))
+                flat = flat[flat >= 0]
+                pos_c, hit, new = self._split_batch(flat, resident)
+                payload = self._staged_payload(new) if new.size else None
+            return {"flat": flat, "pos_c": pos_c, "hit": hit, "new": new,
+                    "payload": payload,
+                    "stage_s": time.perf_counter() - t0}
+
+        self._staged = (raw, epoch, self._stage_pool.submit(work))
+
+    def _take_staged(self, ids):
+        """The staged result iff it matches this prepare call and is still
+        valid; None (recorded as a pipeline miss) otherwise."""
+        if self._staged is None:
+            return None
+        raw, epoch, fut = self._staged
+        self._staged = None
+        res = fut.result()  # join the worker before touching shared state
+        now = np.asarray(ids)
+        if (epoch != self._epoch or raw.shape != now.shape
+                or raw.dtype != now.dtype or not np.array_equal(raw, now)):
+            self._pipe_misses += 1
+            metrics.observe("offload.pipeline_misses", 1)
+            self._observe_occupancy()
+            return None
+        return res
+
+    def _observe_occupancy(self) -> None:
+        total = self._pipe_hits + self._pipe_misses
+        if total:
+            metrics.observe("offload.pipeline_occupancy",
+                            self._pipe_hits / total, "gauge")
+
+    def prepare(self, ids) -> None:
+        """Make the cache ready for a batch: evict/flush if needed, re-admit
+        evicted ids (split-pair batches are joined to int64 host-side — the
+        residency set, the store, and the shard accounting all speak int64).
+        Call BEFORE the train step; rebind `self.state` after it.
+
+        Over high-water with `eviction="clock"` (default): cold residents
+        (untouched since the last eviction round) move to the store, hot rows
+        stay ON DEVICE (`evict_cold`) — falling back to the whole-cache flush
+        only when the hot set itself leaves no room.
+
+        With pipeline=True a matching `stage(ids)` payload is consumed here
+        (the lookup + upload already happened under the previous step);
+        eviction pressure and mismatches fall back to the path below."""
+        staged = self._take_staged(ids)
+        if staged is not None:
+            flat, new = staged["flat"], staged["new"]
+            hit = staged["hit"]
+            if hit.any():
+                # second-chance bit: this batch's residents are HOT
+                self._ref[staged["pos_c"][hit]] = True
+            self._pipe_hits += 1
+            metrics.observe("offload.pipeline_hits", 1)
+            self._observe_occupancy()
+            if new.size == 0:
+                return
+            if not self._would_exceed(new):
+                self._admit_ids(new, staged["payload"],
+                                stage_s=staged["stage_s"])
+                return
+            # pressure: eviction rewrites residency/store, so the staged
+            # payload is only reusable when the id set survives unchanged —
+            # re-run the tail of the sync path instead (rare by design:
+            # occupancy crossing high-water, not the steady state)
+            self._pressure(new, flat)
+            return
+        from ..ops.id64 import np_ids_as_int64
+        flat = np.unique(np_ids_as_int64(ids))
+        flat = flat[flat >= 0]
+        pos_c, hit, new = self._split_batch(flat, self._resident_sorted)
+        if hit.any():
+            # second-chance bit: this batch's residents are HOT
+            self._ref[pos_c[hit]] = True
+        if new.size == 0:
+            return
+        if self._would_exceed(new):
+            self._pressure(new, flat)
+            return
+        self._admit_ids(new, self._staged_payload(new))
+
+    def _pressure(self, new: np.ndarray, flat: np.ndarray) -> None:
+        """The over-high-water tail of prepare(): evict or flush, then admit
+        whatever the batch still needs (the whole batch after a flush — it
+        evicted the batch's previously-resident ids too, and the train step
+        would otherwise reinsert them with initializer values)."""
+        if self.eviction == "clock":
+            self.evict_cold()
+        if self.eviction != "clock" or self._would_exceed(new):
+            self.flush()
+            new = flat
+        per_shard = self._shard_counts + np.bincount(
+            new % self.num_shards, minlength=self.num_shards)
+        if per_shard.max(initial=0) > self.rows_per_shard:
+            warnings.warn(
+                f"batch puts {int(per_shard.max())} unique ids on one "
+                f"shard (> {self.rows_per_shard} slots); the device cache "
+                "cannot hold one batch and some rows will overflow — "
+                "raise `capacity` or shrink the batch", RuntimeWarning)
+        self._admit_ids(new, self._staged_payload(new))
 
     def _ids_to_device(self, ids64: np.ndarray):
         from ..ops.id64 import np_split_ids
         if self.state.keys.ndim == 2:
             return jnp.asarray(np_split_ids(ids64))
         return jnp.asarray(ids64.astype(self.state.keys.dtype))
+
+    def _store_write(self, ids: np.ndarray, weights: np.ndarray,
+                     slots: Dict[str, np.ndarray]) -> None:
+        """Writeback entry point for evicted rows: direct merge at
+        densify_k=1, else defer and fold K writebacks into one merge
+        (`HostStore.drain`) — the compact-accumulation half of the pipelined
+        offload (reads stay exact via the pending overlay in lookup)."""
+        if self.densify_k <= 1:
+            self.store.merge(ids, weights, slots)
+            return
+        self.store.defer(ids, weights, slots)
+        self._defer_count += 1
+        if self._defer_count >= self.densify_k:
+            with metrics.vtimer("offload", "drain"):
+                merged = self.store.drain()
+            self._defer_count = 0
+            metrics.observe("offload.densified_merges", 1)
+            metrics.observe("offload.drained_rows", merged)
 
     def evict_cold(self) -> int:
         """Clock/second-chance eviction: move residents whose referenced bit is
@@ -529,7 +783,7 @@ class HostOffloadTable:
                 self.state, self._ids_to_device(cold_p),
                 self._ids_to_device(hot_p), fresh)
             cfound = np.asarray(cfound)[:cold.size]
-            self.store.merge(
+            self._store_write(
                 cold[cfound],
                 np.asarray(cw)[:cold.size][cfound].astype(np.float32),
                 {k: np.asarray(v)[:cold.size][cfound].astype(np.float32)
@@ -540,7 +794,7 @@ class HostOffloadTable:
         if lost.any():
             # hot rows whose re-insert overflowed (rare): bank them in the
             # store — they re-admit on their next appearance
-            self.store.merge(
+            self._store_write(
                 hot[lost],
                 np.asarray(lost_w)[:nh][lost].astype(np.float32),
                 {k: np.asarray(v)[:nh][lost].astype(np.float32)
@@ -551,6 +805,7 @@ class HostOffloadTable:
         self._shard_counts = np.bincount(
             survivors % self.num_shards, minlength=self.num_shards
         ).astype(np.int64)
+        self._epoch += 1  # residency + store changed: staged lookups stale
         metrics.observe("offload.evicted_cold", int(cfound.sum()))
         metrics.observe("offload.kept_hot", int(survivors.size))
         return int(cfound.sum())
@@ -561,6 +816,11 @@ class HostOffloadTable:
         while training continues undisturbed."""
         with metrics.vtimer("offload", "sync"):
             from ..ops.id64 import np_resident_ids
+            # drain BEFORE the resident merge: pending chunks hold OLDER
+            # (evicted-at-the-time) values and must not overwrite the fresher
+            # device rows written next
+            self.store.drain()
+            self._defer_count = 0
             sel, ids64 = np_resident_ids(np.asarray(self.state.keys))
             self.store.merge(
                 ids64,
@@ -585,6 +845,7 @@ class HostOffloadTable:
         self._resident_sorted = np.empty((0,), np.int64)
         self._ref = np.empty((0,), bool)
         self._shard_counts[:] = 0
+        self._epoch += 1  # residency changed: staged lookups are stale
 
     def load_store(self, ids: np.ndarray, weights: np.ndarray,
                    slots: Dict[str, np.ndarray]) -> None:
@@ -603,6 +864,7 @@ class HostOffloadTable:
                     fresh[k], (len(ids),) + fresh[k].shape[1:]).copy()
         self.store.replace_all(np.asarray(ids, np.int64),
                                np.asarray(weights), full_slots)
+        self._defer_count = 0  # replace_all dropped the pending chunks
         self.reset_cache()
 
     def lookup_anywhere(self, ids) -> np.ndarray:

@@ -14,7 +14,9 @@ Two tools:
   trace budget raises. Accepts either a plain Python callable (it is jitted
   here, and the budget is enforced AT TRACE TIME — the error points at the
   exact call that triggered the retrace) or an ALREADY-jitted function (the
-  budget is checked against its compilation-cache size after every call).
+  budget is checked after every call against the compilation-cache GROWTH
+  since wrap time — `jax.jit` wrappers of one underlying function share a
+  cache, so absolute size would count other instances' programs).
   `max_traces` > 1 covers deliberately multi-mode functions (e.g. the
   `_hot_jit` lifecycle fns compile once per mode).
 
@@ -97,19 +99,25 @@ def assert_no_recompile(fn=None, *, max_traces: int = 1,
                 f"{name!r} is already jitted; jit kwargs {sorted(jit_kwargs)}"
                 " cannot be applied — pass the plain function instead")
 
+        # Budget NEW compilations from wrap time on: `jax.jit(f)` wrappers of
+        # the same underlying function share one compilation cache, so the
+        # absolute size counts programs other instances (other tables, other
+        # tests) compiled — only the delta is this wrapper's to budget.
+        base = _cache_size(fn) or 0
+
         @functools.wraps(fn)
         def guarded(*args, **kwargs):
             out = fn(*args, **kwargs)
             n = _cache_size(fn)
-            if n is not None and n > max_traces:
+            if n is not None and n - base > max_traces:
                 raise RecompileError(
-                    f"{name!r} holds {n} compiled programs (budget "
+                    f"{name!r} compiled {n - base} new programs (budget "
                     f"{max_traces}): this call triggered a retrace — a "
                     "shape/dtype/static-arg changed (never-re-jit rule, "
                     "parallel/sharded.py)")
             return out
 
-        guarded.trace_count = lambda: _cache_size(fn)
+        guarded.trace_count = lambda: (_cache_size(fn) or 0) - base
         return guarded
 
     import jax

@@ -275,3 +275,140 @@ def test_flush_policy_still_available():
         ids = jnp.asarray(rng.integers(0, 1 << 30, size=12).astype(np.int64))
         ot.prepare(ids)
     assert M.report().get("offload.flushes", 0) > before
+
+
+# ---------------------------------------------------------------------------
+# Round 14: the staging pipeline + densified flush
+# ---------------------------------------------------------------------------
+
+
+def _pipelined_rounds(off, spec, opt, batches, grads):
+    """The canonical pipelined loop: stage batch 0, then per step
+    prepare(current) + stage(next) so the host lookup overlaps the step."""
+    off.stage(batches[0])
+    for r, ids in enumerate(batches):
+        off.prepare(ids)
+        if r + 1 < len(batches):
+            off.stage(batches[r + 1])
+        st, _ = lookup_train(spec, off.state, jnp.asarray(ids))
+        off.state = apply_gradients(spec, st, opt, jnp.asarray(ids),
+                                    jnp.asarray(grads[r]))
+
+
+def _id_stream(rounds, seed=7, size=12):
+    rng = np.random.default_rng(seed)
+    batches = [rng.integers(0, 1 << 20, size=size).astype(np.int64)
+               for _ in range(rounds)]
+    grads = [np.asarray(rng.standard_normal((size, DIM)), np.float32)
+             for _ in range(rounds)]
+    return batches, grads
+
+
+@pytest.mark.parametrize("densify_k", [1, 4, 16])
+def test_pipeline_matches_sync_path(densify_k):
+    """Pipelined staging (and densified flushes) must train EXACTLY like the
+    synchronous path — same per-id weights, with the staged payloads
+    actually consumed (hits > 0) under churn that forces evictions."""
+    opt = embed.Adagrad(learning_rate=0.3)
+    batches, grads = _id_stream(rounds=12)
+
+    base = HostOffloadTable(_spec(32), opt, high_water=0.8)
+    for r, ids in enumerate(batches):
+        base.prepare(ids)
+        st, _ = lookup_train(base.spec, base.state, jnp.asarray(ids))
+        base.state = apply_gradients(base.spec, st, opt, jnp.asarray(ids),
+                                     jnp.asarray(grads[r]))
+
+    off = HostOffloadTable(_spec(32), opt, high_water=0.8,
+                           pipeline=True, densify_k=densify_k)
+    _pipelined_rounds(off, off.spec, opt, batches, grads)
+    assert off._pipe_hits > 0
+
+    all_ids = np.unique(np.concatenate(batches))
+    np.testing.assert_array_equal(base.lookup_anywhere(all_ids),
+                                  off.lookup_anywhere(all_ids))
+
+
+def test_pipeline_churn_single_admit_trace():
+    """The pipelined admit path must never re-jit under churn: constant
+    batch size + pow2 id padding keep the compiled admit program at AT MOST
+    one new trace across 20 rounds of admissions, evictions, and flushes
+    (0 when another table already compiled the shape — jit wrappers of one
+    underlying function share the executable cache, and the guard budgets
+    GROWTH since wrap time)."""
+    opt = embed.Adagrad(learning_rate=0.1)
+    batches, grads = _id_stream(rounds=20, seed=11)
+    off = HostOffloadTable(_spec(32), opt, high_water=0.8, pipeline=True)
+    _pipelined_rounds(off, off.spec, opt, batches, grads)
+    assert off.store.ids.size > 0          # churn really flushed
+    assert off._admit.trace_count() <= 1, off._admit.trace_count()
+
+
+def test_pipeline_stale_stage_discarded():
+    """A staged payload for the WRONG batch (or one invalidated by a
+    residency change) must be discarded — counted as a miss, never
+    consumed — and the step still trains correctly."""
+    from openembedding_tpu.utils import metrics as M
+    opt = embed.Adagrad(learning_rate=0.2)
+    off = HostOffloadTable(_spec(32), opt, high_water=0.8, pipeline=True)
+    a = np.arange(100, 112, dtype=np.int64)
+    b = np.arange(200, 212, dtype=np.int64)
+    off.stage(a)
+    off.prepare(b)          # staged ids mismatch -> miss
+    assert off._pipe_misses == 1 and off._pipe_hits == 0
+    assert all(off.is_resident(int(i)) for i in b)
+    off.stage(a)
+    off.reset_cache()       # epoch bump invalidates the staged payload
+    off.prepare(a)
+    assert off._pipe_misses == 2 and off._pipe_hits == 0
+    assert all(off.is_resident(int(i)) for i in a)
+    assert M.report().get("offload.pipeline_occupancy") == 0.0
+
+
+def test_densified_flush_equals_direct_merges():
+    """densify_k=K defers K store writebacks into ONE drained merge with
+    last-wins semantics; the store contents after sync_to_store equal the
+    K=1 run's exactly, and lookups BETWEEN drains see pending rows."""
+    opt = embed.Adagrad(learning_rate=0.3)
+    batches, grads = _id_stream(rounds=10, seed=13)
+
+    def run(k):
+        off = HostOffloadTable(_spec(16), opt, high_water=0.6, densify_k=k)
+        for r, ids in enumerate(batches):
+            off.prepare(ids)
+            st, _ = lookup_train(off.spec, off.state, jnp.asarray(ids))
+            off.state = apply_gradients(off.spec, st, opt, jnp.asarray(ids),
+                                        jnp.asarray(grads[r]))
+        off.sync_to_store()
+        return off
+
+    o1, o8 = run(1), run(8)
+    assert o8.store.ids.size == o1.store.ids.size
+    np.testing.assert_array_equal(o1.store.ids, o8.store.ids)
+    np.testing.assert_array_equal(o1.store.weights, o8.store.weights)
+    for name in o1.store.slots:
+        np.testing.assert_array_equal(o1.store.slots[name],
+                                      o8.store.slots[name])
+
+
+def test_store_defer_drain_last_wins():
+    """HostStore.defer/drain unit pin: pending chunks overlay lookups
+    newest-first, and drain() collapses them into one last-wins merge."""
+    store = HostStore(DIM, {"accum": DIM})
+    ids = np.asarray([5, 9], np.int64)
+    store.defer(ids, np.ones((2, DIM), np.float32),
+                {"accum": np.full((2, DIM), 1.0, np.float32)})
+    store.defer(np.asarray([9], np.int64),
+                np.full((1, DIM), 7.0, np.float32),
+                {"accum": np.full((1, DIM), 7.0, np.float32)})
+    # pending rows are visible before any drain, newest wins
+    hit, w, s = store.lookup(np.asarray([5, 9], np.int64))
+    assert hit.all()
+    assert (w[0] == 1).all() and (w[1] == 7).all()
+    assert len(store) == 0          # nothing merged yet
+    merged = store.drain()
+    assert merged == 2 and len(store) == 2
+    _, w, s = store.lookup(np.asarray([5, 9], np.int64))
+    assert (w[0] == 1).all() and (w[1] == 7).all()
+    assert (s["accum"][1] == 7).all()
+    assert store.drain() == 0       # idempotent when nothing is pending

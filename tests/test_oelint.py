@@ -208,7 +208,7 @@ def test_assert_no_recompile_prejitted_fn():
     guarded = assert_no_recompile(step, label="step")
     guarded(jnp.ones((2, 3)))
     guarded(jnp.ones((2, 3)))  # re-invocation, same shapes: fine
-    with pytest.raises(RecompileError, match="compiled programs"):
+    with pytest.raises(RecompileError, match="new programs"):
         guarded(jnp.ones((2, 4)))
 
 

@@ -1,0 +1,30 @@
+"""tools/upwindow.py battery smoke: `--dry-run` renders the full case plan
+(argv + env + timeout) without probing the relay or running anything — the
+cheap tier-1 guard that a battery edit (new case, typo'd env knob) fails in
+CI instead of at the next scarce chip up-window."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_upwindow_dry_run_lists_battery():
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "upwindow.py"),
+         "--dry-run", "--skip", "bench_dim64"],
+        capture_output=True, text=True, timeout=60)
+    assert p.returncode == 0, p.stderr
+    out = p.stdout
+    # every battery entry renders, including the round-14 additions
+    for name in ("bench_dim9", "bench_placement", "bench_zero",
+                 "bench_offload_pipe"):
+        assert f"[run ] {name}:" in out, out
+    assert "[skip] bench_dim64:" in out
+    # env overrides and timeouts are part of the rendered plan
+    assert "OETPU_BENCH_CASES=zero" in out
+    assert "OETPU_BENCH_CASES=offload_pipe" in out
+    assert "timeout=" in out
+    # dry run must not have touched the evidence file or probed anything
+    assert "probing relay" not in out

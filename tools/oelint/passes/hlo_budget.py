@@ -93,6 +93,12 @@ CONFIGS = (
      "hot_rows": 32, "forbid_a2a_dtypes": ("f32", "bf16", "u16")},
     {"name": "fused_fp32_hot_int8", "group_exchange": True, "wire": "fp32",
      "hot_rows": 32, "hot_wire": "int8"},
+    # round-14 ZeRO dense sharding: the sharded dense update must cost
+    # EXACTLY one reduce-scatter + one all-gather over the flat dense state
+    # (bytes pinned below) and must not perturb the exchange collectives —
+    # same a2a set and wire bytes as fused_fp32.
+    {"name": "fused_fp32_zero", "group_exchange": True, "wire": "fp32",
+     "hot_rows": 0, "dense_shard": True},
 )
 
 
@@ -192,7 +198,8 @@ def make_trainer(config: Dict):
         model, embed.Adagrad(learning_rate=0.1), mesh=make_mesh(),
         wire=config["wire"], group_exchange=config["group_exchange"],
         hot_rows=config["hot_rows"], mig_rows=config.get("mig_rows", 0),
-        hot_wire=config.get("hot_wire"))
+        hot_wire=config.get("hot_wire"),
+        dense_shard=config.get("dense_shard", False))
     return trainer, batch
 
 
@@ -208,11 +215,15 @@ def measure_trainer(trainer, batch) -> Dict[str, int]:
     counts = count_collectives(text)
     cost = trainer.last_wire_cost or {}
     counts["wire_bytes_per_step"] = int(cost.get("bytes_per_step", 0))
-    pay = collective_payloads(text)
+    pay = collective_payloads(
+        text, kinds=("all_to_all", "all_gather", "reduce_scatter"))
     a2a = [(d, b) for k, d, b in pay if k == "all_to_all"]
     ag = [(d, b) for k, d, b in pay if k == "all_gather"]
+    rs = [(d, b) for k, d, b in pay if k == "reduce_scatter"]
     counts["hlo_a2a_bytes"] = sum(b for _, b in a2a)
     counts["hlo_all_gather_bytes"] = sum(b for _, b in ag)
+    # ZeRO dense sharding's reduce-scatter (result = the 1/S local chunk)
+    counts["hlo_reduce_scatter_bytes"] = sum(b for _, b in rs)
     counts["hlo_a2a_dtypes"] = ",".join(sorted({d for d, _ in a2a}))
     model_a2a = (int(cost.get("bytes_per_step", 0))
                  + int(cost.get("hot_a2a_bytes", 0)))

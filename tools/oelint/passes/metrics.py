@@ -36,11 +36,12 @@ SEGMENT = re.compile(r"^[a-z0-9_]+$")
 # the metric-group registry: every observe() name's first segment and every
 # vtimer()/span() group must be one of these (utils/metrics.py doc scheme)
 KNOWN_GROUPS = {
+    "dense",      # ZeRO dense-state sharding (MeshTrainer(dense_shard=True))
     "exchange",   # sharded-exchange wire costs + per-shard load/skew gauges
     "fleet",      # /fleetz cross-node scrape health
     "hot",        # replicated hot-row cache (MeshTrainer(hot_rows=...))
     "metrics",    # the metrics subsystem's own health (report_errors)
-    "offload",    # host-cached table cache admission/flush
+    "offload",    # host-cached table cache admission/flush/staging pipeline
     "persist",    # async/incremental persistence
     "placement",  # self-driving placement controller + cold-tail migration
     "serving",    # REST predict/pull/batching

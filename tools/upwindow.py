@@ -130,6 +130,30 @@ CASES = [
       "OETPU_BENCH_PROBE_TIMEOUT_S": "75",
       "JAX_PLATFORMS": "cpu",
       "XLA_FLAGS": "--xla_force_host_platform_device_count=8"}, 1400),
+    # 13. round-14 ZeRO dense sharding (bench 'zero' case: dense_shard
+    #     on/off — opt-state bytes per replica, ms/step). The S-fold memory
+    #     win needs S >= 2 shards, so like bench_hot it rides the
+    #     8-virtual-device CPU mesh; an up-window re-run pins the chip's
+    #     reduce_scatter/all_gather timings on top.
+    ("bench_zero",
+     [sys.executable, os.path.join(REPO, "bench.py")],
+     {"OETPU_BENCH_CASES": "zero",
+      "OETPU_BENCH_BUDGET_S": "900",
+      "OETPU_BENCH_TOTAL_BUDGET_S": "1140",
+      "OETPU_BENCH_PROBE_TIMEOUT_S": "75",
+      "JAX_PLATFORMS": "cpu",
+      "XLA_FLAGS": "--xla_force_host_platform_device_count=8"}, 1200),
+    # 14. round-14 offload staging pipeline (bench 'offload_pipe' case:
+    #     pipeline on/off x densify K in {1,4,16} — ms/round, pipeline
+    #     occupancy, drained rows). Host-side two-tier cache work; no mesh
+    #     or relay needed, riding the battery keeps the stanzas together.
+    ("bench_offload_pipe",
+     [sys.executable, os.path.join(REPO, "bench.py")],
+     {"OETPU_BENCH_CASES": "offload_pipe",
+      "OETPU_BENCH_BUDGET_S": "600",
+      "OETPU_BENCH_TOTAL_BUDGET_S": "840",
+      "OETPU_BENCH_PROBE_TIMEOUT_S": "75",
+      "JAX_PLATFORMS": "cpu"}, 900),
 ]
 
 
@@ -215,8 +239,18 @@ def main():
                     help="assume the relay is up (caller already probed)")
     ap.add_argument("--force", action="store_true",
                     help="re-run cases already green in a prior invocation")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="print the battery plan (name, argv, env, timeout) "
+                         "and exit without probing or running anything")
     args = ap.parse_args()
     skip = set(filter(None, args.skip.split(",")))
+    if args.dry_run:
+        for name, argv, env_over, timeout in CASES:
+            mark = "skip" if name in skip else "run "
+            env = " ".join(f"{k}={v}" for k, v in sorted(env_over.items()))
+            print(f"[{mark}] {name}: timeout={timeout}s "
+                  f"{env + ' ' if env else ''}{' '.join(argv)}")
+        return 0
     done = set()
     if not args.force and os.path.exists(DONE):
         with open(DONE) as f:
