@@ -18,16 +18,22 @@ CPU_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 
 ci: lint test dryrun bench-smoke
 
-# the full static-analysis + invariant-guard suite (tools/oelint): five
+# the full static-analysis + invariant-guard suite (tools/oelint): eight
 # passes — trace-hazard (recompile hazards in jit-reachable code), host-sync
-# (device_get discipline in `# oelint: hot-path` fns), hlo-budget (compiled
-# collective counts vs tools/oelint/hlo_budget.json), lockset (`# guarded-by:`
-# lock discipline), metrics (name hygiene). CPU-only, no chip; < 90 s.
+# (device_get discipline in `# oelint: hot-path` fns), sharding
+# (PartitionSpec placement-flow consistency), spmd-divergence (per-process
+# host control flow upstream of collectives), hlo-budget (compiled
+# collective counts vs tools/oelint/hlo_budget.json), implicit-reshard
+# (GSPMD-inserted collectives with no traced-op attribution), lockset
+# (`# guarded-by:` discipline + lock-ordering cycles), metrics (name
+# hygiene). CPU-only, no chip; passes run concurrently and the compiles are
+# cached on a source digest — warm runs finish in seconds (<= 25 s budget).
 lint:
 	$(CPU_ENV) $(PY) -m tools.oelint
 
 # fast local iteration: lint only files changed vs HEAD (skips the
-# hlo-budget compile unless exchange/trainer/ops paths changed)
+# hlo-budget/implicit-reshard compile unless exchange/trainer/ops paths
+# changed)
 lint-fast:
 	$(CPU_ENV) $(PY) -m tools.oelint --changed-only
 
@@ -36,8 +42,8 @@ lint-fast:
 lint-budget:
 	$(CPU_ENV) $(PY) -m tools.oelint --update-budget
 
-# metric-name hygiene only (back-compat alias; the check is oelint's fifth
-# pass and runs as part of `make lint`)
+# metric-name hygiene only (back-compat alias; the check is oelint's
+# metrics pass and runs as part of `make lint`)
 lint-metrics:
 	$(PY) tools/lint_metrics.py
 

@@ -104,6 +104,19 @@ class AsyncPersister:
 
         if window < 1:
             raise ValueError("window must be >= 1")
+        if jax.process_count() > 1 and policy is not None \
+                and policy.every_seconds > 0:
+            # The SPMD defense the spmd-divergence lint pass checks for:
+            # persist() drives mesh-global compiled programs (hot_sync /
+            # externalize) and, incrementally, a host allgather — a
+            # wall-clock policy fires at different steps on different
+            # hosts, so one process enters that rendezvous and the rest
+            # never do. Step-driven policies are lockstep-uniform.
+            raise ValueError(
+                "multi-process persisters need a step-driven policy "
+                "(every_steps): wall-clock policies fire at different "
+                "steps on different hosts, and persist() is a collective "
+                "rendezvous (hot_sync/externalize, delta allgather)")
         self.trainer = trainer
         self.model = model
         self.root = root
@@ -143,7 +156,7 @@ class AsyncPersister:
         step = int(state.step)
         if not self.should_persist(step):
             return False
-        self.persist(state)
+        self.persist(state)  # oelint: disable=spmd-divergence -- __init__ rejects wall-clock policies for process_count > 1, so should_persist is step-driven and lockstep-uniform across processes
         return True
 
     def persist(self, state) -> str:
@@ -545,12 +558,14 @@ def _make_shard_row_reader(mesh, axis, state_pspec, use_hash: bool,
              for k, v in ts.slots.items()}
         return found, w, s
 
-    slot_specs = {k: P(axis, None) for k in
+    # trimmed spellings (P(axis), not P(axis, None)): trailing Nones are
+    # placement-identical but cache-key-unequal — the sharding lint rule
+    slot_specs = {k: P(axis) for k in
                   (state_pspec.slots if isinstance(state_pspec.slots, dict)
                    else {})}
     return jax.jit(jax.shard_map(
         read, mesh=mesh, in_specs=(state_pspec, P()),
-        out_specs=(P(axis), P(axis, None), slot_specs), check_vma=False))
+        out_specs=(P(axis), P(axis), slot_specs), check_vma=False))
 
 
 class IncrementalPersister(AsyncPersister):
@@ -584,14 +599,8 @@ class IncrementalPersister(AsyncPersister):
 
     def __init__(self, trainer, model, root: str, *, full_every: int = 8,
                  **kw):
-        if jax.process_count() > 1:
-            policy = kw.get("policy")
-            if policy is not None and policy.every_seconds > 0:
-                raise ValueError(
-                    "multi-process IncrementalPersister needs a step-driven "
-                    "policy (every_steps): wall-clock policies fire at "
-                    "different steps on different hosts, and the touched-id "
-                    "union is a collective")
+        # multi-process wall-clock policies are rejected by
+        # AsyncPersister.__init__ (the defense covers both persisters)
         if full_every < 1:
             raise ValueError("full_every must be >= 1")
         super().__init__(trainer, model, root, **kw)

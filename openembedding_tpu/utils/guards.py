@@ -25,22 +25,43 @@ Two tools:
   that want to assert "N more steps, zero new programs" without adopting the
   raising wrapper.
 
-Both lean on the jit compilation cache itself (`fn._cache_size()`), so they
-measure what XLA actually did, not what the code intended.
+- `collective_fingerprint(fn, *args)` — hash of the ORDERED collective op
+  sequence `fn` traces to for these arguments (primitive name, axis names,
+  output avals — walked from the jaxpr, nested pjit/shard_map/control-flow
+  included). The SPMD contract says this sequence must be identical on every
+  process and must survive hot-row refreshes, migrations and placement
+  cycles (all content-only by design); tests and the soak harness pin it
+  with `assert_collective_fingerprint`, which raises
+  `CollectiveMismatchError` with both sequences when the program changed.
+  This is the runtime twin of the static spmd-divergence and
+  implicit-reshard lint passes (tools/oelint): they catch the Python
+  patterns and the compiled reshards, this catches the traced truth.
+
+The recompile guards lean on the jit compilation cache itself
+(`fn._cache_size()`), so they measure what XLA actually did, not what the
+code intended; the fingerprint leans on `jax.make_jaxpr`, so it is
+compile-free and cheap enough for a soak loop.
 """
 
 from __future__ import annotations
 
 import functools
+import hashlib
 from contextlib import contextmanager
-from typing import Optional
+from typing import List, Optional, Tuple
 
-__all__ = ["RecompileError", "TraceCounter", "assert_no_recompile",
-           "trace_counter"]
+__all__ = ["RecompileError", "CollectiveMismatchError", "TraceCounter",
+           "assert_no_recompile", "trace_counter", "collective_sequence",
+           "collective_fingerprint", "assert_collective_fingerprint"]
 
 
 class RecompileError(RuntimeError):
     """A guarded jitted function compiled more times than its budget."""
+
+
+class CollectiveMismatchError(RuntimeError):
+    """A pinned collective fingerprint changed: the traced collective
+    sequence differs from the one the pin was taken against."""
 
 
 class TraceCounter:
@@ -136,6 +157,76 @@ def assert_no_recompile(fn=None, *, max_traces: int = 1,
     guarded.traces = counter
     guarded.trace_count = lambda: counter.traces
     return guarded
+
+
+# -- collective fingerprint (the SPMD-contract runtime twin) -----------------
+
+# traced collective primitives (jax.lax); pmean/pmax lower through psum/pmax,
+# and shard_map's replication-checking rewrite renames psum to psum2
+_COLLECTIVE_PRIMS = {
+    "psum", "psum2", "pmax", "pmin", "ppermute", "pbroadcast", "all_to_all",
+    "all_gather", "all_gather_invariant", "reduce_scatter", "psum_scatter",
+    "psum_invariant",
+}
+
+
+def _walk_jaxpr(jaxpr, seq: List[Tuple[str, str, Tuple[str, ...]]]) -> None:
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name in _COLLECTIVE_PRIMS:
+            axes = eqn.params.get("axes", eqn.params.get("axis_name"))
+            seq.append((name, str(axes),
+                        tuple(str(v.aval) for v in eqn.outvars)))
+        for v in eqn.params.values():
+            for sub in (v if isinstance(v, (list, tuple)) else (v,)):
+                inner = getattr(sub, "jaxpr", None)  # ClosedJaxpr
+                if inner is not None and hasattr(inner, "eqns"):
+                    _walk_jaxpr(inner, seq)
+                elif hasattr(sub, "eqns"):           # bare Jaxpr param
+                    _walk_jaxpr(sub, seq)
+
+
+def collective_sequence(fn, *args, **kwargs):
+    """Ordered [(primitive, axes, out avals)] of every collective `fn`
+    traces to for these arguments, nested jaxprs included. Works on plain
+    and jitted callables alike (tracing only — nothing compiles or runs)."""
+    import jax
+    closed = jax.make_jaxpr(fn)(*args, **kwargs)
+    seq: List[Tuple[str, str, Tuple[str, ...]]] = []
+    _walk_jaxpr(closed.jaxpr, seq)
+    return seq
+
+
+def collective_fingerprint(fn, *args, **kwargs) -> str:
+    """sha256 (16 hex chars) over `collective_sequence(fn, *args)`: pin it
+    once per compiled mode, and any change to which collectives run, in
+    what order, over which axes, at what shapes/dtypes changes the hash."""
+    seq = collective_sequence(fn, *args, **kwargs)
+    fp = hashlib.sha256(repr(seq).encode()).hexdigest()[:16]
+    from . import metrics as _metrics
+    _metrics.observe("guard.fingerprints", 1.0)
+    return fp
+
+
+def assert_collective_fingerprint(fn, pinned: str, *args,
+                                  label: Optional[str] = None,
+                                  **kwargs) -> str:
+    """Raise `CollectiveMismatchError` if `fn`'s traced collective sequence
+    no longer hashes to `pinned`; returns the (matching) fingerprint. The
+    error carries the full current sequence — diff it against the pin
+    commit to see which collective moved."""
+    seq = collective_sequence(fn, *args, **kwargs)
+    fp = hashlib.sha256(repr(seq).encode()).hexdigest()[:16]
+    if fp != pinned:
+        name = label or getattr(fn, "__name__", None) or repr(fn)
+        from . import metrics as _metrics
+        _metrics.observe("guard.fingerprint_trips", 1.0)
+        raise CollectiveMismatchError(
+            f"{name!r}: traced collective sequence changed (fingerprint "
+            f"{fp} != pinned {pinned}) — the SPMD collective program is "
+            "supposed to be refresh/migration/resize-invariant. Current "
+            f"sequence: {seq}")
+    return fp
 
 
 class _TraceDelta:

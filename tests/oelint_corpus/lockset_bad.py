@@ -32,3 +32,31 @@ class PlantedLockset:
 
     def bad_augmented(self):
         self._state += 1  # PLANT: unguarded-augassign
+
+
+class PlantedOrdering:
+    """Two locks taken in opposite orders on two paths: the classic AB/BA
+    deadlock, plus a single-thread re-acquire of a non-reentrant Lock."""
+
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def ab_path(self):
+        with self._a:
+            with self._b:  # PLANT: lock-order-cycle (a -> b)
+                pass
+
+    def ba_path(self):
+        with self._b:
+            with self._a:  # PLANT: lock-order-cycle (b -> a)
+                pass
+
+    def helper_taking_b(self):
+        with self._b:
+            pass
+
+    def reacquire(self):
+        with self._a:
+            with self._a:  # PLANT: non-reentrant re-acquire
+                pass

@@ -1,0 +1,51 @@
+"""Planted sharding-registry violations for the sharding pass.
+
+Every marked line must be caught; the registry sites WITHOUT a marker
+(the reference spellings) must not be flagged.
+"""
+
+from jax.sharding import PartitionSpec as P
+
+
+class EmbeddingTableState:  # stand-in: the pass matches by constructor name
+    def __init__(self, **kw):
+        self.kw = kw
+
+
+def reference_spec(axis):
+    # the reference spelling: row-sharded weights/slots/keys, replicated
+    # overflow — this site defines the registry entry and is NOT flagged
+    return EmbeddingTableState(
+        weights=P(axis),
+        slots={k: P(axis) for k in ("acc",)},
+        keys=P(axis),
+        overflow=P(),
+    )
+
+
+def conflicting_spec(axis):
+    return EmbeddingTableState(
+        weights=P(),  # PLANT: same leaf bound replicated vs sharded above
+        slots={k: P() for k in ("acc",)},  # PLANT: slot leaf disagrees too
+        keys=P(axis),
+        overflow=P(),
+    )
+
+
+def untrimmed_spelling(axis):
+    # placement-identical to P(axis) but a DIFFERENT jit cache key
+    return P(axis, None)  # PLANT: trailing-None spelling drift
+
+
+def ternary_conflict(axis, serving):
+    return EmbeddingTableState(
+        weights=P(axis),
+        slots={},
+        keys=P(axis),
+        overflow=P(axis) if serving else P(axis),  # PLANT: ternary arms disagree with registry
+    )
+
+
+def fine_unresolvable(dims, axis):
+    # computed dims are skipped, never guessed: no finding here
+    return P(*dims), P(axis)

@@ -1,0 +1,76 @@
+"""Planted SPMD-divergence violations for the spmd-divergence pass.
+
+Every marked line must be caught. The uniform controls at the bottom
+(process_count branch, step-driven cadence) must stay clean.
+"""
+
+import time
+
+import jax
+
+
+def branch_on_process_index(x, axis):
+    if jax.process_index() == 0:
+        x = x + jax.lax.psum(x, axis)  # PLANT: collective under a per-process branch
+    return x
+
+
+def wall_clock_gate(x, axis, last):
+    if time.monotonic() - last > 5.0:
+        return jax.lax.all_gather(x, axis)  # PLANT: wall-clock-gated collective
+    return x
+
+
+def wall_clock_through_a_helper(x):
+    # divergence must propagate through the helper's return value
+    return time.monotonic() > 0
+
+
+def gated_by_helper(x, axis):
+    if wall_clock_through_a_helper(x):
+        return jax.lax.pmax(x, axis)  # PLANT: divergent helper return gates a collective
+    return x
+
+
+def early_exit_then_collective(x, axis):
+    pidx = jax.process_index()
+    if pidx != 0:
+        return x
+    return jax.lax.psum(x, axis)  # PLANT: collective after a divergent early return
+
+
+def set_ordered_collectives(tables, axis):
+    out = []
+    for name in set(tables):
+        out.append(jax.lax.psum(tables[name], axis))  # PLANT: set iteration orders a collective sequence
+    return out
+
+
+def per_shard_view_gate(arr, x, axis):
+    if arr.addressable_shards[0].data.sum() > 0:
+        return jax.lax.psum(x, axis)  # PLANT: per-shard device view gates a collective
+    return x
+
+
+# -- uniform controls: none of these may be flagged --------------------------
+
+
+def uniform_process_count(x, axis):
+    # process_count is identical on every process: branching on it is fine
+    if jax.process_count() > 1:
+        return jax.lax.psum(x, axis)
+    return x
+
+
+def step_driven_cadence(x, axis, step):
+    # step counters are lockstep-uniform: the canonical divergence-free gate
+    if step % 100 == 0:
+        return jax.lax.psum(x, axis)
+    return x
+
+
+def divergent_branch_without_collectives(path):
+    # process-0-only host work with no rendezvous inside or after: fine
+    if jax.process_index() == 0:
+        with open(path, "w") as f:
+            f.write("ok")

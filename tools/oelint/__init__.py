@@ -1,21 +1,30 @@
 """oelint: static-analysis + invariant-guard suite for this repo.
 
-Five passes over `openembedding_tpu/` (see each module's doc):
+Eight passes over `openembedding_tpu/` (see each module's doc):
 
-- trace-hazard — recompile/concretization hazards in jit-reachable code
-- host-sync   — device→host sync discipline in `# oelint: hot-path` fns
-- hlo-budget  — per-config collective counts vs tools/oelint/hlo_budget.json
-- lockset     — `# guarded-by:` lock discipline + mutable class-level state
-- metrics     — metric-name hygiene (the former tools/lint_metrics.py)
+- trace-hazard     — recompile/concretization hazards in jit-reachable code
+- host-sync        — device→host sync discipline in `# oelint: hot-path` fns
+- sharding         — one PartitionSpec spelling per logical placement leaf
+- spmd-divergence  — per-process host control flow upstream of collectives
+- hlo-budget       — per-config collective counts vs tools/oelint/hlo_budget.json
+- implicit-reshard — no compiled collective without a traced-op attribution
+- lockset          — `# guarded-by:` discipline + lock-ordering cycles
+- metrics          — metric-name hygiene (the former tools/lint_metrics.py)
 
 Run them all with `make lint` / `python -m tools.oelint`; the runtime
-counterpart (executable never-re-jit assertions) is
+counterpart (executable never-re-jit + collective-fingerprint assertions) is
 `openembedding_tpu/utils/guards.py`.
+
+Passes run CONCURRENTLY (the hlo-budget/implicit-reshard XLA compiles
+release the GIL under the AST walks); the two compiling passes share one
+measurement behind `hlo_budget.measure_cached`'s source-digest cache, so a
+warm full run costs seconds, not minutes.
 """
 
 from __future__ import annotations
 
 import time
+from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, Iterable, List, Optional, Tuple
 
 from .core import (Finding, SourceFile, changed_files, iter_py_files,
@@ -26,14 +35,19 @@ from .passes import ALL_PASSES, BY_NAME
 def run_passes(pass_names: Optional[Iterable[str]] = None, *,
                root: Optional[str] = None,
                changed_only: bool = False,
+               parallel: bool = True,
                ) -> Tuple[List[Finding], Dict[str, float]]:
     """Run the named passes (default: all) over the repo.
 
     Returns (findings, {pass name: seconds}). Suppressed findings are
     already filtered by each pass; bare (reasonless) suppressions in any
-    scanned file surface as `suppression` findings. `changed_only` narrows
-    file-scanning passes to files changed vs HEAD and skips the hlo-budget
-    compile unless one of its trigger paths changed.
+    scanned file surface as `suppression` findings.
+
+    `changed_only` narrows file-scanning passes to files changed vs HEAD —
+    except passes declaring `NEEDS_ALL_FILES` (cross-file registries /
+    call graphs), which run on their full file set whenever ANY of their
+    files changed — and runs the compiling passes (hlo-budget,
+    implicit-reshard) only when one of their `TRIGGERS` paths changed.
     """
     root = root or repo_root()
     selected = [BY_NAME[n] for n in (pass_names or BY_NAME)]
@@ -43,20 +57,25 @@ def run_passes(pass_names: Optional[Iterable[str]] = None, *,
     timings: Dict[str, float] = {}
     file_cache: Dict[str, SourceFile] = {}
     suppression_checked: set = set()
+    tasks: List[Tuple[str, object, List[SourceFile]]] = []
 
     for p in selected:
-        t0 = time.monotonic()
-        if p.NAME == "hlo-budget":
+        if not p.DIRS:  # compiling pass: no files, gated on TRIGGERS
             if changed is not None and not any(
                     rel.startswith(p.TRIGGERS) for rel in changed):
                 timings[p.NAME] = 0.0
                 continue
-            findings.extend(p.run([], root))
-            timings[p.NAME] = time.monotonic() - t0
+            tasks.append((p.NAME, p, []))
             continue
         rels = iter_py_files(root, p.DIRS, skip=getattr(p, "SKIP", ()))
         if changed is not None:
-            rels = [r for r in rels if r in changed]
+            if getattr(p, "NEEDS_ALL_FILES", False):
+                # cross-file pass: all files, but only if one of them changed
+                if not any(r in changed for r in rels):
+                    timings[p.NAME] = 0.0
+                    continue
+            else:
+                rels = [r for r in rels if r in changed]
         files = []
         for rel in rels:
             sf = file_cache.get(rel)
@@ -71,8 +90,21 @@ def run_passes(pass_names: Optional[Iterable[str]] = None, *,
             if rel not in suppression_checked:
                 suppression_checked.add(rel)
                 findings.extend(sf.bare_suppressions())
-        findings.extend(p.run(files, root))
-        timings[p.NAME] = time.monotonic() - t0
+        tasks.append((p.NAME, p, files))
+
+    def _one(task):
+        name, p, files = task
+        t0 = time.monotonic()
+        return name, p.run(files, root), time.monotonic() - t0
+
+    if parallel and len(tasks) > 1:
+        with ThreadPoolExecutor(max_workers=min(8, len(tasks))) as ex:
+            results = list(ex.map(_one, tasks))
+    else:
+        results = [_one(t) for t in tasks]
+    for name, fs, dt in results:
+        findings.extend(fs)
+        timings[name] = dt
     findings = sorted(set(findings),
                       key=lambda f: (f.path, f.line, f.pass_name, f.message))
     return findings, timings

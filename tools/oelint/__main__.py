@@ -35,8 +35,8 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tools.oelint",
         description="static-analysis + invariant-guard suite "
-                    "(trace-hazard, host-sync, hlo-budget, lockset, "
-                    "metrics)")
+                    "(trace-hazard, host-sync, sharding, spmd-divergence, "
+                    "hlo-budget, implicit-reshard, lockset, metrics)")
     ap.add_argument("passes", nargs="*", metavar="PASS",
                     help=f"passes to run (default all): "
                          f"{', '.join(BY_NAME)}")
@@ -68,6 +68,14 @@ def main(argv=None) -> int:
     t0 = time.monotonic()
     findings, timings = run_passes(args.passes or None,
                                    changed_only=args.changed_only)
+    try:  # expose run health as gauges (scraped when run in-process)
+        from openembedding_tpu.utils import metrics as _metrics
+        for n, dt in timings.items():
+            _metrics.observe("lint.pass_seconds", dt, "gauge",
+                             labels={"pass": n})
+        _metrics.observe("lint.findings", float(len(findings)), "gauge")
+    except Exception:  # noqa: BLE001 — lint must not die on telemetry
+        pass
     for f in findings:
         print(f)
     ran = ", ".join(f"{n} {dt:.1f}s" for n, dt in timings.items())

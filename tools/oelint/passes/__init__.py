@@ -1,6 +1,8 @@
 """oelint pass registry, in documentation order."""
 
-from . import trace_hazard, host_sync, hlo_budget, lockset, metrics
+from . import (trace_hazard, host_sync, sharding, spmd_divergence,
+               hlo_budget, implicit_reshard, lockset, metrics)
 
-ALL_PASSES = (trace_hazard, host_sync, hlo_budget, lockset, metrics)
+ALL_PASSES = (trace_hazard, host_sync, sharding, spmd_divergence,
+              hlo_budget, implicit_reshard, lockset, metrics)
 BY_NAME = {p.NAME: p for p in ALL_PASSES}

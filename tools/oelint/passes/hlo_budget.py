@@ -23,16 +23,21 @@ Runs CPU-only (`JAX_PLATFORMS=cpu`, 8 virtual devices); no chip needed.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import re
-from typing import Dict, List, Optional
+import threading
+from typing import Dict, List, Optional, Tuple
 
-from ..core import Finding
+from ..core import Finding, iter_py_files
 
 NAME = "hlo-budget"
 DIRS = ()  # compiles programs; scans no source files
 BUDGET_REL = "tools/oelint/hlo_budget.json"
+# measured-counts cache keyed on a source digest: warm `make lint` skips all
+# ten XLA compiles (see measure_cached). Local state, gitignored.
+CACHE_REL = "tools/oelint/.hlo_measure_cache.json"
 
 # --changed-only reruns this pass only when these paths changed (anything
 # else cannot alter the compiled collective set)
@@ -121,6 +126,38 @@ def _ensure_cpu() -> None:
 def count_collectives(hlo_text: str) -> Dict[str, int]:
     return {kind: len(re.findall(pat, hlo_text))
             for kind, pat in COLLECTIVES.items()}
+
+
+# -- implicit-reshard attribution (consumed by the implicit-reshard pass) ----
+#
+# Every collective the PROTOCOL asks for is traced from an explicit lax call,
+# and XLA stamps those ops with `metadata={op_name="jit(...)/.../psum"}` —
+# the op_name tail is the traced primitive. GSPMD-INSERTED collectives
+# (resharding between mismatched in/out shardings) carry no such traced-op
+# tail: that absence is the detection signal for the silent-all-gather class.
+_OPNAME_RE = re.compile(r'op_name="([^"]+)"')
+_EXPLICIT_TAILS = {
+    "psum", "psum2", "pmean", "pmax", "pmin", "ppermute", "pbroadcast",
+    "all_to_all", "all_gather", "all_gather_invariant", "reduce_scatter",
+    "psum_scatter",
+}
+
+
+def unattributed_collectives(hlo_text: str) -> List[Tuple[str, str]]:
+    """[(kind, attribution)] for compiled collectives that do NOT trace back
+    to an explicit collective primitive — i.e. GSPMD inserted them."""
+    out: List[Tuple[str, str]] = []
+    for line in hlo_text.splitlines():
+        for kind, pat in COLLECTIVES.items():
+            if not re.search(pat, line):
+                continue
+            m = _OPNAME_RE.search(line)
+            tail = m.group(1).rsplit("/", 1)[-1] if m else ""
+            base = tail.split("[", 1)[0]
+            if base not in _EXPLICIT_TAILS:
+                out.append((kind, m.group(1) if m else "<no metadata>"))
+            break
+    return out
 
 
 def collective_payloads(hlo_text: str,
@@ -228,6 +265,13 @@ def measure_trainer(trainer, batch) -> Dict[str, int]:
     model_a2a = (int(cost.get("bytes_per_step", 0))
                  + int(cost.get("hot_a2a_bytes", 0)))
     counts["wire_model_delta"] = counts["hlo_a2a_bytes"] - model_a2a
+    # GSPMD-inserted collectives (no traced-op attribution). The count is a
+    # pinned budget key (0 everywhere); the "_"-prefixed detail is carried
+    # for the implicit-reshard pass's message and skipped by compare().
+    unattr = unattributed_collectives(text)
+    counts["unattributed_collectives"] = len(unattr)
+    counts["_unattributed_detail"] = "; ".join(
+        f"{kind} <- {attr}" for kind, attr in unattr)
     return counts
 
 
@@ -237,6 +281,70 @@ def measure(configs=CONFIGS) -> Dict[str, Dict[str, int]]:
         trainer, batch = make_trainer(cfg)
         out[cfg["name"]] = measure_trainer(trainer, batch)
     return out
+
+
+# -- source-digest compile cache ---------------------------------------------
+#
+# The ten config compiles dominate `make lint` wall time (~minutes cold).
+# Nothing outside the package source (plus this pass and the jax build) can
+# change what they compile to, so measured counts are cached keyed on a
+# digest of exactly those inputs; a warm `make lint` replays the cached
+# counts and still runs compare()/forbidden_dtype_findings()/the
+# implicit-reshard check against the CURRENT budget json.
+
+_MEASURE_LOCK = threading.Lock()
+_MEASURE_MEMO: Dict[str, Dict[str, Dict]] = {}
+
+
+def source_digest(root: str) -> str:
+    h = hashlib.sha256()
+    try:
+        import jax
+        h.update(jax.__version__.encode())
+    except Exception:  # noqa: BLE001 — no jax == cache never hits anyway
+        pass
+    rels = list(iter_py_files(root, ("openembedding_tpu",)))
+    rels.append("tools/oelint/passes/hlo_budget.py")
+    for rel in sorted(rels):
+        h.update(rel.encode())
+        try:
+            with open(os.path.join(root, rel), "rb") as f:
+                h.update(hashlib.sha256(f.read()).digest())
+        except OSError:
+            h.update(b"<unreadable>")
+    h.update(repr(CONFIGS).encode())
+    return h.hexdigest()
+
+
+def measure_cached(root: str, *, force: bool = False) -> Dict[str, Dict]:
+    """measure() with the digest cache in front. Thread-safe: the hlo-budget
+    and implicit-reshard passes run concurrently and share one compile."""
+    with _MEASURE_LOCK:
+        digest = source_digest(root)
+        if not force:
+            if digest in _MEASURE_MEMO:
+                return _MEASURE_MEMO[digest]
+            path = os.path.join(root, CACHE_REL)
+            try:
+                with open(path, encoding="utf-8") as f:
+                    doc = json.load(f)
+                if doc.get("digest") == digest:
+                    _MEASURE_MEMO[digest] = doc["measured"]
+                    return doc["measured"]
+            except (OSError, ValueError, KeyError):
+                pass
+        measured = measure()
+        _MEASURE_MEMO[digest] = measured
+        tmp = os.path.join(root, CACHE_REL) + f".tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump({"digest": digest, "measured": measured}, f,
+                          indent=2, sort_keys=True)
+                f.write("\n")
+            os.replace(tmp, os.path.join(root, CACHE_REL))
+        except OSError:
+            pass
+        return measured
 
 
 def load_budget(root: str) -> Optional[Dict]:
@@ -266,6 +374,8 @@ def compare(measured: Dict[str, Dict[str, int]],
                 "run --update-budget and review the diff"))
             continue
         for kind in sorted(set(counts) | set(pinned[name])):
+            if kind.startswith("_"):
+                continue  # detail payloads ride along unpinned
             got_raw = counts.get(kind, 0)
             want_raw = pinned[name].get(kind, 0)
             if isinstance(got_raw, str) or isinstance(want_raw, str):
@@ -336,6 +446,10 @@ def update_budget(root: str) -> str:
     _ensure_cpu()
     import jax
     path = os.path.join(root, BUDGET_REL)
+    measured = measure_cached(root, force=True)
+    configs = {name: {k: v for k, v in counts.items()
+                      if not k.startswith("_")}
+               for name, counts in measured.items()}
     doc = {
         "_comment": "Pinned collective counts + static wire bytes per "
                     "compiled train-step config (tools/oelint/passes/"
@@ -344,7 +458,7 @@ def update_budget(root: str) -> str:
                     "surface for collective changes.",
         "jax": jax.__version__,
         "mesh_devices": 8,
-        "configs": measure(),
+        "configs": configs,
     }
     tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "w", encoding="utf-8") as f:
@@ -355,6 +469,6 @@ def update_budget(root: str) -> str:
 
 
 def run(files, root: str) -> List[Finding]:
-    measured = measure()
+    measured = measure_cached(root)
     return (compare(measured, load_budget(root))
             + forbidden_dtype_findings(measured))

@@ -1,0 +1,50 @@
+"""implicit-reshard pass: no compiled collective without a traced-op alibi.
+
+GSPMD (arXiv:2105.04663) is free to INSERT collectives the program never
+asked for: when a value flows between two ops whose shardings disagree, the
+partitioner materializes a reshard — typically an all-gather — and the step
+silently pays full-table wire cost forever. The hlo-budget pass would catch
+the count change, but only against a budget someone could just regenerate;
+this pass is budget-INDEPENDENT (same design as `forbid_a2a_dtypes`): every
+collective in the compiled HLO of every pinned config must attribute back to
+an explicit collective primitive via its `op_name` metadata tail (`psum`,
+`all_to_all`, `reduce_scatter`, ...). A collective with no such traced-op
+attribution is GSPMD-inserted by construction, and is a lint failure with
+the op kind + whatever attribution the line does carry — fix the
+in/out_shardings disagreement, don't regenerate the budget.
+
+Shares the hlo-budget measurement (one compile, one source-digest cache —
+see `hlo_budget.measure_cached`); `--changed-only` reruns it under the same
+trigger paths.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..core import Finding
+from . import hlo_budget
+
+NAME = "implicit-reshard"
+DIRS = ()  # consumes the hlo-budget measurement; scans no source files
+TRIGGERS = hlo_budget.TRIGGERS
+
+
+def findings_for(measured) -> List[Finding]:
+    out: List[Finding] = []
+    for name, counts in sorted(measured.items()):
+        n = int(counts.get("unattributed_collectives", 0))
+        if not n:
+            continue
+        detail = counts.get("_unattributed_detail", "") or "<no detail>"
+        out.append(Finding(
+            hlo_budget.BUDGET_REL, 1, NAME,
+            f"config {name!r}: {n} compiled collective(s) have no traced-op "
+            f"attribution ({detail}) — GSPMD inserted a reshard (mismatched "
+            "in/out shardings on the pinned path); fix the sharding "
+            "disagreement instead of regenerating the budget"))
+    return out
+
+
+def run(files, root: str) -> List[Finding]:
+    return findings_for(hlo_budget.measure_cached(root))

@@ -28,17 +28,36 @@ Second rule, annotation-free: MUTABLE CLASS-LEVEL state (`x = []` / `= {}` /
 behind every object of the class is the classic silent-aliasing bug, and in
 this codebase class attributes double as cross-thread state (ServingHandler
 handler classes). Intentional shared state takes a reasoned suppression.
+
+Third rule, lock ORDERING: every `with <lock>` acquired while another
+declared lock is held adds an acquire-while-held edge `held -> acquired` —
+directly, or through a call whose callee (transitively, bare-name call graph
+as in trace-hazard) acquires locks. A cycle in that graph means two threads
+can take the same pair of locks in opposite orders and deadlock; each edge
+of the cycle is a finding at its witness site. Acquiring a NON-reentrant
+`threading.Lock` while already holding it (directly or through a callee) is
+flagged immediately — that deadlocks a single thread. Lock identity is the
+declaration site (`ClassName.attr` for `self.X = threading.Lock()`,
+`module.NAME` for module-level locks); `threading.Condition(self.X)`
+aliases resolve to the underlying lock. Establish a fixed acquisition order
+to fix a real inversion, or suppress with the invariant that prevents the
+two orders from racing.
 """
 
 from __future__ import annotations
 
 import ast
-from typing import Dict, List, Optional
+import os
+from typing import Dict, List, Optional, Set, Tuple
 
 from ..core import Finding, GUARDED_BY_RE, SourceFile
+from .trace_hazard import _GENERIC_TAILS, _call_chain
 
 NAME = "lockset"
 DIRS = ("openembedding_tpu",)
+# the ordering rule follows calls across files: a changed callee can create
+# an edge from an unchanged caller
+NEEDS_ALL_FILES = True
 
 _EXEMPT_METHODS = {"__init__", "__new__"}
 
@@ -202,6 +221,238 @@ def _check_method(sf: SourceFile, cls: ast.ClassDef, method: ast.AST,
     return out
 
 
+# -- lock-ordering cycle detection (third rule) ------------------------------
+
+
+_LOCK_CTORS = {"Lock": "Lock", "RLock": "RLock", "Condition": "Condition"}
+
+
+class _LockWorld:
+    """Lock declarations, aliases and per-function acquire summaries across
+    the scanned files. Node identity = declaration site: `ClassName.attr`
+    for `self.X = threading.Lock()`, `<module>.NAME` for module globals."""
+
+    def __init__(self, files: List[SourceFile]):
+        self.kinds: Dict[str, str] = {}        # node -> Lock/RLock/Condition
+        self.aliases: Dict[str, str] = {}      # Condition node -> lock node
+        # (file id, class name or "") -> {local expr text -> node}
+        self.scopes: Dict[Tuple[int, str], Dict[str, str]] = {}
+        self.fns: Dict[str, List[Tuple[SourceFile, ast.AST, str]]] = {}
+        for sf in files:
+            if sf.tree is None:
+                continue
+            self._collect_module(sf)
+        self.may_acquire = self._summarize()
+
+    @staticmethod
+    def _lock_ctor(value: ast.AST) -> Optional[str]:
+        if not isinstance(value, ast.Call):
+            return None
+        chain = _call_chain(value)
+        if chain is None:
+            return None
+        return _LOCK_CTORS.get(chain[-1])
+
+    def _collect_module(self, sf: SourceFile) -> None:
+        mod = os.path.splitext(os.path.basename(sf.rel))[0]
+        mod_scope = self.scopes.setdefault((id(sf), ""), {})
+        for node in sf.tree.body:
+            if isinstance(node, ast.Assign):
+                kind = self._lock_ctor(node.value)
+                if kind:
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            ref = f"{mod}.{tgt.id}"
+                            self.kinds[ref] = kind
+                            mod_scope[tgt.id] = ref
+        for cls in ast.walk(sf.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            scope = self.scopes.setdefault((id(sf), cls.name), dict(mod_scope))
+            conditions: List[Tuple[str, ast.Call]] = []
+            for node in ast.walk(cls):
+                if not isinstance(node, ast.Assign):
+                    continue
+                kind = self._lock_ctor(node.value)
+                if not kind:
+                    continue
+                for tgt in node.targets:
+                    attr = _self_attr(tgt)
+                    if attr is None:
+                        continue
+                    ref = f"{cls.name}.{attr}"
+                    self.kinds[ref] = kind
+                    scope[f"self.{attr}"] = ref
+                    if kind == "Condition" and node.value.args:
+                        conditions.append((ref, node.value))
+            for ref, call in conditions:
+                try:
+                    under = ast.unparse(call.args[0])
+                except Exception:  # noqa: BLE001
+                    continue
+                if under in scope:
+                    self.aliases[ref] = scope[under]
+        # index functions with their class scope attached
+        stack: List[Tuple[ast.AST, str]] = [(sf.tree, "")]
+        while stack:
+            node, cls_name = stack.pop()
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self.fns.setdefault(child.name, []).append(
+                        (sf, child, cls_name))
+                    stack.append((child, cls_name))
+                elif isinstance(child, ast.ClassDef):
+                    stack.append((child, child.name))
+
+    def resolve(self, sf: SourceFile, cls_name: str,
+                expr: ast.AST) -> Optional[str]:
+        """With-context expression -> lock node (aliases folded), or None
+        for expressions that are not declared locks (`other._lock`, files,
+        monkeypatch contexts, ...)."""
+        try:
+            txt = ast.unparse(expr)
+        except Exception:  # noqa: BLE001
+            return None
+        scope = self.scopes.get((id(sf), cls_name)) or \
+            self.scopes.get((id(sf), ""), {})
+        ref = scope.get(txt)
+        if ref is None:
+            return None
+        return self.aliases.get(ref, ref)
+
+    def _direct_acquires(self, sf: SourceFile, fn: ast.AST,
+                         cls_name: str) -> Set[str]:
+        out: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    ref = self.resolve(sf, cls_name, item.context_expr)
+                    if ref is not None:
+                        out.add(ref)
+        return out
+
+    def _summarize(self) -> Dict[str, Set[str]]:
+        """Bare fn name -> lock nodes it may (transitively) acquire."""
+        direct: Dict[str, Set[str]] = {}
+        calls: Dict[str, Set[str]] = {}
+        for name, defs in self.fns.items():
+            d: Set[str] = set()
+            c: Set[str] = set()
+            for sf, fn, cls_name in defs:
+                d |= self._direct_acquires(sf, fn, cls_name)
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.Call):
+                        chain = _call_chain(node)
+                        if chain and chain[-1] not in _GENERIC_TAILS:
+                            c.add(chain[-1])
+            direct[name], calls[name] = d, c
+        summary = {n: set(d) for n, d in direct.items()}
+        changed = True
+        while changed:
+            changed = False
+            for name, callees in calls.items():
+                for callee in callees:
+                    extra = summary.get(callee)
+                    if extra and not extra <= summary[name]:
+                        summary[name] |= extra
+                        changed = True
+        return summary
+
+
+def _order_findings(files: List[SourceFile]) -> List[Finding]:
+    world = _LockWorld(files)
+    # acquire-while-held edges: (held, acquired) -> first witness
+    edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+    findings: List[Finding] = []
+    flagged: Set[Tuple[str, int, str]] = set()
+
+    def flag(sf: SourceFile, line: int, msg: str) -> None:
+        key = (sf.rel, line, msg)
+        if key in flagged or sf.suppressed(line, NAME):
+            return
+        flagged.add(key)
+        findings.append(Finding(sf.rel, line, NAME, msg))
+
+    def walk(sf: SourceFile, cls_name: str, node: ast.AST,
+             held: List[str]) -> None:
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            acquired: List[str] = []
+            for item in node.items:
+                ref = world.resolve(sf, cls_name, item.context_expr)
+                if ref is None:
+                    continue
+                for h in held:
+                    if h == ref:
+                        if world.kinds.get(ref) != "RLock":
+                            flag(sf, node.lineno,
+                                 f"re-acquire of non-reentrant `{ref}` "
+                                 "while already held: this deadlocks the "
+                                 "acquiring thread (use RLock or drop the "
+                                 "inner acquire)")
+                    else:
+                        edges.setdefault((h, ref),
+                                         (sf.rel, node.lineno,
+                                          f"`with {ref.split('.', 1)[1]}` "
+                                          f"while holding `{h}`"))
+                acquired.append(ref)
+            for child in ast.iter_child_nodes(node):
+                walk(sf, cls_name, child, held + acquired)
+            return
+        if isinstance(node, ast.Call):
+            chain = _call_chain(node)
+            if chain and chain[-1] not in _GENERIC_TAILS and held:
+                for ref in sorted(world.may_acquire.get(chain[-1], ())):
+                    for h in held:
+                        if h == ref:
+                            if world.kinds.get(ref) != "RLock":
+                                flag(sf, node.lineno,
+                                     f"call `{'.'.join(chain)}` acquires "
+                                     f"non-reentrant `{ref}` already held "
+                                     "here: single-thread deadlock")
+                        else:
+                            edges.setdefault(
+                                (h, ref),
+                                (sf.rel, node.lineno,
+                                 f"call `{'.'.join(chain)}` acquires "
+                                 f"`{ref}` while holding `{h}`"))
+        for child in ast.iter_child_nodes(node):
+            walk(sf, cls_name, child, held)
+
+    for name in sorted(world.fns):
+        for sf, fn, cls_name in world.fns[name]:
+            walk(sf, cls_name, fn, [])
+
+    # cycles: DFS over the held->acquired graph; every edge on a cycle is a
+    # finding at its witness site
+    graph: Dict[str, Set[str]] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+
+    def on_cycle(a: str, b: str) -> bool:
+        """Is there a path b ->* a (making edge a->b part of a cycle)?"""
+        seen: Set[str] = set()
+        stack = [b]
+        while stack:
+            n = stack.pop()
+            if n == a:
+                return True
+            if n in seen:
+                continue
+            seen.add(n)
+            stack.extend(graph.get(n, ()))
+        return False
+
+    for (a, b), (rel, line, how) in sorted(edges.items()):
+        if on_cycle(a, b):
+            sf = next(s for s in files if s.rel == rel)
+            flag(sf, line,
+                 f"lock-order cycle: {how}, but the reverse order is also "
+                 f"taken elsewhere (`{b}` -> `{a}` path exists) — two "
+                 "threads can deadlock; fix a global acquisition order or "
+                 "suppress with the invariant that serializes them")
+    return findings
+
+
 def run(files: List[SourceFile], root: str) -> List[Finding]:
     findings: List[Finding] = []
     for sf in files:
@@ -210,4 +461,5 @@ def run(files: List[SourceFile], root: str) -> List[Finding]:
         for node in ast.walk(sf.tree):
             if isinstance(node, ast.ClassDef):
                 findings.extend(_check_class(sf, node))
-    return sorted(findings, key=lambda f: (f.path, f.line))
+    findings.extend(_order_findings(files))
+    return sorted(set(findings), key=lambda f: (f.path, f.line, f.message))

@@ -39,7 +39,9 @@ KNOWN_GROUPS = {
     "dense",      # ZeRO dense-state sharding (MeshTrainer(dense_shard=True))
     "exchange",   # sharded-exchange wire costs + per-shard load/skew gauges
     "fleet",      # /fleetz cross-node scrape health
+    "guard",      # runtime invariant guards (utils/guards.py fingerprints)
     "hot",        # replicated hot-row cache (MeshTrainer(hot_rows=...))
+    "lint",       # oelint's own run health (pass wall times, finding counts)
     "metrics",    # the metrics subsystem's own health (report_errors)
     "offload",    # host-cached table cache admission/flush/staging pipeline
     "persist",    # async/incremental persistence

@@ -1,0 +1,224 @@
+"""sharding pass: one placement registry, one spelling, per logical leaf.
+
+Round 11's runtime guard caught a real production bug: two sites spelled the
+same row-sharded placement differently — `P(axis, None)` in one and `P(axis)`
+in the other. As PLACEMENTS they are identical; as JIT CACHE KEYS they are
+not (`PartitionSpec('data', None) != PartitionSpec('data')`), so the step
+silently recompiled the whole program on step 2. The fix was a one-off; this
+pass generalizes it into a checked invariant over every statically-resolvable
+`PartitionSpec` declaration site in the tree.
+
+Two rules:
+
+- R1 placement-conflict: every keyword binding of a `P(...)` literal to a
+  field of the table-state constructors (`EmbeddingTableState`, `HotRows`,
+  `MigRows`) registers `constructor.field -> canonical spec` in a
+  cross-file placement registry. Two sites binding the same logical leaf to
+  UNEQUAL canonical specs is a finding at every site that disagrees with the
+  registry's reference spelling (first site in path/line order among the
+  most common canonical form). Canonicalization trims trailing `None`s and
+  resolves axis-name spellings (`axis`, `self.axis`, `self.data_axis`,
+  `DATA_AXIS`, the literal `'data'`) to one token, so the rule compares
+  PLACEMENTS, not surface syntax.
+- R2 spelling-drift: any statically-resolvable `P(...)` literal with a
+  TRAILING `None` is flagged on its own, wherever it appears. Trimming is
+  the canonical spelling everywhere in this repo (jit outputs carry the
+  trimmed form), so an untrimmed literal is at best a latent cache-key
+  bug waiting for a comparison — see `MeshTrainer._table_pspec`.
+
+Sites the pass cannot resolve statically (starred dims, computed axis
+tuples, specs built in loops over `range(ndim)`) are skipped, not guessed:
+`SeqMeshTrainer`'s `P(d, *pad, s)` specs stay a human's job. Suppress a
+deliberate disagreement with `# oelint: disable=sharding -- <reason>`.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+from ..core import Finding, SourceFile
+from .trace_hazard import _call_chain
+
+NAME = "sharding"
+DIRS = ("openembedding_tpu",)
+# R1 needs the whole tree even under --changed-only: a conflict pairs a
+# changed site with an unchanged one.
+NEEDS_ALL_FILES = True
+
+# constructors whose PartitionSpec keywords define the placement registry
+STATE_CTORS = ("EmbeddingTableState", "HotRows", "MigRows")
+# spellings that all resolve to the mesh's data axis (mesh.DATA_AXIS)
+_AXIS_TOKEN = "<axis>"
+_AXIS_NAMES = {"axis", "DATA_AXIS"}
+_AXIS_ATTRS = {"self.axis", "self.data_axis"}
+_AXIS_STRINGS = {"data"}
+
+
+class Site(NamedTuple):
+    """One registry entry: a P(...) literal bound to a constructor field."""
+    key: str          # "EmbeddingTableState.weights", "...slots[]", ...
+    canon: Tuple[str, ...]
+    spelled: str      # source spelling, for the message
+    rel: str
+    line: int
+
+
+def _canon_arg(node: ast.AST) -> Optional[str]:
+    """One P(...) positional arg -> canonical token, or None if unresolvable."""
+    if isinstance(node, ast.Constant):
+        if node.value is None:
+            return "None"
+        if isinstance(node.value, str):
+            return _AXIS_TOKEN if node.value in _AXIS_STRINGS \
+                else repr(node.value)
+        return None
+    try:
+        txt = ast.unparse(node)
+    except Exception:  # noqa: BLE001 — unparse failure == unresolvable
+        return None
+    if txt in _AXIS_NAMES or txt in _AXIS_ATTRS:
+        return _AXIS_TOKEN
+    return None
+
+
+def canonicalize(call: ast.Call) -> Optional[Tuple[Tuple[str, ...], int]]:
+    """(canonical dim tuple, trailing-None count) for a P(...) literal;
+    None when any dim is statically unresolvable (starred/computed)."""
+    if call.keywords:
+        return None
+    parts: List[str] = []
+    for a in call.args:
+        if isinstance(a, ast.Starred):
+            return None
+        c = _canon_arg(a)
+        if c is None:
+            return None
+        parts.append(c)
+    n = len(parts)
+    while parts and parts[-1] == "None":
+        parts.pop()
+    return tuple(parts), n - len(parts)
+
+
+def _is_pspec_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    chain = _call_chain(node)
+    return chain is not None and chain[-1] in ("P", "PartitionSpec")
+
+
+def _spec_values(kw_value: ast.AST):
+    """P(...) literals inside a constructor keyword value, with a key suffix:
+    direct call / either ternary arm -> ""; dict or dict-comp values -> "[]"
+    (slot specs are per-slot-name but share one placement by protocol)."""
+    if _is_pspec_call(kw_value):
+        yield "", kw_value
+    elif isinstance(kw_value, ast.IfExp):
+        for arm in (kw_value.body, kw_value.orelse):
+            if _is_pspec_call(arm):
+                yield "", arm
+    elif isinstance(kw_value, ast.Dict):
+        for v in kw_value.values:
+            if _is_pspec_call(v):
+                yield "[]", v
+    elif isinstance(kw_value, ast.DictComp):
+        if _is_pspec_call(kw_value.value):
+            yield "[]", kw_value.value
+
+
+def build_registry(files: List[SourceFile]) -> List[Site]:
+    """The cross-file placement registry: every statically-resolvable
+    P(...) keyword binding on the table-state constructors."""
+    sites: List[Site] = []
+    for sf in files:
+        if sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = _call_chain(node)
+            if chain is None or chain[-1] not in STATE_CTORS:
+                continue
+            ctor = chain[-1]
+            for kw in node.keywords:
+                if kw.arg is None:
+                    continue
+                for suffix, call in _spec_values(kw.value):
+                    canon = canonicalize(call)
+                    if canon is None:
+                        continue
+                    try:
+                        spelled = ast.unparse(call)
+                    except Exception:  # noqa: BLE001
+                        spelled = "P(...)"
+                    sites.append(Site(f"{ctor}.{kw.arg}{suffix}", canon[0],
+                                      spelled, sf.rel, call.lineno))
+    return sorted(sites, key=lambda s: (s.key, s.rel, s.line))
+
+
+def _conflicts(sites: List[Site]) -> List[Tuple[Site, Site]]:
+    """(disagreeing site, reference site) pairs across the registry."""
+    by_key: Dict[str, List[Site]] = {}
+    for s in sites:
+        by_key.setdefault(s.key, []).append(s)
+    out: List[Tuple[Site, Site]] = []
+    for key in sorted(by_key):
+        group = by_key[key]
+        canons = {s.canon for s in group}
+        if len(canons) <= 1:
+            continue
+        # reference = the most common canonical form; ties break to the
+        # first site in (path, line) order so the report is deterministic
+        counts: Dict[Tuple[str, ...], int] = {}
+        for s in group:
+            counts[s.canon] = counts.get(s.canon, 0) + 1
+        ordered = sorted(group, key=lambda s: (s.rel, s.line))
+        ref = max(ordered, key=lambda s: (counts[s.canon],
+                                          -ordered.index(s)))
+        for s in ordered:
+            if s.canon != ref.canon:
+                out.append((s, ref))
+    return out
+
+
+def run(files: List[SourceFile], root: str) -> List[Finding]:
+    findings: List[Finding] = []
+    by_rel = {sf.rel: sf for sf in files}
+
+    # R1: placement registry conflicts
+    for site, ref in _conflicts(build_registry(files)):
+        sf = by_rel.get(site.rel)
+        if sf is not None and sf.suppressed(site.line, NAME):
+            continue
+        findings.append(Finding(
+            site.rel, site.line, NAME,
+            f"`{site.key}` bound to `{site.spelled}` here but to "
+            f"`{ref.spelled}` at {ref.rel}:{ref.line} — every placement "
+            "site for a logical leaf must agree (unequal PartitionSpecs "
+            "are unequal jit cache keys: the step silently recompiles)"))
+
+    # R2: trailing-None spelling drift
+    for sf in files:
+        if sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if not _is_pspec_call(node):
+                continue
+            canon = canonicalize(node)
+            if canon is None or canon[1] == 0:
+                continue
+            if sf.suppressed(node.lineno, NAME):
+                continue
+            try:
+                spelled = ast.unparse(node)
+            except Exception:  # noqa: BLE001
+                spelled = "P(..., None)"
+            findings.append(Finding(
+                sf.rel, node.lineno, NAME,
+                f"untrimmed PartitionSpec spelling `{spelled}`: trailing "
+                "`None`s are placement-identical but cache-key-UNEQUAL to "
+                "the trimmed form jit outputs carry — spell it trimmed "
+                "(see MeshTrainer._table_pspec)"))
+
+    return sorted(set(findings), key=lambda f: (f.path, f.line, f.message))

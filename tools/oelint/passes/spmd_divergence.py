@@ -1,0 +1,343 @@
+"""spmd-divergence pass: per-process host control flow upstream of collectives.
+
+The SPMD contract (GSPMD, arXiv:2105.04663): every process executes the SAME
+collective sequence in the SAME order, or the mesh hangs — there is no
+timeout, no error, just 256 chips waiting on a rendezvous one process never
+reaches. The compiler enforces nothing on the HOST side of that contract:
+Python is free to branch on `jax.process_index()`, wall clock, per-shard
+device values, or to iterate an unordered set while issuing collectives, and
+all four compile fine and hang in production.
+
+What the pass flags, per function (whole tree, not just jit roots — the
+hazard lives in HOST orchestration code like persisters and sync loops):
+
+- a collective call lexically inside an `if`/`while` whose test is
+  PER-PROCESS DIVERGENT: derived from `process_index`/`host_id`, wall clock
+  (`time.time/monotonic/perf_counter`), entropy (`os.urandom`, `uuid*`,
+  `random.*`), or per-shard device views (`.addressable_shards`,
+  `addressable_data`). `process_count` is uniform across processes and is
+  NOT divergent.
+- a collective call AFTER a divergent branch that can return/raise/break —
+  the early-exit form of the same hang (process 0 reaches the collective,
+  process 1 already returned).
+- a collective call inside `for ... in <set>`: unordered iteration feeding a
+  collective sequence means two processes can issue the same collectives in
+  different orders (deadlock, or silently exchanged payloads).
+
+Divergence propagates through local assignments in source order and through
+function RETURN VALUES: a function whose return is divergent-tainted (or
+sits under a divergent branch) marks its callers' tests divergent — that is
+how `policy.should_persist(step)` (wall-clock inside) taints the persist
+branch that guards `allgather_host_ids`. Collective reachability likewise
+propagates through simple-name calls (same call-graph discipline as
+trace-hazard).
+
+Deliberate, defended cases carry reasoned suppressions
+(`# oelint: disable=spmd-divergence -- <why this cannot diverge>`); the
+canonical example is a wall-clock policy whose constructor already rejects
+multi-process use.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from ..core import Finding, SourceFile
+from .trace_hazard import (_GENERIC_TAILS, _call_chain, _index_functions,
+                           _is_set_expr)
+
+NAME = "spmd-divergence"
+DIRS = ("openembedding_tpu",)
+# call-graph + return-taint summaries span files: a changed caller can pick
+# up divergence from an unchanged callee and vice versa
+NEEDS_ALL_FILES = True
+
+# call tails that ARE collectives (jax.lax + multihost wrappers): issuing one
+# is a cross-process rendezvous
+COLLECTIVE_TAILS = {
+    "psum", "pmean", "pmax", "pmin", "ppermute", "pbroadcast",
+    "all_to_all", "all_gather", "all_gather_invariant", "reduce_scatter",
+    "psum_scatter",
+    "process_allgather", "broadcast_one_to_all", "sync_global_devices",
+    "allgather_host_ids", "global_batch",
+    "make_array_from_process_local_data",
+}
+
+# call tails whose VALUE differs per process
+_DIVERGENT_TAILS = {"process_index", "getpid", "gethostname", "urandom",
+                    "uuid1", "uuid4"}
+# time.<tail>() reads the wall clock (value-returning; time.sleep has no
+# value and is uniform-enough to ignore here)
+_WALL_CLOCK_TAILS = {"time", "monotonic", "perf_counter", "time_ns",
+                     "monotonic_ns", "perf_counter_ns"}
+# attribute reads that expose a per-process device view
+_DIVERGENT_ATTRS = {"addressable_shards", "addressable_data",
+                    "addressable_devices", "local_devices"}
+
+
+def _is_divergent_call(call: ast.Call, div_fns: Set[str]) -> bool:
+    chain = _call_chain(call)
+    if chain is None:
+        return False
+    tail = chain[-1]
+    if tail in _DIVERGENT_TAILS:
+        return True
+    if chain[0] == "time" and tail in _WALL_CLOCK_TAILS:
+        return True
+    if chain[0] == "random" and len(chain) == 2:
+        return True
+    if tail in _DIVERGENT_ATTRS:
+        return True
+    return tail not in _GENERIC_TAILS and tail in div_fns
+
+
+def _is_collective_call(call: ast.Call, coll_fns: Set[str]) -> bool:
+    chain = _call_chain(call)
+    if chain is None:
+        return False
+    tail = chain[-1]
+    if tail in COLLECTIVE_TAILS:
+        return True
+    return tail not in _GENERIC_TAILS and tail in coll_fns
+
+
+def _summarize(index: Dict[str, List], name_filter=None):
+    """Fixpoint over bare function names -> (collective-reaching set,
+    divergent-returning set).
+
+    collective-reaching: calls a collective tail directly or calls a
+    collective-reaching name. divergent-returning: some return expression is
+    divergence-tainted, or a return sits under a divergent test — computed
+    with the same local walk the checker uses, iterated to fixpoint so
+    wrappers (`host_id() -> jax.process_index()`) propagate.
+    """
+    coll_fns: Set[str] = set()
+    div_fns: Set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for fname, infos in index.items():
+            for fi in infos:
+                if fname not in coll_fns and \
+                        _reaches_collective(fi.node, coll_fns):
+                    coll_fns.add(fname)
+                    changed = True
+                if fname not in div_fns and \
+                        _returns_divergent(fi.node, div_fns):
+                    div_fns.add(fname)
+                    changed = True
+    return coll_fns, div_fns
+
+
+def _reaches_collective(fn: ast.AST, coll_fns: Set[str]) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and _is_collective_call(node, coll_fns):
+            return True
+    return False
+
+
+class _Walk:
+    """One function's source-order divergence-taint walk. Shared by the
+    summary computation (does any return diverge?) and the finding checker
+    (is a collective guarded by / sequenced after a divergent decision?)."""
+
+    def __init__(self, fn: ast.AST, div_fns: Set[str]):
+        self.fn = fn
+        self.div_fns = div_fns
+        self.tainted: Set[str] = set()
+
+    def expr_divergent(self, node: Optional[ast.AST]) -> bool:
+        if node is None:
+            return False
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name) and sub.id in self.tainted:
+                return True
+            if isinstance(sub, ast.Call) and \
+                    _is_divergent_call(sub, self.div_fns):
+                return True
+            if isinstance(sub, ast.Attribute) and \
+                    sub.attr in _DIVERGENT_ATTRS:
+                return True
+        return False
+
+    def assign(self, target: ast.AST, divergent: bool) -> None:
+        if isinstance(target, ast.Name):
+            (self.tainted.add if divergent
+             else self.tainted.discard)(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self.assign(elt, divergent)
+
+    def process_assign(self, stmt: ast.stmt) -> None:
+        value = getattr(stmt, "value", None)
+        if value is None:
+            return
+        targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                   else [stmt.target])
+        # elementwise for tuple-to-tuple: `pidx, pcount = process_index(),
+        # process_count()` must taint only pidx
+        for tgt in targets:
+            if isinstance(tgt, (ast.Tuple, ast.List)) and \
+                    isinstance(value, ast.Tuple) and \
+                    len(tgt.elts) == len(value.elts):
+                for t, v in zip(tgt.elts, value.elts):
+                    self.assign(t, self.expr_divergent(v))
+            else:
+                self.assign(tgt, self.expr_divergent(value))
+
+
+def _returns_divergent(fn: ast.AST, div_fns: Set[str]) -> bool:
+    walk = _Walk(fn, div_fns)
+    divergent_depth = 0
+
+    def scan(body) -> bool:
+        nonlocal divergent_depth
+        for stmt in body:
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                walk.process_assign(stmt)
+            elif isinstance(stmt, ast.Return):
+                if divergent_depth or walk.expr_divergent(stmt.value):
+                    return True
+            elif isinstance(stmt, (ast.If, ast.While)):
+                div = walk.expr_divergent(stmt.test)
+                divergent_depth += bool(div)
+                hit = scan(stmt.body) or scan(stmt.orelse)
+                divergent_depth -= bool(div)
+                if hit:
+                    return True
+            elif isinstance(stmt, ast.For):
+                if scan(stmt.body) or scan(stmt.orelse):
+                    return True
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                if scan(stmt.body):
+                    return True
+            elif isinstance(stmt, ast.Try):
+                if scan(stmt.body) or scan(stmt.orelse) or \
+                        scan(stmt.finalbody) or \
+                        any(scan(h.body) for h in stmt.handlers):
+                    return True
+        return False
+
+    return scan(fn.body)
+
+
+def _can_exit(body: List[ast.stmt]) -> bool:
+    """Does this branch body contain an early exit (return/raise/break)?"""
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, (ast.Return, ast.Raise, ast.Break)):
+                return True
+    return False
+
+
+class _Checker:
+    def __init__(self, sf: SourceFile, fn: ast.AST, qualname: str,
+                 coll_fns: Set[str], div_fns: Set[str]):
+        self.sf = sf
+        self.qualname = qualname
+        self.fn = fn
+        self.coll = coll_fns
+        self.walk = _Walk(fn, div_fns)
+        self.findings: List[Finding] = []
+        self._flagged: Set[int] = set()
+
+    def _flag(self, node: ast.AST, message: str) -> None:
+        if node.lineno in self._flagged or \
+                self.sf.suppressed(node.lineno, NAME):
+            return
+        self._flagged.add(node.lineno)
+        self.findings.append(Finding(
+            self.sf.rel, node.lineno, NAME,
+            f"{message} (in `{self.qualname}`) — if any process skips or "
+            "reorders a collective the mesh hangs; make the decision "
+            "uniform (broadcast_one_to_all / step-driven) or hoist the "
+            "collective out"))
+
+    def _collectives_in(self, body: List[ast.stmt]) -> List[ast.Call]:
+        out = []
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Call) and \
+                        _is_collective_call(node, self.coll):
+                    out.append(node)
+        return out
+
+    def run(self) -> List[Finding]:
+        self._scan(self.fn.body, exited_divergent=False)
+        return self.findings
+
+    def _scan(self, body: List[ast.stmt], exited_divergent: bool) -> bool:
+        """Walks one body; returns True if a divergent early-exit was seen
+        (callers use it to flag LATER collectives at their level too)."""
+        for stmt in body:
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                self.walk.process_assign(stmt)
+                if exited_divergent:
+                    for c in self._collectives_in([stmt]):
+                        self._flag(c, self._after_msg(c))
+            elif isinstance(stmt, (ast.If, ast.While)):
+                div = self.walk.expr_divergent(stmt.test)
+                if div:
+                    for c in self._collectives_in(stmt.body) + \
+                            self._collectives_in(stmt.orelse):
+                        self._flag(c, self._under_msg(c, stmt))
+                    if isinstance(stmt, ast.If) and (
+                            _can_exit(stmt.body) or _can_exit(stmt.orelse)):
+                        exited_divergent = True
+                else:
+                    if self._scan(stmt.body, exited_divergent):
+                        exited_divergent = True
+                    if self._scan(stmt.orelse, exited_divergent):
+                        exited_divergent = True
+            elif isinstance(stmt, ast.For):
+                if _is_set_expr(stmt.iter):
+                    for c in self._collectives_in(stmt.body):
+                        self._flag(
+                            c, "collective issued while iterating an "
+                            "unordered set: two processes can emit the "
+                            "same collectives in different orders")
+                if self._scan(stmt.body, exited_divergent) or \
+                        self._scan(stmt.orelse, exited_divergent):
+                    exited_divergent = True
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                if self._scan(stmt.body, exited_divergent):
+                    exited_divergent = True
+            elif isinstance(stmt, ast.Try):
+                for sub in ([stmt.body, stmt.orelse, stmt.finalbody] +
+                            [h.body for h in stmt.handlers]):
+                    if self._scan(sub, exited_divergent):
+                        exited_divergent = True
+            else:
+                if exited_divergent:
+                    for c in self._collectives_in([stmt]):
+                        self._flag(c, self._after_msg(c))
+        return exited_divergent
+
+    @staticmethod
+    def _name(call: ast.Call) -> str:
+        chain = _call_chain(call)
+        return ".".join(chain) if chain else "<collective>"
+
+    def _under_msg(self, call: ast.Call, branch: ast.stmt) -> str:
+        return (f"collective `{self._name(call)}` under a per-process-"
+                f"divergent `{type(branch).__name__.lower()}` (test at "
+                f"line {branch.lineno} derives from process_index/wall "
+                "clock/per-shard state)")
+
+    def _after_msg(self, call: ast.Call) -> str:
+        return (f"collective `{self._name(call)}` sequenced after a "
+                "divergent branch that can return/raise early: processes "
+                "taking the exit never reach this rendezvous")
+
+
+def run(files: List[SourceFile], root: str) -> List[Finding]:
+    index = _index_functions(files)
+    coll_fns, div_fns = _summarize(index)
+    findings: List[Finding] = []
+    for fname in sorted(index):
+        for fi in index[fname]:
+            findings.extend(
+                _Checker(fi.sf, fi.node, fi.qualname, coll_fns,
+                         div_fns).run())
+    return sorted(set(findings), key=lambda f: (f.path, f.line, f.message))

@@ -65,10 +65,16 @@ def run(*, steps=24, persist_every=2, interval_s=0.05, workdir="/tmp/oetpu_sync_
     state = trainer.init(batches[0])
     # the soak's paced trainer must never re-jit across the run: identical
     # batch shapes -> one compiled program, asserted at every step
-    # (utils/guards — the executable half of the never-re-jit rule)
-    from openembedding_tpu.utils.guards import assert_no_recompile
-    step_fn = assert_no_recompile(trainer.jit_train_step(),
-                                  label="soak_train_step")
+    # (utils/guards — the executable half of the never-re-jit rule), and
+    # the traced collective SEQUENCE is pinned at start and re-asserted at
+    # the end (the SPMD-contract half: no refresh/sync path may change
+    # which collectives run, in what order)
+    from openembedding_tpu.utils.guards import (assert_collective_fingerprint,
+                                                assert_no_recompile,
+                                                collective_fingerprint)
+    raw_step = trainer.jit_train_step()
+    step_fn = assert_no_recompile(raw_step, label="soak_train_step")
+    collective_pin = collective_fingerprint(raw_step, state, batches[0])
 
     persister = IncrementalPersister(
         trainer, model, root, window=2,
@@ -163,7 +169,13 @@ def run(*, steps=24, persist_every=2, interval_s=0.05, workdir="/tmp/oetpu_sync_
         pub_srv.shutdown()
         srv.shutdown()
 
+    # the collective program must be exactly what we pinned before the run
+    # (same shapes, same axes, same order) — raises CollectiveMismatchError
+    assert_collective_fingerprint(raw_step, collective_pin, state,
+                                  batches[0], label="soak_train_step")
+
     report = {
+        "collective_fingerprint": collective_pin,
         "steps": trained["step"],
         "persist_every": persist_every,
         "wire": wire,
