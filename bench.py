@@ -48,7 +48,7 @@ throughput, not host dispatch latency — the same way production input pipeline
 drive TPUs (the axon tunnel adds ~40 ms per dispatch that would otherwise swamp
 the measurement; see PERF.md "Measurement hygiene").
 
-Env knobs: OETPU_BENCH_CASES=dim9[,dim64][,mesh1][,mesh1f][,pull][,wire][,wire_inband][,sync][,skew][,hot][,placement][,zero][,offload_pipe] (default: all),
+Env knobs: OETPU_BENCH_CASES=dim9[,dim64][,mesh1][,mesh1f][,pull][,wire][,wire_inband][,sync][,skew][,hot][,placement][,zero][,offload_pipe][,health] (default: all),
 OETPU_BENCH_BUDGET_S (default 540), OETPU_BENCH_SCAN_STEPS / _REPEATS (smoke runs),
 OETPU_BENCH_TOTAL_BUDGET_S / _PROBE_TIMEOUT_S / _PROBE_INTERVAL_S (orchestrator).
 """
@@ -518,6 +518,54 @@ def case_skew():
     out["total_overhead_pct"] = round(
         out["stats_overhead_pct"] + out["sketch_pct_of_step"], 2)
     return out
+
+
+def case_health():
+    """Numerics-sentinel + measured-step-timing overhead (round 16): the
+    PER-STEP train loop — jit_train_step + record_step_stats each step, the
+    examples' convention — with the in-jit health sentinel and the sampled
+    step-time watch ON (sentinel=True, measure_every=8) vs OFF, dim9
+    single-chip workload. The sentinel's stat reductions ride the step's
+    existing stats dict, so the acceptance bound is overhead <= 2%."""
+    import openembedding_tpu as embed
+    from openembedding_tpu.model import Trainer
+    from openembedding_tpu.models import make_deepfm
+
+    WD.stage("health:init", 240)
+    batches, _ = _stacked_batches(9, SCAN_STEPS)
+    eps = {}
+    for flag in (True, False):
+        tag = "on" if flag else "off"
+        model = make_deepfm(vocabulary=VOCAB, dim=9)
+        trainer = Trainer(model, embed.Adagrad(learning_rate=0.05),
+                          sentinel=flag, measure_every=8 if flag else 0)
+        state = trainer.init(batches[0])
+        step = trainer.jit_train_step()
+        WD.stage(f"health:{tag}:compile", 420)
+        state, mets = step(state, batches[0])
+        health = trainer.record_step_stats(mets)
+        assert not health.get("nonfinite"), health
+        WD.stage(f"health:{tag}:measure", 240)
+        best = None
+        for _ in range(REPEATS):
+            t0 = time.perf_counter()
+            for b in batches:
+                state, mets = step(state, b)
+                trainer.record_step_stats(mets)
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        eps[flag] = BATCH * len(batches) / best
+    from openembedding_tpu.utils import metrics as M
+    with M._LOCK:
+        acc = M._REGISTRY.get("trainer.step_ms")
+    return {
+        "sentinel_on_examples_per_sec": round(eps[True], 1),
+        "sentinel_off_examples_per_sec": round(eps[False], 1),
+        # positive = the sentinel + step watch cost throughput
+        "sentinel_overhead_pct": round((eps[False] / eps[True] - 1.0) * 100,
+                                       2),
+        "step_ms_samples": int(acc.hist_snapshot()[2]) if acc else 0,
+    }
 
 
 def case_hot():
@@ -1003,7 +1051,7 @@ def main():
     cases = os.environ.get(
         "OETPU_BENCH_CASES",
         "dim9,dim64,mesh1,mesh1f,pull,wire,wire_inband,sync,skew,hot,"
-        "placement,zero,offload_pipe").split(",")
+        "placement,zero,offload_pipe,health").split(",")
 
     # PRIMARY first: whatever happens later, this number is in the artifact.
     if "dim9" in cases:
@@ -1024,7 +1072,8 @@ def main():
                  ("hot", case_hot),
                  ("placement", case_placement),
                  ("zero", case_zero),
-                 ("offload_pipe", case_offload_pipe)]
+                 ("offload_pipe", case_offload_pipe),
+                 ("health", case_health)]
     for name, fn in secondary:
         if name not in cases:
             continue

@@ -91,6 +91,9 @@ def main():
     ap.add_argument("--load", default="")
     ap.add_argument("--export", default="", help="standalone serving export dir")
     ap.add_argument("--report-interval", type=float, default=0.0)
+    ap.add_argument("--metrics-log", default="", metavar="PATH",
+                    help="append each periodic report (and a final snapshot "
+                         "at exit) as a timestamped JSONL record to PATH")
     ap.add_argument("--profile", default="", metavar="DIR",
                     help="capture a jax.profiler trace of the train loop "
                          "into DIR (view with xprof/tensorboard)")
@@ -180,7 +183,8 @@ def main():
             trainer, model, args.persist,
             policy=embed.PersistPolicy(every_steps=args.persist_steps))
 
-    reporter = M.PeriodicReporter(args.report_interval).start()
+    reporter = M.PeriodicReporter(args.report_interval,
+                                  jsonl_path=args.metrics_log or None).start()
     all_labels, all_scores = [], []
 
     def report_overflow():

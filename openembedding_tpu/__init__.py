@@ -57,6 +57,7 @@ from .optimizers import (
 from .embedding import Embedding, EmbeddingTableState, EmbeddingSpec
 from .variable import EmbeddingVariable
 from .model import EmbeddingModel, Trainer, TrainState
+from .utils.metrics import NonFiniteError
 from . import checkpoint
 from .checkpoint import save_server_model, load_server_model
 from . import persist

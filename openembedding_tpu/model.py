@@ -196,10 +196,27 @@ class Trainer:
 
     def __init__(self, model: EmbeddingModel,
                  optimizer: Optional[SparseOptimizer] = None, seed: int = 0,
-                 *, offload_pipeline: bool = False, offload_densify: int = 1):
+                 *, offload_pipeline: bool = False, offload_densify: int = 1,
+                 sentinel: bool = False, halt_on_nonfinite: bool = False,
+                 measure_every: int = 0):
         self.model = model
         self.optimizer = optimizer or Adagrad()
         self.seed = seed
+        # numerics sentinel: adds additive health stats to the step's stats
+        # dict (per-table grad sumsq / non-finite counts, loss finiteness, ef
+        # residual magnitude, int8/bf16 quantization error), folded into
+        # `health.*` gauges by `metrics.record_step_stats`. A static Python
+        # bool, so sentinel=False traces byte-identical HLO to before.
+        # halt_on_nonfinite implies sentinel and makes
+        # `Trainer.record_step_stats` raise NonFiniteError naming the
+        # offending table/phase.
+        self.halt_on_nonfinite = bool(halt_on_nonfinite)
+        self.sentinel = bool(sentinel) or self.halt_on_nonfinite
+        # sampled measured step timing (utils/stepwatch.StepWatch): sample one
+        # call in N with a block_until_ready bracket into `trainer.step_ms`
+        # plus HLO-byte attribution and `exchange.cost_drift`; 0 = off
+        self.measure_every = int(measure_every)
+        self._stepwatch = None
         # host_cached pipeline knobs (tables/host_offload.py): pipeline=True
         # double-buffers the next batch's host lookup + admit upload on a
         # background thread (drive it via `offload_stage`); densify K>1
@@ -611,6 +628,11 @@ class Trainer:
                 jax.value_and_grad(loss_fn, argnums=(0, 1), has_aux=True)(
                     tr0, pulled)
 
+            # sentinel reads the PRE-reduction dense grads: per-shard local
+            # sumsq psums (via reduce_metrics) to one well-defined global
+            # quantity in both the allreduce and the ZeRO (unreduced-here)
+            # paths
+            raw_dense_grads = dense_grads if self.sentinel else None
             dense_grads = self.reduce_dense_grads(dense_grads)
 
         with _trace.span("trainer", "apply"):
@@ -631,6 +653,9 @@ class Trainer:
                 ps_specs, pulled_tables, batch, row_grads, packed, pull_plans)
             new_tables.update(applied)
             stats.update(push_stats)
+            if self.sentinel:
+                stats.update(self._sentinel_stats(
+                    loss, raw_dense_grads, row_grads, new_tables))
 
         new_state = TrainState(
             step=state.step + 1,
@@ -698,6 +723,88 @@ class Trainer:
     def reduce_metrics(self, metrics):
         return metrics
 
+    # oelint: hot-path device_get=0 (pure traced math appended to the step's
+    # stats dict — the ONE host sync still happens in record_step_stats)
+    def _sentinel_stats(self, loss, dense_grads, row_grads,
+                        tables) -> Dict[str, jax.Array]:
+        """Numerics-sentinel stats for this shard, every value ADDITIVE so
+        `MeshTrainer.reduce_metrics`'s per-key psum yields the global figure:
+        sumsq (host takes sqrt after the psum), non-finite element counts, ef
+        abs-sum + element counts, and the wire-quantization error sumsq
+        (fp32-vs-roundtrip through `ops.wire.pack_inband`, skipped when the
+        exchange ships fp32 or there is no exchange at all)."""
+        f32 = jnp.float32
+        out: Dict[str, jax.Array] = {}
+        loss_arr = jnp.asarray(loss, f32)
+        out["health/loss_nonfinite"] = jnp.sum(
+            ~jnp.isfinite(loss_arr)).astype(f32)
+        sumsq = jnp.zeros((), f32)
+        nonfin = jnp.zeros((), f32)
+        for leaf in jax.tree_util.tree_leaves(dense_grads):
+            g = jnp.asarray(leaf, f32)
+            sumsq = sumsq + jnp.sum(jnp.square(g))
+            nonfin = nonfin + jnp.sum(~jnp.isfinite(g)).astype(f32)
+        out["health/dense_grad_sumsq"] = sumsq
+        out["health/dense_grad_nonfinite"] = nonfin
+        fmt = None
+        if self.num_shards > 1:
+            from .ops.wire import wire_format
+            fmt = wire_format(getattr(self, "wire", None))
+            if fmt == "fp32":
+                fmt = None
+        for name, g in (row_grads or {}).items():
+            g = jnp.asarray(g, f32)
+            out[f"{name}/grad_sumsq"] = jnp.sum(jnp.square(g))
+            out[f"{name}/grad_nonfinite"] = jnp.sum(
+                ~jnp.isfinite(g)).astype(f32)
+            if fmt is not None and g.ndim >= 2 and g.shape[-1] > 0:
+                from .ops.wire import pack_inband, unpack_inband
+                rows = g.reshape(-1, g.shape[-1])
+                back = unpack_inband(pack_inband(rows, fmt),
+                                     rows.shape[-1], fmt)
+                out[f"{name}/quant_err_sumsq"] = jnp.sum(
+                    jnp.square(back - rows))
+        for name, ts in tables.items():
+            ef = getattr(ts, "ef", None)
+            if ef is None:
+                continue
+            out[f"{name}/ef_abs_sum"] = jnp.sum(jnp.abs(jnp.asarray(ef, f32)))
+            # a trace-time constant, shipped as a stat so the host-side mean
+            # divides by the GLOBAL (psum'd) element count
+            out[f"{name}/ef_elems"] = jnp.asarray(float(ef.size), f32)
+        return out
+
+    def record_step_stats(self, step_metrics):
+        """Fold one step's metrics through the spine
+        (`metrics.record_step_stats` — the single allowed per-step
+        device_get) and, with `halt_on_nonfinite=True`, raise
+        `metrics.NonFiniteError` naming the offending table/phase when the
+        sentinel saw a non-finite loss or gradient. Returns the health
+        summary dict."""
+        from .utils import metrics as _metrics
+        stats = step_metrics
+        if isinstance(step_metrics, dict) and "stats" in step_metrics:
+            stats = step_metrics["stats"]
+        health = _metrics.record_step_stats(stats)
+        if self.halt_on_nonfinite and health.get("nonfinite"):
+            raise _metrics.NonFiniteError(health["nonfinite"])
+        return health
+
+    def _wrap_measured(self, fn):
+        """Wrap a jitted step with the sampled measurement mode
+        (`measure_every` > 0): one call in N is bracketed host-side with
+        `block_until_ready` into `trainer.step_ms` + HLO-byte attribution +
+        `exchange.cost_drift`. The watch is cached so repeated
+        `jit_train_step()` calls share one sample counter/baseline."""
+        if self.measure_every <= 0:
+            return fn
+        if self._stepwatch is None:
+            from .utils.stepwatch import StepWatch
+            self._stepwatch = StepWatch(
+                every=self.measure_every,
+                wire_cost=lambda: getattr(self, "last_wire_cost", None))
+        return self._stepwatch.wrap(fn)
+
     def table_pull(self, spec, table, ids):
         """-> (new_table, rows, stats, plan). The plan (routing/dedup state) is handed
         back to table_apply so push reuses pull's work; None on single device."""
@@ -737,7 +844,8 @@ class Trainer:
         """NOTE: the input TrainState is DONATED (huge tables must update in place,
         not 2x HBM) — always rebind: `state, metrics = step(state, batch)`; a stale
         `state` reference is dead after the call."""
-        return jax.jit(self.train_step, donate_argnums=(0,))
+        return self._wrap_measured(jax.jit(self.train_step,
+                                           donate_argnums=(0,)))
 
     def _packed_layouts(self, state: TrainState):
         """{name: column layout} for tables worth packing inside the scan

@@ -48,10 +48,16 @@ class MeshTrainer(Trainer):
                  error_feedback: Optional[bool] = None,
                  dense_shard: bool = False,
                  offload_pipeline: bool = False,
-                 offload_densify: int = 1):
+                 offload_densify: int = 1,
+                 sentinel: bool = False,
+                 halt_on_nonfinite: bool = False,
+                 measure_every: int = 0):
         super().__init__(model, optimizer, seed,
                          offload_pipeline=offload_pipeline,
-                         offload_densify=offload_densify)
+                         offload_densify=offload_densify,
+                         sentinel=sentinel,
+                         halt_on_nonfinite=halt_on_nonfinite,
+                         measure_every=measure_every)
         self.mesh = mesh if mesh is not None else make_mesh()
         self.axis = self.mesh.axis_names[0]
         self.num_shards = self.mesh.devices.size  # overrides Trainer.num_shards
@@ -1066,7 +1072,7 @@ class MeshTrainer(Trainer):
         """Builds the shard_map'ped step. Needs a sample batch/state on first call to
         derive the pytree partition specs."""
         if self._train_step_fn is not None:
-            return self._train_step_fn
+            return self._wrap_measured(self._train_step_fn)
         if sample_batch is None or sample_state is None:
             raise ValueError("first call needs (sample_batch, sample_state)")
         state_spec = self._state_pspec_tree(sample_state)
@@ -1081,7 +1087,7 @@ class MeshTrainer(Trainer):
             check_vma=False,
         )
         self._train_step_fn = jax.jit(stepped, donate_argnums=(0,))
-        return self._train_step_fn
+        return self._wrap_measured(self._train_step_fn)
 
     def jit_train_many(self, sample_batches=None, sample_state=None):
         """Scan-fused K-step driver under shard_map (see Trainer.train_many):
@@ -1156,7 +1162,10 @@ class SeqMeshTrainer(MeshTrainer):
                  hot_rows: "int | Dict[str, int]" = 0,
                  mig_rows: "int | Dict[str, int]" = 0,
                  hot_wire: Optional[str] = None,
-                 error_feedback: Optional[bool] = None):
+                 error_feedback: Optional[bool] = None,
+                 sentinel: bool = False,
+                 halt_on_nonfinite: bool = False,
+                 measure_every: int = 0):
         if len(mesh.axis_names) != 2:
             raise ValueError(
                 f"SeqMeshTrainer needs a 2-D (data, seq) mesh, got axes "
@@ -1166,7 +1175,10 @@ class SeqMeshTrainer(MeshTrainer):
                          group_exchange=group_exchange,
                          shard_stats=shard_stats, hot_rows=hot_rows,
                          mig_rows=mig_rows, hot_wire=hot_wire,
-                         error_feedback=error_feedback)
+                         error_feedback=error_feedback,
+                         sentinel=sentinel,
+                         halt_on_nonfinite=halt_on_nonfinite,
+                         measure_every=measure_every)
         self.data_axis, self.seq_axis = mesh.axis_names
         # collectives (sparse exchange, psum, metrics) span the flattened mesh
         self.axis = tuple(mesh.axis_names)

@@ -437,6 +437,8 @@ class ServingHandler(BaseHTTPRequestHandler):
             return "statusz", None, None
         if path == "/tracez":
             return "tracez", None, None
+        if path == "/sloz":
+            return "sloz", None, None
         return None, None, None
 
     # -- verbs --------------------------------------------------------------
@@ -519,6 +521,14 @@ class ServingHandler(BaseHTTPRequestHandler):
             lines.append(render_status())
         except Exception as e:  # noqa: BLE001 — statusz must render regardless
             lines.append(f"(placement status unavailable: {e})")
+        lines.append("")
+        lines.append("-- SLOs (GET /sloz for JSON) --")
+        try:
+            from .utils import slo
+            slo.EVALUATOR.evaluate_now()
+            lines.append(slo.EVALUATOR.render_text())
+        except Exception as e:  # noqa: BLE001 — statusz must render regardless
+            lines.append(f"(slo status unavailable: {e})")
         lines.append("")
         n = int(self.query.get("n", 40)) if hasattr(self, "query") else 40
         lines.append(f"-- flight recorder (last {n}) --")
@@ -668,6 +678,16 @@ class ServingHandler(BaseHTTPRequestHandler):
                     "spans": [s.as_dict() for s in trace.RECORDER.spans(n)],
                     "events": [e.as_dict()
                                for e in trace.RECORDER.events(n)]})
+            if kind == "sloz":
+                # evaluate on demand (the background thread is optional):
+                # every scrape judges the freshest accumulator state
+                from .utils import slo
+                verdicts = slo.EVALUATOR.evaluate_now()
+                if self.query.get("format") == "text":
+                    return self._text(slo.EVALUATOR.render_text())
+                return self._json(200, {"verdicts": verdicts,
+                                        "exit_code":
+                                            slo.EVALUATOR.exit_code()})
             return self._json(404, {"error": "not found"})
         except _BadRequest as e:
             return self._json(400, {"error": str(e)})
@@ -1259,9 +1279,25 @@ def main(argv=None) -> int:
                     help="on shutdown, write the flight recorder as "
                          "Chrome-trace JSON to PATH (chrome://tracing / "
                          "Perfetto; summarize with tools/trace_report.py)")
+    ap.add_argument("--slo-specs", default=None, metavar="PATH",
+                    help="JSON list of SLO specs (utils/slo.py; default: the "
+                         "built-in predict-p99 / sync-freshness / numerics "
+                         "set). Verdicts on GET /sloz and the /statusz panel")
+    ap.add_argument("--slo-interval", type=float, default=0.0,
+                    help="also evaluate SLOs on a background thread every S "
+                         "seconds (0 = only on /sloz//statusz scrapes) — "
+                         "breaches land in the flight recorder even when "
+                         "nobody is scraping")
     args = ap.parse_args(argv)
     if args.flight_recorder > 0:
         trace.configure(args.flight_recorder)
+    from .utils import slo
+    if args.slo_specs:
+        slo.configure(slo.load_specs(args.slo_specs))
+    slo_eval = None
+    if args.slo_interval > 0:
+        slo.EVALUATOR.interval_s = args.slo_interval
+        slo_eval = slo.EVALUATOR.start()
 
     def kv(pairs, what):
         out = {}
@@ -1293,6 +1329,8 @@ def main(argv=None) -> int:
     finally:
         for sub in httpd.subscribers.values():
             sub.stop()
+        if slo_eval is not None:
+            slo_eval.stop()
         if args.trace_dump:
             print(f"trace dump: {trace.dump_chrome(args.trace_dump)}")
     return 0

@@ -220,9 +220,34 @@ def observe_sync_cost(cost: Dict[str, "object"]) -> None:
     observe("sync.rows_per_delta", float(cost.get("rows", 0)), "gauge")
 
 
+class NonFiniteError(RuntimeError):
+    """Raised by `Trainer(halt_on_nonfinite=True)` when the numerics sentinel
+    sees a non-finite loss or gradient. `sources` maps the offending
+    phase/table name ("loss", "dense", or a table name) to the non-finite
+    element count the sentinel observed that step."""
+
+    def __init__(self, sources: Dict[str, float]):
+        self.sources = dict(sources)
+        names = ", ".join(f"{k} ({int(v)} non-finite value(s))"
+                          for k, v in sorted(self.sources.items()))
+        super().__init__(
+            f"non-finite values detected in: {names} — see the health.* "
+            "gauges and the flight recorder's health/nonfinite event")
+
+
+# per-table sentinel stats from `Trainer._sentinel_stats` (additive across
+# shards; folded to health.* gauges below, never to trainer.* counters —
+# summing sumsq across steps would be meaningless)
+_HEALTH_TABLE_STATS = ("grad_sumsq", "grad_nonfinite", "ef_abs_sum",
+                       "ef_elems", "quant_err_sumsq")
+# global sentinel stats, shipped under the reserved `health/` var
+_HEALTH_GLOBAL_STATS = ("loss_nonfinite", "dense_grad_sumsq",
+                        "dense_grad_nonfinite")
+
+
 # oelint: hot-path -- the documented ONE-device_get-per-step call site; the
 # host-sync pass budget (1) makes a second get here fail `make lint`
-def record_step_stats(stats: Dict[str, "object"]) -> None:
+def record_step_stats(stats: Dict[str, "object"]) -> Dict[str, "object"]:
     """Fold a train step's device-side stats dict (`{var}/pull_indices`, `.../
     pull_unique`, `.../pull_overflow`, ...) into host accumulators.
 
@@ -246,7 +271,17 @@ def record_step_stats(stats: Dict[str, "object"]) -> None:
     `hot.hit_ratio{table=}` (positions served from the replicated cache /
     positions pulled) and `hot.bytes_saved{table=}` in the SAME device_get —
     no second host sync — and as gauges they survive `report(reset=True)`
-    like the other exchange.* gauges."""
+    like the other exchange.* gauges.
+
+    Numerics-sentinel stats (`Trainer(sentinel=True)`) fold to `health.*`
+    gauges in the same device_get: per-table `health.grad_norm` (sqrt of the
+    psum'd sumsq), `health.grad_nonfinite`, `health.ef_abs_mean`,
+    `health.quant_err_rel` (relative wire-quantization error), plus
+    `health.dense_grad_norm` and the `health.nonfinite_total` counter. Returns
+    a health summary dict — `{"sentinel": bool, "nonfinite": {source: count},
+    "grad_norm": {source: norm}}` — that `Trainer.record_step_stats` turns
+    into `NonFiniteError` under `halt_on_nonfinite`; any non-finite sighting
+    also leaves a `health/nonfinite` flight-recorder event."""
     try:
         import jax
         stats = jax.device_get(dict(stats))
@@ -254,6 +289,7 @@ def record_step_stats(stats: Dict[str, "object"]) -> None:
         pass
     import numpy as np
     per_table: Dict[str, Dict[str, float]] = {}
+    health_raw: Dict[str, float] = {}
     for key, value in stats.items():
         var, sep, stat = key.partition("/")
         table_stat = sep and "/" not in stat
@@ -267,6 +303,12 @@ def record_step_stats(stats: Dict[str, "object"]) -> None:
                     continue  # unknown vector stat: nothing sane to fold
             v = float(value)
         except (TypeError, ValueError):
+            continue
+        if var == "health" and table_stat and stat in _HEALTH_GLOBAL_STATS:
+            health_raw[stat] = v
+            continue
+        if table_stat and stat in _HEALTH_TABLE_STATS:
+            per_table.setdefault(var, {})[stat] = v
             continue
         observe(key.replace("/", "."), v)
         if table_stat:
@@ -289,6 +331,65 @@ def record_step_stats(stats: Dict[str, "object"]) -> None:
         if "hot_bytes_saved" in d:
             observe("hot.bytes_saved", d["hot_bytes_saved"], "gauge",
                     labels={"table": var})
+    return _fold_health(per_table, health_raw)
+
+
+def _fold_health(per_table: Dict[str, Dict[str, float]],
+                 health_raw: Dict[str, float]) -> Dict[str, "object"]:
+    """Sentinel stats -> health.* gauges + the returned health summary.
+    sqrt happens HERE, after the cross-shard psum of the additive sumsq
+    stats, so the gauges are true global norms."""
+    health: Dict[str, "object"] = {"sentinel": False, "nonfinite": {},
+                                   "grad_norm": {}}
+    total_nf = 0.0
+    for var, d in per_table.items():
+        if not any(s in d for s in _HEALTH_TABLE_STATS):
+            continue
+        health["sentinel"] = True
+        if "grad_sumsq" in d:
+            gn = max(d["grad_sumsq"], 0.0) ** 0.5
+            observe("health.grad_norm", gn, "gauge", labels={"table": var})
+            health["grad_norm"][var] = gn
+        if "grad_nonfinite" in d:
+            nf = d["grad_nonfinite"]
+            observe("health.grad_nonfinite", nf, "gauge",
+                    labels={"table": var})
+            if nf:
+                total_nf += nf
+                health["nonfinite"][var] = nf
+        if d.get("ef_elems"):
+            observe("health.ef_abs_mean",
+                    d.get("ef_abs_sum", 0.0) / d["ef_elems"], "gauge",
+                    labels={"table": var})
+        if "quant_err_sumsq" in d and d.get("grad_sumsq"):
+            rel = (max(d["quant_err_sumsq"], 0.0) / d["grad_sumsq"]) ** 0.5
+            observe("health.quant_err_rel", rel, "gauge",
+                    labels={"table": var})
+    if health_raw:
+        health["sentinel"] = True
+        if "dense_grad_sumsq" in health_raw:
+            dg = max(health_raw["dense_grad_sumsq"], 0.0) ** 0.5
+            observe("health.dense_grad_norm", dg, "gauge")
+            health["grad_norm"]["dense"] = dg
+        dn = health_raw.get("dense_grad_nonfinite", 0.0)
+        observe("health.dense_grad_nonfinite", dn, "gauge")
+        if dn:
+            total_nf += dn
+            health["nonfinite"]["dense"] = dn
+        ln = health_raw.get("loss_nonfinite", 0.0)
+        if ln:
+            total_nf += ln
+            health["nonfinite"]["loss"] = ln
+    if health["sentinel"]:
+        # observed EVERY sentinel step (0 included) so the numerics SLO has a
+        # judged metric on clean runs instead of verdict UNKNOWN
+        observe("health.nonfinite_total", total_nf)
+        if total_nf:
+            from . import trace  # lazy: trace imports metrics at module level
+            trace.event("health", "nonfinite",
+                        **{k: float(v)
+                           for k, v in health["nonfinite"].items()})
+    return health
 
 
 # per-shard vector stats emitted by `parallel/sharded.exchange_load_stats`
@@ -339,14 +440,17 @@ def report(reset: bool = False) -> Dict[str, float]:
     return out
 
 
-def report_table(reset: bool = False) -> str:
-    """The reference's periodic accumulator table (`WorkerContext.cpp:140-163`)."""
-    vals = report(reset=reset)
+def _format_table(vals: Dict[str, float]) -> str:
     if not vals:
         return "(no metrics)"
     width = max(len(k) for k in vals)
     lines = [f"{k.ljust(width)}  {v:,.3f}" for k, v in sorted(vals.items())]
     return "\n".join(lines)
+
+
+def report_table(reset: bool = False) -> str:
+    """The reference's periodic accumulator table (`WorkerContext.cpp:140-163`)."""
+    return _format_table(report(reset=reset))
 
 
 def reset_all() -> None:
@@ -426,13 +530,19 @@ class PeriodicReporter:
     """Background thread printing the accumulator table every `interval` seconds
     (enabled when interval > 0, like the reference's `server.report_interval`).
     `reset=True` resets windowed kinds between reports; gauges and histograms
-    are preserved (see `report`)."""
+    are preserved (see `report`).
+
+    `jsonl_path` additionally appends each report as one timestamped JSONL
+    record (`{"ts": ..., "metrics": {...}}`) for offline analysis; `stop()`
+    flushes a final record so short runs (or interval=0 runs that never tick)
+    still leave data behind."""
 
     def __init__(self, interval: float, sink: Optional[Callable[[str], None]] = None,
-                 reset: bool = True):
+                 reset: bool = True, jsonl_path: Optional[str] = None):
         self.interval = interval
         self.sink = sink or (lambda s: print(s, flush=True))
         self.reset = reset
+        self.jsonl_path = jsonl_path
         self._stop = threading.Event()
         self._lock = threading.Lock()
         # guarded-by: self._lock
@@ -451,11 +561,19 @@ class PeriodicReporter:
                 self._thread.start()
         return self
 
+    def _write_jsonl(self, vals: Dict[str, float]) -> None:
+        import json
+        with open(self.jsonl_path, "a") as f:
+            f.write(json.dumps({"ts": time.time(), "metrics": vals},
+                               sort_keys=True) + "\n")
+
     def _run(self) -> None:
         while not self._stop.wait(self.interval):
             try:
-                self.sink("== accumulator report ==\n"
-                          + report_table(reset=self.reset))
+                vals = report(reset=self.reset)
+                if self.jsonl_path:
+                    self._write_jsonl(vals)
+                self.sink("== accumulator report ==\n" + _format_table(vals))
             except Exception:  # noqa: BLE001 — a broken pipe/sink must not
                 # kill periodic reporting for the rest of the run
                 observe("metrics.report_errors", 1)
@@ -466,6 +584,11 @@ class PeriodicReporter:
             t, self._thread = self._thread, None
         if t is not None:  # join outside the lock (_run never takes it)
             t.join(timeout=5)
+        if self.jsonl_path:
+            try:  # final flush (no reset: just a snapshot on the way out)
+                self._write_jsonl(report(reset=False))
+            except Exception:  # noqa: BLE001 — same contract as _run
+                observe("metrics.report_errors", 1)
 
     def __enter__(self) -> "PeriodicReporter":
         return self.start()

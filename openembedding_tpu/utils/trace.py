@@ -217,6 +217,11 @@ def span(group: str, name: str, *, labels: Optional[Dict[str, str]] = None,
         yield s
     except BaseException as e:
         s.attrs.setdefault("error", f"{type(e).__name__}: {e}")
+        # explicit status + a discrete flight-recorder event: a span that
+        # exits via exception must be filterable on /tracez (and survive in
+        # the event ring), not be shaped like a fast success
+        s.attrs["status"] = "error"
+        event(group, "span_error", span=name, error=s.attrs["error"])
         raise
     finally:
         ms = (time.perf_counter() - t0) * 1e3

@@ -813,6 +813,10 @@ def test_from_logits_bce_maps_to_logit_loss():
         from openembedding_tpu.keras_compat import from_keras_model
         from openembedding_tpu.model import Trainer, binary_logloss
 
+        # the 0.6 convergence bound is tight enough that unseeded keras
+        # initializers flake it (~1 in 3); pin an init that converges
+        # with margin (ratio 0.45 at 15 steps)
+        keras.utils.set_random_seed(1)
         cat = keras.Input(shape=(2,), dtype="int32", name="cat")
         emb = keras.layers.Embedding(64, 4, name="emb")(cat)
         x = keras.layers.Flatten()(emb)

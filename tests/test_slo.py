@@ -1,0 +1,296 @@
+"""SLO engine tests (`utils/slo.py`): spec parsing/validation, the
+never-observed → UNKNOWN trap, burn-rate windows (including a fast window
+shorter than one evaluation interval), gauge-vs-hist selector behavior,
+worst-series judging under labels=None, breach/recovery transitions with
+their flight-recorder events, exit-code semantics, and the raising-sink
+survival rule the PeriodicReporter pinned in round 9."""
+
+import json
+import time
+
+import pytest
+
+from openembedding_tpu.utils import metrics, slo, trace
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    metrics._REGISTRY.clear()
+    trace.RECORDER.clear()
+    yield
+    metrics._REGISTRY.clear()
+    trace.RECORDER.clear()
+
+
+# -- spec parsing + validation ------------------------------------------------
+
+
+def test_spec_validation_rejects_bad_fields():
+    with pytest.raises(ValueError, match="selector"):
+        slo.SLOSpec(name="s", metric="g.m", threshold=1.0, selector="p33")
+    with pytest.raises(ValueError, match="op"):
+        slo.SLOSpec(name="s", metric="g.m", threshold=1.0, op="~=")
+    with pytest.raises(ValueError, match="slow window"):
+        slo.SLOSpec(name="s", metric="g.m", threshold=1.0,
+                    fast_window_s=60.0, slow_window_s=10.0)
+    with pytest.raises(ValueError, match="unknown"):
+        slo.parse_spec({"name": "s", "metric": "g.m", "threshold": 1.0,
+                        "tresholdd": 2.0})
+
+
+def test_load_specs_checked_in_file(tmp_path):
+    import os
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    specs = slo.load_specs(os.path.join(repo, "tools", "slo_specs.json"))
+    assert {s.name for s in specs} >= {"predict_p99", "numerics",
+                                       "sync_freshness"}
+    # non-list file is rejected
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"name": "x"}))
+    with pytest.raises(ValueError, match="list"):
+        slo.load_specs(str(bad))
+
+
+# -- the UNKNOWN trap ---------------------------------------------------------
+
+
+def test_never_observed_metric_is_unknown_not_ok():
+    ev = slo.SLOEvaluator([slo.SLOSpec(name="lag",
+                                       metric="sync.version_lag_steps",
+                                       threshold=50.0)])
+    (v,) = ev.evaluate_now()
+    assert v["verdict"] == slo.UNKNOWN
+    assert v["value"] is None
+    # absence of evidence is not a pass: the exit gate stays non-zero
+    assert ev.exit_code() == 2
+    # ...and a snapshot-less evaluator is also non-zero
+    assert slo.SLOEvaluator([]).exit_code() == 2
+
+    metrics.observe("sync.version_lag_steps", 3.0, "gauge")
+    (v,) = ev.evaluate_now()
+    assert v["verdict"] == slo.OK and v["value"] == 3.0
+    assert ev.exit_code() == 0
+
+
+def test_resetting_reporter_wipes_counter_evidence_back_to_unknown():
+    """The documented trap: `report(reset=True)` zeroes a counter's window,
+    so the SLO sees never-observed again — judgment-bearing nodes must
+    report with reset=False (as tools/sync_soak.py does)."""
+    spec = slo.SLOSpec(name="numerics", metric="health.nonfinite_total",
+                       threshold=0.0, op="==")
+    ev = slo.SLOEvaluator([spec])
+    metrics.observe("health.nonfinite_total", 0.0)
+    (v,) = ev.evaluate_now()
+    assert v["verdict"] == slo.OK
+    metrics.report(reset=True)
+    ev2 = slo.SLOEvaluator([spec])  # fresh history: only the registry counts
+    (v,) = ev2.evaluate_now()
+    assert v["verdict"] == slo.UNKNOWN
+    # the non-resetting report keeps the evidence
+    metrics.observe("health.nonfinite_total", 0.0)
+    metrics.report(reset=False)
+    (v,) = ev2.evaluate_now()
+    assert v["verdict"] == slo.OK
+
+
+def test_peek_never_creates_the_metric():
+    ev = slo.SLOEvaluator([slo.SLOSpec(name="lag", metric="sync.never_seen",
+                                       threshold=1.0)])
+    ev.evaluate_now()
+    with metrics._LOCK:
+        names = {a.name for a in metrics._REGISTRY.values()}
+    assert "sync.never_seen" not in names
+
+
+# -- burn-rate windows --------------------------------------------------------
+
+
+def test_fast_window_shorter_than_interval_judges_latest_sample():
+    """fast_window_s=0 with a tiny burn threshold = trip on the FIRST bad
+    sample (the numerics SLO shape): the latest sample is always in scope
+    even when the window is shorter than one evaluation interval."""
+    spec = slo.SLOSpec(name="numerics", metric="health.nonfinite_total",
+                       threshold=0.0, op="==", fast_window_s=0.0,
+                       slow_window_s=300.0, burn_threshold=1e-9)
+    ev = slo.SLOEvaluator([spec])
+    t0 = 1000.0
+    metrics.observe("health.nonfinite_total", 0.0, "gauge")
+    (v,) = ev.evaluate_now(now=t0)
+    assert v["verdict"] == slo.OK
+    metrics.observe("health.nonfinite_total", 5.0, "gauge")
+    (v,) = ev.evaluate_now(now=t0 + 10)
+    assert v["verdict"] == slo.BREACHED
+    assert v["value"] == 5.0
+    # recovery is symmetric: a clean latest sample clears the fast window
+    # (BREACHED needs BOTH windows burning), while the slow window still
+    # remembers the bad sample — the breach survives in the flight recorder
+    # and the slo.breaches counter, not in the live verdict
+    metrics.observe("health.nonfinite_total", 0.0, "gauge")
+    (v,) = ev.evaluate_now(now=t0 + 20)
+    assert v["verdict"] == slo.OK
+    assert v["slow_bad_frac"] == pytest.approx(1 / 3)
+    assert metrics.Accumulator.get("slo.breaches").value() == 1
+
+
+def test_single_blip_does_not_breach_multiwindow():
+    """Default burn shape (0.5 in both windows): one bad sample among good
+    ones inside the fast window does not page."""
+    spec = slo.SLOSpec(name="p99", metric="serving.predict.ms",
+                       selector="p99", threshold=100.0,
+                       fast_window_s=60.0, slow_window_s=300.0,
+                       burn_threshold=0.5)
+    ev = slo.SLOEvaluator([spec])
+    t0 = 2000.0
+    for i in range(4):
+        metrics.observe("serving.predict.ms", 5.0, "hist")
+        ev.evaluate_now(now=t0 + i)
+    # a tail blip: p99 now fails, but it is 1 bad among 5 fast samples
+    for _ in range(200):
+        metrics.observe("serving.predict.ms", 500.0, "hist")
+    (v,) = ev.evaluate_now(now=t0 + 4)
+    assert v["verdict"] == slo.OK
+    assert v["fast_bad_frac"] == pytest.approx(0.2)
+    # sustained burn: bad fraction crosses 0.5 in both windows
+    verdicts = [ev.evaluate_now(now=t0 + 5 + i)[0] for i in range(8)]
+    assert verdicts[-1]["verdict"] == slo.BREACHED
+
+
+# -- selector semantics -------------------------------------------------------
+
+
+def test_hist_selector_on_gauge_reads_the_scalar():
+    """A spec written for a histogram still evaluates if the metric turns
+    out to be a gauge: every selector degrades to value()."""
+    metrics.observe("exchange.cost_drift", 0.25, "gauge")
+    ev = slo.SLOEvaluator([slo.SLOSpec(name="drift",
+                                       metric="exchange.cost_drift",
+                                       selector="p99", threshold=2.0)])
+    (v,) = ev.evaluate_now()
+    assert v["verdict"] == slo.OK
+    assert v["value"] == pytest.approx(0.25)
+
+
+def test_hist_quantile_selector_judges_the_quantile():
+    for ms in (1.0,) * 98 + (900.0,) * 2:
+        metrics.observe("serving.predict.ms", ms, "hist")
+    make = lambda sel, thr: slo.SLOEvaluator(  # noqa: E731
+        [slo.SLOSpec(name="s", metric="serving.predict.ms",
+                     selector=sel, threshold=thr, fast_window_s=0.0,
+                     burn_threshold=1e-9)])
+    (v,) = make("p50", 10.0).evaluate_now()
+    assert v["verdict"] == slo.OK
+    (v,) = make("p99", 10.0).evaluate_now()
+    assert v["verdict"] == slo.BREACHED
+    assert v["value"] > 10.0
+
+
+def test_labels_none_judges_worst_series():
+    """labels=None matches every label set; ONE failing table fails the
+    per-table objective."""
+    metrics.observe("health.grad_norm", 1.0, "gauge", labels={"table": "a"})
+    metrics.observe("health.grad_norm", 50.0, "gauge", labels={"table": "b"})
+    ev = slo.SLOEvaluator([slo.SLOSpec(name="gn", metric="health.grad_norm",
+                                       threshold=10.0, fast_window_s=0.0,
+                                       burn_threshold=1e-9)])
+    (v,) = ev.evaluate_now()
+    assert v["verdict"] == slo.BREACHED and v["value"] == 50.0
+    # pinning the labels to the healthy series passes
+    ev2 = slo.SLOEvaluator([slo.SLOSpec(name="gn", metric="health.grad_norm",
+                                        labels={"table": "a"},
+                                        threshold=10.0)])
+    (v,) = ev2.evaluate_now()
+    assert v["verdict"] == slo.OK and v["value"] == 1.0
+
+
+# -- transitions, metrics, events, exit codes ---------------------------------
+
+
+def test_breach_transition_emits_event_counter_and_recovers():
+    spec = slo.SLOSpec(name="lag", metric="sync.version_lag_steps",
+                       threshold=10.0, fast_window_s=0.0,
+                       slow_window_s=10.0, burn_threshold=1e-9)
+    ev = slo.SLOEvaluator([spec])
+    t0 = 3000.0
+    metrics.observe("sync.version_lag_steps", 99.0, "gauge")
+    (v,) = ev.evaluate_now(now=t0)
+    assert v["verdict"] == slo.BREACHED
+    assert ev.exit_code() == 1
+    assert metrics.Accumulator.get("slo.breaches").value() == 1
+    assert metrics.Accumulator.get(
+        "slo.ok", "gauge", labels={"slo": "lag"}).value() == 0.0
+    breaches = [e for e in trace.RECORDER.tail() if e.name == "breach"]
+    assert len(breaches) == 1 and breaches[0].attrs["slo"] == "lag"
+    # still breached next round: no second breach event (transition-edge only)
+    ev.evaluate_now(now=t0 + 1)
+    assert metrics.Accumulator.get("slo.breaches").value() == 1
+    assert len([e for e in trace.RECORDER.tail()
+                if e.name == "breach"]) == 1
+    # recovery: lag drops, bad samples age out of the 10s slow window
+    metrics.observe("sync.version_lag_steps", 2.0, "gauge")
+    (v,) = ev.evaluate_now(now=t0 + 20)
+    assert v["verdict"] == slo.OK
+    assert any(e.name == "recovered" for e in trace.RECORDER.tail())
+    assert metrics.Accumulator.get(
+        "slo.ok", "gauge", labels={"slo": "lag"}).value() == 1.0
+    assert ev.exit_code() == 0
+
+
+def test_exit_code_breached_beats_unknown():
+    metrics.observe("sync.version_lag_steps", 99.0, "gauge")
+    ev = slo.SLOEvaluator([
+        slo.SLOSpec(name="lag", metric="sync.version_lag_steps",
+                    threshold=10.0, fast_window_s=0.0, burn_threshold=1e-9),
+        slo.SLOSpec(name="ghost", metric="serving.predict.ms",
+                    threshold=10.0),
+    ])
+    ev.evaluate_now()
+    assert ev.exit_code() == 1  # BREACHED outranks the UNKNOWN spec
+
+
+def test_render_text_and_snapshot_shapes():
+    ev = slo.SLOEvaluator([slo.SLOSpec(name="lag",
+                                       metric="sync.version_lag_steps",
+                                       threshold=10.0)])
+    assert ev.render_text() == "(no SLO verdicts yet)"
+    ev.evaluate_now()
+    text = ev.render_text()
+    assert "UNKNOWN" in text and "never-observed" in text
+    (snap,) = ev.snapshot()
+    assert snap["name"] == "lag" and snap["verdict"] == slo.UNKNOWN
+
+
+# -- background evaluator survives a raising sink -----------------------------
+
+
+def test_background_evaluator_survives_raising_sink():
+    calls = []
+
+    def bad_sink(verdicts):
+        calls.append(len(verdicts))
+        raise RuntimeError("sink died")
+
+    metrics.observe("sync.version_lag_steps", 1.0, "gauge")
+    ev = slo.SLOEvaluator([slo.SLOSpec(name="lag",
+                                       metric="sync.version_lag_steps",
+                                       threshold=10.0)],
+                          interval_s=0.02, sink=bad_sink)
+    with ev:
+        deadline = time.time() + 5.0
+        while len(calls) < 3 and time.time() < deadline:
+            time.sleep(0.01)
+    assert len(calls) >= 3  # kept evaluating after every raise
+    assert metrics.Accumulator.get("slo.eval_errors").value() >= 3
+    # the verdicts themselves stayed healthy
+    assert ev.exit_code() == 0
+
+
+def test_configure_replaces_specs_and_drops_stale_history():
+    ev = slo.SLOEvaluator([slo.SLOSpec(name="old", metric="sync.rollbacks",
+                                       threshold=0.0)])
+    ev.evaluate_now()
+    assert [v["name"] for v in ev.snapshot()] == ["old"]
+    ev.configure([slo.SLOSpec(name="new", metric="sync.rollbacks",
+                              threshold=0.0)])
+    assert ev.snapshot() == []  # old verdict history discarded
+    ev.evaluate_now()
+    assert [v["name"] for v in ev.snapshot()] == ["new"]

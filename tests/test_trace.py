@@ -84,7 +84,21 @@ def test_span_records_error_and_reraises():
             raise ValueError("no")
     s = trace.RECORDER.spans()[0]
     assert s.attrs["error"] == "ValueError: no"
+    # error exits are greppable: status attr + a flight-recorder event that
+    # survives span-ring eviction
+    assert s.attrs["status"] == "error"
     assert s.duration_ms is not None
+    evs = [e for e in trace.RECORDER.tail() if e.name == "span_error"]
+    assert len(evs) == 1
+    assert evs[0].group == "g"
+    assert evs[0].attrs == {"span": "boom", "error": "ValueError: no"}
+    # clean exits don't get the status attr or the event
+    with trace.span("g", "fine"):
+        pass
+    ok = next(s for s in trace.RECORDER.spans() if s.name == "fine")
+    assert "status" not in ok.attrs
+    assert len([e for e in trace.RECORDER.tail()
+                if e.name == "span_error"]) == 1
 
 
 def test_flight_recorder_eviction_order():

@@ -104,6 +104,13 @@ CONFIGS = (
     # same a2a set and wire bytes as fused_fp32.
     {"name": "fused_fp32_zero", "group_exchange": True, "wire": "fp32",
      "hot_rows": 0, "dense_shard": True},
+    # round-16 numerics sentinel: the health stats ride the step's stats
+    # psum — the pinned contract is that sentinel=True costs ONLY a handful
+    # of extra SCALAR all-reduces (one per health stat key) and changes the
+    # exchange a2a set and wire bytes by exactly zero vs fused_fp32 (and
+    # every sentinel-off config above stays byte-identical, delta 0).
+    {"name": "fused_fp32_sentinel", "group_exchange": True, "wire": "fp32",
+     "hot_rows": 0, "sentinel": True},
 )
 
 
@@ -236,7 +243,8 @@ def make_trainer(config: Dict):
         wire=config["wire"], group_exchange=config["group_exchange"],
         hot_rows=config["hot_rows"], mig_rows=config.get("mig_rows", 0),
         hot_wire=config.get("hot_wire"),
-        dense_shard=config.get("dense_shard", False))
+        dense_shard=config.get("dense_shard", False),
+        sentinel=config.get("sentinel", False))
     return trainer, batch
 
 

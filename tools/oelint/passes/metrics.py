@@ -40,6 +40,7 @@ KNOWN_GROUPS = {
     "exchange",   # sharded-exchange wire costs + per-shard load/skew gauges
     "fleet",      # /fleetz cross-node scrape health
     "guard",      # runtime invariant guards (utils/guards.py fingerprints)
+    "health",     # numerics sentinel (grad norms, non-finite counts, ef/quant error)
     "hot",        # replicated hot-row cache (MeshTrainer(hot_rows=...))
     "lint",       # oelint's own run health (pass wall times, finding counts)
     "metrics",    # the metrics subsystem's own health (report_errors)
@@ -48,6 +49,7 @@ KNOWN_GROUPS = {
     "placement",  # self-driving placement controller + cold-tail migration
     "serving",    # REST predict/pull/batching
     "skew",       # heavy-hitter sketches (utils/sketch.py)
+    "slo",        # SLO engine verdicts/evaluation health (utils/slo.py)
     "sync",       # online model sync
     "train",      # example-loop wall timers
     "trainer",    # train-step phases + per-table pull stats
@@ -112,8 +114,62 @@ def lint_text(sf: SourceFile) -> List[Finding]:
     return bad
 
 
+def _lint_slo_specs(root: str) -> List[Finding]:
+    """Checked-in SLO spec files (tools/**/*slo*.json) must reference metric
+    names in the `group.name` scheme with a registered group — a spec with a
+    typo'd metric would otherwise sit at UNKNOWN forever, and an unregistered
+    group means the metric can never be emitted by linted code."""
+    import glob
+    import json
+    import os
+    findings: List[Finding] = []
+    pattern = os.path.join(root, "tools", "**", "*slo*.json")
+    for path in sorted(glob.glob(pattern, recursive=True)):
+        rel = os.path.relpath(path, root)
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            findings.append(Finding(rel, 1, NAME,
+                                    f"unparseable SLO spec file: {e}"))
+            continue
+        if not isinstance(doc, list):
+            findings.append(Finding(rel, 1, NAME,
+                                    "SLO spec file must be a JSON list of "
+                                    "spec objects"))
+            continue
+        for i, d in enumerate(doc):
+            where = f"spec #{i} ({d.get('name', '?')})" \
+                if isinstance(d, dict) else f"spec #{i}"
+            if not isinstance(d, dict) or "metric" not in d:
+                findings.append(Finding(rel, 1, NAME,
+                                        f"{where}: not a spec object with a "
+                                        "'metric' field"))
+                continue
+            metric = str(d["metric"])
+            if not NAME_RE.fullmatch(metric):
+                findings.append(Finding(
+                    rel, 1, NAME, f"{where}: metric {metric!r} — metric "
+                    "names are dot-joined lowercase group.name segments"))
+                continue
+            segments = metric.split(".")
+            if segments[0] not in KNOWN_GROUPS:
+                findings.append(Finding(
+                    rel, 1, NAME, f"{where}: metric {metric!r} — unknown "
+                    f"group {segments[0]!r}; register it in "
+                    "tools/oelint/passes/metrics.py KNOWN_GROUPS"))
+            for seg in segments:
+                if INSTANCE_DIM.fullmatch(seg):
+                    findings.append(Finding(
+                        rel, 1, NAME, f"{where}: metric {metric!r} — "
+                        f"segment {seg!r} embeds a per-instance dimension; "
+                        "SLO specs pin instances with 'labels'"))
+    return findings
+
+
 def run(files: List[SourceFile], root: str) -> List[Finding]:
     findings: List[Finding] = []
     for sf in files:
         findings.extend(lint_text(sf))
+    findings.extend(_lint_slo_specs(root))
     return sorted(findings, key=lambda f: (f.path, f.line))

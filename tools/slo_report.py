@@ -1,0 +1,83 @@
+"""Operator CLI for the SLO engine: fetch a node's verdicts and exit with them.
+
+    python tools/slo_report.py http://127.0.0.1:8501
+    python tools/slo_report.py http://127.0.0.1:8501 --json
+    python tools/slo_report.py http://127.0.0.1:8501 --watch 5
+
+GETs `/sloz` on a serving node (`utils/slo.py`; the node evaluates its spec
+set against its live accumulator registry per request), prints the verdict
+table, and exits with the SLO verdict — 0 every objective OK, 1 any
+BREACHED, 2 anything UNKNOWN (absence of evidence is not a pass) — so the
+CLI slots straight into CI gates and cron checks. `--watch` re-polls and
+reprints until interrupted (exit code then reflects the LAST poll).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.request
+
+
+def fetch(url: str, timeout: float = 10.0) -> dict:
+    base = url.rstrip("/")
+    if not base.startswith("http"):
+        base = f"http://{base}"
+    if not base.endswith("/sloz"):
+        base = f"{base}/sloz"
+    with urllib.request.urlopen(base, timeout=timeout) as r:
+        return json.loads(r.read().decode())
+
+
+def format_verdicts(doc: dict) -> str:
+    rows = doc.get("verdicts", [])
+    if not rows:
+        return "(no SLO verdicts)"
+    lines = []
+    for v in rows:
+        val = ("never-observed" if v.get("value") is None
+               else f"{v['value']:.6g}")
+        lines.append(f"[{v['verdict']:>8}] {v['name']}: "
+                     f"{v['metric']}.{v['selector']} {v['op']} "
+                     f"{v['threshold']:g} (value={val}, n={v['samples']})"
+                     + (f" — {v['description']}" if v.get("description")
+                        else ""))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="SLO verdicts from a live node's GET /sloz; exits with "
+                    "the verdict (0 OK / 1 breached / 2 unknown)")
+    ap.add_argument("url", help="node base URL (or full .../sloz)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the raw /sloz JSON instead of the table")
+    ap.add_argument("--watch", type=float, default=0.0, metavar="S",
+                    help="re-poll every S seconds until interrupted")
+    ap.add_argument("--timeout", type=float, default=10.0)
+    args = ap.parse_args(argv)
+    code = 2
+    try:
+        while True:
+            doc = fetch(args.url, timeout=args.timeout)
+            code = int(doc.get("exit_code", 2))
+            if args.json:
+                print(json.dumps(doc, indent=2))
+            else:
+                print(format_verdicts(doc))
+            if args.watch <= 0:
+                break
+            time.sleep(args.watch)
+            print()
+    except KeyboardInterrupt:
+        pass
+    except OSError as e:
+        print(f"slo_report: {args.url}: {e}", file=sys.stderr)
+        return 2
+    return code
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

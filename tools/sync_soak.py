@@ -34,9 +34,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def run(*, steps=24, persist_every=2, interval_s=0.05, workdir="/tmp/oetpu_sync_soak",
         predict_threads=4, wire="fp32", vocab=1 << 10, batch=16, dim=4,
-        lag_bound_steps=None, step_delay_s=0.0, quiet=False):
+        lag_bound_steps=None, step_delay_s=0.0, quiet=False,
+        metrics_log=None, sentinel=True, measure_every=8):
     """-> report dict (see asserts at the bottom). Raises AssertionError when
-    the soak's invariants break."""
+    the soak's invariants break. The report carries the SLO verdicts
+    (`utils/slo.DEFAULT_SLOS` judged once at exit over everything the soak
+    observed — predict latency, sync freshness, sentinel numerics) and
+    `slo_exit_code`, which `main()` adopts as the process exit status."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import numpy as np
 
@@ -59,9 +63,21 @@ def run(*, steps=24, persist_every=2, interval_s=0.05, workdir="/tmp/oetpu_sync_
     sign = "soak-0"
 
     model = make_deepfm(vocabulary=vocab, dim=dim, hidden=(8,))
-    trainer = Trainer(model, embed.Adagrad(learning_rate=0.05), seed=0)
+    # sentinel + sampled measurement on by default: the soak IS the
+    # production-day rehearsal, so it trains with the health rails it gates on
+    trainer = Trainer(model, embed.Adagrad(learning_rate=0.05), seed=0,
+                      sentinel=sentinel, measure_every=measure_every)
     batches = list(synthetic_criteo(batch, id_space=vocab, steps=steps,
                                     seed=1))
+    reporter = None
+    if metrics_log:
+        from openembedding_tpu.utils.metrics import PeriodicReporter
+        # reset=False: the soak judges the DEFAULT_SLOS over the whole run at
+        # exit, and a resetting reporter would zero counter windows (e.g.
+        # health.nonfinite_total) back to never-observed -> verdict UNKNOWN
+        reporter = PeriodicReporter(max(interval_s, 0.5),
+                                    sink=lambda _s: None, reset=False,
+                                    jsonl_path=metrics_log).start()
     state = trainer.init(batches[0])
     # the soak's paced trainer must never re-jit across the run: identical
     # batch shapes -> one compiled program, asserted at every step
@@ -137,7 +153,8 @@ def run(*, steps=24, persist_every=2, interval_s=0.05, workdir="/tmp/oetpu_sync_
     def train():
         s = state
         for b in batches[1:]:
-            s, _ = step_fn(s, b)
+            s, mets = step_fn(s, b)
+            trainer.record_step_stats(mets)
             persister.maybe_persist(s, batch=b)
             trained["step"] = int(s.step)
             if step_delay_s > 0:  # emulate a real per-step training cadence
@@ -168,6 +185,8 @@ def run(*, steps=24, persist_every=2, interval_s=0.05, workdir="/tmp/oetpu_sync_
         persister.close()
         pub_srv.shutdown()
         srv.shutdown()
+        if reporter is not None:
+            reporter.stop()  # flushes the final JSONL record
 
     # the collective program must be exactly what we pinned before the run
     # (same shapes, same axes, same order) — raises CollectiveMismatchError
@@ -190,6 +209,14 @@ def run(*, steps=24, persist_every=2, interval_s=0.05, workdir="/tmp/oetpu_sync_
         "last_error": sub.last_error,
         "wall_s": round(time.monotonic() - t0, 2),
     }
+    # the SLO gate: judge everything the soak observed (predict latency
+    # hists, sync freshness gauges, sentinel numerics) against the stock
+    # objectives — the process-exit verdict main() adopts
+    from openembedding_tpu.utils import slo
+    verdicts = slo.EVALUATOR.evaluate_now()
+    report["slo"] = {v["name"]: v["verdict"] for v in verdicts}
+    report["slo_exit_code"] = slo.EVALUATOR.exit_code()
+    log("SLOs:\n" + slo.EVALUATOR.render_text())
     log(json.dumps(report, indent=2))
     assert report["failed_predicts"] == 0, report
     assert report["final_lag_steps"] == 0, report
@@ -214,14 +241,22 @@ def main(argv=None):
     ap.add_argument("--step-delay-s", type=float, default=0.0,
                     help="sleep per train step, emulating a real step time "
                          "so version lag is measurable")
+    ap.add_argument("--metrics-log", default=None, metavar="PATH",
+                    help="append periodic accumulator reports (and a final "
+                         "snapshot) as timestamped JSONL records to PATH")
+    ap.add_argument("--no-slo-gate", action="store_true",
+                    help="report SLO verdicts but exit 0 regardless "
+                         "(default: exit with the SLO verdict — 0 all OK, "
+                         "1 breached, 2 unknown)")
     args = ap.parse_args(argv)
     report = run(steps=args.steps, persist_every=args.persist_every,
                  interval_s=args.interval_s,
                  predict_threads=args.predict_threads, wire=args.wire,
                  workdir=args.workdir, lag_bound_steps=args.lag_bound_steps,
-                 step_delay_s=args.step_delay_s)
+                 step_delay_s=args.step_delay_s,
+                 metrics_log=args.metrics_log)
     print(json.dumps(report))
-    return 0
+    return 0 if args.no_slo_gate else report["slo_exit_code"]
 
 
 if __name__ == "__main__":

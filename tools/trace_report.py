@@ -2,13 +2,16 @@
 
     python tools/trace_report.py /tmp/serving_trace.json
     python tools/trace_report.py /tmp/serving_trace.json --by name --sort p99
+    python tools/trace_report.py http://127.0.0.1:8501/tracez
 
 Reads the Chrome-trace JSON the flight recorder exports (`utils/trace.py
-dump_chrome`, serving `--trace-dump`, examples `--trace-dump`), aggregates
-the complete ("X") events per span name (or per group/category with
-`--by group`) and prints count / mean / p50 / p95 / p99 / max / total
-milliseconds — the offline twin of the live `/metrics` histograms, with the
-advantage that it works on a dump mailed from a production node.
+dump_chrome`, serving `--trace-dump`, examples `--trace-dump`) — or, given
+an `http(s)://` URL, fetches a RUNNING node's `GET /tracez` ring live, so an
+operator can profile without a restart — aggregates the complete ("X")
+events per span name (or per group/category with `--by group`) and prints
+count / mean / p50 / p95 / p99 / max / total milliseconds — the offline twin
+of the live `/metrics` histograms, with the advantage that it works on a
+dump mailed from a production node.
 """
 
 from __future__ import annotations
@@ -18,7 +21,28 @@ import json
 from typing import Dict, List
 
 
+def _tracez_events(doc: dict) -> List[dict]:
+    """A live `GET /tracez` body ({"spans": [...], "events": [...]},
+    `Span.as_dict` shape) -> Chrome-trace "X" event dicts the aggregator
+    already understands (ms -> us for `dur`)."""
+    out = []
+    for s in doc.get("spans", []):
+        out.append({"ph": "X", "name": str(s.get("name", "?")),
+                    "cat": str(s.get("group", "?")),
+                    "dur": float(s.get("duration_ms") or 0.0) * 1e3})
+    return out
+
+
 def load_events(path: str) -> List[dict]:
+    """Chrome-trace dump path, or an `http(s)://` URL to a node (its
+    `/tracez` is fetched — appended automatically when missing)."""
+    if path.startswith(("http://", "https://")):
+        import urllib.request
+        url = path.rstrip("/")
+        if not url.endswith("/tracez"):
+            url = f"{url}/tracez"
+        with urllib.request.urlopen(url, timeout=10.0) as r:
+            return _tracez_events(json.loads(r.read().decode()))
     with open(path) as f:
         doc = json.load(f)
     events = doc.get("traceEvents", doc if isinstance(doc, list) else [])
@@ -76,7 +100,8 @@ def format_table(rows: List[dict]) -> str:
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="per-group latency table from a trace.dump_chrome() dump")
-    ap.add_argument("dump", help="Chrome-trace JSON path")
+    ap.add_argument("dump", help="Chrome-trace JSON path, or a live node's "
+                                 "http(s)://host:port[/tracez] URL")
     ap.add_argument("--by", choices=("name", "group"), default="name",
                     help="aggregate per span name (default) or per group")
     ap.add_argument("--sort", choices=("p50", "p95", "p99", "mean", "max",

@@ -154,6 +154,11 @@ CASES = [
       "OETPU_BENCH_TOTAL_BUDGET_S": "840",
       "OETPU_BENCH_PROBE_TIMEOUT_S": "75",
       "JAX_PLATFORMS": "cpu"}, 900),
+    # 15. round-16 numerics sentinel + step watch (bench 'health' case:
+    #     per-step loop with sentinel+measure_every on vs off — the <= 2%
+    #     overhead acceptance bound). Single-chip relay case like bench_dim9;
+    #     two compiles of the dim9 step (sentinel on/off), budget sized so.
+    ("bench_health", *bench_case("health", 700)),
 ]
 
 
