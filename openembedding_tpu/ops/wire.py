@@ -362,36 +362,41 @@ def exchange_cost(tables, num_shards: int, fmt: str,
     """Static per-device wire cost of one train step.
 
     `tables`: list of dicts {dim, cap, pair (bool), id_itemsize} — one per
-    PS table, `cap` the per-(src,dst) bucket capacity of ITS batch. Tables
-    sharing `dim` form one dim-group; `fused=False` prices the pre-round-6
-    per-table protocol for comparison. Bytes are what ONE device ships
-    through the three all_to_alls (recv volume is symmetric). `bytes_scales`
-    breaks out the in-band scale lanes (int8 only) already included in the
-    row/grad totals — the honest price of the in-collective format.
+    PS table, `cap` the per-(src,dst) bucket capacity of ITS batch. Each
+    table may carry an optional `fmt` overriding the call-level format (the
+    per-table wire dict, round 17); tables sharing (dim, fmt) form one
+    dim-group — a mixed-format dim splits into one fused group per format,
+    exactly how `MeshTrainer._exchange_groups` splits the compiled a2as.
+    `fused=False` prices the pre-round-6 per-table protocol for comparison.
+    Bytes are what ONE device ships through the three all_to_alls (recv
+    volume is symmetric). `bytes_scales` breaks out the in-band scale lanes
+    (int8 only) already included in the row/grad totals — the honest price
+    of the in-collective format.
     """
     S = num_shards
     groups = {}
     for t in tables:
-        groups.setdefault(t["dim"], []).append(t)
+        groups.setdefault((t["dim"], t.get("fmt", fmt)), []).append(t)
     n_units = len(groups) if fused else len(tables)
     w = jnp.dtype(wire_dtype(fmt)).itemsize
     bytes_ids = bytes_rows = bytes_grads = bytes_scales = 0
-    for dim, members in groups.items():
+    for (dim, tf), members in groups.items():
         # fused groups widen mixed-layout ids to the common wire layout;
         # a uniform group keeps its native layout (see dedup.concat_owner_buckets)
         pair_wire = any(m["pair"] for m in members)
         iid = max(m["id_itemsize"] for m in members)
+        tw = jnp.dtype(wire_dtype(tf)).itemsize
         for m in members:
             cap = m["cap"]
             per_id = (id_wire_itemsize(pair_wire, iid) if fused
                       else id_wire_itemsize(m["pair"], m["id_itemsize"]))
             bytes_ids += S * cap * per_id
-            bytes_rows += S * cap * rows_wire_width(dim, fmt) * w
-            bytes_grads += S * cap * grads_wire_width(dim, fmt) * w
-            if fmt == "int8":
+            bytes_rows += S * cap * rows_wire_width(dim, tf) * tw
+            bytes_grads += S * cap * grads_wire_width(dim, tf) * tw
+            if tf == "int8":
                 # one set of scale lanes in the row payload, one in the grads
                 bytes_scales += S * cap * _SCALE_LANES * scale_blocks(dim) \
-                    * w * 2
+                    * tw * 2
     total = bytes_ids + bytes_rows + bytes_grads
     return {"format": fmt, "num_shards": S, "fused": fused,
             "dim_groups": len(groups), "tables": len(tables),

@@ -919,7 +919,27 @@ def _apply_unique(spec: EmbeddingSpec, state: EmbeddingTableState, optimizer,
 # dedup/routing, serving, and the optimizer apply are EXACTLY the per-table
 # protocol above — only the wire is shared, so a group of one table with fp32
 # wire is bit-identical to `sharded_lookup_train`/`sharded_apply_gradients`.
+# Since round 17 formats are per table: groups are keyed on (dim, fmt) —
+# `split_wire_groups` subdivides the model's dim-groups so every group the
+# protocol below sees is format-uniform (its encoded widths stay uniform and
+# the concat still fuses one a2a).
 # ---------------------------------------------------------------------------
+
+
+def split_wire_groups(groups, fmt_for):
+    """Split dim-groups by per-table wire format: tables sharing (dim, fmt)
+    stay fused on one a2a pair; a mixed-format dim yields one subgroup per
+    format, in first-appearance order with declaration order kept inside.
+    A format-uniform group returns unchanged — the identity for every
+    single-format config, which is what keeps their HLO byte-identical to
+    the round-13 grouping."""
+    out = []
+    for g in groups:
+        by_fmt = {}
+        for n in g:
+            by_fmt.setdefault(fmt_for(n), []).append(n)
+        out.extend(by_fmt.values())
+    return out
 
 
 # oelint: hot-path device_get=0

@@ -47,6 +47,7 @@ class MeshTrainer(Trainer):
                  hot_wire: Optional[str] = None,
                  error_feedback: Optional[bool] = None,
                  dense_shard: bool = False,
+                 dense_wire: Optional[str] = None,
                  offload_pipeline: bool = False,
                  offload_densify: int = 1,
                  sentinel: bool = False,
@@ -67,7 +68,23 @@ class MeshTrainer(Trainer):
         # bf16 (ops/wire.py; "fp32" opts out of quantization entirely).
         # Since round 13 the encode runs INSIDE the protocol (owner/client
         # edge), so the compiled a2a operands carry this format — both the
-        # fused and the per-table paths.
+        # fused and the per-table paths. Since round 17 a PER-TABLE dict is
+        # accepted too ({"big_table": "int8", "*": "fp32"} — "*" the default
+        # for unnamed tables): formats resolve once at trace time
+        # (`wire_for`), and the fused exchange splits dim-groups on
+        # (dim, fmt) so mixed-format tables ride separate a2a groups while
+        # same-format tables stay fused (`_exchange_groups`).
+        if isinstance(wire, dict):
+            from ..ops import wire as wire_mod
+            unknown = [k for k in wire
+                       if k != "*" and k not in model.specs]
+            if unknown:
+                raise ValueError(
+                    f"wire= names unknown tables {sorted(unknown)} "
+                    f"(model tables: {sorted(model.specs)}; use '*' for "
+                    "the default format)")
+            for v in wire.values():
+                wire_mod.wire_format(v)  # validate each format eagerly
         self.wire = wire
         # wire format of the hot-row backward's dense (H, dim) reduction:
         # None -> follow `wire` (fp32 keeps the round-10 one-psum plan; int8
@@ -129,6 +146,27 @@ class MeshTrainer(Trainer):
         # meshes and off by default — ZeRO-off compiles byte-identical HLO
         # (oelint hlo-budget delta 0).
         self.dense_shard = bool(dense_shard)
+        # quantized dense ZeRO collectives (round 17): encode the flat dense
+        # grad chunk with the round-13 in-band codec before the reduce — the
+        # fp32 reduce_scatter becomes an a2a of encoded partials + a
+        # per-replica fp32 sum (mirroring the round-13 two-stage hot int8
+        # reduce) — and the params all_gather ships the u16 bf16 carrier,
+        # with fp32 master weights (and, for int8, a per-replica
+        # error-feedback residual) kept as extra `__zero__` flat slots
+        # (parallel/zero.py DENSE_MASTER_KEY / DENSE_EF_KEY). Requires
+        # dense_shard; inert at mesh size 1 like everything else here.
+        if dense_wire in ("fp32", "none"):
+            dense_wire = None
+        if dense_wire is not None:
+            if dense_wire not in ("bf16", "int8"):
+                raise ValueError(
+                    f"dense_wire={dense_wire!r}: expected 'int8', 'bf16', "
+                    "or None/'fp32' (the lossless round-14 path)")
+            if not self.dense_shard:
+                raise ValueError(
+                    "dense_wire quantizes the ZeRO dense collectives — "
+                    "construct MeshTrainer(dense_shard=True, dense_wire=...)")
+        self.dense_wire = dense_wire
         self._zero_plan = None
         self._zero_fns: Dict[str, Any] = {}
         self._hot_fns: Dict[str, Any] = {}
@@ -245,13 +283,35 @@ class MeshTrainer(Trainer):
         return {n: s for n, s in self.model.ps_specs().items()
                 if self.mig_rows_for(n)}
 
+    # -- per-table wire resolution -------------------------------------------
+
+    def wire_for(self, name: str) -> str:
+        """The resolved wire format for ONE table: with a per-table dict the
+        table's entry wins, then the dict's "*" default, then the usual
+        $OETPU_WIRE/bf16 chain; a plain string/None resolves globally.
+        Resolution happens at trace time — format changes re-jit, content
+        never does."""
+        from ..ops import wire as wire_mod
+        if isinstance(self.wire, dict):
+            return wire_mod.wire_format(
+                self.wire.get(name, self.wire.get("*")))
+        return wire_mod.wire_format(self.wire)
+
+    def wire_default(self) -> str:
+        """The resolved format tables without a dict entry get (the global
+        format when `wire` is not a dict) — what `hot_wire=None` follows."""
+        from ..ops import wire as wire_mod
+        if isinstance(self.wire, dict):
+            return wire_mod.wire_format(self.wire.get("*"))
+        return wire_mod.wire_format(self.wire)
+
     # -- error feedback (lossy-pull residuals) -------------------------------
 
     def ef_for(self, name: str) -> bool:
         """Whether this table carries the per-row error-feedback residual
         (`EmbeddingTableState.ef`). Inert at mesh size 1 (no wire) and for
         dense-mirrored / host-cached tables (they never ride the exchange);
-        default = on iff the resolved wire format is int8."""
+        default = on iff the table's resolved wire format is int8."""
         if self.num_shards <= 1:
             return False
         spec = self.model.specs.get(name)
@@ -260,8 +320,7 @@ class MeshTrainer(Trainer):
             return False
         if self.error_feedback is not None:
             return bool(self.error_feedback)
-        from ..ops import wire as wire_mod
-        return wire_mod.wire_format(self.wire) == "int8"
+        return self.wire_for(name) == "int8"
 
     # -- ZeRO dense-state sharding (parallel/zero.py) ------------------------
 
@@ -283,9 +342,14 @@ class MeshTrainer(Trainer):
         model statics, so one plan serves trace time and the host-side
         conversions alike."""
         if self._zero_plan is None:
+            from ..ops import wire as wire_mod
             from . import zero
+            # dense_wire needs whole in-band codec blocks per chunk; the
+            # extra zero padding is inert (and absent for fp32 — the
+            # round-14 layout stays bit-identical)
+            align = wire_mod.INBAND_BLOCK if self.dense_wire else 1
             self._zero_plan = zero.build_plan(params, self.optimizer,
-                                              self.num_shards)
+                                              self.num_shards, align=align)
         return self._zero_plan
 
     def dense_to_sharded(self, state: TrainState) -> TrainState:
@@ -302,16 +366,38 @@ class MeshTrainer(Trainer):
             return state
         zero.check_scalar_slots_equal(plan, state.dense_slots)
         if "shard" not in self._zero_fns:
+            extra = []
+            if self.dense_wire:
+                # dense_wire rides two more flat slots: fp32 masters for this
+                # replica's chunk (the all_gather ships a rounded bf16
+                # carrier) and — int8 only — the full-length per-replica
+                # error-feedback residual. Both are derived/zero state:
+                # `unshard_slots` iterates plan slots only, so externalize()
+                # drops them and checkpoints stay byte-identical to a
+                # dense_wire-off run.
+                extra.append(zero.DENSE_MASTER_KEY)
+                if self.dense_wire == "int8":
+                    extra.append(zero.DENSE_EF_KEY)
             out_sh = {zero.ZERO_KEY: {
                 k: NamedSharding(self.mesh,
                                  P(None, self.axis) if k in plan.vector_slots
-                                 else P())
-                for k in (*plan.vector_slots, *plan.scalar_slots)}}
-            self._zero_fns["shard"] = jax.jit(
-                lambda slots: {zero.ZERO_KEY: zero.shard_slots(plan, slots)},
-                out_shardings=out_sh)
+                                 or k in extra else P())
+                for k in (*plan.vector_slots, *plan.scalar_slots, *extra)}}
+
+            def shard(slots, trainable):
+                flat = dict(zero.shard_slots(plan, slots))
+                if self.dense_wire:
+                    flat[zero.DENSE_MASTER_KEY] = \
+                        zero.flatten_tree(plan, trainable).reshape(1, -1)
+                    if self.dense_wire == "int8":
+                        flat[zero.DENSE_EF_KEY] = jnp.zeros(
+                            (1, plan.num_shards * plan.padded), jnp.float32)
+                return {zero.ZERO_KEY: flat}
+
+            self._zero_fns["shard"] = jax.jit(shard, out_shardings=out_sh)
         return state.replace(
-            dense_slots=self._zero_fns["shard"](state.dense_slots))
+            dense_slots=self._zero_fns["shard"](
+                state.dense_slots, self._dense_trainable(state)))
 
     def dense_to_replicated(self, state: TrainState) -> TrainState:
         """The flat sharded dense_slots -> the baseline per-leaf form (no-op
@@ -325,8 +411,30 @@ class MeshTrainer(Trainer):
             self._zero_fns["unshard"] = jax.jit(
                 lambda fs: zero.unshard_slots(plan, fs),
                 out_shardings=NamedSharding(self.mesh, P()))
-        return state.replace(dense_slots=self._zero_fns["unshard"](
-            state.dense_slots[zero.ZERO_KEY]))
+        new_slots = self._zero_fns["unshard"](
+            state.dense_slots[zero.ZERO_KEY])
+        if not self.dense_wire:
+            return state.replace(dense_slots=new_slots)
+        # dense_wire: the replicated forward params carry the bf16-carrier
+        # all_gather's rounding — the external form must hold the fp32
+        # masters instead (exactly what a dense_wire-off run would hold, and
+        # what dense_to_sharded seeds the masters from on the way back in).
+        # The int8 error-feedback residual is dropped here and re-seeded to
+        # zeros on load: EF is a convergence aid, not model state.
+        if "master" not in self._zero_fns:
+            self._zero_fns["master"] = jax.jit(
+                lambda fm, tr: zero.unflatten_tree(plan, fm.reshape(-1), tr),
+                out_shardings=NamedSharding(self.mesh, P()))
+        new_trainable = self._zero_fns["master"](
+            state.dense_slots[zero.ZERO_KEY][zero.DENSE_MASTER_KEY],
+            self._dense_trainable(state))
+        split = getattr(self.model.module, "split_params", None)
+        if split is not None:
+            new_params = self.model.module.merge_params(
+                new_trainable, split(state.dense_params)[1])
+        else:
+            new_params = new_trainable
+        return state.replace(dense_slots=new_slots, dense_params=new_params)
 
     def externalize(self, state: TrainState) -> TrainState:
         """See Trainer.externalize: placement writeback + dense unshard."""
@@ -841,7 +949,13 @@ class MeshTrainer(Trainer):
         """The ZeRO-sharded dense apply (runs inside shard_map; see
         parallel/zero.py for the layout and the bit-exactness argument):
         reduce_scatter the un-psum'd grads, update this replica's 1/S chunk,
-        all_gather the new weights."""
+        all_gather the new weights. With `dense_wire` both collectives
+        quantize: the grads ride an a2a of in-band-encoded partials summed
+        per replica in fp32 (the round-13 two-stage hot-reduce shape — a
+        reduce_scatter that never ships fp32), the updated params all_gather
+        on the u16 bf16 carrier, and the chunk's fp32 masters (plus, for
+        int8, the full-length error-feedback residual) persist as two more
+        "__zero__" flat slots that externalize() drops."""
         if not self.zero_enabled:
             return super().dense_update(params, slots, grads)
         from ..utils import trace as _trace
@@ -850,6 +964,18 @@ class MeshTrainer(Trainer):
         if plan.total == 0:
             return super().dense_update(params, slots, grads)
         flat_slots = slots[zero.ZERO_KEY]
+        fmt = self.dense_wire
+        dcost = zero.dense_wire_cost(plan, fmt)
+        if self.last_wire_cost is not None:
+            # trace-time byte attribution for the dense collectives — the
+            # hlo-budget pass pins model == compiled HLO on these
+            cost = dict(self.last_wire_cost)
+            cost["dense_wire_format"] = dcost["format"]
+            cost["dense_a2a_bytes"] = dcost["a2a_bytes"]
+            cost["dense_reduce_scatter_bytes"] = dcost["rs_bytes"]
+            cost["dense_all_gather_bytes"] = dcost["ag_bytes"]
+            cost["dense_bytes_per_step"] = dcost["bytes_per_step"]
+            self.last_wire_cost = cost
         _metrics.observe("dense.params_total", float(plan.total), "gauge")
         _metrics.observe("dense.zero_shards", float(plan.num_shards), "gauge")
         _metrics.observe("dense.shard_elems", float(plan.chunk), "gauge")
@@ -857,29 +983,78 @@ class MeshTrainer(Trainer):
             "dense.opt_state_bytes_per_replica",
             float(len(plan.vector_slots) * plan.chunk * 4
                   + len(plan.scalar_slots) * 4), "gauge")
-        # both collectives move padded f32 elements (ring-equivalent halves
-        # of the baseline's all-reduce)
-        _metrics.observe("dense.reduce_scatter_bytes", float(plan.padded * 4),
+        # truthful per-collective bytes: fp32 moves padded f32 both ways
+        # (ring-equivalent halves of the baseline all-reduce); quantized
+        # formats zero the reduce_scatter — it compiles into the encoded a2a
+        _metrics.observe("dense.reduce_scatter_bytes",
+                         float(dcost["rs_bytes"]), "gauge")
+        _metrics.observe("dense.a2a_bytes", float(dcost["a2a_bytes"]),
                          "gauge")
-        _metrics.observe("dense.all_gather_bytes", float(plan.padded * 4),
+        _metrics.observe("dense.all_gather_bytes", float(dcost["ag_bytes"]),
                          "gauge")
-        with _trace.span("trainer", "dense_reduce_scatter",
-                         bytes=plan.padded * 4):
-            flat_g = zero.flatten_tree(plan, grads)
-            g_local = jax.lax.psum_scatter(flat_g, self.axis,
-                                           scatter_dimension=0, tiled=True)
-        with _trace.span("trainer", "dense_update", elems=plan.chunk):
-            flat_w = zero.flatten_tree(plan, params)
-            i = jax.lax.axis_index(self.axis)
-            w_local = jax.lax.dynamic_slice(flat_w, (i * plan.chunk,),
-                                            (plan.chunk,))
+        _metrics.observe("dense.wire_bytes_per_step",
+                         float(dcost["bytes_per_step"]), "gauge")
+        S, chunk = plan.num_shards, plan.chunk
+        new_ef = None
+        if not fmt:
+            with _trace.span("trainer", "dense_reduce_scatter",
+                             bytes=dcost["rs_bytes"]):
+                flat_g = zero.flatten_tree(plan, grads)
+                g_local = jax.lax.psum_scatter(flat_g, self.axis,
+                                               scatter_dimension=0,
+                                               tiled=True)
+        else:
+            with _trace.span("trainer", "dense_grad_exchange",
+                             bytes=dcost["a2a_bytes"]):
+                flat_g = zero.flatten_tree(plan, grads)
+                if fmt == "int8":
+                    flat_g = flat_g \
+                        + flat_slots[zero.DENSE_EF_KEY].reshape(-1)
+                enc = zero.encode_flat(flat_g, fmt)       # (padded/B, W)
+                if fmt == "int8":
+                    new_ef = (flat_g - zero.decode_flat(enc, fmt)) \
+                        .reshape(1, -1)
+                W = enc.shape[1]
+                recv = jax.lax.all_to_all(
+                    enc.reshape(S, enc.shape[0] // S, W), self.axis, 0, 0)
+                # two-stage reduce: every replica decodes ALL S sources'
+                # partials of its own chunk and sums them in fp32 — one
+                # lossy step per gradient, never a chain of S roundings
+                g_local = zero.decode_flat(recv.reshape(-1, W), fmt) \
+                    .reshape(S, chunk).sum(axis=0)
+        with _trace.span("trainer", "dense_update", elems=chunk):
+            if fmt:
+                # this replica's fp32 masters live in the flat slot — the
+                # replicated `params` only hold the rounded bf16 carrier
+                w_local = flat_slots[zero.DENSE_MASTER_KEY].reshape(-1)
+                opt_slots = {k: v for k, v in flat_slots.items()
+                             if k not in (zero.DENSE_MASTER_KEY,
+                                          zero.DENSE_EF_KEY)}
+            else:
+                flat_w = zero.flatten_tree(plan, params)
+                i = jax.lax.axis_index(self.axis)
+                w_local = jax.lax.dynamic_slice(flat_w, (i * chunk,),
+                                                (chunk,))
+                opt_slots = flat_slots
             new_w_local, new_flat_slots = self.optimizer.apply(
-                w_local.reshape(1, -1), flat_slots,
+                w_local.reshape(1, -1), opt_slots,
                 g_local.reshape(1, -1), jnp.ones((1,), jnp.int32))
-        with _trace.span("trainer", "dense_gather", bytes=plan.padded * 4):
-            flat_new = jax.lax.all_gather(new_w_local.reshape(-1), self.axis,
-                                          tiled=True)
+        with _trace.span("trainer", "dense_gather", bytes=dcost["ag_bytes"]):
+            w_flat = new_w_local.reshape(-1)
+            if fmt:
+                carrier = jax.lax.bitcast_convert_type(
+                    w_flat.astype(jnp.bfloat16), jnp.uint16)
+                gathered = jax.lax.all_gather(carrier, self.axis, tiled=True)
+                flat_new = jax.lax.bitcast_convert_type(
+                    gathered, jnp.bfloat16).astype(jnp.float32)
+            else:
+                flat_new = jax.lax.all_gather(w_flat, self.axis, tiled=True)
             new_params = zero.unflatten_tree(plan, flat_new, params)
+        if fmt:
+            new_flat_slots = dict(new_flat_slots)
+            new_flat_slots[zero.DENSE_MASTER_KEY] = new_w_local.reshape(1, -1)
+            if fmt == "int8":
+                new_flat_slots[zero.DENSE_EF_KEY] = new_ef
         return new_params, {zero.ZERO_KEY: new_flat_slots}
 
     def _reduce_loss(self, loss):
@@ -895,10 +1070,16 @@ class MeshTrainer(Trainer):
     # -- fused multi-table exchange ------------------------------------------
 
     def _exchange_groups(self, ps_specs):
-        """Dim-groups restricted to the tables actually pulled this step."""
-        return [[n for n in g if n in ps_specs]
-                for g in self.model.dim_groups()
-                if any(n in ps_specs for n in g)]
+        """Dim-groups restricted to the tables actually pulled this step,
+        then split by resolved per-table wire format: tables sharing
+        (dim, fmt) stay fused on one a2a pair, mixed-format dims ride
+        separate groups. Uniform-format configs split into exactly the
+        round-13 dim-groups — same grouping, byte-identical HLO."""
+        from .sharded import split_wire_groups
+        groups = [[n for n in g if n in ps_specs]
+                  for g in self.model.dim_groups()
+                  if any(n in ps_specs for n in g)]
+        return split_wire_groups(groups, self.wire_for)
 
     # oelint: hot-path device_get=0
     def tables_pull(self, tables, batch, ps_specs, packed):
@@ -921,7 +1102,8 @@ class MeshTrainer(Trainer):
                 new_states, outs, stats_list, plan_list = grouped_lookup_train(
                     specs, [tables[n] for n in names], ids_list,
                     axis=self.axis, capacity_factor=self.capacity_factor,
-                    wire=self.wire, load_stats=self.shard_stats)
+                    wire=self.wire_for(names[0]),
+                    load_stats=self.shard_stats)
                 for n, ts, out, st, pl in zip(names, new_states, outs,
                                               stats_list, plan_list):
                     pulled_tables[n], pulled[n], plans[n] = ts, out, pl
@@ -949,8 +1131,8 @@ class MeshTrainer(Trainer):
                 [row_grads[n] for n in names], axis=self.axis,
                 capacity_factor=self.capacity_factor,
                 plans=[plans[n] for n in names],
-                packed_list=[packed.get(n) for n in names], wire=self.wire,
-                hot_wire=self.hot_wire)
+                packed_list=[packed.get(n) for n in names],
+                wire=self.wire_for(names[0]), hot_wire=self.hot_wire)
             for n, ts, st in zip(names, states, stats_list):
                 new_tables[n] = ts
                 for k, v in st.items():
@@ -980,7 +1162,10 @@ class MeshTrainer(Trainer):
                 # at the protocol entry — so their wire slot is 8 B whatever
                 # the batch dtype; array tables ship the batch dtype as-is
                 "pair": spec.use_hash_table,
-                "id_itemsize": jnp.dtype(ids.dtype).itemsize})
+                "id_itemsize": jnp.dtype(ids.dtype).itemsize,
+                # the table's RESOLVED format: exchange_cost groups on
+                # (dim, fmt), mirroring _exchange_groups' split
+                "fmt": self.wire_for(name)})
             # per-table pull sizes, LABELED by table: the per-table skew
             # (Parallax: sparse behavior is dominated by it) reads straight
             # off /metrics as oetpu_exchange_pull_positions{table=...}
@@ -998,42 +1183,55 @@ class MeshTrainer(Trainer):
                                  labels={"table": name})
         # since round 13 BOTH exchange protocols put the resolved wire format
         # through the compiled a2as (in-band scales); the model prices the
-        # a2a RESULT buffers, the same thing oelint's hlo-budget counts
-        fmt = wire_mod.wire_format(self.wire)
+        # a2a RESULT buffers, the same thing oelint's hlo-budget counts.
+        # Per-table "fmt" keys make the model group on (dim, fmt) exactly
+        # like _exchange_groups does
+        fmt = self.wire_default()
         cost = wire_mod.exchange_cost(
             tables, self.num_shards, fmt, fused=self.group_exchange)
         self.last_wire_cost = cost
         _metrics.observe_exchange_cost(cost)
         for name in ps_specs:
-            _metrics.observe("exchange.wire_dtype",
-                             float(cost.get("wire_itemsize", 4)), "gauge",
-                             labels={"table": name})
+            # the table's RESOLVED row-payload itemsize — under mixed wire
+            # each table reports its own format, not one global value
+            _metrics.observe(
+                "exchange.wire_dtype",
+                float(jnp.dtype(wire_mod.wire_dtype(
+                    self.wire_for(name))).itemsize),
+                "gauge", labels={"table": name})
         # hot-cache static costs: cache size per table + the wire bytes of
         # the backward's dense hot reduce, priced by hot_reduce_cost for the
         # resolved hot format (ring allreduce for fp32/bf16, the two-stage
         # a2a+all_gather exchange for int8) — the cheap-collective price the
         # replicated hot set pays instead of riding the a2a (SparCML's
-        # dense-ified hot aggregate)
-        hot_fmt = (wire_mod.wire_format(self.hot_wire)
-                   if self.hot_wire is not None else fmt)
-        hot_tables = []
+        # dense-ified hot aggregate). hot_wire=None follows each TABLE's
+        # resolved format, so mixed wire prices hot tables per format too
+        hot_by_fmt: Dict[str, list] = {}
         for name, spec in ps_specs.items():
             H = self.hot_rows_for(name)
             if not H:
                 continue
             _metrics.observe("hot.rows", float(H), "gauge",
                              labels={"table": name})
-            hot_tables.append({"dim": spec.output_dim, "hot": H})
-        if hot_tables:
-            hcost = wire_mod.hot_reduce_cost(hot_tables, self.num_shards,
-                                             hot_fmt)
-            _metrics.observe("hot.replicate_bytes_per_step",
-                             float(hcost["bytes"]), "gauge")
+            hfmt = (wire_mod.wire_format(self.hot_wire)
+                    if self.hot_wire is not None else self.wire_for(name))
+            hot_by_fmt.setdefault(hfmt, []).append(
+                {"dim": spec.output_dim, "hot": H})
+        if hot_by_fmt:
+            tot = a2a = ag = 0
+            for hfmt, hot_tables in hot_by_fmt.items():
+                hcost = wire_mod.hot_reduce_cost(hot_tables, self.num_shards,
+                                                 hfmt)
+                tot += int(hcost["bytes"])
+                a2a += int(hcost["a2a_bytes"])
+                ag += int(hcost["all_gather_bytes"])
+            _metrics.observe("hot.replicate_bytes_per_step", float(tot),
+                             "gauge")
             cost = dict(cost)
-            cost["hot_replicate_bytes"] = int(hcost["bytes"])
-            cost["hot_a2a_bytes"] = int(hcost["a2a_bytes"])
-            cost["hot_all_gather_bytes"] = int(hcost["all_gather_bytes"])
-            cost["hot_wire_format"] = hcost["format"]
+            cost["hot_replicate_bytes"] = tot
+            cost["hot_a2a_bytes"] = a2a
+            cost["hot_all_gather_bytes"] = ag
+            cost["hot_wire_format"] = ",".join(sorted(hot_by_fmt))
             self.last_wire_cost = cost
 
     # packed scan layout: the base `_packed_layouts` gate applies per shard
@@ -1048,19 +1246,19 @@ class MeshTrainer(Trainer):
         return sharded_apply_gradients(
             spec, table, self.opt_for(spec), ids, grads, axis=self.axis,
             capacity_factor=self.capacity_factor, plan=plan, packed=layout,
-            wire=self.wire, hot_wire=self.hot_wire)
+            wire=self.wire_for(spec.name), hot_wire=self.hot_wire)
 
     def table_pull(self, spec, table, ids):
         return sharded_lookup_train(
             spec, table, ids, axis=self.axis,
             capacity_factor=self.capacity_factor,
-            load_stats=self.shard_stats, wire=self.wire)
+            load_stats=self.shard_stats, wire=self.wire_for(spec.name))
 
     def table_apply(self, spec, table, ids, grads, plan=None):
         return sharded_apply_gradients(
             spec, table, self.opt_for(spec), ids, grads, axis=self.axis,
             capacity_factor=self.capacity_factor, plan=plan,
-            wire=self.wire, hot_wire=self.hot_wire)
+            wire=self.wire_for(spec.name), hot_wire=self.hot_wire)
 
     def table_lookup(self, spec, table, ids):
         return sharded_lookup(spec, table, ids, axis=self.axis,

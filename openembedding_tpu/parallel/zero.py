@@ -43,7 +43,7 @@ equal to a ZeRO-off run's.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -52,6 +52,22 @@ from ..optimizers import SparseOptimizer
 
 # reserved key marking a dense_slots pytree as the flat sharded form
 ZERO_KEY = "__zero__"
+
+# Reserved slot names INSIDE the ZERO_KEY dict for the quantized dense wire
+# (round 17, `MeshTrainer(dense_wire=...)`):
+# - DENSE_EF_KEY: this replica's error-feedback residual over the FULL
+#   padded vector — what its int8 grad encode failed to ship last step
+#   (global (1, S*padded) sharded P(None, axis): each replica's local block
+#   is its own full-length residual, true dist-EF-SGD semantics);
+# - DENSE_MASTER_KEY: the fp32 master weights of this replica's chunk
+#   (global (1, padded) sharded P(None, axis) -> local (1, chunk)) — the
+#   replicated forward params carry the bf16-carrier all_gather's rounding,
+#   the chunk's optimizer math never does.
+# Both are INTERNAL: `unshard_slots` iterates plan slot names only, so the
+# external (replicated) form never carries them — checkpoints keep the
+# dense_wire-off schema and stay cross-compatible.
+DENSE_EF_KEY = "__dense_ef__"
+DENSE_MASTER_KEY = "__dense_master__"
 
 
 def is_sharded_slots(slots) -> bool:
@@ -77,7 +93,10 @@ class DenseShardPlan:
 
 
 def build_plan(params, optimizer: SparseOptimizer,
-               num_shards: int) -> DenseShardPlan:
+               num_shards: int, *, align: int = 1) -> DenseShardPlan:
+    """`align` rounds the chunk up to a multiple (dense_wire passes
+    `ops.wire.INBAND_BLOCK` so every chunk splits into whole codec blocks);
+    the extra padding lanes are zero like the base padding — inert."""
     leaves, treedef = jax.tree_util.tree_flatten(params)
     for leaf in leaves:
         if jnp.dtype(leaf.dtype).itemsize > 4:
@@ -93,6 +112,8 @@ def build_plan(params, optimizer: SparseOptimizer,
     total = off
     S = max(1, int(num_shards))
     chunk = -(-total // S) if total else 0
+    if align > 1 and chunk:
+        chunk = -(-chunk // align) * align
     # width classification via a probe dim that cannot collide with 1
     widths = optimizer.slot_shapes(2)
     vector = tuple(k for k, w in widths.items() if w != 1)
@@ -163,6 +184,52 @@ def unshard_slots(plan: DenseShardPlan, flat_slots: Dict[str, jax.Array]):
             d[name] = flat_slots[name].reshape(1, 1)
         out.append(d)
     return jax.tree_util.tree_unflatten(plan.treedef, out)
+
+
+def encode_flat(flat: jax.Array, fmt: str) -> jax.Array:
+    """(n,) f32 (n a multiple of `ops.wire.INBAND_BLOCK`) -> the round-13
+    in-band wire encoding, one codec block per INBAND_BLOCK elements:
+    (n/B, W) in the carrier dtype (s8 payload + bitcast scale lanes for
+    int8, u16 bitcast for bf16). Round-to-nearest — the dense int8 path
+    carries an error-feedback residual instead of stochastic rounding."""
+    from ..ops import wire
+    return wire.pack_inband(flat.reshape(-1, wire.INBAND_BLOCK), fmt)
+
+
+def decode_flat(enc: jax.Array, fmt: str) -> jax.Array:
+    """Inverse of encode_flat -> (n,) f32."""
+    from ..ops import wire
+    return wire.unpack_inband(enc, wire.INBAND_BLOCK, fmt).reshape(-1)
+
+
+def dense_wire_cost(plan: DenseShardPlan, fmt: Optional[str]) -> dict:
+    """Static per-device collective bytes of one dense update, per dense
+    wire format — the dense counterpart of `ops.wire.exchange_cost`, priced
+    off the same RESULT buffers the oelint hlo-budget counters read:
+
+    - fmt None/'fp32': reduce_scatter + all_gather of the padded f32 vector
+      (the round-14 plan; `rs_bytes`/`ag_bytes` are those result buffers);
+    - 'int8'/'bf16': the two-stage quantized reduce — an all_to_all whose
+      (S, R/S, W) result buffer re-assembles every source's encoding of
+      this replica's chunk (R = padded/INBAND_BLOCK codec blocks, W the
+      in-band wire width) — plus a u16-carrier all_gather of the updated
+      params (`a2a_bytes`/`ag_bytes`).
+    """
+    from ..ops import wire
+    S, padded = plan.num_shards, plan.padded
+    if S <= 1 or padded == 0:
+        return {"format": fmt or "fp32", "rs_bytes": 0, "a2a_bytes": 0,
+                "ag_bytes": 0, "bytes_per_step": 0}
+    if not fmt or fmt == "fp32":
+        rs = ag = padded * 4
+        return {"format": "fp32", "rs_bytes": rs, "a2a_bytes": 0,
+                "ag_bytes": ag, "bytes_per_step": rs + ag}
+    blocks = padded // wire.INBAND_BLOCK
+    w = jnp.dtype(wire.wire_carrier_dtype(fmt)).itemsize
+    a2a = blocks * wire.rows_wire_width(wire.INBAND_BLOCK, fmt) * w
+    ag = padded * 2  # updated params ship on the u16 bf16 carrier
+    return {"format": fmt, "rs_bytes": 0, "a2a_bytes": int(a2a),
+            "ag_bytes": int(ag), "bytes_per_step": int(a2a + ag)}
 
 
 def check_scalar_slots_equal(plan: DenseShardPlan, slots_tree) -> None:

@@ -73,11 +73,18 @@ class PlacementController:
     """
 
     def __init__(self, trainer, policy: PlacementPolicy, *,
-                 monitor=None, interval_steps: int = 50):
+                 monitor=None, interval_steps: int = 50,
+                 manage_wire: bool = False):
         self.trainer = trainer
         self.policy = policy
         self._monitor = monitor
         self.interval_steps = int(interval_steps)
+        # opt-in: let the controller also set per-table wire precision
+        # (policy.recommend_wire -> MeshTrainer(wire={...})). Off by default
+        # because a format change is a re-jit, not a content swap.
+        self.manage_wire = bool(manage_wire)
+        self._wire_active: Dict[str, str] = {}
+        self._wire_rejits = 0
         self._lock = threading.Lock()
         # guarded-by: self._lock
         self._pending: Optional[PlacementDecision] = None
@@ -161,6 +168,10 @@ class PlacementController:
         Returns the state with hot caches + migration directories
         attached."""
         tel = self.telemetry()
+        if self.manage_wire:
+            # set the formats BEFORE the sizing re-jit below so enabling
+            # wire management at prime time costs zero extra compiles
+            state = self.apply_wire(state, self.policy.recommend_wire(tel))
         sizes = self.policy.size_hot(tel)
         hot_rows = {n: int(h) for n, h in sizes.items() if h > 0}
         mig_rows = {n: self.policy.mig_rows for n in self._managed_tables()}
@@ -314,6 +325,61 @@ class PlacementController:
         return PlacementDecision(tables=tables, refresh=refresh,
                                  migrate=migrate, reason=" | ".join(reasons))
 
+    # -- wire precision ------------------------------------------------------
+
+    def apply_wire(self, state, rec: Dict[str, str]):
+        """Install a per-table wire recommendation (`policy.recommend_wire`).
+        The content path: when every table's RESOLVED format already matches
+        the recommendation this is a pure no-op — no re-jit, nothing dropped
+        — which is the steady state once the traffic shape stabilizes. A
+        real format change swaps `trainer.wire` to a per-table dict, attaches
+        or drops the int8 error-feedback residuals to match (zeros-reset is
+        safe: EF is a convergence aid, not model state), and drops the
+        compiled step — ONE re-jit, counted in `placement.wire_rejits`."""
+        tr = self.trainer
+        if tr.num_shards <= 1:
+            return state
+        managed = self._managed_tables()
+        rec = {n: f for n, f in rec.items() if n in managed}
+        if all(tr.wire_for(n) == f for n, f in rec.items()):
+            with self._lock:
+                self._wire_active = {n: tr.wire_for(n) for n in managed}
+            return state
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        new_wire = dict(rec)
+        new_wire["*"] = tr.wire_default()
+        tr.wire = new_wire
+        tables = dict(state.tables)
+        for n, spec in managed.items():
+            ts = tables.get(n)
+            if ts is None:
+                continue
+            need = tr.ef_for(n)
+            if need and ts.ef is None:
+                tables[n] = ts.replace(ef=jax.device_put(
+                    jnp.zeros((ts.weights.shape[0], spec.output_dim),
+                              jnp.float32),
+                    NamedSharding(tr.mesh, P(tr.axis))))
+            elif not need and ts.ef is not None:
+                tables[n] = ts.replace(ef=None)
+        state = state.replace(tables=tables)
+        # formats are trace-time statics: drop the compiled artifacts so the
+        # next jit builds the new (dim, fmt) grouping
+        tr._train_step_fn = None
+        tr._eval_step_fn = None
+        tr._train_many_fn = None
+        tr._hot_fns = {}
+        tr._mig_fns = {}
+        with self._lock:
+            self._wire_rejits += 1
+            self._wire_active = {n: tr.wire_for(n) for n in managed}
+        _metrics.observe("placement.wire_rejits", 1)
+        _trace.event("placement", "wire", step=self._step,
+                     formats=dict(sorted(self._wire_active.items())))
+        return state
+
     # -- apply ---------------------------------------------------------------
 
     def apply(self, state, decision: PlacementDecision):
@@ -363,7 +429,11 @@ class PlacementController:
                     self._step % self.interval_steps != 0:
                 return state
             pending = self.decide(state)
-        return self.apply(state, pending)
+        state = self.apply(state, pending)
+        if self.manage_wire:
+            state = self.apply_wire(
+                state, self.policy.recommend_wire(self.telemetry()))
+        return state
 
     # -- background watcher --------------------------------------------------
 
@@ -417,6 +487,9 @@ class PlacementController:
                 "migrated_rows": dict(self._migrated_rows),
                 "decisions": self._decisions,
                 "imbalance_target": self.policy.imbalance_target,
+                "manage_wire": self.manage_wire,
+                "wire_formats": dict(self._wire_active),
+                "wire_rejits": self._wire_rejits,
             }
 
     def render_text(self) -> str:
@@ -424,7 +497,9 @@ class PlacementController:
         lines = [f"controller: step={st['step']} primed={st['primed']} "
                  f"decisions={st['decisions']} "
                  f"budget={st['hot_budget_bytes']}B "
-                 f"imbalance_target={st['imbalance_target']}"]
+                 f"imbalance_target={st['imbalance_target']}"
+                 + (f" manage_wire=on wire_rejits={st['wire_rejits']}"
+                    if st["manage_wire"] else "")]
         import re
         rep = _metrics.report()
         for name in sorted(self._managed_tables()):
@@ -432,6 +507,9 @@ class PlacementController:
             imb = rep.get(f'exchange.shard_imbalance{{table="{name}"}}')
             hit = rep.get(f'hot.hit_ratio{{table="{name}"}}')
             parts = [f"table {name}: hot_rows={h}"]
+            if self.trainer.num_shards > 1:
+                # active per-table wire format (resolved, not the raw knob)
+                parts.append(f"wire={self.trainer.wire_for(name)}")
             if st["predicted_hit"].get(name) is not None:
                 parts.append(
                     f"predicted_hit={st['predicted_hit'][name]:.3f}")

@@ -204,6 +204,47 @@ class PlacementPolicy:
                           f"{self.refresh_min_gain}"), gain
         return False, f"predicted gain {gain:.3f} below threshold", gain
 
+    # -- wire-precision recommendation ---------------------------------------
+
+    # knobs for `recommend_wire` (class-level so skew_report's dry run and
+    # the controller agree by construction):
+    # a table whose rows are at most this wide ships fp32 — the id lanes
+    # dominate its wire bytes and int8's scale lanes would WIDEN dim-1 rows
+    wire_fp32_max_dim = 4
+    # int8 needs real skew (EF residuals converge on revisited rows) and
+    # enough row width to amortize the in-band scale lanes
+    wire_int8_min_dim = 8
+    wire_int8_min_share = 0.5          # top-`wire_int8_top_k` traffic share
+    wire_int8_top_k = 1024
+
+    def recommend_wire(self, tables: Sequence[TableTelemetry]) \
+            -> Dict[str, str]:
+        """Per-table wire format off the measured coverage curves — the
+        precision dimension of the placement budget (feeds
+        `MeshTrainer(wire={...})` via the controller, or prints from
+        `skew_report --recommend`):
+
+        - dim <= `wire_fp32_max_dim`: "fp32" — tiny rows are id-lane bound,
+          quantizing them buys nothing and costs scale lanes;
+        - dim >= `wire_int8_min_dim` AND the top-1024 ids carry >=
+          `wire_int8_min_share` of traffic: "int8" — wide rows under heavy
+          skew are exactly where 4x compression + error feedback holds AUC
+          (PERF.md round 13);
+        - otherwise "bf16" — the unbiased 2x default for flat-traffic or
+          unmeasured tables.
+        """
+        out: Dict[str, str] = {}
+        for t in tables:
+            if t.dim <= self.wire_fp32_max_dim:
+                out[t.name] = "fp32"
+            elif (t.dim >= self.wire_int8_min_dim
+                  and t.share_at(self.wire_int8_top_k)
+                  >= self.wire_int8_min_share):
+                out[t.name] = "int8"
+            else:
+                out[t.name] = "bf16"
+        return out
+
     # -- cold-tail migration gate --------------------------------------------
 
     def migration_due(self, t: TableTelemetry) -> Tuple[bool, str]:

@@ -176,3 +176,46 @@ def test_mesh_trainer_int8_ef_wire_parity(heldout):
     a_int8 = run("int8")
     # measured on the CPU suite: see the platform note above `_margin`
     assert abs(a_int8 - a_fp32) < _margin(0.01), (a_int8, a_fp32)
+
+
+@pytest.mark.slow  # two ~1 min training runs; rides the full (`-m ''`) battery
+def test_mesh_trainer_dense_wire_int8_parity(heldout):
+    """Round-17 acceptance: quantizing the dense ZeRO collectives
+    (`dense_wire="int8"`: in-band two-stage grad reduce + bf16-carrier param
+    all_gather, per-chunk EF + fp32 masters) trains to AUC parity with the
+    lossless round-14 path on the same data. Both runs also quantize the
+    sparse exchange so the delta isolates the DENSE wire. Same reduced-epoch
+    rationale as the sibling test above: parity is a difference of two runs
+    on identical batches."""
+    from openembedding_tpu.parallel import MeshTrainer, make_mesh
+
+    batches_h, labels, _ = heldout
+    epochs = 4
+
+    def run(dense_wire):
+        trainer = MeshTrainer(
+            make_wdl(vocabulary=VOCAB, dim=8, hidden=(64, 32)),
+            embed.Adam(learning_rate=0.02), mesh=make_mesh(), wire="int8",
+            dense_shard=True, dense_wire=dense_wire)
+        state = None
+        many = None
+        for epoch in range(epochs):
+            batches = list(planted_criteo(BATCH, steps=STEPS_PER_EPOCH,
+                                          seed=epoch))
+            stacked = jax.tree_util.tree_map(lambda *xs: np.stack(xs),
+                                             *batches)
+            if state is None:
+                state = trainer.init(batches[0])
+                many = trainer.jit_train_many(stacked, state)
+            state, m = many(state, stacked)
+        assert np.isfinite(np.asarray(m["loss"])).all()
+        ev = trainer.jit_eval_step(batches_h[0], state)
+        scores = np.concatenate(
+            [np.asarray(ev(state, b)["logits"]).reshape(-1)
+             for b in batches_h])
+        return auc(labels, scores)
+
+    a_lossless = run(None)
+    a_q = run("int8")
+    # measured on the CPU suite: see the platform note above `_margin`
+    assert abs(a_q - a_lossless) < _margin(0.01), (a_q, a_lossless)

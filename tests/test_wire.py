@@ -370,3 +370,109 @@ def test_grouped_pair_wire_x64_off():
             return losses
 
         np.testing.assert_array_equal(run(True), run(False))
+
+
+# ---------------------------------------------------------------------------
+# round 17: per-table wire (dim-groups split on (dim, fmt))
+# ---------------------------------------------------------------------------
+
+
+def test_mixed_wire_splits_dim_groups_and_pins_a2a_count():
+    """Per-table wire: `wire={table: fmt}` resolves once at trace time and
+    the fused exchange keys its groups on (dim, fmt) — {a: int8, *: fp32}
+    splits the dim-8 {a, b} group in two (3 groups -> 9 a2as) with both s8
+    and f32 payload lanes in the compiled HLO, while a format-uniform dict
+    is an identity split that compiles the round-13 program unchanged
+    (6 a2as, same bytes as the plain-string config)."""
+    import re
+
+    def compile_txt(wire_cfg):
+        rng = np.random.default_rng(6)
+        tr = MeshTrainer(_three_table_model(),
+                         embed.Adagrad(learning_rate=0.05), mesh=make_mesh(),
+                         wire=wire_cfg, group_exchange=True)
+        b = _batch(rng)
+        state = tr.init(b)
+        step = tr.jit_train_step(b, state)
+        return step.lower(state, b).compile().as_text()
+
+    def a2a_count(txt):
+        return len(re.findall(r" all-to-all(?:-start)?\(", txt))
+
+    def a2a_dtypes(txt):
+        # result types on the definition head (tuple results list each
+        # tensor), same parse the oelint hlo-budget pass pins bytes with
+        out = set()
+        for line in txt.splitlines():
+            m = re.search(r" all-to-all(?:-start)?\(", line)
+            if m:
+                out |= {d for d in re.findall(
+                    r"(pred|bf16|f32|s8|u8|s16|u16|s32|u32|s64|u64)\[",
+                    line[:m.start()])}
+        return out
+
+    mixed = compile_txt({"a": "int8", "*": "fp32"})
+    assert a2a_count(mixed) == 9, "mixed formats: expected 3 a2a groups"
+    assert {"s8", "f32"} <= a2a_dtypes(mixed)
+    uniform = compile_txt({"*": "fp32"})
+    baseline = compile_txt("fp32")
+    assert a2a_count(uniform) == 6
+    assert a2a_count(baseline) == 6
+    assert a2a_dtypes(uniform) == a2a_dtypes(baseline)
+
+
+def test_mixed_wire_counts_lanes_bit_exact_and_gauges_truthful():
+    """Mixed formats split a dim-group's payload wire but never the id side:
+    under {a: int8, *: fp32} every count-lane-derived stat (dedup counts,
+    bucket fill, shard loads, overflow) is BIT-identical to the all-fp32
+    run, and the fp32-wired tables move only through the second-order logit
+    shift a's quantized rows cause (~1e-8), orders of magnitude below a's
+    own quantization error. The per-table `exchange.wire_dtype{table=}`
+    gauges report the mixed wire truthfully."""
+    from openembedding_tpu.utils import metrics as M
+
+    rng = np.random.default_rng(7)
+    batches = [_batch(rng) for _ in range(3)]
+
+    def run(wire_cfg):
+        M._REGISTRY.clear()
+        tr = MeshTrainer(_three_table_model(),
+                         embed.Adagrad(learning_rate=0.1), mesh=make_mesh(),
+                         wire=wire_cfg, group_exchange=True)
+        state = tr.init(batches[0])
+        step = tr.jit_train_step(batches[0], state)
+        stats = []
+        for b in batches:
+            state, m = step(state, b)
+            stats.append({k: np.asarray(v) for k, v in m["stats"].items()})
+        return _probe_tables(tr, state, batches), stats, M.report()
+
+    exact, st_exact, _ = run("fp32")
+    mixed, st_mixed, rep = run({"a": "int8", "*": "fp32"})
+    # id/count lanes: every stat the exchange derives from ids is bitwise
+    # unchanged by the payload-format split
+    for se, sm in zip(st_exact, st_mixed):
+        assert sorted(se) == sorted(sm)
+        for k in se:
+            np.testing.assert_array_equal(se[k], sm[k], err_msg=k)
+    # a rides int8 (s8 lanes pinned in the HLO test above) within format
+    # tolerance; the fp32-wired tables see no quantizer at all — their
+    # drift is only the second-order logit shift from a's quantized rows
+    np.testing.assert_allclose(mixed["a"], exact["a"], rtol=0.06, atol=0.06)
+    d_rest = max(np.abs(mixed["b"] - exact["b"]).max(),
+                 np.abs(mixed["w"] - exact["w"]).max())
+    assert d_rest < 1e-6, d_rest
+    assert rep['exchange.wire_dtype{table="a"}'] == 1.0   # s8 itemsize
+    assert rep['exchange.wire_dtype{table="b"}'] == 4.0   # f32 itemsize
+    assert rep['exchange.wire_dtype{table="w"}'] == 4.0
+
+
+def test_wire_dict_validation():
+    """Unknown table names and bogus formats fail at construction, not at
+    trace time three layers deep."""
+    with pytest.raises(ValueError, match="unknown tables"):
+        MeshTrainer(_three_table_model(), embed.Adagrad(learning_rate=0.1),
+                    mesh=make_mesh(), wire={"nope": "int8"})
+    with pytest.raises(ValueError):
+        MeshTrainer(_three_table_model(), embed.Adagrad(learning_rate=0.1),
+                    mesh=make_mesh(), wire={"a": "int7"})

@@ -160,12 +160,13 @@ def test_zero_single_shard_is_noop():
 # ---------------------------------------------------------------------------
 
 
-def _run_training(tmp_path, tag, *, dense_shard):
+def _run_training(tmp_path, tag, *, dense_shard, dense_wire=None):
     from openembedding_tpu.export import export_standalone
     from openembedding_tpu.persist import IncrementalPersister, PersistPolicy
     batches = _batches(6, seed=7)
     tr = MeshTrainer(_model(), embed.Adam(learning_rate=0.01),
-                     mesh=make_mesh(), wire="fp32", dense_shard=dense_shard)
+                     mesh=make_mesh(), wire="fp32", dense_shard=dense_shard,
+                     dense_wire=dense_wire)
     state = tr.init(batches[0])
     step = tr.jit_train_step(batches[0], state)
     root = tmp_path / tag
@@ -304,3 +305,167 @@ def test_zero_rejects_wide_dtypes():
     params = {"a": jnp.zeros((3,), jnp.float64)}
     with pytest.raises(ValueError, match="f32|float64|4-byte"):
         zero.build_plan(params, embed.Adagrad(learning_rate=0.1), 4)
+
+
+# ---------------------------------------------------------------------------
+# round 17: quantized dense collectives (dense_wire)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("fmt", ["bf16", "int8"])
+def test_dense_wire_trains_close_to_fp32(fmt):
+    """`dense_wire` swaps the fp32 psum_scatter for the in-band-encoded
+    two-stage reduce (encode -> a2a partials -> per-replica fp32 sum) and
+    ships the param all_gather on the bf16 carrier, one lossy step per
+    gradient. The ZeRO plan aligns chunks to the codec block, int8 carries
+    fp32 masters + per-chunk EF residuals as extra `__zero__` slots, and N
+    steps stay within format tolerance of the lossless round-14 path."""
+    from openembedding_tpu.ops import wire as wire_mod
+
+    def run(dense_wire):
+        batches = _batches(4, seed=3)
+        tr = MeshTrainer(_model(), embed.Adagrad(learning_rate=0.1),
+                         mesh=make_mesh(), wire="fp32", dense_shard=True,
+                         dense_wire=dense_wire)
+        state = tr.init(batches[0])
+        step = tr.jit_train_step(batches[0], state)
+        losses = []
+        for b in batches:
+            state, m = step(state, b)
+            losses.append(float(m["loss"]))
+        return tr, state, losses
+
+    tr_f, st_f, l_f = run(None)
+    tr_q, st_q, l_q = run(fmt)
+    plan = tr_q._zero_plan
+    assert plan.chunk % wire_mod.INBAND_BLOCK == 0
+    flat = st_q.dense_slots[zero.ZERO_KEY]
+    assert zero.DENSE_MASTER_KEY in flat
+    assert (zero.DENSE_EF_KEY in flat) == (fmt == "int8")
+    assert np.all(np.isfinite(l_q))
+    np.testing.assert_allclose(l_q, l_f, rtol=0.02, atol=0.02)
+    # externalize folds the masters back and drops the wire-only slots:
+    # same tree schema as the lossless run, params within tolerance
+    ext_f = tr_f.externalize(st_f)
+    ext_q = tr_q.externalize(st_q)
+    assert (jax.tree_util.tree_structure(ext_q.dense_slots)
+            == jax.tree_util.tree_structure(ext_f.dense_slots))
+    assert (jax.tree_util.tree_structure(ext_q.dense_params)
+            == jax.tree_util.tree_structure(ext_f.dense_params))
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                                rtol=0.05, atol=0.05),
+        ext_q.dense_params, ext_f.dense_params)
+    # gauges: the quantized path reports a2a bytes, not a reduce_scatter
+    rep = metrics.report()
+    assert rep["dense.a2a_bytes"] > 0
+    assert rep["dense.reduce_scatter_bytes"] == 0
+    assert rep["dense.wire_bytes_per_step"] > 0
+
+
+def test_dense_wire_checkpoint_cross_compatible(tmp_path):
+    """The serialized form stays ONE layout (replicated fp32 — masters
+    folded into dense_params, EF wire residuals dropped/reseeded): a dump
+    saved under any of {replicated, ZeRO, ZeRO-bf16, ZeRO-int8} loads into
+    any other, the loaded external state is bitwise the saved one, and
+    training continues finite."""
+    batches = _batches(3, seed=13)
+    configs = {
+        "replicated": {},
+        "zero": {"dense_shard": True},
+        "zero_bf16": {"dense_shard": True, "dense_wire": "bf16"},
+        "zero_int8": {"dense_shard": True, "dense_wire": "int8"},
+    }
+
+    def make(cfg):
+        return MeshTrainer(_model(), embed.Adagrad(learning_rate=0.1),
+                           mesh=make_mesh(), wire="fp32", **configs[cfg])
+
+    saved = {}
+    for cfg in ("replicated", "zero_int8"):
+        tr = make(cfg)
+        state = tr.init(batches[0])
+        step = tr.jit_train_step(batches[0], state)
+        for b in batches[:2]:
+            state, _ = step(state, b)
+        path = str(tmp_path / cfg)
+        tr.save(state, path, model_sign="x")
+        saved[cfg] = (path, tr.externalize(state))
+
+    for src, (path, ext_src) in saved.items():
+        for dst in configs:
+            tr2 = make(dst)
+            st2 = tr2.init(batches[0])
+            st2 = tr2.load(st2, path)
+            if dst != "replicated":
+                assert zero.is_sharded_slots(st2.dense_slots)
+                flat = st2.dense_slots[zero.ZERO_KEY]
+                assert ((zero.DENSE_MASTER_KEY in flat)
+                        == bool(configs[dst].get("dense_wire")))
+            ext2 = tr2.externalize(st2)
+            _trees_bitwise_equal(ext_src.dense_params, ext2.dense_params)
+            _trees_bitwise_equal(ext_src.dense_slots, ext2.dense_slots)
+            step2 = tr2.jit_train_step(batches[0], st2)
+            st2, m = step2(st2, batches[2])
+            assert np.isfinite(float(m["loss"])), (src, dst)
+
+
+def test_dense_wire_artifacts_schema_oblivious_and_reload(tmp_path):
+    """A dense_wire="int8" run writes artifacts — sharded checkpoint,
+    standalone export, incremental sync deltas — with EXACTLY the file set
+    and array schema of a replicated fp32 control run (masters fold into
+    dense_params; `__dense_ef__`/`__dense_master__` never leak to disk),
+    and its checkpoint reloads into a fresh dense_wire trainer which keeps
+    training."""
+    l_q = _run_training(tmp_path, "q", dense_shard=True, dense_wire="int8")
+    _run_training(tmp_path, "c", dense_shard=False)
+    assert np.all(np.isfinite(l_q))
+
+    def listing(root):
+        out = {}
+        for r, _dirs, files in os.walk(root):
+            for fn in files:
+                p = os.path.join(r, fn)
+                out[os.path.relpath(p, root)] = p
+        return out
+
+    q, c = listing(tmp_path / "q"), listing(tmp_path / "c")
+    assert sorted(q) == sorted(c)
+    checked = 0
+    for rel, p in q.items():
+        if not rel.endswith(".npz"):
+            continue
+        a, b = np.load(p), np.load(c[rel])
+        assert sorted(a.files) == sorted(b.files), rel
+        for k in a.files:
+            assert "__dense_ef__" not in k and "__dense_master__" not in k, k
+            assert a[k].shape == b[k].shape and a[k].dtype == b[k].dtype, \
+                (rel, k)
+        checked += 1
+    assert checked > 0
+
+    tr = MeshTrainer(_model(), embed.Adam(learning_rate=0.01),
+                     mesh=make_mesh(), wire="fp32", dense_shard=True,
+                     dense_wire="int8")
+    batches = _batches(2, seed=7)
+    st = tr.init(batches[0])
+    st = tr.load(st, str(tmp_path / "q" / "ckpt"))
+    flat = st.dense_slots[zero.ZERO_KEY]
+    assert zero.DENSE_MASTER_KEY in flat and zero.DENSE_EF_KEY in flat
+    step = tr.jit_train_step(batches[0], st)
+    st, m = step(st, batches[1])
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_dense_wire_validation():
+    """Config errors fail at construction: dense_wire needs dense_shard,
+    unknown formats are rejected, and "fp32"/"none" mean OFF."""
+    with pytest.raises(ValueError, match="dense_shard"):
+        MeshTrainer(_model(), embed.Adagrad(learning_rate=0.1),
+                    mesh=make_mesh(), dense_wire="int8")
+    with pytest.raises(ValueError, match="dense_wire"):
+        MeshTrainer(_model(), embed.Adagrad(learning_rate=0.1),
+                    mesh=make_mesh(), dense_shard=True, dense_wire="int4")
+    tr = MeshTrainer(_model(), embed.Adagrad(learning_rate=0.1),
+                     mesh=make_mesh(), dense_shard=True, dense_wire="fp32")
+    assert tr.dense_wire is None

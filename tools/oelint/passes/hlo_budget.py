@@ -111,6 +111,25 @@ CONFIGS = (
     # every sentinel-off config above stays byte-identical, delta 0).
     {"name": "fused_fp32_sentinel", "group_exchange": True, "wire": "fp32",
      "hot_rows": 0, "sentinel": True},
+    # round-17 per-table wire: the one dim-8 group splits on (dim, fmt) into
+    # TWO fused a2a groups (6 a2as, not 3) and the compiled payloads must
+    # carry BOTH formats — `require_a2a_dtypes` fails the lint when either
+    # side silently falls back (f32 gone = table "a" got quantized, s8 gone
+    # = table "b" fell back to fp32), budget-independently.
+    {"name": "fused_mixed_wire", "group_exchange": True,
+     "wire": {"a": "fp32", "b": "int8"}, "hot_rows": 0,
+     "require_a2a_dtypes": ("f32", "s8")},
+    # round-17 quantized dense ZeRO collectives: dense_wire="int8" replaces
+    # the fp32 reduce-scatter with an s8 in-band a2a + per-replica fp32 sum
+    # and ships the params all_gather on the u16 bf16 carrier. `pins` holds
+    # hlo_reduce_scatter_bytes at EXACTLY 0 budget-independently (a silent
+    # fall-back to the fp32 reduce_scatter fails `make lint` even straight
+    # after --update-budget), and the s8 requirement pins the encoded grad
+    # a2a itself.
+    {"name": "fused_fp32_zero_int8", "group_exchange": True, "wire": "fp32",
+     "hot_rows": 0, "dense_shard": True, "dense_wire": "int8",
+     "require_a2a_dtypes": ("s8",),
+     "pins": {"hlo_reduce_scatter_bytes": 0}},
 )
 
 
@@ -238,12 +257,16 @@ def make_trainer(config: Dict):
     from openembedding_tpu.parallel import MeshTrainer, make_mesh
 
     model, batch = _budget_model()
+    wire = config["wire"]
+    if isinstance(wire, dict):
+        wire = dict(wire)  # MeshTrainer keeps the per-table dict as-is
     trainer = MeshTrainer(
         model, embed.Adagrad(learning_rate=0.1), mesh=make_mesh(),
-        wire=config["wire"], group_exchange=config["group_exchange"],
+        wire=wire, group_exchange=config["group_exchange"],
         hot_rows=config["hot_rows"], mig_rows=config.get("mig_rows", 0),
         hot_wire=config.get("hot_wire"),
         dense_shard=config.get("dense_shard", False),
+        dense_wire=config.get("dense_wire"),
         sentinel=config.get("sentinel", False))
     return trainer, batch
 
@@ -271,7 +294,8 @@ def measure_trainer(trainer, batch) -> Dict[str, int]:
     counts["hlo_reduce_scatter_bytes"] = sum(b for _, b in rs)
     counts["hlo_a2a_dtypes"] = ",".join(sorted({d for d, _ in a2a}))
     model_a2a = (int(cost.get("bytes_per_step", 0))
-                 + int(cost.get("hot_a2a_bytes", 0)))
+                 + int(cost.get("hot_a2a_bytes", 0))
+                 + int(cost.get("dense_a2a_bytes", 0)))
     counts["wire_model_delta"] = counts["hlo_a2a_bytes"] - model_a2a
     # GSPMD-inserted collectives (no traced-op attribution). The count is a
     # pinned budget key (0 everywhere); the "_"-prefixed detail is carried
@@ -450,6 +474,56 @@ def forbidden_dtype_findings(measured: Dict[str, Dict],
     return out
 
 
+def required_dtype_findings(measured: Dict[str, Dict],
+                            configs=CONFIGS) -> List[Finding]:
+    """Budget-independent inverse of `forbidden_dtype_findings`: configs
+    declaring `require_a2a_dtypes` fail when any required payload dtype is
+    MISSING from the compiled all-to-alls — a quantized path that silently
+    widened (or a mixed-wire split that collapsed to one format) is a lint
+    failure even straight after --update-budget."""
+    out: List[Finding] = []
+    by_name = {c["name"]: c for c in configs}
+    for name, counts in sorted(measured.items()):
+        require = by_name.get(name, {}).get("require_a2a_dtypes", ())
+        if not require:
+            continue
+        got = {d for d in
+               str(counts.get("hlo_a2a_dtypes", "")).split(",") if d}
+        missing = sorted(set(require) - got)
+        if missing:
+            out.append(Finding(
+                BUDGET_REL, 1, NAME,
+                f"config {name!r}: compiled all-to-all payload dtype(s) "
+                f"{', '.join(missing)} are REQUIRED for this wire mode but "
+                "absent — a quantized path silently widened or a mixed-wire "
+                "group collapsed to one format (measured a2a dtypes: "
+                f"{counts.get('hlo_a2a_dtypes')!r})"))
+    return out
+
+
+def pinned_value_findings(measured: Dict[str, Dict],
+                          configs=CONFIGS) -> List[Finding]:
+    """Budget-independent exact-value pins: configs declaring `pins`
+    ({counter: value}) fail when the measured counter differs — unlike the
+    json budget, --update-budget cannot absorb a regression on these (e.g.
+    dense_wire configs pin hlo_reduce_scatter_bytes at 0: any fp32
+    reduce_scatter reappearing on the quantized dense path fails loud)."""
+    out: List[Finding] = []
+    by_name = {c["name"]: c for c in configs}
+    for name, counts in sorted(measured.items()):
+        pins = by_name.get(name, {}).get("pins", {})
+        for key, want in sorted(pins.items()):
+            got = counts.get(key, 0)
+            if got != want:
+                out.append(Finding(
+                    BUDGET_REL, 1, NAME,
+                    f"config {name!r}: {key} = {got} but this config PINS "
+                    f"it at {want} (declared in hlo_budget.CONFIGS, not the "
+                    "json budget — --update-budget cannot absorb this; the "
+                    "compiled path regressed)"))
+    return out
+
+
 def update_budget(root: str) -> str:
     _ensure_cpu()
     import jax
@@ -479,4 +553,6 @@ def update_budget(root: str) -> str:
 def run(files, root: str) -> List[Finding]:
     measured = measure_cached(root)
     return (compare(measured, load_budget(root))
-            + forbidden_dtype_findings(measured))
+            + forbidden_dtype_findings(measured)
+            + required_dtype_findings(measured)
+            + pinned_value_findings(measured))

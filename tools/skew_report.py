@@ -194,6 +194,7 @@ def recommend_report(samples, *, budget_bytes: int, mig_rows: int,
     policy = PlacementPolicy(budget_bytes, mig_rows=mig_rows,
                              imbalance_target=imbalance_target)
     sizes = policy.size_hot(tel)
+    wires = policy.recommend_wire(tel)
     lines = [f"policy: hot_budget={budget_bytes}B mig_rows={mig_rows} "
              f"imbalance_target={imbalance_target}"]
     for t in tel:
@@ -201,7 +202,8 @@ def recommend_report(samples, *, budget_bytes: int, mig_rows: int,
         hot_ids = [i for i, _e in t.top_ids[:H]]
         line = (f"table {t.name}: hot_rows={H} "
                 f"({H * row_bytes(t.dim, t.slot_cols)}B replicated) "
-                f"predicted_hit={t.share_at(H):.3f}")
+                f"predicted_hit={t.share_at(H):.3f} "
+                f"wire={wires.get(t.name, 'bf16')}")
         if t.shard_positions is not None and t.shard_positions.sum() > 0:
             load = t.shard_positions
             imb = float(load.max() / load.mean())
@@ -235,7 +237,8 @@ def main(argv=None) -> int:
     ap.add_argument("--recommend", action="store_true",
                     help="dry-run the self-driving placement policy on this "
                          "scrape: per-table hot_rows vs the byte budget, "
-                         "predicted hit ratio, migration plan")
+                         "predicted hit ratio, migration plan, recommended "
+                         "wire format")
     ap.add_argument("--hot-budget-kb", type=float, default=64.0,
                     help="--recommend: replicated hot-cache byte budget")
     ap.add_argument("--mig-rows", type=int, default=64,

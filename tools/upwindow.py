@@ -143,6 +143,20 @@ CASES = [
       "OETPU_BENCH_PROBE_TIMEOUT_S": "75",
       "JAX_PLATFORMS": "cpu",
       "XLA_FLAGS": "--xla_force_host_platform_device_count=8"}, 1200),
+    # 13b. round-17 bytes endgame (bench 'wire_total' case: total compiled
+    #     wire bytes per step — sparse a2as + hot reduce + dense collectives
+    #     — round-12 fp32 system vs global-int8 vs policy-mixed wire with
+    #     dense_wire="int8"; result-byte and link-accounted cuts). Needs
+    #     S >= 2, so like bench_zero it rides the 8-virtual-device CPU mesh;
+    #     THREE fused-exchange compiles, budget sized for them.
+    ("bench_wire_total",
+     [sys.executable, os.path.join(REPO, "bench.py")],
+     {"OETPU_BENCH_CASES": "wire_total",
+      "OETPU_BENCH_BUDGET_S": "1100",
+      "OETPU_BENCH_TOTAL_BUDGET_S": "1340",
+      "OETPU_BENCH_PROBE_TIMEOUT_S": "75",
+      "JAX_PLATFORMS": "cpu",
+      "XLA_FLAGS": "--xla_force_host_platform_device_count=8"}, 1400),
     # 14. round-14 offload staging pipeline (bench 'offload_pipe' case:
     #     pipeline on/off x densify K in {1,4,16} — ms/round, pipeline
     #     occupancy, drained rows). Host-side two-tier cache work; no mesh

@@ -159,6 +159,11 @@ def main():
             "fused_fp32": ("fp32", True),
             "fused_bf16": ("bf16", True),
             "fused_int8": ("int8", True),
+            # round-17 per-table mixed wire: the dim-16 group splits on
+            # (dim, fmt) into an int8 and an fp32 a2a group (9 collectives,
+            # not 6) — the analytic model must price every mixed-format
+            # group exactly (delta 0), same as the uniform modes
+            "fused_mixed": ({"latent": "int8", "*": "fp32"}, True),
         }.items():
             tr, state, ms, hlo_text = train(fmt, fused, bs)
             runs[label] = (tr, state)
@@ -192,7 +197,8 @@ def main():
         base = probe(*runs["fused_fp32"])
         exactf = probe(*runs["unfused_fp32"])
         np.testing.assert_array_equal(base, exactf)  # fusion is transparent
-        for label, tol in (("fused_bf16", 0.02), ("fused_int8", 0.06)):
+        for label, tol in (("fused_bf16", 0.02), ("fused_int8", 0.06),
+                           ("fused_mixed", 0.06)):  # latent rides int8
             got = probe(*runs[label])
             err = np.abs(got - base).max()
             scale = max(np.abs(base).max(), 1e-6)
