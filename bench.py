@@ -48,7 +48,7 @@ throughput, not host dispatch latency — the same way production input pipeline
 drive TPUs (the axon tunnel adds ~40 ms per dispatch that would otherwise swamp
 the measurement; see PERF.md "Measurement hygiene").
 
-Env knobs: OETPU_BENCH_CASES=dim9[,dim64][,mesh1][,mesh1f][,pull][,wire][,wire_inband][,sync][,skew][,hot][,placement][,zero][,offload_pipe][,health] (default: all),
+Env knobs: OETPU_BENCH_CASES=dim9[,dim64][,mesh1][,mesh1f][,pull][,wire][,wire_inband][,sync][,skew][,hot][,placement][,zero][,offload_pipe][,pipeline][,health] (default: all),
 OETPU_BENCH_BUDGET_S (default 540), OETPU_BENCH_SCAN_STEPS / _REPEATS (smoke runs),
 OETPU_BENCH_TOTAL_BUDGET_S / _PROBE_TIMEOUT_S / _PROBE_INTERVAL_S (orchestrator).
 """
@@ -1151,6 +1151,90 @@ def case_offload_pipe():
     return out
 
 
+def case_pipeline():
+    """Round-18 software-pipelined train loop: `MeshTrainer(pipeline_steps=
+    True)` vs the serial scan on the same K-step windows — ms/step both
+    ways, fp32 bit-parity of the window losses, conflict-patch rows (the
+    exact-replay re-gather of rows the previous batch updated), and the
+    modeled overlapped vs patch bytes. The overlap needs S >= 2 shards, so
+    the battery entry rides the 8-virtual-device CPU mesh — CPU pins
+    STRUCTURE only (bit-parity, patch size, collective set); the ms/step
+    speedup claim waits for a chip capture (upwindow bench_pipeline)."""
+    import jax
+    import openembedding_tpu as embed
+    from openembedding_tpu.models import make_deepfm
+    from openembedding_tpu.parallel import MeshTrainer, make_mesh
+    from openembedding_tpu.utils import metrics as metrics_mod
+
+    WD.stage("pipeline:init", 240)
+    devs = jax.devices()
+    S = min(8, len(devs))
+    mesh = make_mesh(devs[:S])
+    cpu = devs[0].platform == "cpu"
+    vocab = int(os.environ.get("OETPU_BENCH_PIPE_VOCAB", str(1 << 13)))
+    batch = min(BATCH, 1024) if cpu else BATCH
+    K = 8                      # steps per compiled window
+    windows = 4 if cpu else 8
+
+    def stream(seed=29):
+        rng = np.random.default_rng(seed)
+        out = []
+        for _ in range(windows):
+            bs = [{"sparse": {"categorical":
+                              rng.integers(0, vocab, (batch, 26)).astype(
+                                  np.int32)},
+                   "dense": rng.normal(size=(batch, 13)).astype(np.float32),
+                   "label": rng.integers(0, 2, (batch,)).astype(np.float32)}
+                  for _ in range(K)]
+            out.append(jax.tree_util.tree_map(
+                lambda *xs: np.stack(xs), *bs))
+        return out
+
+    def one_config(name, pipe):
+        WD.stage(f"pipeline:{name}", 700)
+        metrics_mod._REGISTRY.clear()
+        model = make_deepfm(vocabulary=vocab, dim=9)
+        tr = MeshTrainer(model, embed.Adagrad(learning_rate=0.05), mesh=mesh,
+                         capacity_factor=0.0, wire="fp32",
+                         pipeline_steps=pipe)
+        ws = stream()
+        first = jax.tree_util.tree_map(lambda x: x[0], ws[0])
+        state = tr.init(first)
+        many = tr.jit_train_many(ws[0], state)
+        times, losses, m = [], [], None
+        for i, w in enumerate(ws):
+            t0 = time.perf_counter()
+            state, m = many(state, w)
+            jax.block_until_ready((state, m))
+            if i:
+                times.append((time.perf_counter() - t0) / K)
+            losses.extend(float(x) for x in np.asarray(m["loss"]))
+        out = {"ms_per_step": round(min(times) * 1e3, 2)}
+        if pipe:
+            tr.record_window_stats(m)  # conflict gauges off the last window
+            rep = metrics_mod.report()
+            out["conflict_rows_last_window"] = int(
+                rep.get('exchange.conflict_rows{table="categorical"}', 0))
+            cost = tr.last_wire_cost or {}
+            out["overlapped_bytes_per_step"] = int(
+                cost.get("overlapped_bytes", 0))
+            out["conflict_patch_bytes_per_step"] = int(
+                cost.get("conflict_patch_bytes", 0))
+        return out, losses
+
+    out = {"num_shards": S, "vocab": vocab, "batch": batch, "window": K,
+           "windows": windows, "platform": devs[0].platform}
+    out["serial"], l_serial = one_config("serial", False)
+    out["pipelined"], l_pipe = one_config("pipelined", True)
+    # fp32 bit-parity rides every bench run, not just the test suite
+    out["loss_bit_equal"] = l_serial == l_pipe
+    base = out["serial"]["ms_per_step"]
+    if base and out["pipelined"]["ms_per_step"]:
+        out["pipeline_speedup"] = round(
+            base / out["pipelined"]["ms_per_step"], 3)
+    return out
+
+
 def case_pull():
     """Embedding-pull p50 (BASELINE.md metric). A pull = the serving/forward read:
     dedup + row gather for one 4096x26 Zipfian batch against the 2^24-row dim-9
@@ -1210,7 +1294,7 @@ def main():
     cases = os.environ.get(
         "OETPU_BENCH_CASES",
         "dim9,dim64,mesh1,mesh1f,pull,wire,wire_inband,sync,skew,hot,"
-        "placement,zero,wire_total,offload_pipe,health").split(",")
+        "placement,zero,wire_total,offload_pipe,pipeline,health").split(",")
 
     # PRIMARY first: whatever happens later, this number is in the artifact.
     if "dim9" in cases:
@@ -1233,6 +1317,7 @@ def main():
                  ("zero", case_zero),
                  ("wire_total", case_wire_total),
                  ("offload_pipe", case_offload_pipe),
+                 ("pipeline", case_pipeline),
                  ("health", case_health)]
     for name, fn in secondary:
         if name not in cases:
@@ -1307,6 +1392,11 @@ def main():
             if "pipe_k1" in out:
                 RESULT["metric"] = "offload_pipe_k1_ms_per_round"
                 RESULT["value"] = out["pipe_k1"].get("ms_per_round")
+                RESULT["unit"] = "ms"
+                break
+            if "pipelined" in out:
+                RESULT["metric"] = "pipeline_ms_per_step"
+                RESULT["value"] = out["pipelined"].get("ms_per_step")
                 RESULT["unit"] = "ms"
                 break
 

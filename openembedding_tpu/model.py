@@ -197,6 +197,7 @@ class Trainer:
     def __init__(self, model: EmbeddingModel,
                  optimizer: Optional[SparseOptimizer] = None, seed: int = 0,
                  *, offload_pipeline: bool = False, offload_densify: int = 1,
+                 offload_stage_depth: int = 1,
                  sentinel: bool = False, halt_on_nonfinite: bool = False,
                  measure_every: int = 0):
         self.model = model
@@ -220,9 +221,12 @@ class Trainer:
         # host_cached pipeline knobs (tables/host_offload.py): pipeline=True
         # double-buffers the next batch's host lookup + admit upload on a
         # background thread (drive it via `offload_stage`); densify K>1
-        # accumulates evict/flush writebacks and merges once per K batches
+        # accumulates evict/flush writebacks and merges once per K batches;
+        # stage_depth D>1 turns the single staging slot into a ring so the
+        # loop can run the host lookup up to D batches ahead
         self.offload_pipeline = bool(offload_pipeline)
         self.offload_densify = int(offload_densify)
+        self.offload_stage_depth = int(offload_stage_depth)
         # storage="host_cached" variables (tables/host_offload.py), filled by
         # init_tables; empty when every table lives fully in HBM
         self.offload: Dict[str, Any] = {}
@@ -350,6 +354,11 @@ class Trainer:
                 if i + 1 < len(batches):
                     trainer.offload_stage(batches[i + 1])      # overlaps step
                 state, m = step(state, batch)
+
+        With offload_stage_depth=D > 1 the stage slot is a ring: call this up
+        to D batches ahead (`trainer.offload_stage(batches[i + d])` for
+        d = 1..D) and each `offload_prepare` consumes the oldest matching
+        entry, so D host lookups run under D device steps.
 
         Staging is a hint: `offload_prepare` verifies the staged ids match and
         falls back to the synchronous path when they don't."""
@@ -522,7 +531,8 @@ class Trainer:
                 from .tables.host_offload import HostOffloadTable
                 ot = HostOffloadTable(spec, self.opt_for(spec), seed=self.seed,
                                       pipeline=self.offload_pipeline,
-                                      densify_k=self.offload_densify)
+                                      densify_k=self.offload_densify,
+                                      stage_depth=self.offload_stage_depth)
                 self.offload[name] = ot
                 tables[name] = ot.state
             else:
@@ -596,6 +606,23 @@ class Trainer:
         with _trace.span("trainer", "pull"):
             pulled_tables, pulled, stats, pull_plans = self.tables_pull(
                 state.tables, batch, ps_specs, packed)
+
+        return self._train_step_tail(state, batch, ps_specs, sad_specs,
+                                     packed, tr0, fr0, pulled_tables, pulled,
+                                     stats, pull_plans)
+
+    def _train_step_tail(self, state, batch, ps_specs, sad_specs, packed,
+                         tr0, fr0, pulled_tables, pulled, stats, pull_plans):
+        """The post-pull remainder of `train_step` — fwd/bwd + dense apply +
+        sparse apply — factored out so the software-pipelined
+        `MeshTrainer.train_many` can feed it a pull PREFETCHED one scan
+        iteration earlier (parallel/trainer.py). The serial path calls it
+        straight after its own pull: pure code motion (the getattr re-lookups
+        below trace no equations), so pipeline-off HLO stays byte-identical.
+        `batch` is the already-transformed batch."""
+        model = self.model
+        split = getattr(model.module, "split_params", None)
+        train_apply = getattr(model.module, "apply_train", None)
 
         def loss_fn(tr_params, pulled_rows):
             dense_params = (model.module.merge_params(tr_params, fr0)

@@ -311,6 +311,67 @@ def bucket_validity(bucket_ids: jax.Array) -> jax.Array:
     return pair_valid(bucket_ids) if is_pair(bucket_ids) else bucket_ids >= 0
 
 
+# ---------------------------------------------------------------------------
+# Conflict-set primitives for the software-pipelined train loop
+# (`MeshTrainer(pipeline_steps=True)`, `parallel/sharded.py`
+# `grouped_conflict_patch`): batch t+1's speculatively prefetched rows are
+# valid except where batch t's push updated them, and the intersection rides
+# the same fused-sort machinery as the exchange itself — no hash table, no
+# data-dependent shapes.
+# ---------------------------------------------------------------------------
+
+
+def member_mask(ref_ids: jax.Array, ref_valid: jax.Array,
+                query_ids: jax.Array, query_valid: jax.Array) -> jax.Array:
+    """Per-QUERY membership in the valid reference id set, ONE fused sort.
+
+    `ref_ids` (R[, 2]) / `query_ids` (Q[, 2]) share one id layout (single-lane
+    int or the split-pair 63-bit layout). Sort the concatenation by id with a
+    reference-membership weight riding along; a query is a member iff its id
+    segment holds at least one VALID reference entry. Invalid queries are
+    never members; invalid reference entries never vouch — so sentinel-filled
+    bucket padding on either side can collide harmlessly."""
+    R = ref_ids.shape[0]
+    n = R + query_ids.shape[0]
+    cat = jnp.concatenate([ref_ids, query_ids], axis=0)
+    contrib = jnp.concatenate([ref_valid.astype(jnp.int32),
+                               jnp.zeros((n - R,), jnp.int32)])
+    iota = jnp.arange(n, dtype=jnp.int32)
+    if cat.ndim == 2:  # split-pair layout
+        s_hi, s_lo, s_contrib, s_idx = jax.lax.sort(
+            (cat[:, 0], cat[:, 1], contrib, iota), num_keys=2)
+        id_change = (s_hi[1:] != s_hi[:-1]) | (s_lo[1:] != s_lo[:-1])
+    else:
+        s_id, s_contrib, s_idx = jax.lax.sort((cat, contrib, iota),
+                                              num_keys=1)
+        id_change = s_id[1:] != s_id[:-1]
+    is_new = jnp.concatenate([jnp.ones((1,), bool), id_change])
+    seg = (jnp.cumsum(is_new) - 1).astype(jnp.int32)
+    seg_refs = jax.ops.segment_sum(s_contrib, seg, num_segments=n,
+                                   indices_are_sorted=True)
+    hit = seg_refs[seg] > 0
+    out = jnp.zeros((n,), bool).at[s_idx].set(hit)
+    return out[R:] & query_valid
+
+
+def compact_member_slots(member: jax.Array, pcap: int):
+    """Compact a (S, cap) membership mask to per-row slot-index buckets
+    (S, pcap) — slot j of row s lands at its rank among row s's members,
+    -1 padding. Members beyond `pcap` drop and are counted in the returned
+    scalar overflow (the conflict-patch budget knob: an overflowed row keeps
+    its one-step-stale speculative value — bounded staleness, gauged)."""
+    S, cap = member.shape
+    pos = jnp.cumsum(member.astype(jnp.int32), axis=1) - 1
+    within = member & (pos < pcap)
+    row = jnp.arange(S, dtype=jnp.int32)[:, None]
+    flat_tgt = jnp.where(within, row * pcap + pos, S * pcap)
+    col = jnp.broadcast_to(jnp.arange(cap, dtype=jnp.int32), (S, cap))
+    slots = jnp.full((S * pcap,), -1, jnp.int32).at[flat_tgt.reshape(-1)].set(
+        col.reshape(-1), mode="drop").reshape(S, pcap)
+    overflow = jnp.sum(member & ~within).astype(jnp.int32)
+    return slots, overflow
+
+
 def unbucket(bucket_rows: jax.Array, owner: jax.Array, slot: jax.Array) -> jax.Array:
     """Inverse of bucket_by_owner for per-id payloads: read back each input element's
     row from its (owner, slot) position. bucket_rows: (num_shards, capacity, ...)."""

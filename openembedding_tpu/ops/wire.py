@@ -409,6 +409,31 @@ def exchange_cost(tables, num_shards: int, fmt: str,
             "bytes_per_step": int(total) if S > 1 else 0}
 
 
+def conflict_patch_cost(tables, num_shards: int, fmt: str) -> dict:
+    """Static per-device wire cost of the pipelined loop's conflict patch
+    (`parallel/sharded.grouped_conflict_patch`): ONE extra all_to_all per
+    (dim, fmt) group shipping `pcap` row+slot entries per (src, dst) pair,
+    encoded with the push codec (row payload + exact count lanes carrying
+    slot+1). `tables`: list of dicts {dim, cap, pcap} with the optional
+    per-table `fmt` override, mirroring `exchange_cost`'s input — `pcap`
+    from `parallel/sharded.conflict_patch_cap` (== cap in the exact default,
+    bounded by conflict_factor otherwise). These are the ONLY wire bytes
+    pipelining adds on top of the serial exchange; everything else just
+    moves off the critical path ("overlapped_bytes")."""
+    S = num_shards
+    groups = {}
+    for t in tables:
+        groups.setdefault((t["dim"], t.get("fmt", fmt)), []).append(t)
+    bytes_patch = 0
+    for (dim, tf), members in groups.items():
+        tw = jnp.dtype(wire_dtype(tf)).itemsize
+        for m in members:
+            bytes_patch += S * m["pcap"] * grads_wire_width(dim, tf) * tw
+    return {"format": fmt, "num_shards": S,
+            "collectives": len(groups) if S > 1 else 0,
+            "bytes_patch": int(bytes_patch) if S > 1 else 0}
+
+
 def hot_reduce_cost(hot_rows_by_table, num_shards: int, fmt: str) -> dict:
     """Static per-device cost model of the hot-row gradient reduction
     (`parallel/sharded._hot_apply`), per hot format:

@@ -1111,11 +1111,271 @@ def grouped_apply_gradients(
 
 
 # ---------------------------------------------------------------------------
-# Hot-set lifecycle: host-side identity construction + device-side
-# writeback/gather (both run inside shard_map; driven off the hot path by
-# MeshTrainer.refresh_hot_rows / hot_sync between steps — shapes are static,
-# so swapping hot sets never re-jits).
+# Split-phase exchange for the software-pipelined train loop
+# (`MeshTrainer(pipeline_steps=True)`): `grouped_prefetch` issues batch t+1's
+# id plane + speculative weight plane with no data dependency on batch t's
+# gradients (XLA overlaps its a2as with batch t's dense compute),
+# `grouped_conflict_patch` re-gathers only the rows batch t's push actually
+# updated, and `grouped_finalize_pull` runs the client tail (hot overlay +
+# duplicate expansion) at consume time. fp32 wire stays bit-exact to the
+# serial `grouped_lookup_train` flow; narrow wire is approximate (the patch
+# re-quantizes and error-feedback residuals are not replayed).
 # ---------------------------------------------------------------------------
+
+
+def plan_carry(plan: ExchangePlan) -> dict:
+    """ExchangePlan -> a dict of ARRAYS safe to ride a `lax.scan` carry (the
+    static ints `cap`/`hot_rows` would be traced into the carry and break the
+    plan's shape-level uses; they travel out of band — `plan_from_carry`
+    re-attaches them from the prologue's trace-time plan)."""
+    return {"uniq": plan.uniq, "buckets": plan.buckets,
+            "recv_ids": plan.recv_ids, "recv_valid": plan.recv_valid,
+            "hot_slot": plan.hot_slot, "mig_moved": plan.mig_moved}
+
+
+def plan_from_carry(carry: dict, cap: int, hot_rows: int) -> ExchangePlan:
+    """Inverse of `plan_carry`: rebuild the plan around the scan body's
+    carried arrays with the trace-time static ints re-attached."""
+    return ExchangePlan(carry["uniq"], carry["buckets"], carry["recv_ids"],
+                        carry["recv_valid"], cap, carry["hot_slot"],
+                        hot_rows, carry["mig_moved"])
+
+
+def conflict_patch_cap(cap: int, conflict_factor: float) -> int:
+    """Static per-(src,dst) capacity of the conflict-patch buckets:
+    `conflict_factor <= 0` re-gathers every possible conflict (pcap = cap,
+    exact — the default, mirroring capacity_factor's exact mode); otherwise
+    ceil(factor * cap) clipped to [1, cap], overflowed rows keeping their
+    one-step-stale speculative value (counted in `conflict_overflow`)."""
+    if conflict_factor <= 0:
+        return cap
+    return max(1, min(cap, int(-(-conflict_factor * cap // 1))))
+
+
+# oelint: jit-entry
+# oelint: hot-path device_get=0
+def grouped_prefetch(
+    specs, states, ids_list, *,
+    axis: str = DATA_AXIS,
+    capacity_factor: float = 0.0,
+    wire: Optional[str] = None,
+    load_stats: bool = True,
+):
+    """Id plane + speculative weight plane of a fused training pull for one
+    dim-group, WITHOUT the client tail (`grouped_finalize_pull` runs that at
+    consume time, one step later).
+
+    Issued for batch t+1 this depends only on batch t+1's ids and the
+    CURRENT table state — no data dependency on batch t's gradients — so XLA
+    is free to overlap both of its all_to_alls with batch t's dense
+    forward/backward. Hash inserts happen here, in the same order the serial
+    loop would insert (apply never touches keys and the open-addressing find
+    is stable under later inserts), so the speculatively gathered rows
+    differ from a serial pull's ONLY at rows batch t's push updates — the
+    exact set `grouped_conflict_patch` re-gathers. Hot/mig probes ride the
+    prefetched sort unchanged (their directories only change between
+    windows).
+
+    Returns (new_states, plans, uniq_rows_list, stats_list):
+    `uniq_rows_list` holds each table's decoded per-UNIQUE-slot rows
+    (n, dim) float32 — speculative until patched, hot slots zero until the
+    finalize overlay."""
+    from ..ops import wire as wire_mod
+    S = jax.lax.axis_size(axis)
+    if S == 1:
+        raise ValueError(
+            "grouped_prefetch needs S >= 2: the pipelined loop has nothing "
+            "to overlap on a 1-device mesh (MeshTrainer falls back to the "
+            "serial train_many there)")
+    dim = specs[0].output_dim
+    ids_list = [adapt_batch_ids(spec, state, ids)
+                for spec, state, ids in zip(specs, states, ids_list)]
+    hots = [state.hot for state in states]
+    plans = grouped_make_plans(specs, ids_list, axis=axis,
+                               capacity_factor=capacity_factor, hots=hots,
+                               migs=[state.mig for state in states])
+    fmt = wire_mod.wire_format(wire)
+    new_states, rows_list = [], []
+    for spec, state, plan in zip(specs, states, plans):
+        state, rows = _serve_rows(spec, state, plan, train=True, axis=axis,
+                                  fmt=fmt)
+        new_states.append(state)
+        rows_list.append(rows)
+    # same wire flow as grouped_lookup_train: ONE a2a for the group's rows
+    stacked = jnp.concatenate(rows_list, axis=1)
+    if fmt == "fp32":
+        enc = wire_mod.encode_rows(stacked.reshape(-1, dim), fmt)
+        back = jax.lax.all_to_all(
+            enc.reshape(S, -1, enc.shape[-1]), axis, 0, 0)
+        dec = wire_mod.decode_rows(
+            back.reshape(-1, enc.shape[-1]), dim, fmt).reshape(S, -1, dim)
+    else:
+        back = jax.lax.all_to_all(stacked, axis, 0, 0)
+        dec = wire_mod.unpack_inband(
+            back.reshape(-1, stacked.shape[-1]), dim,
+            fmt).reshape(S, -1, dim)
+    uniq_rows_list, off = [], 0
+    for plan in plans:
+        seg = dec[:, off:off + plan.cap]
+        off += plan.cap
+        uniq_rows_list.append(
+            unbucket(seg, plan.buckets.owner, plan.buckets.slot))
+    stats_list = []
+    for spec, ids, plan in zip(specs, ids_list, plans):
+        st = {
+            "pull_indices": jnp.asarray(ids_positions(spec, ids), jnp.int32),
+            "pull_unique": plan.uniq.num_unique,
+            "pull_overflow": plan.buckets.overflow,
+        }
+        if plan.hot_slot is not None:
+            st.update(_hot_pull_stats(spec, plan, flatten_ids(spec, ids),
+                                      fmt))
+        if plan.mig_moved is not None:
+            st.update(_mig_pull_stats(plan))
+        if load_stats:
+            st.update(exchange_load_stats(plan, axis=axis))
+        stats_list.append(st)
+    return new_states, plans, uniq_rows_list, stats_list
+
+
+# oelint: jit-entry
+# oelint: hot-path device_get=0
+def grouped_finalize_pull(specs, states, ids_list, plans, uniq_rows_list):
+    """Client tail of a prefetched pull: hot-cache overlay + duplicate
+    expansion, run at CONSUME time so the overlay reads the hot cache as of
+    the previous batch's apply (hot rows never ride the buckets — the
+    speculative unique rows hold zeros there, and the fresh overlay is what
+    keeps hot rows exact under pipelining). Pure local math, no collective.
+    Returns per-table batch-shaped rows in each table's dtype."""
+    outs = []
+    for spec, state, ids, plan, uniq_rows in zip(specs, states, ids_list,
+                                                 plans, uniq_rows_list):
+        ids = adapt_batch_ids(spec, state, ids)
+        ur = _merge_hot_rows(plan, uniq_rows, state.hot)
+        out = jnp.take(ur, plan.uniq.inverse, axis=0)
+        outs.append(out.astype(spec.dtype).reshape(
+            _out_shape(spec, ids) + (spec.output_dim,)))
+    return outs
+
+
+def _gather_rows_readonly(spec: EmbeddingSpec, state: EmbeddingTableState,
+                          flat_recv: jax.Array, flat_valid: jax.Array,
+                          S: int) -> jax.Array:
+    """Row gather for ids this shard serves, strictly read-only: no hash
+    insert (the prefetch already inserted every patched id), no
+    error-feedback side effects. Mig-annex-aware exactly like `_serve_rows`;
+    packed train_many layouts slice the weight columns out. -> (n, dim) in
+    the table's storage dtype."""
+    mig = state.mig
+    if mig is not None:
+        m_found, m_rank, _ = _mig_find(mig, flat_recv, flat_valid)
+        main_valid = flat_valid & ~m_found
+    else:
+        m_found = None
+        main_valid = flat_valid
+    if spec.use_hash_table:
+        from ..tables.hash_table import hash_find
+        if flat_recv.ndim == 2:
+            from ..ops.id64 import PAIR_EMPTY
+            probe = jnp.where(main_valid[:, None], flat_recv, PAIR_EMPTY)
+        else:
+            probe = jnp.where(main_valid, flat_recv, -1)
+        capacity = state.keys.shape[0]
+        slot = hash_find(state.keys, probe)
+        idx = jnp.where((slot < capacity) & main_valid, slot, capacity)
+        rows = lookup_rows(state.weights, idx)
+    else:
+        idx = jnp.where(main_valid, flat_recv // S, -1)
+        rows = lookup_rows(state.weights, idx)
+    if rows.shape[1] != spec.output_dim:
+        # packed weights+slots layout inside train_many's scan
+        rows = rows[:, :spec.output_dim]
+    if m_found is not None:
+        M = mig.weights.shape[0]
+        arows = lookup_rows(mig.weights, jnp.where(m_found, m_rank, M))
+        if arows.shape[1] != spec.output_dim:
+            arows = arows[:, :spec.output_dim]
+        rows = jnp.where(m_found[:, None], arows.astype(rows.dtype), rows)
+    return rows
+
+
+# oelint: jit-entry
+# oelint: hot-path device_get=0
+def grouped_conflict_patch(
+    specs, states, prev_plans, plans, uniq_rows_list, *,
+    axis: str = DATA_AXIS,
+    conflict_factor: float = 0.0,
+    wire: Optional[str] = None,
+):
+    """Repair a dim-group's speculatively prefetched rows after the previous
+    batch's push. Every row that push touched on this shard is exactly a
+    VALID recv slot of the previous plan, so the conflict set is the
+    intersection of the previous plan's recv ids with the new plan's (one
+    fused sort per table, `ops/dedup.member_mask`). The serving shards
+    re-gather only those rows from the POST-apply tables, compact them to
+    `conflict_patch_cap` slots per source, and ONE all_to_all per group
+    ships row + origin bucket slot back (slot+1 riding the exact count
+    lanes, 0 = empty — the push codec reused verbatim); the client scatters
+    them over its speculative unique rows. fp32 wire makes patched rows
+    bit-identical to an unpipelined pull.
+
+    Returns (patched_uniq_rows_list, stats_list) with per-table
+    `conflict_rows` (this source's compacted patch rows — psum to the step
+    total) and `conflict_overflow` (members dropped by the pcap budget;
+    those rows keep their one-step-stale value)."""
+    from ..ops import wire as wire_mod
+    from ..ops.dedup import compact_member_slots, member_mask
+    S = jax.lax.axis_size(axis)
+    dim = specs[0].output_dim
+    fmt = wire_mod.wire_format(wire)
+    payloads, metas = [], []
+    for spec, state, pplan, plan in zip(specs, states, prev_plans, plans):
+        cap = plan.cap
+        pcap = conflict_patch_cap(cap, conflict_factor)
+        pair = plan.recv_ids.ndim == 3
+        ref = (pplan.recv_ids.reshape(-1, 2) if pair
+               else pplan.recv_ids.reshape(-1))
+        qry = (plan.recv_ids.reshape(-1, 2) if pair
+               else plan.recv_ids.reshape(-1))
+        member = member_mask(ref, pplan.recv_valid.reshape(-1), qry,
+                             plan.recv_valid.reshape(-1)).reshape(S, cap)
+        slots, oflow = compact_member_slots(member, pcap)
+        cl = jnp.clip(slots, 0, cap - 1)
+        taken = jnp.take_along_axis(plan.recv_ids,
+                                    cl[..., None] if pair else cl, axis=1)
+        flat_ids = taken.reshape(-1, 2) if pair else taken.reshape(-1)
+        rows = _gather_rows_readonly(spec, state, flat_ids,
+                                     (slots >= 0).reshape(-1), S)
+        payload = wire_mod.encode_grads(
+            rows.astype(jnp.float32),
+            (slots + 1).reshape(-1).astype(jnp.int32), fmt)
+        payloads.append(payload.reshape(S, pcap, -1))
+        metas.append((pcap, member, oflow))
+    recv = jax.lax.all_to_all(jnp.concatenate(payloads, axis=1), axis, 0, 0)
+    width = recv.shape[-1]
+    patched, stats_list, off = [], [], 0
+    for spec, plan, uniq_rows, (pcap, member, oflow) in zip(
+            specs, plans, uniq_rows_list, metas):
+        seg = recv[:, off:off + pcap].reshape(-1, width)
+        off += pcap
+        prow, pc = wire_mod.decode_grads(seg, dim, fmt)
+        cap = plan.cap
+        live = pc > 0
+        o = jnp.repeat(jnp.arange(S, dtype=jnp.int32), pcap)
+        flat_pos = jnp.where(live, o * cap + jnp.clip(pc - 1, 0, cap - 1),
+                             S * cap)
+        stage = jnp.zeros((S * cap, dim), jnp.float32).at[flat_pos].set(
+            prow, mode="drop").reshape(S, cap, dim)
+        smask = jnp.zeros((S * cap,), bool).at[flat_pos].set(
+            live, mode="drop").reshape(S, cap)
+        patch_u = unbucket(stage, plan.buckets.owner, plan.buckets.slot)
+        mask_u = unbucket(smask, plan.buckets.owner, plan.buckets.slot)
+        patched.append(jnp.where(mask_u[:, None],
+                                 patch_u.astype(uniq_rows.dtype), uniq_rows))
+        stats_list.append({
+            "conflict_rows": jnp.sum(member).astype(jnp.int32) - oflow,
+            "conflict_overflow": oflow})
+    return patched, stats_list
 
 
 def build_hot_identity(spec: EmbeddingSpec, hot_rows: int, ids64=None, *,

@@ -50,12 +50,16 @@ class MeshTrainer(Trainer):
                  dense_wire: Optional[str] = None,
                  offload_pipeline: bool = False,
                  offload_densify: int = 1,
+                 offload_stage_depth: int = 1,
+                 pipeline_steps: bool = False,
+                 conflict_factor: float = 0.0,
                  sentinel: bool = False,
                  halt_on_nonfinite: bool = False,
                  measure_every: int = 0):
         super().__init__(model, optimizer, seed,
                          offload_pipeline=offload_pipeline,
                          offload_densify=offload_densify,
+                         offload_stage_depth=offload_stage_depth,
                          sentinel=sentinel,
                          halt_on_nonfinite=halt_on_nonfinite,
                          measure_every=measure_every)
@@ -167,6 +171,24 @@ class MeshTrainer(Trainer):
                     "dense_wire quantizes the ZeRO dense collectives — "
                     "construct MeshTrainer(dense_shard=True, dense_wire=...)")
         self.dense_wire = dense_wire
+        # software-pipelined train_many (round 18): prefetch batch t+1's
+        # exchange (id plane + speculative row gather) under batch t's dense
+        # compute, then re-gather only the rows batch t actually updated (the
+        # CONFLICT PATCH, `sharded.grouped_conflict_patch`) so fp32 results
+        # stay bit-exact to the serial scan. Static trace-time bool:
+        # pipeline_steps=False routes train_many through the base scan
+        # untouched — byte-identical HLO (hlo-budget delta 0). Inert on
+        # 1-device meshes (nothing to overlap: the exchange is local).
+        self.pipeline_steps = bool(pipeline_steps)
+        # conflict-patch compaction cap as a fraction of the bucket capacity:
+        # 0 (default) keeps the patch EXACT (pcap = cap, bit-exactness
+        # guaranteed); 0 < f < 1 bounds patch wire bytes at f * cap rows per
+        # (src, dst) pair — overflowed rows keep their one-step-stale
+        # speculative value (counted in the window's "conflict_overflow")
+        if not (0.0 <= float(conflict_factor) <= 1.0):
+            raise ValueError(f"conflict_factor={conflict_factor!r}: expected "
+                             "0.0 (exact) .. 1.0")
+        self.conflict_factor = float(conflict_factor)
         self._zero_plan = None
         self._zero_fns: Dict[str, Any] = {}
         self._hot_fns: Dict[str, Any] = {}
@@ -543,7 +565,8 @@ class MeshTrainer(Trainer):
                 ot = HostOffloadTable(spec, self.opt_for(spec), seed=self.seed,
                                       mesh=mesh, axis=self.axis,
                                       pipeline=self.offload_pipeline,
-                                      densify_k=self.offload_densify)
+                                      densify_k=self.offload_densify,
+                                      stage_depth=self.offload_stage_depth)
                 self.offload[name] = ot
                 tables[name] = ot.state
                 continue
@@ -1139,7 +1162,274 @@ class MeshTrainer(Trainer):
                     stats[f"{n}/{k}"] = v
         return new_tables, stats
 
-    def _observe_wire_cost(self, ps_specs, batch):
+    # -- software-pipelined train_many (round 18) ----------------------------
+
+    def _pipeline_on(self) -> bool:
+        """Static trace-time gate: pipelining is inert on 1-device meshes
+        (the exchange is local — there is nothing to overlap) and off by
+        default, so the serial path compiles byte-identical HLO."""
+        return self.pipeline_steps and self.num_shards > 1
+
+    def _pipeline_groups(self, ps_specs):
+        """Exchange groups the pipelined loop fans over: the fused
+        (dim, fmt)-groups, or singleton groups under group_exchange=False
+        (the per-table protocol has no split-phase entry points; fp32
+        grouped vs per-table pulls are bit-identical — the round-6 pin — so
+        exactness is preserved there too)."""
+        groups = self._exchange_groups(ps_specs)
+        if not self.group_exchange:
+            return [[n] for g in groups for n in g]
+        return groups
+
+    # oelint: hot-path device_get=0
+    def _pipeline_prefetch(self, tables, batch, ps_specs):
+        """Issue a batch's exchange a FULL STEP ahead: id plane (dedup/sort/
+        route + id a2a) and the speculative row gather
+        (`sharded.grouped_prefetch`). Returns (new_tables, plans, rows,
+        stats) keyed by table, stats prefixed like tables_pull's."""
+        from ..utils import trace as _trace
+        from .sharded import grouped_prefetch
+        self._observe_wire_cost(ps_specs, batch, pipelined=True)
+        new_tables = dict(tables)
+        plans, rows, stats = {}, {}, {}
+        groups = self._pipeline_groups(ps_specs)
+        with _trace.span("trainer", "prefetch", groups=len(groups)):
+            for names in groups:
+                specs = [ps_specs[n] for n in names]
+                ids_list = [jnp.asarray(batch["sparse"][s.feature_name])
+                            for s in specs]
+                states, plan_list, rows_list, stats_list = grouped_prefetch(
+                    specs, [tables[n] for n in names], ids_list,
+                    axis=self.axis, capacity_factor=self.capacity_factor,
+                    wire=self.wire_for(names[0]),
+                    load_stats=self.shard_stats)
+                for n, ts, pl, rw, st in zip(names, states, plan_list,
+                                             rows_list, stats_list):
+                    new_tables[n], plans[n], rows[n] = ts, pl, rw
+                    for k, v in st.items():
+                        stats[f"{n}/{k}"] = v
+        return new_tables, plans, rows, stats
+
+    # oelint: hot-path device_get=0
+    def _pipeline_finalize(self, tables, batch, ps_specs, plans, rows):
+        """Client tail of the carried prefetch — hot-cache overlay +
+        duplicate expansion at CONSUME time (`sharded.grouped_finalize_pull`;
+        pure local math, no collective)."""
+        from ..utils import trace as _trace
+        from .sharded import grouped_finalize_pull
+        pulled = {}
+        with _trace.span("trainer", "pull"):
+            for names in self._pipeline_groups(ps_specs):
+                specs = [ps_specs[n] for n in names]
+                ids_list = [jnp.asarray(batch["sparse"][s.feature_name])
+                            for s in specs]
+                outs = grouped_finalize_pull(
+                    specs, [tables[n] for n in names], ids_list,
+                    [plans[n] for n in names], [rows[n] for n in names])
+                for n, out in zip(names, outs):
+                    pulled[n] = out
+        return pulled
+
+    # oelint: hot-path device_get=0
+    def _pipeline_patch(self, ps_specs, tables, prev_plans, plans, rows):
+        """Repair the next batch's speculative rows against what this batch's
+        apply just wrote (`sharded.grouped_conflict_patch`). Returns
+        (patched_rows, {name: conflict_rows psum}, conflict_overflow psum)."""
+        from ..utils import trace as _trace
+        from .sharded import grouped_conflict_patch
+        patched, conflict = {}, {}
+        coflow = jnp.zeros((), jnp.int32)
+        with _trace.span("trainer", "conflict_patch"):
+            for names in self._pipeline_groups(ps_specs):
+                specs = [ps_specs[n] for n in names]
+                outs, stats_list = grouped_conflict_patch(
+                    specs, [tables[n] for n in names],
+                    [prev_plans[n] for n in names],
+                    [plans[n] for n in names],
+                    [rows[n] for n in names], axis=self.axis,
+                    conflict_factor=self.conflict_factor,
+                    wire=self.wire_for(names[0]))
+                for n, out, st in zip(names, outs, stats_list):
+                    patched[n] = out
+                    conflict[n] = jax.lax.psum(st["conflict_rows"],
+                                               self.axis)
+                    coflow = coflow + jax.lax.psum(st["conflict_overflow"],
+                                                   self.axis)
+        return patched, conflict, coflow
+
+    def train_many(self, state: TrainState, batches):
+        """See `Trainer.train_many`. With pipeline_steps=True on a real mesh
+        the window is SOFTWARE-PIPELINED (`_train_many_pipelined`); the
+        returned metrics gain per-window "conflict" ({table: patched rows})
+        and "conflict_overflow" counters — fold them into gauges with
+        `record_window_stats`."""
+        if not self._pipeline_on():
+            return super().train_many(state, batches)
+        return self._train_many_pipelined(state, batches)
+
+    def _train_many_pipelined(self, state: TrainState, batches):
+        """Prologue / steady-state / epilogue around `lax.scan`:
+
+            prologue:  prefetch(b[0])
+            body t:    prefetch(b[t+1])         # issued FIRST — overlaps
+                       finalize(b[t])           # batch t's fwd/bwd/applies
+                       fwd/bwd + applies (b[t]) # model._train_step_tail
+                       conflict_patch(b[t+1])   # repair the speculation
+            epilogue:  finalize(b[K-1]) + fwd/bwd + applies
+
+        The prefetch has no data dependency on batch t's gradients (the
+        jaxpr pin in tests/test_pipeline.py), so XLA may hoist its
+        collectives under the dense compute; batch t's push a2a + scatter
+        likewise overlap batch t+1's id plane. Hash inserts happen in serial
+        order (prologue inserts b[0], body t inserts b[t+1]), apply never
+        touches keys, and the patch re-gathers every row the apply could
+        have touched — fp32 results are bit-exact vs the serial scan.
+        Narrow wire stays approximate (error feedback is not replayed)."""
+        if self.offload and not getattr(self, "_offload_prepared", False):
+            raise ValueError(
+                "train_many on storage='host_cached' tables needs the union "
+                "of the K batches' ids admitted first: use "
+                "trainer.offload_train_many(state, batches) (or call "
+                "offload_prepare(state, batches) before every window).")
+        from ..ops.sparse import pack_table, unpack_table
+        from .sharded import plan_carry, plan_from_carry
+        model = self.model
+        ps_specs = model.ps_specs()
+        sad_specs = model.sad_specs()
+        layouts = self._packed_layouts(state)
+        if layouts:
+            tables = dict(state.tables)
+            for name, lay in layouts.items():
+                ts = tables[name]
+                tables[name] = ts.replace(
+                    weights=pack_table(ts.weights, ts.slots, lay), slots={})
+            state = state.replace(tables=tables)
+        K = jax.tree_util.tree_leaves(batches)[0].shape[0]
+
+        def batch_at(t):
+            return jax.tree_util.tree_map(lambda x: x[t], batches)
+
+        def transform(b):
+            return (model.batch_transform(b)
+                    if model.batch_transform is not None else b)
+
+        def stats_overflow(stats):
+            oflow = jnp.zeros((), jnp.int32)
+            for k, v in stats.items():
+                if k.endswith("_overflow"):
+                    oflow = oflow + jnp.asarray(v).astype(jnp.int32)
+            return oflow
+
+        def step_tail(state, bt, pulled, stats, plans_t):
+            split = getattr(model.module, "split_params", None)
+            if split is not None:
+                tr0, fr0 = split(state.dense_params)
+            else:
+                tr0, fr0 = state.dense_params, None
+            return self._train_step_tail(
+                state, bt, ps_specs, sad_specs, layouts, tr0, fr0,
+                dict(state.tables), pulled, stats, plans_t)
+
+        # prologue: batch 0's exchange runs un-overlapped (nothing to hide
+        # it under yet); its pull stats contribute only overflow
+        b0 = transform(batch_at(0))
+        tables, plans0, rows0, pf_stats = self._pipeline_prefetch(
+            state.tables, b0, ps_specs)
+        state = state.replace(tables=tables)
+        total_oflow = jax.lax.psum(stats_overflow(pf_stats), self.axis)
+        # static plan ints (cap, hot_rows) travel out of band — shapes are
+        # uniform over the window, so the prologue's trace-time values hold
+        statics = {n: (plans0[n].cap, plans0[n].hot_rows) for n in plans0}
+        pre0 = {n: {"plan": plan_carry(plans0[n]), "rows": rows0[n]}
+                for n in plans0}
+
+        def body(carry, xs):
+            state, pre = carry
+            bt, bn = xs
+            bt = transform(bt)
+            bn = transform(bn)
+            # (1) batch t+1's exchange FIRST: no data dependency on batch
+            # t's grads, so its collectives are free to overlap the compute
+            tables, plans_n, rows_n, pf_stats = self._pipeline_prefetch(
+                state.tables, bn, ps_specs)
+            state = state.replace(tables=tables)
+            # (2) consume the carried prefetch as batch t's pull
+            plans_t = {n: plan_from_carry(pre[n]["plan"], *statics[n])
+                       for n in pre}
+            pulled = self._pipeline_finalize(
+                state.tables, bt, ps_specs, plans_t,
+                {n: pre[n]["rows"] for n in pre})
+            # (3) fwd/bwd + dense & sparse applies; batch t+1's pull stats
+            # ride this step's metrics (the per-batch stats accounting)
+            state, metrics = step_tail(state, bt, pulled, dict(pf_stats),
+                                       plans_t)
+            # (4) repair batch t+1's speculative rows post-apply
+            patched, conflict, coflow = self._pipeline_patch(
+                ps_specs, state.tables, plans_t, plans_n, rows_n)
+            oflow = stats_overflow(metrics.get("stats", {}))
+            pre_n = {n: {"plan": plan_carry(plans_n[n]), "rows": patched[n]}
+                     for n in plans_n}
+            return (state, pre_n), (metrics["loss"], oflow, conflict, coflow)
+
+        if K > 1:
+            head = jax.tree_util.tree_map(lambda x: x[:-1], batches)
+            nxt = jax.tree_util.tree_map(lambda x: x[1:], batches)
+            (state, pre), (losses, oflows, conflicts, coflows) = jax.lax.scan(
+                body, (state, pre0), (head, nxt))
+            total_oflow = total_oflow + jnp.sum(oflows)
+            conflict = {n: jnp.sum(conflicts[n]) for n in conflicts}
+            coflow = jnp.sum(coflows)
+        else:
+            pre = pre0
+            losses = None
+            conflict = {n: jnp.zeros((), jnp.int32) for n in ps_specs}
+            coflow = jnp.zeros((), jnp.int32)
+
+        # epilogue: the last batch consumes its prefetch; nothing left to
+        # prefetch or patch
+        bl = transform(batch_at(K - 1))
+        plans_l = {n: plan_from_carry(pre[n]["plan"], *statics[n])
+                   for n in pre}
+        pulled = self._pipeline_finalize(state.tables, bl, ps_specs, plans_l,
+                                         {n: pre[n]["rows"] for n in pre})
+        state, metrics = step_tail(state, bl, pulled, {}, plans_l)
+        total_oflow = total_oflow + stats_overflow(metrics.get("stats", {}))
+        last = jnp.reshape(metrics["loss"], (1,))
+        losses = last if losses is None else jnp.concatenate([losses, last])
+
+        if layouts:
+            tables = dict(state.tables)
+            for name, lay in layouts.items():
+                spec = self.model.specs[name]
+                ts = tables[name]
+                w, slots = unpack_table(ts.weights, lay, spec.output_dim,
+                                        spec.dtype)
+                tables[name] = ts.replace(weights=w, slots=slots)
+            state = state.replace(tables=tables)
+        return state, {"loss": losses, "overflow": total_oflow,
+                       "conflict": conflict, "conflict_overflow": coflow}
+
+    def record_window_stats(self, metrics) -> None:
+        """Fold a train_many window's host-visible counters into gauges —
+        pipelined windows publish `exchange.conflict_rows{table=}` plus the
+        pcap-dropped `exchange.conflict_overflow`. ONE device_get per
+        window (the window-level sibling of `metrics.record_step_stats`);
+        no-op on serial windows."""
+        conflict = (metrics.get("conflict")
+                    if isinstance(metrics, dict) else None)
+        if not conflict:
+            return
+        import numpy as np
+        vals = jax.device_get(conflict)
+        for name, v in vals.items():
+            _metrics.observe("exchange.conflict_rows", float(np.asarray(v)),
+                             "gauge", labels={"table": name})
+        co = metrics.get("conflict_overflow")
+        if co is not None:
+            _metrics.observe("exchange.conflict_overflow",
+                             float(np.asarray(jax.device_get(co))), "gauge")
+
+    def _observe_wire_cost(self, ps_specs, batch, *, pipelined=False):
         """Publish the static wire-cost model of the traced step (runs once
         per trace — all inputs are shapes, not values)."""
         from ..ops import wire as wire_mod
@@ -1199,6 +1489,30 @@ class MeshTrainer(Trainer):
                 float(jnp.dtype(wire_mod.wire_dtype(
                     self.wire_for(name))).itemsize),
                 "gauge", labels={"table": name})
+        if pipelined:
+            # pipelined windows (round 18): the prefetched id+row a2as and
+            # the push a2a ride under the dense compute — OFF the critical
+            # path ("overlapped_bytes", which StepWatch's drift baseline
+            # excludes) — and the conflict patch is the only NEW wire the
+            # pipeline adds, priced by the same static model and pinned by
+            # the fused_fp32_pipelined hlo-budget config
+            from .sharded import conflict_patch_cap
+            ptables = [dict(t, pcap=conflict_patch_cap(
+                t["cap"], self.conflict_factor)) for t in tables]
+            pcost = wire_mod.conflict_patch_cost(ptables, self.num_shards,
+                                                 fmt)
+            cost = dict(cost)
+            cost["overlapped_bytes"] = int(cost["bytes_per_step"])
+            cost["conflict_patch_bytes"] = int(pcost["bytes_patch"])
+            cost["bytes_per_step"] = (int(cost["bytes_per_step"])
+                                      + int(pcost["bytes_patch"]))
+            cost["collectives_per_step"] = (int(cost["collectives_per_step"])
+                                            + int(pcost["collectives"]))
+            self.last_wire_cost = cost
+            _metrics.observe("exchange.conflict_patch_bytes",
+                             float(pcost["bytes_patch"]), "gauge")
+            _metrics.observe("exchange.overlapped_bytes",
+                             float(cost["overlapped_bytes"]), "gauge")
         # hot-cache static costs: cache size per table + the wire bytes of
         # the backward's dense hot reduce, priced by hot_reduce_cost for the
         # resolved hot format (ring allreduce for fp32/bf16, the two-stage
@@ -1300,10 +1614,18 @@ class MeshTrainer(Trainer):
         stacked_spec = jax.tree_util.tree_map(
             lambda p: P(None, *p), bspec, is_leaf=lambda x: isinstance(x, P))
 
+        metrics_spec = {"loss": P(), "overflow": P()}
+        if self._pipeline_on():
+            # the pipelined window reports two extra replicated counters;
+            # the serial branch keeps EXACTLY the round-17 spec dict (the
+            # byte-identical-HLO guarantee extends to the jit cache key)
+            metrics_spec["conflict"] = {n: P()
+                                        for n in self.model.ps_specs()}
+            metrics_spec["conflict_overflow"] = P()
         many = jax.shard_map(
             self.train_many, mesh=self.mesh,
             in_specs=(state_spec, stacked_spec),
-            out_specs=(state_spec, {"loss": P(), "overflow": P()}),
+            out_specs=(state_spec, metrics_spec),
             check_vma=False,
         )
         self._train_many_fn = jax.jit(many, donate_argnums=(0,))

@@ -174,7 +174,12 @@ class PlacementController:
             state = self.apply_wire(state, self.policy.recommend_wire(tel))
         sizes = self.policy.size_hot(tel)
         hot_rows = {n: int(h) for n, h in sizes.items() if h > 0}
-        mig_rows = {n: self.policy.mig_rows for n in self._managed_tables()}
+        # per-table annex capacity off the measured cold-tail imbalance
+        # (policy.size_mig); tables the telemetry doesn't cover keep the
+        # static default
+        sized_mig = self.policy.size_mig(tel)
+        mig_rows = {n: int(sized_mig.get(n, self.policy.mig_rows))
+                    for n in self._managed_tables()}
         tr = self.trainer
         changed = False
         for attr, val in (("hot_rows", hot_rows), ("mig_rows", mig_rows)):
@@ -199,9 +204,12 @@ class PlacementController:
         for n, h in hot_rows.items():
             _metrics.observe("placement.hot_rows", float(h), "gauge",
                              labels={"table": n})
+        for n, m in mig_rows.items():
+            _metrics.observe("placement.mig_rows", float(m), "gauge",
+                             labels={"table": n})
         _trace.event("placement", "prime",
                      hot_rows=dict(hot_rows),
-                     mig_rows=self.policy.mig_rows,
+                     mig_rows=dict(mig_rows),
                      budget_bytes=self.policy.hot_budget_bytes)
         if tr.mig_enabled:
             state = tr.migrate_rows(state)  # attach empty directories
@@ -213,6 +221,15 @@ class PlacementController:
                     self._last_refresh_reason[n] = "prime"
         self._primed = True
         return state
+
+    def _mig_cap(self, name: str) -> int:
+        """The table's INSTALLED annex capacity (a trace-time shape the
+        trainer holds after prime) — plans must fit it; falls back to the
+        policy's static default before prime sizes the annexes."""
+        cap = getattr(self.trainer, "mig_rows", 0)
+        if isinstance(cap, dict):
+            return int(cap.get(name, 0)) or self.policy.mig_rows
+        return int(cap) or self.policy.mig_rows
 
     # -- decide --------------------------------------------------------------
 
@@ -292,7 +309,7 @@ class PlacementController:
                     base[int(i) % S] += ws
                 ids, owners, proj = plan_migration(
                     base, cands, num_shards=S,
-                    max_moves=self.policy.mig_rows,
+                    max_moves=self._mig_cap(t.name),
                     target=self.policy.imbalance_target,
                     total=cold_tot, exclude=hot_ids)
                 moves = (ids, owners)

@@ -86,10 +86,12 @@ class PlacementPolicy:
 
     - `hot_budget_bytes`: the ONE knob operators must set — per-device
       replicated-cache byte budget (see module doc).
-    - `mig_rows`: migration annex capacity per table (static; contents
-      rotate freely). The annex costs `mig_rows x row_bytes` per shard
-      per table — cheap next to the hot budget, so it is a default, not a
-      budget term.
+    - `mig_rows`: migration annex scale (capacity is static per table;
+      contents rotate freely). The annex costs rows x `row_bytes` per
+      shard per table — cheap next to the hot budget, so it is a default,
+      not a budget term. `size_mig` adapts the per-table capacity within
+      [mig_rows/4, 4*mig_rows] off the measured cold-tail imbalance; the
+      flat value is the no-telemetry fallback.
     - `refresh_min_gain`: predicted hit-ratio gain (new top-H coverage minus
       installed-set coverage) a refresh must clear — the hysteresis band
       that stops the controller chasing sketch noise.
@@ -163,6 +165,57 @@ class PlacementPolicy:
             if self.min_hot_rows and t.coverage:
                 alloc[t.name] = max(alloc[t.name], self.min_hot_rows)
         return alloc
+
+    def size_mig(self, tables: Sequence[TableTelemetry]) -> Dict[str, int]:
+        """Per-table migration annex capacity M off the MEASURED cold-tail
+        imbalance (`shard_positions` — the same vector `migration_due`
+        gates on).
+
+        The annex is a static shape: every row costs `row_bytes` per shard
+        whether used or not, and capacity can only change at a re-jit. A
+        flat table wastes the static default; a heavily skewed one starves
+        at it. Sizing rule per table: count the sketch heavy hitters homed
+        on the hottest shard whose estimated step traffic covers that
+        shard's excess over `imbalance_target`, double it (draining the
+        head exposes followers the planner also wants to move), clamp to
+        [mig_rows/4, 4*mig_rows]. A within-target table gets the floor; a
+        table whose tracked mass cannot cover the excess gets the cap (the
+        skew lives below the sketch's horizon — give the planner room).
+        Tables with no load vector or no sketch data keep the static
+        `mig_rows` default."""
+        lo = max(self.mig_rows // 4, 1)
+        hi = max(self.mig_rows * 4, 1)
+        out: Dict[str, int] = {}
+        for t in tables:
+            if t.shard_positions is None or not t.top_ids:
+                out[t.name] = self.mig_rows
+                continue
+            load = np.asarray(t.shard_positions, np.float64)
+            mean = float(load.mean())
+            if mean <= 0:
+                out[t.name] = self.mig_rows
+                continue
+            S = int(load.size)
+            hot_shard = int(load.argmax())
+            excess = float(load[hot_shard]) - self.imbalance_target * mean
+            if excess <= 0:
+                out[t.name] = lo
+                continue
+            step_total = float(load.sum())
+            total = max(t.total, 1.0)
+            need, covered = 0, 0.0
+            for i, e in t.top_ids:
+                if int(i) % S != hot_shard:
+                    continue
+                need += 1
+                covered += max(float(e), 0.0) / total * step_total
+                if covered >= excess:
+                    break
+            if covered < excess:
+                out[t.name] = hi
+            else:
+                out[t.name] = int(np.clip(2 * need, lo, hi))
+        return out
 
     # -- refresh hysteresis --------------------------------------------------
 

@@ -34,13 +34,17 @@ whenever the initializer is slot-independent (e.g. Constant) — tested in
 `tests/test_host_offload.py`. With slot-position-dependent random init, first-touch
 values differ (the documented init-on-slot divergence of `tables/hash_table.py`).
 
-Pipelining (round 14, arXiv:1905.04035): with `pipeline=True` a one-worker
-staging thread double-buffers the NEXT batch's host lookup + device upload
-(`stage(ids)`, driven by `Trainer.offload_stage`) while the current step
-computes; the matching `prepare(ids)` consumes the payload and pays only the
-jitted scatter. Staging is a HINT — an epoch counter bumped on every
-residency/store mutation invalidates stale payloads, and mismatches fall
-back to the synchronous path, so correctness never depends on the loop shape.
+Pipelining (round 14, arXiv:1905.04035; ring depth round 18): with
+`pipeline=True` a one-worker staging thread buffers up to `stage_depth`
+future batches' host lookups + device uploads (`stage(ids)`, driven by
+`Trainer.offload_stage`) while the current step computes; the matching
+`prepare(ids)` consumes the payload and pays only the jitted scatter.
+Staging is a HINT — a residency epoch plus a `HostStore.version` counter
+invalidate stale payloads (a residency-only change revalidates by
+re-splitting the batch and accepting iff the non-resident set is unchanged,
+the depth>1 steady state), and mismatches fall back to the synchronous
+path, so correctness never depends on the loop shape.
+`offload.pipeline_occupancy{slot=}` gauges per-ring-slot hit rate.
 Admit shapes pad to powers of two (like the eviction pads), so the pipelined
 path compiles a bounded program set and `assert_no_recompile` enforces it.
 `densify_k=K` batches the evict/flush writebacks: K rounds append into
@@ -54,6 +58,7 @@ from __future__ import annotations
 import threading
 import time
 import warnings
+from collections import deque
 from typing import Dict, Optional, Tuple
 
 import jax
@@ -85,6 +90,10 @@ class HostStore:
         self._lock = threading.RLock()
         # deferred writeback chunks, oldest first: [(sorted ids, w, slots)]
         self._pending = []
+        # content version: bumped on every mutation `lookup` could observe
+        # (merge/defer/replace_all). Staged payloads record the version they
+        # looked up against; a changed version invalidates them.
+        self.version = 0
 
     def __len__(self) -> int:
         return len(self.ids)
@@ -124,6 +133,7 @@ class HostStore:
             return
         order = np.argsort(ids, kind="stable")
         with self._lock:
+            self.version += 1
             self._pending.append((
                 np.asarray(ids)[order].astype(np.int64),
                 np.asarray(weights)[order].astype(np.float32),
@@ -158,6 +168,7 @@ class HostStore:
         ids, weights = ids[order], weights[order]
         slots = {k: v[order] for k, v in slots.items()}
         with self._lock:
+            self.version += 1
             if len(self.ids) == 0:
                 exists = np.zeros((len(ids),), bool)
                 pos_c = np.zeros((len(ids),), np.int64)
@@ -205,6 +216,7 @@ class HostStore:
             out.slots = {k: v.copy() for k, v in self.slots.items()}
             out._lock = threading.RLock()
             out._pending = []
+            out.version = 0
             return out
 
     def replace_all(self, ids: np.ndarray, weights: np.ndarray,
@@ -212,6 +224,7 @@ class HostStore:
         """Wholesale replacement (checkpoint load); ids must be unique."""
         order = np.argsort(ids, kind="stable")
         with self._lock:
+            self.version += 1
             self._pending = []  # stale by definition: the store they patched is gone
             self.ids = ids[order].astype(np.int64)
             self.weights = weights[order].astype(np.float32)
@@ -401,7 +414,8 @@ class HostOffloadTable:
     def __init__(self, spec: EmbeddingSpec, optimizer: SparseOptimizer, *,
                  seed: int = 0, high_water: float = 0.6,
                  mesh=None, axis=None, eviction: str = "clock",
-                 pipeline: bool = False, densify_k: int = 1):
+                 pipeline: bool = False, stage_depth: int = 1,
+                 densify_k: int = 1):
         if not spec.use_hash_table:
             raise ValueError("host offload needs a hash-table spec "
                              "(input_dim=-1 + capacity)")
@@ -411,6 +425,8 @@ class HostOffloadTable:
             raise ValueError("eviction must be 'clock' or 'flush'")
         if int(densify_k) < 1:
             raise ValueError("densify_k >= 1 (1 = merge every writeback)")
+        if int(stage_depth) < 1:
+            raise ValueError("stage_depth >= 1 (1 = single staging slot)")
         self.spec = spec
         self.optimizer = optimizer
         self.seed = seed
@@ -467,18 +483,29 @@ class HostOffloadTable:
         # externally-visible store content never lags)
         self.densify_k = int(densify_k)
         self._defer_count = 0
-        # pipelined staging (double buffer): `stage(ids)` runs the NEXT
+        # pipelined staging (ring, depth D): `stage(ids)` runs a FUTURE
         # batch's host lookup + device upload on this worker while the
-        # current step computes; `prepare(ids)` consumes the staged payload
-        # when the batch matches and nothing invalidated it (`_epoch` bumps
-        # on every residency/store mutation), else falls back to the
+        # current step computes; up to `stage_depth` batches may be in
+        # flight, oldest first. `prepare(ids)` consumes the matching staged
+        # payload when nothing invalidated it (`_epoch` bumps on every
+        # residency mutation, `HostStore.version` on every store mutation);
+        # a residency-only change re-splits the staged batch against the
+        # CURRENT residency snapshot and accepts iff the non-resident set is
+        # unchanged — the deep-ring steady state, where earlier in-flight
+        # batches admit disjoint ids. Everything else falls back to the
         # synchronous path. Admit shapes pad to powers of two, so the
         # pipelined path never re-jits (`assert_no_recompile` below).
         self.pipeline = bool(pipeline)
+        self.stage_depth = int(stage_depth)
         self._epoch = 0
-        self._staged = None  # (raw ids copy, epoch at stage, Future)
+        # oldest first: (raw ids copy, epoch at stage, store version at
+        # stage, ring slot label, Future)
+        self._stage_ring: deque = deque()
+        self._stage_seq = 0
         self._pipe_hits = 0
         self._pipe_misses = 0
+        self._slot_hits: Dict[int, int] = {}
+        self._slot_misses: Dict[int, int] = {}
         self._stage_pool = None
         if self.pipeline:
             from concurrent.futures import ThreadPoolExecutor
@@ -614,16 +641,22 @@ class HostOffloadTable:
                             stage_s / (stage_s + admit_s + 1e-12), "gauge")
 
     def stage(self, ids) -> None:
-        """Pipelined double-buffer: run the NEXT batch's host lookup +
-        device upload on the staging worker while the current step computes.
-        No-op unless built with pipeline=True. The matching `prepare(ids)`
-        consumes the payload; any intervening residency/store change
-        (`_epoch`) or a different batch falls back to the sync path, so
-        staging is only ever a hint — never a correctness dependency."""
+        """Pipelined stage-ahead: run a FUTURE batch's host lookup + device
+        upload on the staging worker while the current step computes. Up to
+        `stage_depth` batches ride the ring (oldest dropped, counted as a
+        miss, when a new stage would exceed the depth). No-op unless built
+        with pipeline=True. The matching `prepare(ids)` consumes the
+        payload; an invalidating residency/store change or a different batch
+        falls back to the sync path, so staging is only ever a hint — never
+        a correctness dependency. Known conservative case: with depth >= 2,
+        an id newly introduced in TWO in-flight batches makes the later
+        batch's non-resident set shrink when the earlier one admits it, so
+        the later stage misses (still bit-identical via the sync path)."""
         if not self.pipeline:
             return
         raw = np.array(ids, copy=True)
         epoch = self._epoch
+        sver = self.store.version
         resident = self._resident_sorted  # replaced-not-mutated: safe to share
 
         def work():
@@ -638,30 +671,68 @@ class HostOffloadTable:
                     "payload": payload,
                     "stage_s": time.perf_counter() - t0}
 
-        self._staged = (raw, epoch, self._stage_pool.submit(work))
+        while len(self._stage_ring) >= self.stage_depth:
+            # drop-oldest: staged but never consumed is wasted overlap
+            _, _, _, slot, _ = self._stage_ring.popleft()
+            self._pipe_miss(slot)
+        slot = self._stage_seq % self.stage_depth
+        self._stage_seq += 1
+        self._stage_ring.append(
+            (raw, epoch, sver, slot, self._stage_pool.submit(work)))
+
+    def _pipe_hit(self, slot: int) -> None:
+        self._pipe_hits += 1
+        self._slot_hits[slot] = self._slot_hits.get(slot, 0) + 1
+        metrics.observe("offload.pipeline_hits", 1)
+        self._observe_occupancy()
+
+    def _pipe_miss(self, slot: int) -> None:
+        self._pipe_misses += 1
+        self._slot_misses[slot] = self._slot_misses.get(slot, 0) + 1
+        metrics.observe("offload.pipeline_misses", 1)
+        self._observe_occupancy()
 
     def _take_staged(self, ids):
-        """The staged result iff it matches this prepare call and is still
-        valid; None (recorded as a pipeline miss) otherwise."""
-        if self._staged is None:
-            return None
-        raw, epoch, fut = self._staged
-        self._staged = None
-        res = fut.result()  # join the worker before touching shared state
+        """The staged result iff a ring entry matches this prepare call and
+        is still valid; None otherwise. Entries staged for other batches in
+        front of the match are popped and counted as misses; entries BEHIND
+        the match (later batches in a deep ring) stay staged. Validity:
+        exact when neither residency epoch nor store version moved; when
+        only residency moved, the batch is re-split against the current
+        snapshot and accepted iff the non-resident set is unchanged (the
+        staged store lookup then still covers exactly the admit set — a
+        changed set could overwrite trained rows with stale store values)."""
         now = np.asarray(ids)
-        if (epoch != self._epoch or raw.shape != now.shape
-                or raw.dtype != now.dtype or not np.array_equal(raw, now)):
-            self._pipe_misses += 1
-            metrics.observe("offload.pipeline_misses", 1)
-            self._observe_occupancy()
+        while self._stage_ring:
+            raw, epoch, sver, slot, fut = self._stage_ring.popleft()
+            if (raw.shape != now.shape or raw.dtype != now.dtype
+                    or not np.array_equal(raw, now)):
+                self._pipe_miss(slot)
+                continue
+            res = fut.result()  # join the worker before touching shared state
+            if epoch == self._epoch and sver == self.store.version:
+                res["slot"] = slot
+                return res
+            if sver == self.store.version:
+                pos_c, hit, new = self._split_batch(res["flat"],
+                                                    self._resident_sorted)
+                if np.array_equal(new, res["new"]):
+                    return dict(res, pos_c=pos_c, hit=hit, slot=slot)
+            self._pipe_miss(slot)
             return None
-        return res
+        return None
 
     def _observe_occupancy(self) -> None:
         total = self._pipe_hits + self._pipe_misses
         if total:
             metrics.observe("offload.pipeline_occupancy",
                             self._pipe_hits / total, "gauge")
+        for slot in sorted(set(self._slot_hits) | set(self._slot_misses)):
+            h = self._slot_hits.get(slot, 0)
+            t = h + self._slot_misses.get(slot, 0)
+            if t:
+                metrics.observe("offload.pipeline_occupancy", h / t, "gauge",
+                                labels={"slot": str(slot)})
 
     def prepare(self, ids) -> None:
         """Make the cache ready for a batch: evict/flush if needed, re-admit
@@ -684,9 +755,7 @@ class HostOffloadTable:
             if hit.any():
                 # second-chance bit: this batch's residents are HOT
                 self._ref[staged["pos_c"][hit]] = True
-            self._pipe_hits += 1
-            metrics.observe("offload.pipeline_hits", 1)
-            self._observe_occupancy()
+            self._pipe_hit(staged["slot"])
             if new.size == 0:
                 return
             if not self._would_exceed(new):

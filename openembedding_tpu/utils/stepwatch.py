@@ -26,6 +26,15 @@ set the baseline and `exchange.cost_drift` gauges the relative drift
 (0 = the wire is priced as it was when training started; a mispriced wire
 or placement policy shows up as sustained drift instead of silently
 mis-steering byte-budget decisions).
+
+Overlap awareness (round 18): software-pipelined windows move the prefetched
+exchange off the critical path — the wire model marks those bytes
+`overlapped_bytes`. Charging them to the sampled wall time would understate
+µs/byte while pipelined and read as phantom drift the moment pipelining
+toggles; instead only the EXPOSED bytes (`bytes_per_step − overlapped_bytes`)
+price the baseline, and `trainer.overlap_ms` gauges the modeled time the
+hidden bytes would have cost at the baseline rate — the sum-of-parts minus
+measured-wall evidence that the hiding is real.
 """
 
 from __future__ import annotations
@@ -133,8 +142,14 @@ class StepWatch:
                                     "gauge", labels={"kind": kind})
         cost = self.wire_cost() if self.wire_cost is not None else None
         bytes_per_step = int((cost or {}).get("bytes_per_step", 0) or 0)
-        if bytes_per_step > 0:
-            us_per_byte = ms * 1e3 / bytes_per_step
+        overlapped = int((cost or {}).get("overlapped_bytes", 0) or 0)
+        # pipelined windows hide `overlapped` bytes under the dense compute —
+        # only the EXPOSED bytes sit on the sampled critical path, so they
+        # alone price µs/byte and the drift baseline (no phantom drift when
+        # pipelining toggles)
+        exposed = max(bytes_per_step - overlapped, 0)
+        if exposed > 0:
+            us_per_byte = ms * 1e3 / exposed
             metrics.observe("exchange.us_per_byte", us_per_byte, "gauge")
             if self._baseline_n < BASELINE_SAMPLES:
                 n = self._baseline_n
@@ -145,6 +160,12 @@ class StepWatch:
                 metrics.observe(
                     "exchange.cost_drift",
                     us_per_byte / self._baseline_us_per_byte - 1.0, "gauge")
+        if overlapped > 0 and self._baseline_us_per_byte:
+            # modeled time the hidden collectives would have added had they
+            # stayed on the critical path (sum-of-parts − measured wall)
+            metrics.observe("trainer.overlap_ms",
+                            overlapped * self._baseline_us_per_byte / 1e3,
+                            "gauge")
 
     def wrap(self, fn):
         """-> callable with the same signature as `fn`; every Nth call is

@@ -6,6 +6,7 @@ evict/re-admit round trips."""
 import numpy as np
 import pytest
 
+import jax
 import jax.numpy as jnp
 
 import openembedding_tpu as embed
@@ -345,9 +346,12 @@ def test_pipeline_churn_single_admit_trace():
 
 
 def test_pipeline_stale_stage_discarded():
-    """A staged payload for the WRONG batch (or one invalidated by a
-    residency change) must be discarded — counted as a miss, never
-    consumed — and the step still trains correctly."""
+    """A staged payload for the WRONG batch must be discarded (miss, never
+    consumed); a residency-only change (reset_cache — the store untouched)
+    REVALIDATES the staged payload against the new snapshot and accepts it
+    (the round-18 ring steady state); a store MUTATION after staging
+    genuinely invalidates it (miss — stale store values could overwrite
+    trained rows). Every path still trains correctly."""
     from openembedding_tpu.utils import metrics as M
     opt = embed.Adagrad(learning_rate=0.2)
     off = HostOffloadTable(_spec(32), opt, high_water=0.8, pipeline=True)
@@ -358,11 +362,20 @@ def test_pipeline_stale_stage_discarded():
     assert off._pipe_misses == 1 and off._pipe_hits == 0
     assert all(off.is_resident(int(i)) for i in b)
     off.stage(a)
-    off.reset_cache()       # epoch bump invalidates the staged payload
-    off.prepare(a)
-    assert off._pipe_misses == 2 and off._pipe_hits == 0
+    off.reset_cache()       # residency-only: the staged lookup re-splits
+    off.prepare(a)          # to the same non-resident set -> accepted
+    assert off._pipe_misses == 1 and off._pipe_hits == 1
     assert all(off.is_resident(int(i)) for i in a)
-    assert M.report().get("offload.pipeline_occupancy") == 0.0
+    c = np.arange(300, 312, dtype=np.int64)
+    off.stage(c)
+    init = {k: np.asarray(v) for k, v in
+            jax.device_get(opt.init_slots(1, DIM)).items()}
+    off.store.merge(np.array([999], np.int64),
+                    np.zeros((1, DIM), np.float32), init)  # version bump
+    off.prepare(c)          # store mutated under the stage -> miss
+    assert off._pipe_misses == 2 and off._pipe_hits == 1
+    assert all(off.is_resident(int(i)) for i in c)
+    assert M.report().get("offload.pipeline_occupancy") == pytest.approx(1 / 3)
 
 
 def test_densified_flush_equals_direct_merges():

@@ -130,6 +130,20 @@ CONFIGS = (
      "hot_rows": 0, "dense_shard": True, "dense_wire": "int8",
      "require_a2a_dtypes": ("s8",),
      "pins": {"hlo_reduce_scatter_bytes": 0}},
+    # round-18 software-pipelined train_many: the K-step window compiles a
+    # scan whose body prefetches batch t+1's exchange BEFORE batch t's dense
+    # compute/apply. fused_fp32_many is the serial K-step window on the same
+    # model so the pipelined delta is a reviewable json diff: pipelining may
+    # add ONLY the conflict-patch collectives (wire_conflict_patch_bytes —
+    # the exact-replay re-gather of rows batch t updated) on top of the
+    # serial set — zero hidden wire beyond the patch. The unattributed pin
+    # is update-proof: GSPMD must not insert resharding into the rotated
+    # carry plumbing.
+    {"name": "fused_fp32_many", "group_exchange": True, "wire": "fp32",
+     "hot_rows": 0, "train_many": 4},
+    {"name": "fused_fp32_pipelined", "group_exchange": True, "wire": "fp32",
+     "hot_rows": 0, "train_many": 4, "pipeline_steps": True,
+     "pins": {"unattributed_collectives": 0}},
 )
 
 
@@ -267,22 +281,44 @@ def make_trainer(config: Dict):
         hot_wire=config.get("hot_wire"),
         dense_shard=config.get("dense_shard", False),
         dense_wire=config.get("dense_wire"),
-        sentinel=config.get("sentinel", False))
+        sentinel=config.get("sentinel", False),
+        pipeline_steps=config.get("pipeline_steps", False))
     return trainer, batch
 
 
-def measure_trainer(trainer, batch) -> Dict[str, int]:
+def measure_trainer(trainer, batch, *, train_many: int = 0) -> Dict[str, int]:
     """Compile the train step, count collectives, record the static wire
     model (`exchange.wire_bytes_per_step` from `trainer.last_wire_cost`)
     AND the measured truth: per-collective payload bytes/dtypes read off
     the compiled HLO, plus `wire_model_delta` = measured minus modeled a2a
-    bytes (0 == the cost model prices the compiled program exactly)."""
+    bytes (0 == the cost model prices the compiled program exactly).
+
+    `train_many=K` compiles the K-step `jit_train_many` window instead of
+    the single step (the round-18 pipelined-scan configs): counts are then
+    per compiled MODULE — the scan body's collectives appear once however
+    many iterations run — with the prologue/epilogue instances on top, so
+    a serial-window baseline config is what makes the numbers comparable."""
     state = trainer.init(batch)
-    step = trainer.jit_train_step(batch, state)
-    text = step.lower(state, batch).compile().as_text()
+    if train_many:
+        import jax as _jax
+        import numpy as _np
+        stacked = _jax.tree_util.tree_map(
+            lambda x: _np.stack([_np.asarray(x)] * int(train_many)), batch)
+        fn = trainer.jit_train_many(stacked, state)
+        text = fn.lower(state, stacked).compile().as_text()
+    else:
+        step = trainer.jit_train_step(batch, state)
+        text = step.lower(state, batch).compile().as_text()
     counts = count_collectives(text)
     cost = trainer.last_wire_cost or {}
     counts["wire_bytes_per_step"] = int(cost.get("bytes_per_step", 0))
+    if "conflict_patch_bytes" in cost:
+        # pipelined configs only: the ONLY wire the pipeline may add, plus
+        # the modeled bytes it moves off the critical path
+        counts["wire_conflict_patch_bytes"] = int(
+            cost["conflict_patch_bytes"])
+        counts["wire_overlapped_bytes"] = int(
+            cost.get("overlapped_bytes", 0))
     pay = collective_payloads(
         text, kinds=("all_to_all", "all_gather", "reduce_scatter"))
     a2a = [(d, b) for k, d, b in pay if k == "all_to_all"]
@@ -311,7 +347,8 @@ def measure(configs=CONFIGS) -> Dict[str, Dict[str, int]]:
     out: Dict[str, Dict[str, int]] = {}
     for cfg in configs:
         trainer, batch = make_trainer(cfg)
-        out[cfg["name"]] = measure_trainer(trainer, batch)
+        out[cfg["name"]] = measure_trainer(
+            trainer, batch, train_many=cfg.get("train_many", 0))
     return out
 
 

@@ -195,21 +195,27 @@ def recommend_report(samples, *, budget_bytes: int, mig_rows: int,
                              imbalance_target=imbalance_target)
     sizes = policy.size_hot(tel)
     wires = policy.recommend_wire(tel)
+    # per-table annex capacity off the measured cold-tail imbalance — the
+    # same sizing `PlacementController.prime` installs
+    migs = policy.size_mig(tel)
     lines = [f"policy: hot_budget={budget_bytes}B mig_rows={mig_rows} "
+             f"(flat default; per-table M below) "
              f"imbalance_target={imbalance_target}"]
     for t in tel:
         H = sizes.get(t.name, 0)
+        M = migs.get(t.name, mig_rows)
         hot_ids = [i for i, _e in t.top_ids[:H]]
         line = (f"table {t.name}: hot_rows={H} "
                 f"({H * row_bytes(t.dim, t.slot_cols)}B replicated) "
                 f"predicted_hit={t.share_at(H):.3f} "
-                f"wire={wires.get(t.name, 'bf16')}")
+                f"wire={wires.get(t.name, 'bf16')} "
+                f"mig_rows={M}")
         if t.shard_positions is not None and t.shard_positions.sum() > 0:
             load = t.shard_positions
             imb = float(load.max() / load.mean())
             mids, mown, proj = plan_migration(
                 load, candidate_weights(t.top_ids, hot_ids),
-                num_shards=load.size, max_moves=mig_rows,
+                num_shards=load.size, max_moves=M,
                 target=imbalance_target, total=t.total, exclude=hot_ids)
             line += (f" imbalance={imb:.3f} migration_plan={mids.size} rows"
                      f" -> projected {proj:.3f}")
@@ -242,7 +248,9 @@ def main(argv=None) -> int:
     ap.add_argument("--hot-budget-kb", type=float, default=64.0,
                     help="--recommend: replicated hot-cache byte budget")
     ap.add_argument("--mig-rows", type=int, default=64,
-                    help="--recommend: migration annex capacity per table")
+                    help="--recommend: migration annex scale (the policy "
+                         "sizes each table's M within [x/4, 4x] off the "
+                         "measured shard imbalance)")
     ap.add_argument("--imbalance-target", type=float, default=1.05)
     ap.add_argument("--dim", type=int, default=16,
                     help="--recommend: row dim fallback when the scrape "

@@ -168,6 +168,20 @@ CASES = [
       "OETPU_BENCH_TOTAL_BUDGET_S": "840",
       "OETPU_BENCH_PROBE_TIMEOUT_S": "75",
       "JAX_PLATFORMS": "cpu"}, 900),
+    # 14b. round-18 software-pipelined train loop (bench 'pipeline' case:
+    #     pipeline_steps on/off over K=8 scan windows — ms/step, loss bit
+    #     parity, conflict-patch vs overlapped bytes). CPU pins the structure
+    #     (bit-exactness + patch-byte accounting); a chip re-run pins the
+    #     actual overlap speedup. TWO fused-exchange train_many compiles on
+    #     the 8-virtual-device CPU mesh, budget sized like bench_wire_total.
+    ("bench_pipeline",
+     [sys.executable, os.path.join(REPO, "bench.py")],
+     {"OETPU_BENCH_CASES": "pipeline",
+      "OETPU_BENCH_BUDGET_S": "1100",
+      "OETPU_BENCH_TOTAL_BUDGET_S": "1340",
+      "OETPU_BENCH_PROBE_TIMEOUT_S": "75",
+      "JAX_PLATFORMS": "cpu",
+      "XLA_FLAGS": "--xla_force_host_platform_device_count=8"}, 1400),
     # 15. round-16 numerics sentinel + step watch (bench 'health' case:
     #     per-step loop with sentinel+measure_every on vs off — the <= 2%
     #     overhead acceptance bound). Single-chip relay case like bench_dim9;
