@@ -14,20 +14,23 @@ CPU_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 	XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 .PHONY: ci test dryrun bench-smoke native lint lint-fast lint-budget \
-	lint-metrics
+	lint-metrics weave
 
-ci: lint test dryrun bench-smoke
+ci: lint test dryrun bench-smoke weave
 
-# the full static-analysis + invariant-guard suite (tools/oelint): eight
+# the full static-analysis + invariant-guard suite (tools/oelint): eleven
 # passes — trace-hazard (recompile hazards in jit-reachable code), host-sync
 # (device_get discipline in `# oelint: hot-path` fns), sharding
 # (PartitionSpec placement-flow consistency), spmd-divergence (per-process
 # host control flow upstream of collectives), hlo-budget (compiled
 # collective counts vs tools/oelint/hlo_budget.json), implicit-reshard
 # (GSPMD-inserted collectives with no traced-op attribution), lockset
-# (`# guarded-by:` discipline + lock-ordering cycles), metrics (name
-# hygiene). CPU-only, no chip; passes run concurrently and the compiles are
-# cached on a source digest — warm runs finish in seconds (<= 25 s budget).
+# (`# guarded-by:` discipline + lock-ordering cycles), atomicity
+# (check-then-act split across a lock release), cond-wait (Condition.wait
+# predicate loops, notify under the lock), thread-lifecycle (every thread
+# has a reachable join), metrics (name hygiene). CPU-only, no chip; passes
+# run concurrently and the compiles are cached on a source digest — warm
+# runs finish in seconds (<= 25 s budget).
 lint:
 	$(CPU_ENV) $(PY) -m tools.oelint
 
@@ -46,6 +49,15 @@ lint-budget:
 # metrics pass and runs as part of `make lint`)
 lint-metrics:
 	$(PY) tools/lint_metrics.py
+
+# deterministic concurrency testing (tools/oeweave): explore seeded-random +
+# preemption-bounded interleavings of the threaded control plane (subscriber
+# state machine, micro-batcher, persister, placement watcher, offload store,
+# sketch worker, reporter, SLO evaluator) on a cooperative scheduler; any
+# failing schedule prints a replay token that reproduces it bit-for-bit.
+# ~60 s budget; typical full run is a few seconds.
+weave:
+	$(CPU_ENV) $(PY) -m tools.oeweave --budget-s 60
 
 # the full battery (mesh collectives, serving HA processes, persist crash
 # consistency, planted-signal AUC regression, keras parity, ...)

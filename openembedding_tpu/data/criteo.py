@@ -486,6 +486,19 @@ def prefetch_to_device(it: Iterator, size: int = 2,
 
     q: queue_mod.Queue = queue_mod.Queue(maxsize=size)
     _END = object()
+    stop = threading.Event()
+
+    def _put(item) -> bool:
+        """Bounded put: gives up once the consumer has left (a consumer that
+        abandons the generator would otherwise strand the producer blocked
+        forever on the full queue — the thread leak this replaces)."""
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.05)
+                return True
+            except queue_mod.Full:
+                continue
+        return False
 
     def producer():
         try:
@@ -494,17 +507,27 @@ def prefetch_to_device(it: Iterator, size: int = 2,
                     item = jax.device_put(item, sharding)
                 else:
                     item = jax.tree_util.tree_map(jax.numpy.asarray, item)
-                q.put(item)
-            q.put(_END)
+                if not _put(item):
+                    return
+            _put(_END)
         except BaseException as e:  # propagate to the consumer, don't fake EOF
-            q.put(e)
+            _put(e)
 
     t = threading.Thread(target=producer, daemon=True)
     t.start()
-    while True:
-        item = q.get()
-        if item is _END:
-            return
-        if isinstance(item, BaseException):
-            raise item
-        yield item
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()
+        while True:  # unblock a producer mid-put, then reap it
+            try:
+                q.get_nowait()
+            except queue_mod.Empty:
+                break
+        t.join(timeout=5)

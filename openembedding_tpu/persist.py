@@ -140,6 +140,8 @@ class AsyncPersister:
                     shutil.rmtree(d, ignore_errors=True)
         self._q: "queue.Queue" = queue.Queue(maxsize=window)
         self._error: Optional[BaseException] = None
+        self._close_mu = threading.Lock()
+        self._closed = False  # guarded-by: self._close_mu
         self._thread = threading.Thread(target=self._writer, daemon=True)
         self._thread.start()
 
@@ -207,6 +209,11 @@ class AsyncPersister:
         while True:
             item = self._q.get()
             if item is None:
+                # balance the sentinel's put: a wait()/close() AFTER this
+                # close would otherwise block forever in _q.join() on the
+                # never-finished sentinel task (oeweave async_persister
+                # scenario: racing double close deadlocked here)
+                self._q.task_done()
                 return
             write_cb, step, path = item
             try:
@@ -310,6 +317,14 @@ class AsyncPersister:
         self._raise_pending_error()
 
     def close(self) -> None:
+        # idempotent, including racing closes (`with persister:` + an
+        # explicit close, or an atexit hook): only the first caller drains
+        # and stops the writer; later/racing callers just wait for it
+        with self._close_mu:
+            first, self._closed = not self._closed, True
+        if not first:
+            self._thread.join(timeout=30)
+            return
         try:
             self.wait()
         finally:

@@ -843,6 +843,9 @@ class ServingHandler(BaseHTTPRequestHandler):
             if kind == "node":
                 # reference: controller can shut nodes down
                 # (`ModelController.cpp:158-164`); here the node is this process
+                # oelint: disable=thread-lifecycle -- shutdown() must run off
+                # the request thread (it blocks until this very handler
+                # returns); the thread self-terminates with the server
                 threading.Thread(target=self.server.shutdown, daemon=True).start()
                 return self._json(200, {"shutdown": sign})
             return self._json(404, {"error": "not found"})
@@ -1008,6 +1011,10 @@ class MicroBatcher:
             leader = len(group) == 1
             if not leader and sum(e["n"] for e in group) >= self.max_batch:
                 self._full.notify_all()  # wake the leader early
+        # oelint: disable=atomicity -- leadership is decided once at enqueue
+        # (len==1 under the lock) and never contested: followers only wait,
+        # and the pop under the re-taken lock is the leader's own key, so the
+        # snapshot cannot go stale between the two critical sections
         if leader:
             # the first arrival owns the window + the device call; a full
             # group releases it before the window expires

@@ -196,8 +196,10 @@ class SyncSubscriber:
 
     def _sync_once(self) -> int:
         servable = self.manager.find_model(self.model_sign)
-        if self.version is None:
-            with self._mu:
+        with self._mu:
+            # check and seed under one lock: a poll racing a manual
+            # sync_once() must not both observe None and double-seed
+            if self.version is None:
                 self.version = int(getattr(servable, "step", 0))
         sign = quote(self.model_sign, safe="")
         q = (f"?after={self.version}&wait_s={self.wait_s}"

@@ -83,17 +83,17 @@ class HostStore:
     the batching."""
 
     def __init__(self, dim: int, slot_widths: Dict[str, int]):
-        self.ids = np.empty((0,), np.int64)
-        self.weights = np.empty((0, dim), np.float32)
-        self.slots = {k: np.empty((0, w), np.float32)
-                      for k, w in slot_widths.items()}
         self._lock = threading.RLock()
+        self.ids = np.empty((0,), np.int64)  # guarded-by: self._lock
+        self.weights = np.empty((0, dim), np.float32)  # guarded-by: self._lock
+        self.slots = {k: np.empty((0, w), np.float32)
+                      for k, w in slot_widths.items()}  # guarded-by: self._lock
         # deferred writeback chunks, oldest first: [(sorted ids, w, slots)]
-        self._pending = []
+        self._pending = []  # guarded-by: self._lock
         # content version: bumped on every mutation `lookup` could observe
         # (merge/defer/replace_all). Staged payloads record the version they
         # looked up against; a changed version invalidates them.
-        self.version = 0
+        self.version = 0  # guarded-by: self._lock
 
     def __len__(self) -> int:
         return len(self.ids)
@@ -499,7 +499,12 @@ class HostOffloadTable:
         self.stage_depth = int(stage_depth)
         self._epoch = 0
         # oldest first: (raw ids copy, epoch at stage, store version at
-        # stage, ring slot label, Future)
+        # stage, ring slot label, Future). Ring + slot counters are
+        # TRAINING-THREAD-OWNED (not lock-guarded): stage()/prepare() both
+        # run on the training thread; the one-worker pool only executes the
+        # submitted closure, which touches the store (own RLock) and the
+        # device — never this ring. Audited round 19 (oeweave
+        # host_offload_store scenario drives the cross-thread half).
         self._stage_ring: deque = deque()
         self._stage_seq = 0
         self._pipe_hits = 0

@@ -251,17 +251,20 @@ class SkewMonitor:
             return False
 
     def _ensure_worker(self) -> None:
-        if self._thread is None or not self._thread.is_alive():
-            with self._lock:
-                if self._thread is None or not self._thread.is_alive():
-                    self._thread = threading.Thread(
-                        target=self._run, daemon=True,
-                        name="oetpu-skew-monitor")
-                    self._thread.start()
+        with self._lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._run, daemon=True,
+                    name="oetpu-skew-monitor")
+                self._thread.start()
 
     def _run(self) -> None:
         while True:
-            table, ids = self._q.get()
+            item = self._q.get()
+            if item is None:  # close() sentinel: drain reached, exit
+                self._q.task_done()
+                return
+            table, ids = item
             try:
                 self.sketch(table).update(ids)
             except Exception:  # noqa: BLE001 — telemetry must never crash
@@ -274,6 +277,21 @@ class SkewMonitor:
         reports)."""
         if not self.sync:
             self._q.join()
+
+    def close(self) -> None:
+        """Stop the worker after folding everything already queued.
+        Idempotent; a later `observe` restarts the worker, so close is a
+        quiesce point, not an end-of-life. (Before the round-19 oeweave
+        audit the worker had NO stop path at all: every monitor leaked its
+        thread until process exit.)"""
+        if self.sync:
+            return
+        with self._lock:
+            t, self._thread = self._thread, None
+        if t is None or not t.is_alive():
+            return
+        self._q.put(None)  # sentinel queues BEHIND pending batches
+        t.join(timeout=5)
 
     def reset(self) -> None:
         with self._lock:

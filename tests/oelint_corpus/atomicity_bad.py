@@ -1,0 +1,69 @@
+"""oelint corpus: planted atomicity violations (parsed, never imported).
+
+Both check-then-act shapes the pass exists for, next to the correct
+versions of the same code so the clean idioms are pinned as non-findings.
+"""
+
+import threading
+
+
+class PlantedAtomicity:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._groups = {}  # guarded-by: self._lock
+        self.version = None  # guarded-by: self._lock
+        self._count = 0  # guarded-by: self._lock
+
+    # -- shape A: locked read -> tainted local -> branch -> locked write ----
+
+    def bad_split_leader(self, key, entry):
+        with self._lock:
+            group = self._groups.setdefault(key, [])
+            group.append(entry)
+            leader = len(group) == 1
+        if leader:  # PLANT: split-check-then-act
+            with self._lock:
+                self._groups.pop(key, None)
+
+    def bad_split_snapshot(self):
+        with self._lock:
+            n = self._count
+        if n == 0:  # PLANT: stale-snapshot-act
+            with self._lock:
+                self._count = 1
+
+    def good_split_held_across(self, key):
+        with self._lock:
+            group = self._groups.setdefault(key, [])
+            if len(group) == 1:  # check and act under ONE critical section
+                self._groups.pop(key, None)
+
+    def good_unrelated_branch(self):
+        with self._lock:
+            n = self._count
+        if n > 10:  # decision acts on nothing guarded: not a finding
+            return n
+        return 0
+
+    # -- shape B: unlocked guarded read guarding a locked write -------------
+
+    def bad_double_checked_seed(self, head):
+        if self.version is None:  # PLANT: unlocked-guard-of-locked-write
+            with self._lock:
+                self.version = int(head)
+
+    def bad_unlocked_count_guard(self):
+        while self._count < 4:  # PLANT: unlocked-loop-guard
+            with self._lock:
+                self._count += 1
+
+    def good_check_inside_lock(self, head):
+        with self._lock:
+            if self.version is None:  # re-checked under the lock: clean
+                self.version = int(head)
+
+    def good_condition_alias(self):
+        with self._cond:  # Condition(self._lock) alias holds the lock
+            if self.version is None:
+                self.version = 0

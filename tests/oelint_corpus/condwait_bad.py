@@ -1,0 +1,74 @@
+"""oelint corpus: planted cond-wait violations (parsed, never imported).
+
+Condition discipline: wait in a predicate loop under the lock, notify under
+the lock. The clean variants pin the accepted idioms (while-loop wait,
+wait_for, notify inside the with, waiting via the underlying-lock alias).
+"""
+
+import threading
+
+
+class PlantedCondWait:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._ready = False
+        self._stop = False
+
+    # -- wait must sit in a while-predicate loop under the lock -------------
+
+    def bad_bare_wait(self):
+        with self._cv:
+            self._cv.wait()  # PLANT: wait-no-loop
+
+    def bad_if_guarded_wait(self):
+        with self._cv:
+            if not self._ready:
+                self._cv.wait()  # PLANT: wait-if-not-while
+
+    def bad_wait_without_lock(self):
+        self._cv.wait()  # PLANT: wait-outside-lock
+
+    def good_predicate_loop(self):
+        with self._cv:
+            while not self._ready:
+                self._cv.wait()
+
+    def good_timed_tick_loop(self):
+        with self._cv:
+            while not self._stop:
+                self._cv.wait(timeout=0.05)
+
+    def good_wait_for(self):
+        with self._cv:
+            self._cv.wait_for(lambda: self._ready)
+
+    def good_wait_under_lock_alias(self):
+        with self._lock:  # holding the underlying lock holds the condition
+            while not self._ready:
+                self._cv.wait()
+
+    # -- notify must run with the lock held ---------------------------------
+
+    def bad_unlocked_notify(self):
+        self._ready = True
+        self._cv.notify()  # PLANT: notify-outside-lock
+
+    def bad_unlocked_notify_all(self):
+        self._cv.notify_all()  # PLANT: notify-all-outside-lock
+
+    def good_locked_notify(self):
+        with self._cv:
+            self._ready = True
+            self._cv.notify_all()
+
+
+class EventIsNotACondition:
+    """Event.wait is level-triggered and loop-free by design: none of the
+    condition rules apply to it."""
+
+    def __init__(self):
+        self._ev = threading.Event()
+
+    def good_event_wait(self):
+        self._ev.wait(timeout=0.1)  # not a Condition: never a finding

@@ -1,0 +1,114 @@
+"""oelint corpus: planted thread-lifecycle violations (parsed, never
+imported).
+
+Every stored or started thread needs a reachable join. The clean classes
+pin the accepted idioms: tuple-swap join in stop(), join via a stop helper
+reached from close(), threads returned/stored/handed off.
+"""
+
+import threading
+
+
+class PlantedNoStopMethod:
+    """Stores a worker but has NO stop/close at all (the pre-round-19
+    SkewMonitor shape)."""
+
+    def __init__(self):
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run)  # PLANT: no-stop-method
+        self._thread.start()
+
+    def _run(self):
+        pass
+
+
+class PlantedStopWithoutJoin:
+    """Has a stop() — but it only flips the flag and never joins."""
+
+    def __init__(self):
+        self._stop = threading.Event()
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run)  # PLANT: stop-never-joins
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()  # forgot: self._thread.join()
+
+    def _run(self):
+        pass
+
+
+class PlantedFireAndForget:
+    def spawn_anonymous(self, server):
+        threading.Thread(target=server.shutdown, daemon=True).start()  # PLANT: anonymous-fire-and-forget
+
+    def spawn_local(self):
+        t = threading.Thread(target=self._work)  # PLANT: local-fire-and-forget
+        t.start()
+
+    def _work(self):
+        pass
+
+
+class CleanTupleSwap:
+    def __init__(self):
+        self._stop = threading.Event()
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join()
+
+    def _run(self):
+        pass
+
+
+class CleanJoinViaHelper:
+    """close() reaches the join transitively through self._halt()."""
+
+    def __init__(self):
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run)
+        self._thread.start()
+
+    def close(self):
+        self._halt()
+
+    def _halt(self):
+        if self._thread is not None:
+            self._thread.join()
+
+    def _run(self):
+        pass
+
+
+class CleanHandoff:
+    def make_worker(self):
+        t = threading.Thread(target=self._run)
+        t.start()
+        return t  # returned: the caller owns the join
+
+    def lend_worker(self, registry):
+        t = threading.Thread(target=self._run)
+        t.start()
+        registry.adopt(t)  # handed off: the registry owns it
+
+    def joined_locally(self):
+        t = threading.Thread(target=self._run)
+        t.start()
+        t.join()
+
+    def _run(self):
+        pass

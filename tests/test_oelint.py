@@ -31,8 +31,9 @@ if ROOT not in sys.path:
 
 from tools.oelint import run_passes  # noqa: E402
 from tools.oelint.core import SourceFile  # noqa: E402
-from tools.oelint.passes import (hlo_budget, host_sync,  # noqa: E402
-                                 implicit_reshard, lockset,
+from tools.oelint.passes import (atomicity, condwait,  # noqa: E402
+                                 hlo_budget, host_sync, implicit_reshard,
+                                 lifecycle, lockset,
                                  metrics as metrics_pass, sharding,
                                  spmd_divergence, trace_hazard)
 
@@ -74,6 +75,45 @@ def test_lockset_catches_every_plant():
     assert_catches_all_plants(lockset, corpus_file("lockset_bad.py"))
 
 
+def test_atomicity_catches_every_plant():
+    assert_catches_all_plants(atomicity, corpus_file("atomicity_bad.py"))
+
+
+def test_atomicity_clean_idioms_stay_clean():
+    """check+act under one critical section, re-check inside the lock, and
+    Condition aliases are never flagged: exactly the plants fire."""
+    sf = corpus_file("atomicity_bad.py")
+    findings = atomicity.run([sf], ROOT)
+    assert {f.line for f in findings} == plant_lines(sf), \
+        "\n".join(map(str, findings))
+
+
+def test_condwait_catches_every_plant():
+    assert_catches_all_plants(condwait, corpus_file("condwait_bad.py"))
+
+
+def test_condwait_clean_idioms_stay_clean():
+    """while-predicate waits (timed included), wait_for, locked notify, the
+    underlying-lock alias, and Event.wait are never flagged."""
+    sf = corpus_file("condwait_bad.py")
+    findings = condwait.run([sf], ROOT)
+    assert {f.line for f in findings} == plant_lines(sf), \
+        "\n".join(map(str, findings))
+
+
+def test_lifecycle_catches_every_plant():
+    assert_catches_all_plants(lifecycle, corpus_file("lifecycle_bad.py"))
+
+
+def test_lifecycle_clean_idioms_stay_clean():
+    """tuple-swap join, join via a stop helper, and returned/handed-off/
+    locally-joined threads are never flagged."""
+    sf = corpus_file("lifecycle_bad.py")
+    findings = lifecycle.run([sf], ROOT)
+    assert {f.line for f in findings} == plant_lines(sf), \
+        "\n".join(map(str, findings))
+
+
 def test_metrics_catches_every_plant():
     assert_catches_all_plants(metrics_pass, corpus_file("metrics_bad.py"))
 
@@ -107,8 +147,8 @@ def test_spmd_divergence_uniform_controls_stay_clean():
 
 def test_clean_corpus_is_clean():
     sf = corpus_file("clean.py")
-    for pass_mod in (trace_hazard, host_sync, lockset, metrics_pass,
-                     sharding, spmd_divergence):
+    for pass_mod in (trace_hazard, host_sync, lockset, atomicity, condwait,
+                     lifecycle, metrics_pass, sharding, spmd_divergence):
         findings = pass_mod.run([sf], ROOT)
         assert not findings, (pass_mod.NAME, list(map(str, findings)))
     assert sf.bare_suppressions() == []
@@ -130,6 +170,7 @@ def test_tree_is_clean_under_file_passes():
     under every file-scanning pass (real findings fixed, false positives
     carry reasoned pragmas — zero bare suppressions anywhere)."""
     findings, _ = run_passes(["trace-hazard", "host-sync", "lockset",
+                              "atomicity", "cond-wait", "thread-lifecycle",
                               "metrics", "sharding", "spmd-divergence"])
     assert findings == [], "\n".join(map(str, findings))
 

@@ -640,3 +640,22 @@ def test_sync_soak_short(tmp_path):
     assert report["swaps"] >= 2
     assert report["final_lag_steps"] == 0
     assert report["predicts"] > 0
+
+
+def test_sync_weave_short():
+    """The soak's deterministic-interleaving variant (sync_soak --weave):
+    the same actors explored under tools/oeweave — every schedule must hold
+    the no-torn-status / no-lost-wakeup / clean-shutdown invariants. Short
+    budget here; `make weave` runs the full one."""
+    import importlib.util
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "sync_soak", os.path.join(repo, "tools", "sync_soak.py"))
+    soak = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(soak)
+    report = soak.run_weave(schedules=4, sweep=8, quiet=True)
+    assert report["failures"] == 0
+    per = report["scenarios"]
+    assert set(per) == set(soak.WEAVE_SCENARIOS)
+    assert all(v["explored"] >= 8 for v in per.values()), per
+    assert report["schedules_explored"] >= 8 * len(per)

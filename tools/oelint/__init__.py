@@ -1,6 +1,6 @@
 """oelint: static-analysis + invariant-guard suite for this repo.
 
-Eight passes over `openembedding_tpu/` (see each module's doc):
+Eleven passes over `openembedding_tpu/` (see each module's doc):
 
 - trace-hazard     — recompile/concretization hazards in jit-reachable code
 - host-sync        — device→host sync discipline in `# oelint: hot-path` fns
@@ -9,6 +9,9 @@ Eight passes over `openembedding_tpu/` (see each module's doc):
 - hlo-budget       — per-config collective counts vs tools/oelint/hlo_budget.json
 - implicit-reshard — no compiled collective without a traced-op attribution
 - lockset          — `# guarded-by:` discipline + lock-ordering cycles
+- atomicity        — check-then-act on guarded state split across the lock
+- cond-wait        — Condition.wait predicate loops, notify under the lock
+- thread-lifecycle — every stored/started thread has a reachable join
 - metrics          — metric-name hygiene (the former tools/lint_metrics.py)
 
 Run them all with `make lint` / `python -m tools.oelint`; the runtime

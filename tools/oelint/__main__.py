@@ -36,7 +36,8 @@ def main(argv=None) -> int:
         prog="python -m tools.oelint",
         description="static-analysis + invariant-guard suite "
                     "(trace-hazard, host-sync, sharding, spmd-divergence, "
-                    "hlo-budget, implicit-reshard, lockset, metrics)")
+                    "hlo-budget, implicit-reshard, lockset, atomicity, "
+                    "cond-wait, thread-lifecycle, metrics)")
     ap.add_argument("passes", nargs="*", metavar="PASS",
                     help=f"passes to run (default all): "
                          f"{', '.join(BY_NAME)}")

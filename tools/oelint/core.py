@@ -152,6 +152,62 @@ class SourceFile:
         return None
 
 
+# -- shared concurrency-annotation support -----------------------------------
+#
+# The three threaded-control-plane passes (lockset, atomicity, cond-wait) all
+# key off the same two class-level facts; they live here so the annotation
+# semantics cannot drift between passes.
+
+
+def self_attr(node: ast.AST) -> Optional[str]:
+    """`self.X` -> "X", else None."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def condition_aliases(cls: ast.ClassDef) -> Dict[str, str]:
+    """self.Y -> self.X for `self.Y = threading.Condition(self.X)` (holding
+    the Condition holds its underlying lock)."""
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            func = node.value.func
+            if isinstance(func, ast.Attribute) and func.attr == "Condition" \
+                    and node.value.args:
+                try:
+                    lock_src = ast.unparse(node.value.args[0])
+                except Exception:  # noqa: BLE001
+                    continue
+                for tgt in node.targets:
+                    attr = self_attr(tgt)
+                    if attr is not None:
+                        aliases[f"self.{attr}"] = lock_src
+    return aliases
+
+
+def guarded_attrs(sf: "SourceFile", cls: ast.ClassDef) -> Dict[str, str]:
+    """attr name -> lock expression, from `# guarded-by:` annotations on
+    assignments (typically in __init__) or class-level AnnAssign lines."""
+    guarded: Dict[str, str] = {}
+    for node in ast.walk(cls):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            continue
+        m = sf.stmt_annotation(node, GUARDED_BY_RE)
+        if not m:
+            continue
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        for tgt in targets:
+            attr = self_attr(tgt)
+            if attr is None and isinstance(tgt, ast.Name):
+                attr = tgt.id  # class-level declaration
+            if attr is not None:
+                guarded[attr] = m.group(1)
+    return guarded
+
+
 def iter_py_files(root: str, dirs: Iterable[str],
                   skip: Iterable[str] = ()) -> List[str]:
     """Repo-relative .py paths under `dirs`, sorted; `skip` entries are

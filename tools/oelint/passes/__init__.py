@@ -1,8 +1,10 @@
 """oelint pass registry, in documentation order."""
 
 from . import (trace_hazard, host_sync, sharding, spmd_divergence,
-               hlo_budget, implicit_reshard, lockset, metrics)
+               hlo_budget, implicit_reshard, lockset, atomicity, condwait,
+               lifecycle, metrics)
 
 ALL_PASSES = (trace_hazard, host_sync, sharding, spmd_divergence,
-              hlo_budget, implicit_reshard, lockset, metrics)
+              hlo_budget, implicit_reshard, lockset, atomicity, condwait,
+              lifecycle, metrics)
 BY_NAME = {p.NAME: p for p in ALL_PASSES}

@@ -1,0 +1,271 @@
+"""atomicity pass: check-then-act on `# guarded-by:` state split across a
+lock release.
+
+lockset proves every WRITE to a guarded attribute happens under its lock.
+That is necessary but not sufficient: the classic control-plane race is a
+*decision* made from guarded state while the lock is NOT held, followed by a
+locked write that assumes the decision still holds. Both halves pass lockset
+individually; the interleaving between them is the bug. Two lexical shapes
+cover every instance this repo has actually shipped:
+
+**Shape A — tainted-local check-then-act.** Guarded state is read under the
+lock into a local, the lock is released, a branch is taken on that local,
+and the branch re-acquires the same lock to write guarded state:
+
+    with self._lock:
+        n = len(self._groups[key])      # read under lock -> taints `n`
+    if n == 1:                          # decision on stale snapshot
+        ...
+        with self._lock:
+            self._groups.pop(key)       # act — state may have changed
+
+Taint propagates through locals (`leader = n == 1` taints `leader`); acting
+writes include mutator calls (`.pop/.append/.clear/...`) and keyed stores,
+not just rebinds. The window between the two `with` blocks is where another
+thread invalidates the decision.
+
+**Shape B — unlocked guard of a locked write.** The test itself reads a
+guarded attribute with no lock held, and the guarded branch takes the lock
+to write guarded state:
+
+    if self.version is None:            # unlocked read of guarded attr
+        ...
+        with self._mu:
+            self.version = head         # two threads both saw None
+
+Double-checked locking is the textbook instance; the fix is to move the
+check inside the lock (or re-check under it).
+
+Both shapes are lexical and method-local by design (same honesty contract
+as lockset): cross-method protocols that make a split safe ("only one
+thread ever calls this") are documented with a reasoned suppression.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from ..core import (Finding, SourceFile, condition_aliases, guarded_attrs,
+                    self_attr)
+
+NAME = "atomicity"
+DIRS = ("openembedding_tpu",)
+
+_EXEMPT_METHODS = {"__init__", "__new__"}
+
+# attribute calls that mutate the receiver in place — `self.x.pop()` is a
+# write to guarded `x` just as much as `self.x = ...`
+_MUTATORS = {
+    "append", "add", "clear", "discard", "extend", "insert", "pop",
+    "popitem", "popleft", "remove", "setdefault", "update",
+    "appendleft", "sort", "reverse",
+}
+
+
+def _lock_names(guarded: Dict[str, str],
+                aliases: Dict[str, str]) -> Dict[str, Set[str]]:
+    """lock expr -> every expression whose `with` holds it (itself plus any
+    Condition constructed from it)."""
+    out: Dict[str, Set[str]] = {}
+    for lock in set(guarded.values()):
+        holds = {lock}
+        for cond, under in aliases.items():
+            if under == lock:
+                holds.add(cond)
+        out[lock] = holds
+    return out
+
+
+def _held_locks(stack: List[ast.AST],
+                holders: Dict[str, Set[str]]) -> Set[str]:
+    """Declared locks held at this point in the lexical stack."""
+    held: Set[str] = set()
+    for node in stack:
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                try:
+                    txt = ast.unparse(item.context_expr)
+                except Exception:  # noqa: BLE001
+                    continue
+                for lock, holds in holders.items():
+                    if txt in holds:
+                        held.add(lock)
+    return held
+
+
+def _reads_of(node: ast.AST, guarded: Dict[str, str]) -> Set[str]:
+    """Guarded attrs read anywhere inside `node` (as `self.attr`)."""
+    out: Set[str] = set()
+    for sub in ast.walk(node):
+        attr = self_attr(sub)
+        if attr is not None and attr in guarded:
+            out.add(attr)
+    return out
+
+
+def _guarded_writes(node: ast.AST, guarded: Dict[str, str]):
+    """(attr, lineno) for every write/mutation of a guarded attr in `node`:
+    rebinds, aug-assigns, keyed stores/deletes, and mutator calls."""
+    for sub in ast.walk(node):
+        if isinstance(sub, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = (sub.targets if isinstance(sub, ast.Assign)
+                       else [sub.target])
+            for tgt in targets:
+                yield from _target_writes(tgt, guarded)
+        elif isinstance(sub, ast.Delete):
+            for tgt in sub.targets:
+                yield from _target_writes(tgt, guarded)
+        elif isinstance(sub, ast.Call) and isinstance(sub.func,
+                                                      ast.Attribute):
+            if sub.func.attr in _MUTATORS:
+                attr = self_attr(sub.func.value)
+                if attr is not None and attr in guarded:
+                    yield attr, sub.lineno
+
+
+def _target_writes(tgt: ast.AST, guarded: Dict[str, str]):
+    attr = self_attr(tgt)
+    if attr is None and isinstance(tgt, ast.Subscript):
+        attr = self_attr(tgt.value)
+    if attr is not None and attr in guarded:
+        yield attr, tgt.lineno
+    elif isinstance(tgt, (ast.Tuple, ast.List)):
+        for elt in tgt.elts:
+            yield from _target_writes(elt, guarded)
+
+
+def _local_names(expr: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(expr) if isinstance(n, ast.Name)}
+
+
+def _check_method(sf: SourceFile, cls: ast.ClassDef, method: ast.AST,
+                  guarded: Dict[str, str],
+                  aliases: Dict[str, str]) -> List[Finding]:
+    out: List[Finding] = []
+    holders = _lock_names(guarded, aliases)
+
+    # -- shape B: unlocked guarded read in a test, locked write inside ------
+    def walk_b(node: ast.AST, stack: List[ast.AST]) -> None:
+        if isinstance(node, (ast.If, ast.While)):
+            held = _held_locks(stack, holders)
+            checked = {a for a in _reads_of(node.test, guarded)
+                       if guarded[a] not in held}
+            if checked:
+                for sub in ast.walk(node):
+                    if not isinstance(sub, (ast.With, ast.AsyncWith)):
+                        continue
+                    inner = _held_locks(stack + [node, sub], holders)
+                    for attr, line in _guarded_writes(sub, guarded):
+                        lock = guarded[attr]
+                        if lock not in inner:
+                            continue  # lockset's department
+                        stale = sorted(a for a in checked
+                                       if guarded[a] == lock)
+                        if not stale:
+                            continue
+                        if sf.suppressed(node.lineno, NAME):
+                            continue
+                        out.append(Finding(
+                            sf.rel, node.lineno, NAME,
+                            f"check-then-act: test reads guarded "
+                            f"`self.{stale[0]}` without `{lock}`, then the "
+                            f"branch takes the lock to write `self.{attr}` "
+                            f"(line {line}) — two threads can both pass the "
+                            f"check; move the check inside `with {lock}:` "
+                            f"({cls.name}.{method.name})"))
+                        break
+        for child in ast.iter_child_nodes(node):
+            walk_b(child, stack + [node])
+
+    walk_b(method, [])
+
+    # -- shape A: locked read -> tainted local -> branch -> locked write ----
+    def scan_suite(stmts: List[ast.stmt], outer_stack: List[ast.AST]) -> None:
+        # taint per suite: local name -> (lock, read attr, read line)
+        taint: Dict[str, tuple] = {}
+        for stmt in stmts:
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                locks = _held_locks(outer_stack + [stmt], holders)
+                for sub in ast.walk(stmt):
+                    if not isinstance(sub, ast.Assign):
+                        continue
+                    reads = _reads_of(sub.value, guarded)
+                    via = _local_names(sub.value) & set(taint)
+                    src = None
+                    for a in sorted(reads):
+                        if guarded[a] in locks:
+                            src = (guarded[a], a, sub.lineno)
+                            break
+                    if src is None and via:
+                        src = taint[sorted(via)[0]]
+                    if src is None:
+                        continue
+                    for tgt in sub.targets:
+                        if isinstance(tgt, ast.Name):
+                            taint[tgt.id] = src
+            elif isinstance(stmt, (ast.If, ast.While)):
+                used = _local_names(stmt.test) & set(taint)
+                if used and not _held_locks(outer_stack, holders):
+                    name = sorted(used)[0]
+                    lock, attr, read_line = taint[name]
+                    for sub in ast.walk(stmt):
+                        if not isinstance(sub, (ast.With, ast.AsyncWith)):
+                            continue
+                        inner = _held_locks(
+                            outer_stack + [stmt, sub], holders)
+                        if lock not in inner:
+                            continue
+                        hits = [(a, ln) for a, ln in
+                                _guarded_writes(sub, guarded)
+                                if guarded[a] == lock]
+                        if not hits:
+                            continue
+                        if sf.suppressed(stmt.lineno, NAME):
+                            break
+                        wa, wl = hits[0]
+                        out.append(Finding(
+                            sf.rel, stmt.lineno, NAME,
+                            f"check-then-act split across `{lock}`: "
+                            f"`{name}` snapshots guarded `self.{attr}` "
+                            f"under the lock (line {read_line}), the lock "
+                            f"is released, and the branch re-acquires it "
+                            f"to write `self.{wa}` (line {wl}) — the "
+                            f"snapshot can be stale; hold the lock across "
+                            f"check and act ({cls.name}.{method.name})"))
+                        break
+            # descend into nested suites (loop/branch bodies, try blocks)
+            for body in (getattr(stmt, "body", None),
+                         getattr(stmt, "orelse", None),
+                         getattr(stmt, "finalbody", None)):
+                if isinstance(body, list) and body and \
+                        isinstance(body[0], ast.stmt):
+                    scan_suite(body, outer_stack + [stmt])
+            for handler in getattr(stmt, "handlers", []) or []:
+                scan_suite(handler.body, outer_stack + [stmt])
+
+    scan_suite(list(method.body), [])
+    return out
+
+
+def run(files: List[SourceFile], root: str) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in files:
+        if sf.tree is None:
+            continue
+        for cls in ast.walk(sf.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            guarded = guarded_attrs(sf, cls)
+            if not guarded:
+                continue
+            aliases = condition_aliases(cls)
+            for method in cls.body:
+                if not isinstance(method, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef)):
+                    continue
+                if method.name in _EXEMPT_METHODS:
+                    continue
+                findings.extend(
+                    _check_method(sf, cls, method, guarded, aliases))
+    return sorted(set(findings), key=lambda f: (f.path, f.line, f.message))

@@ -1,0 +1,150 @@
+"""cond-wait pass: Condition wait/notify discipline.
+
+`threading.Condition` has two usage rules that Python will not enforce for
+you, and whose violations are the two canonical lost-wakeup bugs:
+
+1. **wait() must sit in a predicate loop.** A bare `cond.wait()` (or one
+   guarded by `if`) misses both spurious wakeups and the window where the
+   state changed and changed back; the fix is always
+
+       with self._cv:
+           while not predicate:
+               self._cv.wait()
+
+   The pass requires every `.wait(...)` on a declared Condition attribute
+   to have a `while` ancestor inside the `with` that holds the condition
+   (or its underlying lock — `threading.Condition(self._lock)` aliases
+   resolve). `wait_for(pred)` loops internally and is exempt from the loop
+   rule (it still needs the lock). `Event.wait` is a different protocol
+   (level-triggered, no predicate) and is not a Condition — only attributes
+   assigned `threading.Condition(...)` in the class are checked.
+
+2. **notify()/notify_all() must be called with the lock held.** CPython
+   raises RuntimeError at runtime for this one, but only on the interleaving
+   that actually executes the call — i.e. in the branch your tests never
+   hit. The pass makes it a static finding: every notify on a declared
+   Condition must be lexically inside a `with` on that condition or its
+   underlying lock. (Beyond the crash, an unlocked notify is the classic
+   lost wakeup: the waiter checks its predicate, the notifier fires before
+   the waiter blocks, the waiter sleeps forever.)
+
+Timed waits used as interruptible ticks (`cond.wait(timeout)` where the
+loop exit is the timeout, not the predicate) are still predicate loops in
+correct code — `while not self._stop: self._cv.wait(t)` passes; if a bare
+timed wait is genuinely deliberate, that is what a reasoned suppression is
+for.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from ..core import Finding, SourceFile, condition_aliases, self_attr
+
+NAME = "cond-wait"
+DIRS = ("openembedding_tpu",)
+
+
+def _declared_conditions(cls: ast.ClassDef) -> Set[str]:
+    """Attrs assigned `threading.Condition(...)` / `Condition(...)`."""
+    out: Set[str] = set()
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign) or \
+                not isinstance(node.value, ast.Call):
+            continue
+        func = node.value.func
+        name = func.attr if isinstance(func, ast.Attribute) else \
+            (func.id if isinstance(func, ast.Name) else None)
+        if name != "Condition":
+            continue
+        for tgt in node.targets:
+            attr = self_attr(tgt)
+            if attr is not None:
+                out.add(attr)
+    return out
+
+
+def _with_exprs(node: ast.AST) -> List[str]:
+    out = []
+    for item in node.items:
+        try:
+            out.append(ast.unparse(item.context_expr))
+        except Exception:  # noqa: BLE001
+            pass
+    return out
+
+
+def _check_class(sf: SourceFile, cls: ast.ClassDef) -> List[Finding]:
+    conds = _declared_conditions(cls)
+    if not conds:
+        return []
+    aliases = condition_aliases(cls)
+    out: List[Finding] = []
+
+    def holds(cond_attr: str, withs: List[ast.AST]) -> bool:
+        cond_expr = f"self.{cond_attr}"
+        accept = {cond_expr}
+        under = aliases.get(cond_expr)
+        if under:
+            accept.add(under)
+        return any(e in accept for w in withs for e in _with_exprs(w))
+
+    def walk(node: ast.AST, stack: List[ast.AST]) -> None:
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute):
+            op = node.func.attr
+            attr = self_attr(node.func.value)
+            if attr in conds and op in ("wait", "wait_for",
+                                        "notify", "notify_all"):
+                withs = [n for n in stack
+                         if isinstance(n, (ast.With, ast.AsyncWith))]
+                if not holds(attr, withs):
+                    if not sf.suppressed(node.lineno, NAME):
+                        out.append(Finding(
+                            sf.rel, node.lineno, NAME,
+                            f"`self.{attr}.{op}()` outside `with "
+                            f"self.{attr}:` — "
+                            + ("an unlocked notify is a lost wakeup (the "
+                               "signal can fire between a waiter's check "
+                               "and its block)"
+                               if op.startswith("notify") else
+                               "wait without the lock raises at runtime "
+                               "and tears the predicate")
+                            + f" ({cls.name})"))
+                elif op == "wait":
+                    # predicate-loop rule: a while between the with and the
+                    # wait (the innermost holding with, conservatively: any)
+                    inner_with = max(
+                        (i for i, n in enumerate(stack)
+                         if isinstance(n, (ast.With, ast.AsyncWith))
+                         and holds(attr, [n])), default=-1)
+                    looped = any(isinstance(n, ast.While)
+                                 for n in stack[inner_with + 1:])
+                    if not looped and not sf.suppressed(node.lineno, NAME):
+                        out.append(Finding(
+                            sf.rel, node.lineno, NAME,
+                            f"`self.{attr}.wait()` is not inside a `while "
+                            f"<predicate>` loop under the lock — spurious "
+                            f"wakeups and check/act windows break straight-"
+                            f"line waits; use `while not pred: "
+                            f"self.{attr}.wait()` or `wait_for` "
+                            f"({cls.name})"))
+        for child in ast.iter_child_nodes(node):
+            walk(child, stack + [node])
+
+    for method in cls.body:
+        if isinstance(method, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            walk(method, [])
+    return out
+
+
+def run(files: List[SourceFile], root: str) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in files:
+        if sf.tree is None:
+            continue
+        for cls in ast.walk(sf.tree):
+            if isinstance(cls, ast.ClassDef):
+                findings.extend(_check_class(sf, cls))
+    return sorted(set(findings), key=lambda f: (f.path, f.line, f.message))

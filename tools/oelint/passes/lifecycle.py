@@ -1,0 +1,240 @@
+"""thread-lifecycle pass: every thread needs a reachable join.
+
+A `threading.Thread` with no stop path outlives its owner: shutdown hangs
+(non-daemon), work is silently dropped mid-task (daemon), pytest leaks
+threads across tests, and the oeweave harness reports it as a WeaveLeak.
+The repo convention is that whoever *stores* a thread owns its lifecycle —
+this pass makes the convention checkable, in two rules:
+
+**Owned threads** (`self.X = threading.Thread(...)`): some stop-entry
+method of the class — a method whose name starts with stop/close/shutdown/
+terminate/abort/quit/finalize/teardown/drain, or `__exit__`/`__del__` —
+must reach `self.X.join(...)`, directly or through same-class `self.m()`
+calls. The tuple-swap idiom counts (and is preferred, it is also the
+race-free one):
+
+    t, self._thread = self._thread, None
+    if t is not None:
+        t.join()
+
+A class that stores a thread but has no stop-entry method at all is the
+purest form of the bug (pre-round-19 SkewMonitor): flagged at the
+assignment.
+
+**Fire-and-forget locals**: `threading.Thread(target=...).start()` — or a
+local `t = Thread(...)` that is started but never joined, returned, stored
+on self, appended to a container, or passed to another call — has *no*
+owner. Nothing can ever wait for it, observe its failure, or stop it.
+Either hand it to an owner or suppress with the reason the leak is
+deliberate (e.g. a self-terminating shutdown helper).
+
+The check is lexical and per-class; threads whose join lives in a different
+class (handed-off ownership) take a reasoned suppression naming the owner,
+which is exactly the documentation the hand-off needs.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set
+
+from ..core import Finding, SourceFile, self_attr
+
+NAME = "thread-lifecycle"
+DIRS = ("openembedding_tpu",)
+
+STOP_RE = re.compile(
+    r"^(stop|close|shutdown|terminate|abort|quit|finalize|teardown|drain"
+    r"|__exit__|__del__)")
+
+
+def _is_thread_ctor(value: ast.AST) -> bool:
+    if not isinstance(value, ast.Call):
+        return False
+    func = value.func
+    name = func.attr if isinstance(func, ast.Attribute) else \
+        (func.id if isinstance(func, ast.Name) else None)
+    return name == "Thread"
+
+
+def _thread_attr_assigns(cls: ast.ClassDef) -> Dict[str, int]:
+    """attr -> first assignment line for `self.X = threading.Thread(...)`."""
+    out: Dict[str, int] = {}
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign) or \
+                not _is_thread_ctor(node.value):
+            continue
+        for tgt in node.targets:
+            attr = self_attr(tgt)
+            if attr is not None:
+                out.setdefault(attr, node.lineno)
+    return out
+
+
+def _attrs_joined_in(method: ast.AST) -> Set[str]:
+    """Thread attrs this method joins: `self.X.join()` directly, or via a
+    local alias (`t = self.X` / `t, self.X = self.X, None` / any tuple or
+    plain assignment whose RHS mentions self.X) that is later `.join()`ed."""
+    aliases: Dict[str, Set[str]] = {}  # local name -> attrs it may hold
+    joined: Set[str] = set()
+    for node in ast.walk(method):
+        if isinstance(node, ast.Assign):
+            rhs_attrs = {a for a in
+                         (self_attr(s) for s in ast.walk(node.value))
+                         if a is not None}
+            if not rhs_attrs:
+                continue
+            for tgt in node.targets:
+                names = ([tgt] if isinstance(tgt, ast.Name)
+                         else list(tgt.elts)
+                         if isinstance(tgt, (ast.Tuple, ast.List)) else [])
+                for n in names:
+                    if isinstance(n, ast.Name):
+                        aliases.setdefault(n.id, set()).update(rhs_attrs)
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "join":
+            recv = node.func.value
+            attr = self_attr(recv)
+            if attr is not None:
+                joined.add(attr)
+            elif isinstance(recv, ast.Name):
+                joined |= aliases.get(recv.id, set())
+    return joined
+
+
+def _self_calls(method: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(method):
+        if isinstance(node, ast.Call):
+            name = self_attr(node.func)
+            if name is not None:
+                out.add(name)
+    return out
+
+
+def _check_owned(sf: SourceFile, cls: ast.ClassDef) -> List[Finding]:
+    threads = _thread_attr_assigns(cls)
+    if not threads:
+        return []
+    methods = {m.name: m for m in cls.body
+               if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    joins = {name: _attrs_joined_in(m) for name, m in methods.items()}
+    calls = {name: _self_calls(m) & set(methods)
+             for name, m in methods.items()}
+    stop_entries = [n for n in methods if STOP_RE.match(n)]
+
+    # transitive: attrs joined by anything reachable from each stop entry
+    reachable_joins: Set[str] = set()
+    for entry in stop_entries:
+        seen: Set[str] = set()
+        stack = [entry]
+        while stack:
+            m = stack.pop()
+            if m in seen:
+                continue
+            seen.add(m)
+            reachable_joins |= joins.get(m, set())
+            stack.extend(calls.get(m, ()))
+
+    out: List[Finding] = []
+    for attr, line in sorted(threads.items()):
+        if attr in reachable_joins or sf.suppressed(line, NAME):
+            continue
+        if not stop_entries:
+            msg = (f"`{cls.name}.{attr}` stores a Thread but the class has "
+                   f"no stop()/close() method that joins it — the worker "
+                   f"outlives every owner (leaked thread); add a stop path "
+                   f"with a sentinel + join")
+        else:
+            msg = (f"`{cls.name}.{attr}` stores a Thread but no stop path "
+                   f"({', '.join(sorted(stop_entries))}) reaches "
+                   f"`self.{attr}.join()` — shutdown leaks the worker; "
+                   f"join it (tuple-swap `t, self.{attr} = self.{attr}, "
+                   f"None; t.join()` is the race-free idiom)")
+        out.append(Finding(sf.rel, line, NAME, msg))
+    return out
+
+
+def _check_fire_and_forget(sf: SourceFile, fn: ast.AST) -> List[Finding]:
+    out: List[Finding] = []
+
+    # anonymous: Thread(...).start()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "start" and \
+                _is_thread_ctor(node.func.value):
+            if not sf.suppressed(node.lineno, NAME):
+                out.append(Finding(
+                    sf.rel, node.lineno, NAME,
+                    "fire-and-forget `Thread(...).start()`: nobody can "
+                    "join, observe, or stop this thread; bind it to an "
+                    "owner with a stop path, or suppress with why the "
+                    "leak is deliberate"))
+
+    # named locals: t = Thread(...); t.start() with no escape
+    local_lines: Dict[str, int] = {}
+    escaped: Set[str] = set()
+    started: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and _is_thread_ctor(node.value):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    local_lines[tgt.id] = node.lineno
+                else:  # stored on self/container: owned elsewhere
+                    pass
+    if not local_lines:
+        return out
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            if isinstance(node.func, ast.Attribute) and \
+                    isinstance(node.func.value, ast.Name) and \
+                    node.func.value.id in local_lines:
+                if node.func.attr == "start":
+                    started.add(node.func.value.id)
+                elif node.func.attr == "join":
+                    escaped.add(node.func.value.id)
+            # passed as an argument -> someone else may own it
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                for sub in ast.walk(arg):
+                    if isinstance(sub, ast.Name) and sub.id in local_lines:
+                        escaped.add(sub.id)
+        elif isinstance(node, ast.Return) and node.value is not None:
+            for sub in ast.walk(node.value):
+                if isinstance(sub, ast.Name) and sub.id in local_lines:
+                    escaped.add(sub.id)
+        elif isinstance(node, ast.Assign):
+            # rebound onto self.X / a container / another name -> escapes
+            for sub in ast.walk(node.value):
+                if isinstance(sub, ast.Name) and sub.id in local_lines and \
+                        not _is_thread_ctor(node.value):
+                    escaped.add(sub.id)
+        elif isinstance(node, (ast.Yield, ast.YieldFrom)) and \
+                getattr(node, "value", None) is not None:
+            for sub in ast.walk(node.value):
+                if isinstance(sub, ast.Name) and sub.id in local_lines:
+                    escaped.add(sub.id)
+    for name in sorted(started - escaped):
+        line = local_lines[name]
+        if not sf.suppressed(line, NAME):
+            out.append(Finding(
+                sf.rel, line, NAME,
+                f"local thread `{name}` is started but never joined, "
+                f"returned, stored, or handed off — a fire-and-forget "
+                f"leak; join it on the exit path or give it an owner"))
+    return out
+
+
+def run(files: List[SourceFile], root: str) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in files:
+        if sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ClassDef):
+                findings.extend(_check_owned(sf, node))
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                findings.extend(_check_fire_and_forget(sf, node))
+    return sorted(set(findings), key=lambda f: (f.path, f.line, f.message))

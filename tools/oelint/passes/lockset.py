@@ -50,7 +50,8 @@ import ast
 import os
 from typing import Dict, List, Optional, Set, Tuple
 
-from ..core import Finding, GUARDED_BY_RE, SourceFile
+from ..core import (Finding, SourceFile, condition_aliases, guarded_attrs,
+                    self_attr)
 from .trace_hazard import _GENERIC_TAILS, _call_chain
 
 NAME = "lockset"
@@ -62,11 +63,11 @@ NEEDS_ALL_FILES = True
 _EXEMPT_METHODS = {"__init__", "__new__"}
 
 
-def _self_attr(node: ast.AST) -> Optional[str]:
-    if isinstance(node, ast.Attribute) and \
-            isinstance(node.value, ast.Name) and node.value.id == "self":
-        return node.attr
-    return None
+# hoisted into core.py (round 19) so lockset/atomicity/cond-wait share one
+# definition of the annotations; kept under the old names for local callers
+_self_attr = self_attr
+_condition_aliases = condition_aliases
+_guarded_attrs = guarded_attrs
 
 
 def _with_lock_exprs(stack: List[ast.AST]) -> List[str]:
@@ -80,47 +81,6 @@ def _with_lock_exprs(stack: List[ast.AST]) -> List[str]:
                 except Exception:  # noqa: BLE001 — unparse is best-effort
                     pass
     return out
-
-
-def _condition_aliases(cls: ast.ClassDef) -> Dict[str, str]:
-    """self.Y -> self.X for `self.Y = threading.Condition(self.X)` (holding
-    the Condition holds its underlying lock)."""
-    aliases: Dict[str, str] = {}
-    for node in ast.walk(cls):
-        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
-            func = node.value.func
-            if isinstance(func, ast.Attribute) and func.attr == "Condition" \
-                    and node.value.args:
-                try:
-                    lock_src = ast.unparse(node.value.args[0])
-                except Exception:  # noqa: BLE001
-                    continue
-                for tgt in node.targets:
-                    attr = _self_attr(tgt)
-                    if attr is not None:
-                        aliases[f"self.{attr}"] = lock_src
-    return aliases
-
-
-def _guarded_attrs(sf: SourceFile, cls: ast.ClassDef) -> Dict[str, str]:
-    """attr name -> lock expression, from `# guarded-by:` annotations on
-    assignments (typically in __init__) or class-level AnnAssign lines."""
-    guarded: Dict[str, str] = {}
-    for node in ast.walk(cls):
-        if not isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
-            continue
-        m = sf.stmt_annotation(node, GUARDED_BY_RE)
-        if not m:
-            continue
-        targets = (node.targets if isinstance(node, ast.Assign)
-                   else [node.target])
-        for tgt in targets:
-            attr = _self_attr(tgt)
-            if attr is None and isinstance(tgt, ast.Name):
-                attr = tgt.id  # class-level declaration
-            if attr is not None:
-                guarded[attr] = m.group(1)
-    return guarded
 
 
 def _check_class(sf: SourceFile, cls: ast.ClassDef) -> List[Finding]:

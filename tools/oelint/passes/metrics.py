@@ -53,6 +53,7 @@ KNOWN_GROUPS = {
     "sync",       # online model sync
     "train",      # example-loop wall timers
     "trainer",    # train-step phases + per-table pull stats
+    "weave",      # oeweave deterministic-interleaving runs (tools/oeweave)
 }
 
 # per-instance dimensions embedded in a NAME segment instead of a label:

@@ -1,0 +1,20 @@
+"""oeweave: deterministic interleaving checker for the threaded control plane.
+
+Run it:
+    make weave                      # explore every scenario (CI budget)
+    python -m tools.oeweave         # same, direct
+    python -m tools.oeweave sync_subscriber --schedules 50
+    python -m tools.oeweave --replay 'sync_subscriber:oeweave1:0121...'
+
+Library surface:
+    from tools.oeweave import explore, replay, scenarios
+    result = explore.explore(scenarios.SCENARIOS["sync_subscriber"])
+
+See `scheduler.py` for the execution model and `explore.py` for policies
+and replay tokens.
+"""
+
+from . import explore, scheduler  # noqa: F401
+from .explore import Failure, Result, decode_token, encode_token, replay  # noqa: F401
+from .scheduler import (WeaveDeadlock, WeaveError, WeaveLeak,  # noqa: F401
+                        WeaveScheduler, yield_point)

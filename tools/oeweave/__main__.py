@@ -1,0 +1,103 @@
+"""CLI: explore the control-plane scenarios, or replay a failing token.
+
+Exit 0 when every explored schedule passes; exit 1 with one replay token
+per failure otherwise. `weave.schedules_explored` / `weave.failures` are
+reported at the end of the run (the same accumulator surface `make ci`
+tooling scrapes).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+
+def _cpu_env() -> None:
+    # the scenarios never touch devices; keep jax off the TPU so `make
+    # weave` can run next to a training job (same discipline as oelint)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main(argv=None) -> int:
+    _cpu_env()
+    ap = argparse.ArgumentParser(prog="oeweave", description=__doc__)
+    ap.add_argument("scenarios", nargs="*",
+                    help="scenario names (default: all)")
+    ap.add_argument("--schedules", type=int, default=25,
+                    help="random schedules per scenario")
+    ap.add_argument("--sweep", type=int, default=40,
+                    help="preemption-sweep schedules per scenario")
+    ap.add_argument("--depth", type=int, default=2,
+                    help="preemption bound for the sweep")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--budget-s", type=float, default=60.0,
+                    help="wall-clock budget over all scenarios")
+    ap.add_argument("--replay", metavar="SCENARIO:TOKEN",
+                    help="replay one recorded schedule and exit")
+    ap.add_argument("--list", action="store_true", help="list scenarios")
+    args = ap.parse_args(argv)
+
+    from tools.oeweave import explore as ex
+    from tools.oeweave import scenarios as sc
+
+    if args.list:
+        for name in sc.SCENARIOS:
+            print(name)
+        return 0
+
+    if args.replay:
+        name, _, token = args.replay.partition(":")
+        if name not in sc.SCENARIOS:
+            ap.error(f"unknown scenario {name!r}")
+        sc.warm()
+        fail = ex.replay(sc.SCENARIOS[name], token)
+        if fail is None:
+            print(f"{name}: schedule replays clean (fixed?)")
+            return 0
+        print(f"{name}: reproduced [{fail.kind}] {fail.error}")
+        print(f"  token: {fail.token}")
+        return 1
+
+    names = args.scenarios or list(sc.SCENARIOS)
+    for n in names:
+        if n not in sc.SCENARIOS:
+            ap.error(f"unknown scenario {n!r} (try --list)")
+    sc.warm()
+
+    from openembedding_tpu.utils import metrics
+
+    t0 = time.monotonic()
+    explored = 0
+    failures = []
+    rc = 0
+    for name in names:
+        left = args.budget_s - (time.monotonic() - t0)
+        if left <= 0:
+            print(f"budget exhausted; skipping {name} and later scenarios")
+            break
+        res = ex.explore(sc.SCENARIOS[name],
+                         random_schedules=args.schedules, seed=args.seed,
+                         preemption_schedules=args.sweep,
+                         preemption_depth=args.depth)
+        explored += res.schedules_explored
+        status = "ok" if res.ok else f"{len(res.failures)} FAILING"
+        print(f"{name}: {res.schedules_explored} schedules, {status}"
+              + (f" ({res.truncated} truncated)" if res.truncated else ""))
+        for f in res.failures:
+            failures.append((name, f))
+            print(f"  [{f.kind}] {f.error}")
+            print(f"  replay: python -m tools.oeweave "
+                  f"--replay '{name}:{f.token}'")
+            rc = 1
+    metrics.observe("weave.schedules_explored", explored)
+    metrics.observe("weave.failures", len(failures))
+    print(f"\nweave.schedules_explored={explored} "
+          f"weave.failures={len(failures)} "
+          f"({time.monotonic() - t0:.1f}s)")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

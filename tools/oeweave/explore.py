@@ -1,0 +1,218 @@
+"""oeweave exploration: policies, schedules, replay tokens.
+
+A *schedule* is the sequence of choice indices the scheduler recorded
+(`WeaveScheduler.choices`). Three policies produce schedules:
+
+- `RandomPolicy(seed)` — seeded bounded-random: at every decision pick a
+  uniformly random candidate. Same seed, same scenario → identical
+  schedule (the seed-determinism pin in tests).
+- `SweepPolicy(overrides)` — preemption-bounded sweep: run the baseline
+  (always keep the current thread running when possible; else lowest tid)
+  but at the decision indices in `overrides` force a specific alternative.
+  `sweep()` enumerates all single-preemption schedules, then (budget
+  permitting) pairs — a bounded systematic walk of "what if a context
+  switch happened *here*".
+- `ReplayPolicy(choices)` — replay a recorded schedule; past the recorded
+  tail it always picks index 0, which is deterministic, so a token
+  replays bit-for-bit even though teardown may take extra decisions.
+
+A failing schedule is reported as a replay token:
+
+    oeweave1:<base36 choice per decision>
+
+`replay(scenario, token)` re-runs the exact interleaving and re-raises
+the failure — the token is the bug report.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from .scheduler import (WeaveBudget, WeaveError, WeaveScheduler)
+
+TOKEN_PREFIX = "oeweave1:"
+_ALPHA = "0123456789abcdefghijklmnopqrstuvwxyz"
+
+
+def encode_token(choices: List[int]) -> str:
+    parts = []
+    for c in choices:
+        if c < 36:
+            parts.append(_ALPHA[c])
+        else:  # unreachably wide decision; escape it
+            parts.append(f"({c})")
+    return TOKEN_PREFIX + "".join(parts)
+
+
+def decode_token(token: str) -> List[int]:
+    if not token.startswith(TOKEN_PREFIX):
+        raise ValueError(f"not an oeweave replay token: {token!r}")
+    body = token[len(TOKEN_PREFIX):]
+    out: List[int] = []
+    i = 0
+    while i < len(body):
+        ch = body[i]
+        if ch == "(":
+            j = body.index(")", i)
+            out.append(int(body[i + 1:j]))
+            i = j + 1
+        else:
+            out.append(_ALPHA.index(ch))
+            i += 1
+    return out
+
+
+class RandomPolicy:
+    def __init__(self, seed: int):
+        self._rng = random.Random(seed)
+
+    def __call__(self, n: int, tids: List[int], runnables: List[bool],
+                 cur_tid: int, decision: int) -> int:
+        return self._rng.randrange(n)
+
+
+class SweepPolicy:
+    """Baseline run-to-completion order with forced preemptions.
+
+    Default choice keeps the current thread running while it is RUNNABLE
+    (no preemption), else runs the lowest-tid runnable candidate, and only
+    fires a timeout when nothing is runnable — i.e. the schedule an
+    uncontended real machine would produce. `overrides[d] = k` forces
+    candidate k at decision d (the injected context switch).
+    """
+
+    def __init__(self, overrides: Optional[Dict[int, int]] = None):
+        self.overrides = overrides or {}
+
+    def __call__(self, n: int, tids: List[int], runnables: List[bool],
+                 cur_tid: int, decision: int) -> int:
+        if decision in self.overrides:
+            return self.overrides[decision] % n
+        if cur_tid in tids and runnables[tids.index(cur_tid)]:
+            return tids.index(cur_tid)
+        for i, r in enumerate(runnables):
+            if r:
+                return i
+        return 0
+
+
+class ReplayPolicy:
+    def __init__(self, choices: List[int]):
+        self.choices = choices
+
+    def __call__(self, n: int, tids: List[int], runnables: List[bool],
+                 cur_tid: int, decision: int) -> int:
+        if decision < len(self.choices):
+            return self.choices[decision] % n
+        return 0
+
+
+@dataclass
+class Failure:
+    token: str
+    error: str
+    kind: str  # exception | deadlock | leak
+
+
+@dataclass
+class Result:
+    schedules_explored: int = 0
+    truncated: int = 0
+    failures: List[Failure] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.failures
+
+
+def run_schedule(scenario: Callable[[], None], policy,
+                 max_decisions: int = 20000):
+    """One schedule. Returns (failure_or_None, scheduler)."""
+    sched = WeaveScheduler(policy, max_decisions=max_decisions)
+    try:
+        sched.run(scenario)
+    except WeaveBudget:
+        return Failure(encode_token(sched.choices), "budget", "truncated"), sched
+    except BaseException as e:  # noqa: BLE001 — every failure gets a token
+        kind = type(e).__name__
+        if "Deadlock" in kind:
+            kind = "deadlock"
+        elif "Leak" in kind:
+            kind = "leak"
+        else:
+            kind = "exception"
+        return Failure(encode_token(sched.choices), repr(e), kind), sched
+    return None, sched
+
+
+def explore(scenario: Callable[[], None], *,
+            random_schedules: int = 20, seed: int = 0,
+            preemption_schedules: int = 40, preemption_depth: int = 2,
+            max_decisions: int = 20000,
+            stop_on_first: bool = False) -> Result:
+    """Random exploration + preemption-bounded sweep over one scenario."""
+    res = Result()
+
+    def record(fail: Optional[Failure]) -> bool:
+        res.schedules_explored += 1
+        if fail is None:
+            return False
+        if fail.kind == "truncated":
+            res.truncated += 1
+            return False
+        res.failures.append(fail)
+        return True
+
+    # seeded bounded-random
+    for i in range(random_schedules):
+        fail, _ = run_schedule(scenario, RandomPolicy(seed + i), max_decisions)
+        if record(fail) and stop_on_first:
+            return res
+
+    # preemption-bounded sweep: baseline, then forced alternatives at each
+    # decision point, breadth-first up to `preemption_depth` preemptions.
+    budget = preemption_schedules
+    fail, base = run_schedule(scenario, SweepPolicy(), max_decisions)
+    if record(fail) and stop_on_first:
+        return res
+    budget -= 1
+    frontier: List[Dict[int, int]] = [{}]
+    counts_for: Dict[str, List[int]] = {"": list(base.candidate_counts)}
+    for depth in range(preemption_depth):
+        nxt: List[Dict[int, int]] = []
+        for ov in frontier:
+            key = ",".join(f"{d}:{k}" for d, k in sorted(ov.items()))
+            counts = counts_for.get(key)
+            if counts is None:
+                continue
+            start = (max(ov) + 1) if ov else 0
+            for d in range(start, len(counts)):
+                for alt in range(1, counts[d]):
+                    if budget <= 0:
+                        return res
+                    child = dict(ov)
+                    child[d] = alt
+                    fail, sched = run_schedule(
+                        scenario, SweepPolicy(child), max_decisions)
+                    budget -= 1
+                    if record(fail) and stop_on_first:
+                        return res
+                    ckey = ",".join(
+                        f"{dd}:{kk}" for dd, kk in sorted(child.items()))
+                    counts_for[ckey] = list(sched.candidate_counts)
+                    nxt.append(child)
+        frontier = nxt
+        if not frontier:
+            break
+    return res
+
+
+def replay(scenario: Callable[[], None], token: str,
+           max_decisions: int = 20000) -> Optional[Failure]:
+    """Re-run the exact recorded interleaving; returns its Failure (or None
+    if the schedule no longer fails — e.g. after a fix)."""
+    choices = decode_token(token)
+    fail, _ = run_schedule(scenario, ReplayPolicy(choices), max_decisions)
+    return fail

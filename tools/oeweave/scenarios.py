@@ -1,0 +1,421 @@
+"""oeweave scenarios: the threaded control-plane modules under the scheduler.
+
+Each scenario is a zero-arg callable run under `WeaveScheduler` (primitives
+patched): it constructs the object under test INSIDE the weave context (so
+its locks/queues/threads are deterministic), drives it from several weave
+threads, and asserts the invariants the module's docs promise — no torn
+status, no lost wakeups, no double-apply, idempotent start/stop, clean
+shutdown. Failures (assertion, deadlock, leak) surface through
+`explore.Result` with a replay token.
+
+Scenarios script the *wire/device* half (fake `sync_once`, stub model,
+stubbed `decide`) — the point is the host-side locking, not the payloads.
+`warm()` pre-imports the heavy modules: imports inside a weave run could
+spawn real threads mid-schedule (jax pools) and must already be done.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+import time
+from types import SimpleNamespace
+from typing import Callable, Dict
+
+import numpy as np
+
+_WARMED = False
+
+
+def warm() -> None:
+    """Import every module a scenario touches, before any weave run."""
+    global _WARMED
+    if _WARMED:
+        return
+    import openembedding_tpu.persist            # noqa: F401
+    import openembedding_tpu.serving            # noqa: F401
+    import openembedding_tpu.sync.subscriber    # noqa: F401
+    import openembedding_tpu.tables.host_offload  # noqa: F401
+    import openembedding_tpu.placement.controller  # noqa: F401
+    import openembedding_tpu.utils.metrics      # noqa: F401
+    import openembedding_tpu.utils.sketch       # noqa: F401
+    import openembedding_tpu.utils.slo          # noqa: F401
+    import openembedding_tpu.export             # noqa: F401
+    _WARMED = True
+
+
+# -- SyncSubscriber: IDLE -> FETCHING -> APPLYING -> DEGRADED machine ---------
+
+
+def sync_subscriber() -> None:
+    """Racing start/start, concurrent status readers, fault injection,
+    racing stop/stop. Invariants: (state=DEGRADED => reason set),
+    applied == version (both bump under one lock hold), exactly one worker
+    ever spawned, `_thread` None after stop, zero leaks."""
+    from openembedding_tpu.sync import subscriber as sub
+    s = sub.SyncSubscriber(manager=None, model_sign="m", feed="http://feed",
+                           interval_s=0.01, max_backoff_s=0.05)
+    script = ["ok", "fail", "ok", "ok"]
+
+    def fake_sync_once() -> int:
+        outcome = script.pop(0) if script else "ok"
+        s._set_state(sub.FETCHING)
+        if outcome == "fail":
+            raise sub.SyncError("injected fault")
+        s._set_state(sub.APPLYING)
+        with s._mu:
+            s.version = (s.version or 0) + 1
+            s.applied += 1
+        s._set_state(sub.IDLE)
+        return 1
+
+    s.sync_once = fake_sync_once
+    runs = []
+    orig_run = s._run
+
+    def counted_run() -> None:
+        runs.append(1)
+        orig_run()
+
+    s._run = counted_run
+
+    def reader() -> None:
+        for _ in range(3):
+            st = s.status()
+            if st["state"] == sub.DEGRADED:
+                assert st["last_degraded_reason"], \
+                    "torn status: DEGRADED without a reason"
+            assert st["applied"] == (st["version"] or 0), \
+                f"torn (version, applied): {st['version']}, {st['applied']}"
+            time.sleep(0.005)
+
+    starters = [threading.Thread(target=s.start, name=f"start{i}")
+                for i in range(2)]
+    readers = [threading.Thread(target=reader, name=f"read{i}")
+               for i in range(2)]
+    for t in starters + readers:
+        t.start()
+    for t in starters + readers:
+        t.join()
+    time.sleep(0.02)  # let the worker take some polls
+    stoppers = [threading.Thread(target=s.stop, name=f"stop{i}")
+                for i in range(2)]
+    for t in stoppers:
+        t.start()
+    for t in stoppers:
+        t.join()
+    assert len(runs) <= 1, f"start() leaked {len(runs)} workers"
+    with s._mu:
+        assert s._thread is None, "stop() left _thread set"
+    st = s.status()
+    assert st["applied"] == (st["version"] or 0)
+
+
+# -- MicroBatcher: leader/follower window under the shared condition ----------
+
+
+def micro_batcher() -> None:
+    """N concurrent predicts through one group window. Invariants: every
+    request gets exactly its own logits row back (no cross-wiring, no lost
+    wakeup leaves a follower parked), groups map drains empty."""
+    from openembedding_tpu import serving
+
+    mb = serving.MicroBatcher(manager=None, window_ms=5.0, max_batch=4)
+
+    class _Model:
+        def predict(self, merged):
+            return np.asarray(merged["sparse"]["f"], np.float32)
+
+    model = _Model()
+    outs: Dict[int, np.ndarray] = {}
+
+    def req(i: int) -> None:
+        batch = {"sparse": {"f": np.array([[float(i)]], np.float32)}}
+        outs[i] = mb.predict(model, "m", batch)
+
+    threads = [threading.Thread(target=req, args=(i,), name=f"req{i}")
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for i in range(4):
+        assert outs[i].shape[0] == 1 and float(outs[i][0, 0]) == float(i), \
+            f"request {i} got someone else's rows: {outs[i]!r}"
+    assert not mb._groups, f"groups not drained: {mb._groups!r}"
+
+
+# -- PeriodicReporter ---------------------------------------------------------
+
+
+def periodic_reporter() -> None:
+    """Racing start/start and stop/stop. Invariants: exactly one reporter
+    thread, `_thread` None after stop, zero leaks."""
+    from openembedding_tpu.utils import metrics as m
+
+    rep = m.PeriodicReporter(interval=0.01, sink=lambda s: None, reset=False)
+    runs = []
+    orig_run = rep._run
+
+    def counted_run() -> None:
+        runs.append(1)
+        orig_run()
+
+    rep._run = counted_run
+    starters = [threading.Thread(target=rep.start, name=f"start{i}")
+                for i in range(2)]
+    for t in starters:
+        t.start()
+    for t in starters:
+        t.join()
+    time.sleep(0.03)
+    stoppers = [threading.Thread(target=rep.stop, name=f"stop{i}")
+                for i in range(2)]
+    for t in stoppers:
+        t.start()
+    for t in stoppers:
+        t.join()
+    assert len(runs) <= 1, f"start() leaked {len(runs)} reporter threads"
+    with rep._lock:
+        assert rep._thread is None, "stop() left _thread set"
+
+
+# -- PlacementController watcher ----------------------------------------------
+
+
+def placement_watcher() -> None:
+    """Watcher parks decisions, on_step consumes them, racing start/stop.
+    Invariants: a parked decision is applied at most once (no double-apply),
+    idempotent start, clean stop."""
+    from openembedding_tpu.placement.controller import PlacementController
+
+    trainer = SimpleNamespace(mig_enabled=False, hot_enabled=False)
+    policy = SimpleNamespace(hot_budget_bytes=0, imbalance_target=0.0)
+    ctrl = PlacementController(trainer, policy, interval_steps=0)
+    decision = SimpleNamespace(refresh=True, migrate=False, tables={},
+                               reason="weave")
+    decided = []
+
+    def fake_decide(state=None):
+        decided.append(1)
+        return decision
+
+    ctrl.decide = fake_decide
+    starters = [threading.Thread(target=ctrl.start, args=(0.01,),
+                                 name=f"start{i}") for i in range(2)]
+    for t in starters:
+        t.start()
+    applied_rounds = 0
+    for step in range(1, 5):
+        with ctrl._lock:
+            before = ctrl._pending
+        ctrl.on_step(None, step=step)
+        if before is not None:
+            applied_rounds += 1
+        time.sleep(0.008)
+    stoppers = [threading.Thread(target=ctrl.stop, name=f"stop{i}")
+                for i in range(2)]
+    for t in stoppers:
+        t.start()
+    for t in starters + stoppers:
+        t.join()
+    ctrl.stop()
+    with ctrl._lock:
+        t = ctrl._thread
+    if t is not None:
+        # stop() joins with a timeout; under adversarial scheduling that can
+        # expire with the watcher still runnable — the invariant is that it
+        # EVENTUALLY exits (a stuck watcher fails as deadlock/leak)
+        t.join()
+    assert t is None or not t.is_alive(), "watcher still alive after stop"
+    # on_step swapped _pending out atomically: a decision parked once is
+    # never applied twice, so rounds applied <= rounds decided
+    assert applied_rounds <= len(decided)
+
+
+# -- HostOffloadTable's host store (the stage ring's shared state) ------------
+
+
+def host_offload_store() -> None:
+    """The staging worker's `lookup` racing the training thread's
+    merge/defer/drain. Invariants: a reader only ever sees fully-merged
+    values (monotone versions k=1..K for one id, never a torn row), and
+    `snapshot()` is internally consistent."""
+    from openembedding_tpu.tables.host_offload import HostStore
+
+    store = HostStore(dim=2, slot_widths={"m": 1})
+    rounds = 5
+
+    def writer() -> None:
+        for k in range(1, rounds + 1):
+            ids = np.array([7], np.int64)
+            w = np.full((1, 2), float(k), np.float32)
+            sl = {"m": np.full((1, 1), float(k), np.float32)}
+            if k % 2:
+                store.merge(ids, w, sl)
+            else:
+                store.defer(ids, w, sl)
+                store.drain()
+
+    seen = []
+
+    def reader() -> None:
+        for _ in range(rounds):
+            hit, w, sl = store.lookup(np.array([7], np.int64))
+            if hit[0]:
+                assert w[0, 0] == w[0, 1] == sl["m"][0, 0], \
+                    f"torn row: weights {w[0]!r} slots {sl['m'][0]!r}"
+                seen.append(float(w[0, 0]))
+            time.sleep(0.001)
+
+    def snapshotter() -> None:
+        snap = store.snapshot()
+        assert len(snap.ids) == len(snap.weights), "torn snapshot"
+
+    threads = [threading.Thread(target=writer, name="writer"),
+               threading.Thread(target=reader, name="reader"),
+               threading.Thread(target=snapshotter, name="snap")]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert seen == sorted(seen), f"non-monotone reads: {seen}"
+
+
+# -- AsyncPersister / GC ------------------------------------------------------
+
+
+def async_persister() -> None:
+    """persist() from the training thread racing wait() and double close().
+    Invariants: every submitted persist commits, close is idempotent (the
+    double-close used to deadlock on the sentinel's task_done), writer
+    thread joins, zero leaks."""
+    from openembedding_tpu.persist import AsyncPersister, PersistPolicy
+
+    root = tempfile.mkdtemp(prefix="oeweave-persist-")
+    trainer = SimpleNamespace(num_shards=1,
+                              externalize=lambda state: state)
+    p = AsyncPersister(trainer, model=None, root=root, window=1, keep=10,
+                       policy=PersistPolicy(every_steps=1))
+    committed = []
+    p._write_full_payload = (
+        lambda snapshot, stores, tmp: (os.makedirs(tmp, exist_ok=True),
+                                       committed.append(1)))
+
+    def producer() -> None:
+        for step in (1, 2, 3):
+            p.persist(SimpleNamespace(step=step))
+
+    def waiter() -> None:
+        p.wait()
+
+    threads = [threading.Thread(target=producer, name="producer"),
+               threading.Thread(target=waiter, name="waiter")]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    closers = [threading.Thread(target=p.close, name=f"close{i}")
+               for i in range(2)]
+    for t in closers:
+        t.start()
+    for t in closers:
+        t.join()
+    p.close()  # third, sequential: must stay a no-op
+    assert len(committed) == 3, f"lost persists: {len(committed)}/3 written"
+    # close() joins with a timeout, and an adversarial schedule may starve
+    # the (runnable) writer past any timeout — "stopped" here means the
+    # writer EVENTUALLY exits once scheduled, so join untimed before
+    # asserting. A writer that never exits still fails: deadlock/leak.
+    p._thread.join()
+    assert not p._thread.is_alive(), "writer thread alive after close"
+
+
+# -- SkewMonitor --------------------------------------------------------------
+
+
+def skew_monitor() -> None:
+    """Two producers feeding the bounded queue, drain, close. Invariants:
+    every accepted batch is folded in, close() joins the worker (the leak
+    the thread-lifecycle pass flagged), zero leaks."""
+    from openembedding_tpu.utils.sketch import SkewMonitor
+
+    mon = SkewMonitor(k=8, queue_size=16)
+    accepted = []
+
+    def producer(base: int) -> None:
+        for i in range(3):
+            if mon.observe("t", np.array([base + i, base], np.int64)):
+                accepted.append(2)
+
+    threads = [threading.Thread(target=producer, args=(b,), name=f"prod{b}")
+               for b in (10, 20)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    mon.drain()
+    total = sum(sk.total for sk in [mon.sketch(t) for t in mon.tables()])
+    assert total == sum(accepted), \
+        f"accepted {sum(accepted)} ids but folded {total}"
+    mon.close()
+    with mon._lock:
+        t = mon._thread
+    assert t is None or not t.is_alive(), "worker alive after close"
+
+
+# -- SLOEvaluator -------------------------------------------------------------
+
+
+def slo_evaluator() -> None:
+    """Racing start/start, evaluate_now from a second thread mid-tick,
+    racing stop/stop. Invariants: one evaluator thread, snapshot always a
+    consistent list, `_thread` None after stop."""
+    from openembedding_tpu.utils.slo import SLOEvaluator
+
+    ev = SLOEvaluator(specs=[], interval_s=0.01)
+    runs = []
+    orig_run = ev._run
+
+    def counted_run() -> None:
+        runs.append(1)
+        orig_run()
+
+    ev._run = counted_run
+
+    def evaluator() -> None:
+        for _ in range(2):
+            ev.evaluate_now()
+            ev.snapshot()
+            time.sleep(0.004)
+
+    starters = [threading.Thread(target=ev.start, name=f"start{i}")
+                for i in range(2)]
+    side = threading.Thread(target=evaluator, name="eval")
+    for t in starters + [side]:
+        t.start()
+    for t in starters + [side]:
+        t.join()
+    time.sleep(0.02)
+    stoppers = [threading.Thread(target=ev.stop, name=f"stop{i}")
+                for i in range(2)]
+    for t in stoppers:
+        t.start()
+    for t in stoppers:
+        t.join()
+    assert len(runs) <= 1, f"start() leaked {len(runs)} evaluator threads"
+    with ev._lock:
+        assert ev._thread is None, "stop() left _thread set"
+
+
+SCENARIOS: Dict[str, Callable[[], None]] = {
+    "sync_subscriber": sync_subscriber,
+    "micro_batcher": micro_batcher,
+    "periodic_reporter": periodic_reporter,
+    "placement_watcher": placement_watcher,
+    "host_offload_store": host_offload_store,
+    "async_persister": async_persister,
+    "skew_monitor": skew_monitor,
+    "slo_evaluator": slo_evaluator,
+}

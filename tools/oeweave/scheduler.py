@@ -1,0 +1,990 @@
+"""oeweave scheduler: deterministic cooperative execution of threaded code.
+
+The scheduler serializes a multi-threaded scenario onto ONE runnable thread
+at a time. Real OS threads still exist (so ``threading.current_thread()``,
+thread-locals and contextvars behave normally), but every instrumented
+primitive — lock acquire/release, condition wait/notify, event wait/set,
+queue put/get, thread start/join, ``time.sleep`` — is a *yield point* where
+a scheduling **policy** chooses which thread runs next. The sequence of
+choices IS the schedule; recording it gives a compact replay token that
+reproduces any interleaving bit-for-bit (see `explore.py`).
+
+Design notes:
+
+- Instrumentation is context-manager patching (`patched()`): while active,
+  ``threading.Thread/Lock/RLock/Condition/Event/Semaphore``,
+  ``queue.Queue/SimpleQueue`` and ``time.sleep/monotonic/time`` resolve to
+  weave implementations. Production modules are untouched; objects they
+  construct *inside* the context pick up weave primitives.
+- Threads not registered with the scheduler (jax internals, pytest
+  machinery) fall through to real primitives — they are bystanders, not
+  participants.
+- Time is virtual: ``monotonic()`` returns ``base + now`` where ``now``
+  only advances when the policy *chooses* to fire a pending timeout. A
+  timed wait is therefore a scheduling choice like any other ("the timeout
+  fires here"), which is how lost-wakeup bugs that hide behind generous
+  timeouts become reachable in milliseconds.
+- Deadlock (no runnable thread, no pending timeout) aborts the schedule
+  with every thread's block reason — this is how a classic lost wakeup
+  (``if not ready: cond.wait()``) actually manifests.
+- At scenario end the scheduler *drains*: remaining threads are scheduled
+  (timeouts fire) until they finish or only indefinitely-blocked threads
+  remain; those are reported as **leaked threads**, the "clean shutdown"
+  invariant.
+"""
+
+from __future__ import annotations
+
+import queue as _queue_mod
+import threading as _threading
+import time as _time_mod
+from contextlib import contextmanager
+from typing import Callable, Dict, List, Optional, Tuple
+
+# Real primitives, captured at import so weave internals never recurse into
+# patched versions.
+_REAL_THREAD = _threading.Thread
+_REAL_LOCK = _threading.Lock
+_REAL_RLOCK = _threading.RLock
+_REAL_CONDITION = _threading.Condition
+_REAL_EVENT = _threading.Event
+_REAL_SEMAPHORE = _threading.Semaphore
+_REAL_QUEUE = _queue_mod.Queue
+_REAL_SIMPLE_QUEUE = _queue_mod.SimpleQueue
+_REAL_MONOTONIC = _time_mod.monotonic
+_REAL_TIME = _time_mod.time
+_REAL_SLEEP = _time_mod.sleep
+_get_ident = _threading.get_ident
+
+# The single active scheduler (one weave run at a time; runs are themselves
+# serialized by the harness).
+_ACTIVE: Optional["WeaveScheduler"] = None
+
+
+@contextmanager
+def _unpatched():
+    """Momentarily restore real threading internals.
+
+    CPython's Thread/Event constructors resolve `Event`/`Condition` through
+    the threading module globals — constructing a REAL helper object while
+    patched would hand it weave internals. Weave code constructing real
+    primitives (the scheduler gate, thread bootstraps, event mirrors) wraps
+    the construction in this.
+    """
+    cur = (_threading.Thread, _threading.Lock, _threading.RLock,
+           _threading.Condition, _threading.Event, _threading.Semaphore,
+           _queue_mod.Queue, _queue_mod.SimpleQueue,
+           _time_mod.sleep, _time_mod.monotonic, _time_mod.time)
+    _threading.Thread = _REAL_THREAD
+    _threading.Lock = _REAL_LOCK
+    _threading.RLock = _REAL_RLOCK
+    _threading.Condition = _REAL_CONDITION
+    _threading.Event = _REAL_EVENT
+    _threading.Semaphore = _REAL_SEMAPHORE
+    _queue_mod.Queue = _REAL_QUEUE
+    _queue_mod.SimpleQueue = _REAL_SIMPLE_QUEUE
+    _time_mod.sleep = _REAL_SLEEP
+    _time_mod.monotonic = _REAL_MONOTONIC
+    _time_mod.time = _REAL_TIME
+    try:
+        yield
+    finally:
+        (_threading.Thread, _threading.Lock, _threading.RLock,
+         _threading.Condition, _threading.Event, _threading.Semaphore,
+         _queue_mod.Queue, _queue_mod.SimpleQueue,
+         _time_mod.sleep, _time_mod.monotonic, _time_mod.time) = cur
+
+# How long a parked thread waits on its gate before declaring the harness
+# itself wedged (a bug in the scheduler, not the scenario).
+_GATE_TIMEOUT_S = 30.0
+
+RUNNABLE = "runnable"
+BLOCKED = "blocked"
+FINISHED = "finished"
+
+
+class WeaveError(Exception):
+    """Base for scheduler-detected scenario failures."""
+
+
+class WeaveDeadlock(WeaveError):
+    """No runnable thread and no pending timeout."""
+
+
+class WeaveLeak(WeaveError):
+    """Threads still alive/blocked after the scenario body returned."""
+
+
+class WeaveBudget(WeaveError):
+    """Schedule exceeded max_decisions (treated as truncated, not failed)."""
+
+
+class WeaveInternal(WeaveError):
+    """The harness itself wedged (gate timeout) — a scheduler bug."""
+
+
+class _WeaveKilled(BaseException):
+    """Raised inside a weave thread at its next yield point to tear it down.
+
+    BaseException so scenario code's ``except Exception`` does not swallow
+    the teardown.
+    """
+
+
+class _ThreadState:
+    __slots__ = ("tid", "name", "go", "kill", "status", "reason", "deadline",
+                 "wake_flag", "ident", "weave_thread")
+
+    def __init__(self, tid: int, name: str):
+        self.tid = tid
+        self.name = name
+        self.go = False
+        self.kill = False
+        self.status = RUNNABLE
+        self.reason: str = ""
+        self.deadline: Optional[float] = None  # virtual-clock instant
+        self.wake_flag: Optional[str] = None   # "signal" | "timeout"
+        self.ident: Optional[int] = None
+        self.weave_thread: Optional["WeaveThread"] = None
+
+    def describe(self) -> str:
+        if self.status == BLOCKED:
+            dl = "" if self.deadline is None else f" (timeout@{self.deadline:.3f})"
+            return f"{self.name}: blocked on {self.reason}{dl}"
+        return f"{self.name}: {self.status}"
+
+
+class WeaveScheduler:
+    """Cooperative scheduler; see module docstring.
+
+    `policy(n, tids, runnables, cur_tid, decision)` -> index in [0, n)
+    choosing among the sorted-by-tid candidate threads (`runnables[i]`
+    False means candidate i is a pending timeout, not a runnable thread).
+    Every call is recorded in `choices`; `candidate_counts` records n for
+    the preemption sweep.
+    """
+
+    def __init__(self,
+                 policy: Callable[[int, List[int], List[bool], int, int], int],
+                 max_decisions: int = 20000):
+        self.policy = policy
+        self.max_decisions = int(max_decisions)
+        self.choices: List[int] = []
+        self.candidate_counts: List[int] = []
+        self.now = 0.0
+        self.base = _REAL_MONOTONIC()
+        self.threads: List[_ThreadState] = []
+        self.by_ident: Dict[int, _ThreadState] = {}
+        self.fatal: Optional[BaseException] = None
+        self.thread_errors: List[Tuple[str, BaseException]] = []
+        self._cv = _REAL_CONDITION()
+        self._decision = 0
+        self._next_tid = 0
+
+    # -- registration ---------------------------------------------------------
+
+    def _register(self, name: str) -> _ThreadState:
+        st = _ThreadState(self._next_tid, name)
+        self._next_tid += 1
+        self.threads.append(st)
+        return st
+
+    def _bind(self, st: _ThreadState) -> None:
+        st.ident = _get_ident()
+        self.by_ident[st.ident] = st
+
+    def current(self) -> Optional[_ThreadState]:
+        return self.by_ident.get(_get_ident())
+
+    # -- the decision core ----------------------------------------------------
+
+    def _candidates(self) -> List[_ThreadState]:
+        """Runnable threads, plus timed-blocked threads whose deadline is
+        the EARLIEST pending one. Virtual time is monotone: a later timeout
+        cannot fire before an earlier one still pending — without this
+        restriction the explorer reaches schedules real time cannot (e.g. a
+        10 s join timing out before a 10 ms tick)."""
+        out = [t for t in self.threads if t.status == RUNNABLE]
+        timed = [t for t in self.threads
+                 if t.status == BLOCKED and t.deadline is not None]
+        if timed:
+            dmin = min(t.deadline for t in timed)
+            out.extend(t for t in timed if t.deadline == dmin)
+        out.sort(key=lambda t: t.tid)
+        return out
+
+    def _choose_and_transfer(self, st: _ThreadState, *, parked: bool) -> None:
+        """Pick the next thread to run and hand control over.
+
+        `parked`: st has just blocked (it is not runnable unless it has a
+        deadline). Otherwise st stays a candidate and may keep running.
+        """
+        if self.fatal is not None:
+            raise _WeaveKilled()
+        cands = self._candidates()
+        if not cands:
+            self._abort(WeaveDeadlock(
+                "deadlock: no runnable thread, no pending timeout\n  "
+                + "\n  ".join(t.describe() for t in self.threads
+                              if t.status != FINISHED)))
+            raise _WeaveKilled()
+        if self._decision >= self.max_decisions:
+            self._abort(WeaveBudget(
+                f"schedule exceeded {self.max_decisions} decisions; "
+                "threads:\n  "
+                + "\n  ".join(t.describe() for t in self.threads
+                              if t.status != FINISHED)))
+            raise _WeaveKilled()
+        n = len(cands)
+        idx = self.policy(n, [t.tid for t in cands],
+                          [t.status == RUNNABLE for t in cands],
+                          st.tid, self._decision)
+        idx = max(0, min(n - 1, int(idx)))
+        self.choices.append(idx)
+        self.candidate_counts.append(n)
+        self._decision += 1
+        nxt = cands[idx]
+        if nxt.status == BLOCKED:
+            # the policy chose to fire this thread's timeout
+            if nxt.deadline is not None and nxt.deadline > self.now:
+                self.now = nxt.deadline
+            nxt.status = RUNNABLE
+            nxt.wake_flag = "timeout"
+            nxt.reason = ""
+            nxt.deadline = None
+        if nxt is st:
+            return  # keep running (or: own timeout fired immediately)
+        self._switch_to(nxt, wait=True, me=st)
+        if parked and st.status == BLOCKED:
+            # woken gate but still marked blocked (shouldn't happen) — guard
+            st.status = RUNNABLE
+
+    def _switch_to(self, nxt: _ThreadState, *, wait: bool,
+                   me: Optional[_ThreadState]) -> None:
+        with self._cv:
+            if me is not None:
+                me.go = False
+            nxt.go = True
+            self._cv.notify_all()
+            if not wait or me is None:
+                return
+            deadline = _REAL_MONOTONIC() + _GATE_TIMEOUT_S
+            while not me.go and not me.kill:
+                left = deadline - _REAL_MONOTONIC()
+                if left <= 0:
+                    raise WeaveInternal(
+                        f"{me.name}: gate timeout — scheduler wedged")
+                self._cv.wait(left)
+        if me.kill:
+            raise _WeaveKilled()
+
+    def yield_point(self, op: str = "") -> None:
+        """A preemption opportunity: the policy may switch threads here."""
+        st = self.current()
+        if st is None:
+            return
+        if st.kill:
+            raise _WeaveKilled()
+        self._choose_and_transfer(st, parked=False)
+
+    def block(self, st: _ThreadState, reason: str,
+              timeout: Optional[float] = None) -> bool:
+        """Park st until `wake()` or (policy-chosen) timeout.
+
+        Returns True when woken by signal, False on timeout.
+        """
+        st.status = BLOCKED
+        st.reason = reason
+        st.deadline = None if timeout is None else self.now + max(0.0, timeout)
+        st.wake_flag = None
+        self._choose_and_transfer(st, parked=True)
+        # here st.go is True again and wake_flag says why
+        flag = st.wake_flag
+        st.wake_flag = None
+        st.reason = ""
+        st.deadline = None
+        return flag == "signal"
+
+    def wake(self, st: _ThreadState) -> None:
+        """Mark a blocked thread runnable (it runs when the policy picks it)."""
+        if st.status == BLOCKED:
+            st.status = RUNNABLE
+            st.wake_flag = "signal"
+            st.reason = ""
+            st.deadline = None
+
+    def _abort(self, exc: BaseException) -> None:
+        """Record a fatal failure and kill every weave thread."""
+        if self.fatal is None:
+            self.fatal = exc
+        with self._cv:
+            for t in self.threads:
+                if t.status != FINISHED:
+                    t.kill = True
+            self._cv.notify_all()
+
+    def finish(self, st: _ThreadState) -> None:
+        """Thread body returned: wake joiners, pass control on, exit."""
+        st.status = FINISHED
+        st.go = False
+        for t in self.threads:
+            if t.status == BLOCKED and t.reason == f"join:{st.tid}":
+                self.wake(t)
+        cands = self._candidates()
+        if cands:
+            nxt = cands[0] if len(cands) == 1 else None
+            if nxt is None:
+                n = len(cands)
+                idx = self.policy(n, [t.tid for t in cands],
+                                  [t.status == RUNNABLE for t in cands],
+                                  st.tid, self._decision)
+                idx = max(0, min(n - 1, int(idx)))
+                self.choices.append(idx)
+                self.candidate_counts.append(n)
+                self._decision += 1
+                nxt = cands[idx]
+            if nxt.status == BLOCKED:
+                if nxt.deadline is not None and nxt.deadline > self.now:
+                    self.now = nxt.deadline
+                nxt.status = RUNNABLE
+                nxt.wake_flag = "timeout"
+                nxt.reason = ""
+                nxt.deadline = None
+            self._switch_to(nxt, wait=False, me=st)
+        elif any(t.status == BLOCKED for t in self.threads):
+            self._abort(WeaveDeadlock(
+                "deadlock at thread exit: remaining threads blocked forever\n  "
+                + "\n  ".join(t.describe() for t in self.threads
+                              if t.status == BLOCKED)))
+
+    # -- top level ------------------------------------------------------------
+
+    def run(self, fn: Callable[[], None]) -> None:
+        """Run `fn` as the scenario main thread under this scheduler.
+
+        Raises the first failure: a thread exception, WeaveDeadlock,
+        WeaveLeak, or WeaveBudget.
+        """
+        global _ACTIVE
+        if _ACTIVE is not None:
+            raise RuntimeError("nested weave runs are not supported")
+        main = self._register("main")
+        self._bind(main)
+        main.go = True
+        _ACTIVE = self
+        try:
+            with patched():
+                try:
+                    fn()
+                    self._drain(main)
+                except _WeaveKilled:
+                    pass
+        finally:
+            main.status = FINISHED
+            _ACTIVE = None
+            self._teardown()
+        if self.fatal is not None:
+            raise self.fatal
+        if self.thread_errors:
+            name, err = self.thread_errors[0]
+            raise WeaveError(f"thread {name!r} raised {err!r}") from err
+
+    def _drain(self, main: _ThreadState) -> None:
+        """After the scenario body: let finishing threads finish, then flag
+        leaks. Timed waits are timed out; indefinite blocks are leaks."""
+        if self.fatal is not None:
+            return
+        budget = self.max_decisions
+        while budget > 0:
+            others = [t for t in self.threads
+                      if t is not main and t.status != FINISHED]
+            if not others:
+                break
+            cands = [t for t in others
+                     if t.status == RUNNABLE
+                     or (t.status == BLOCKED and t.deadline is not None)]
+            if not cands:
+                leaked = ", ".join(t.describe() for t in others)
+                self._abort(WeaveLeak(f"leaked threads after scenario: {leaked}"))
+                return
+            budget -= 1
+            # pick the next NON-main thread ourselves (deterministically):
+            # routing this through the policy lets prefer-current policies
+            # keep choosing the idle main forever and never surface the leak
+            nxt = min((t for t in cands if t.status == RUNNABLE),
+                      key=lambda t: t.tid, default=None)
+            if nxt is None:  # only timed waits left: fire the earliest
+                nxt = min(cands, key=lambda t: (t.deadline, t.tid))
+                if nxt.deadline is not None and nxt.deadline > self.now:
+                    self.now = nxt.deadline
+                nxt.status = RUNNABLE
+                nxt.wake_flag = "timeout"
+                nxt.reason = ""
+                nxt.deadline = None
+            self._switch_to(nxt, wait=True, me=main)
+            if self.fatal is not None:
+                return
+        else:
+            others = [t for t in self.threads
+                      if t is not main and t.status != FINISHED]
+            if others:
+                self._abort(WeaveLeak(
+                    "threads still running after drain budget: "
+                    + ", ".join(t.describe() for t in others)))
+
+    def _teardown(self) -> None:
+        """Kill any still-alive weave thread and join its OS thread."""
+        with self._cv:
+            for t in self.threads:
+                if t.status != FINISHED:
+                    t.kill = True
+                    t.status = RUNNABLE
+            self._cv.notify_all()
+        for t in self.threads:
+            wt = t.weave_thread
+            if wt is not None and wt._os_thread is not None:
+                wt._os_thread.join(timeout=5.0)
+
+    # virtual clock
+    def monotonic(self) -> float:
+        return self.base + self.now
+
+    def sleep(self, st: _ThreadState, seconds: float) -> None:
+        if seconds <= 0:
+            self.yield_point("sleep0")
+            return
+        self.block(st, f"sleep:{seconds:g}", timeout=seconds)
+
+
+# -- weave primitives ---------------------------------------------------------
+
+
+def _sched_and_state() -> Tuple[Optional[WeaveScheduler], Optional[_ThreadState]]:
+    s = _ACTIVE
+    if s is None:
+        return None, None
+    return s, s.current()
+
+
+class WeaveLock:
+    """Deterministic Lock. Falls back to a real lock for unregistered
+    threads (bystanders keep mutual exclusion against each other, not
+    against weave threads — weave threads never run concurrently anyway)."""
+
+    _reentrant = False
+
+    def __init__(self):
+        self._owner: Optional[int] = None   # tid
+        self._count = 0
+        self._waiters: List[int] = []
+        self._real = _REAL_RLOCK()
+
+    def _state_of(self, sched: WeaveScheduler,
+                  tid: int) -> Optional[_ThreadState]:
+        for t in sched.threads:
+            if t.tid == tid:
+                return t
+        return None
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        sched, st = _sched_and_state()
+        if sched is None or st is None:
+            if timeout is not None and timeout > 0:
+                return self._real.acquire(blocking, timeout)
+            return self._real.acquire(blocking)
+        sched.yield_point("lock.acquire")
+        if self._owner == st.tid:
+            if self._reentrant:
+                self._count += 1
+                return True
+            raise RuntimeError(
+                "deadlock: non-reentrant lock re-acquired by owner "
+                f"{st.name}")
+        tmo = None if timeout is None or timeout < 0 else float(timeout)
+        while self._owner is not None:
+            if not blocking:
+                return False
+            self._waiters.append(st.tid)
+            signaled = sched.block(st, f"lock:{id(self):#x}", tmo)
+            if st.tid in self._waiters:
+                self._waiters.remove(st.tid)
+            if not signaled and self._owner is not None:
+                return False  # timed out
+        self._owner = st.tid
+        self._count = 1
+        return True
+
+    def release(self) -> None:
+        sched, st = _sched_and_state()
+        if sched is None or st is None:
+            self._real.release()
+            return
+        if self._owner != st.tid:
+            raise RuntimeError("release of un-acquired lock")
+        self._count -= 1
+        if self._count > 0:
+            return
+        self._owner = None
+        for tid in list(self._waiters):
+            t = self._state_of(sched, tid)
+            if t is not None:
+                sched.wake(t)
+        self._waiters.clear()
+        sched.yield_point("lock.release")
+
+    def locked(self) -> bool:
+        if _ACTIVE is None:
+            # best effort on the real path
+            got = self._real.acquire(False)
+            if got:
+                self._real.release()
+            return not got
+        return self._owner is not None
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    # condition support: fully release regardless of recursion, return count
+    def _release_save(self) -> int:
+        sched, st = _sched_and_state()
+        if sched is None or st is None or self._owner != st.tid:
+            raise RuntimeError("cannot wait on un-acquired lock")
+        count = self._count
+        self._count = 0
+        self._owner = None
+        for tid in list(self._waiters):
+            t = self._state_of(sched, tid)
+            if t is not None:
+                sched.wake(t)
+        self._waiters.clear()
+        return count
+
+    def _acquire_restore(self, count: int) -> None:
+        sched, st = _sched_and_state()
+        if sched is None or st is None:
+            raise RuntimeError("weave lock restore outside scheduler")
+        while self._owner is not None:
+            self._waiters.append(st.tid)
+            sched.block(st, f"lock:{id(self):#x}", None)
+            if st.tid in self._waiters:
+                self._waiters.remove(st.tid)
+        self._owner = st.tid
+        self._count = count
+
+    def _is_owned(self) -> bool:
+        _, st = _sched_and_state()
+        return st is not None and self._owner == st.tid
+
+
+class WeaveRLock(WeaveLock):
+    _reentrant = True
+
+
+class WeaveCondition:
+    """Deterministic Condition over a WeaveLock.
+
+    Matches threading semantics: wait/notify require the lock; a waiter
+    fully releases the lock, parks, and re-acquires before returning.
+    notify() marks waiters runnable — they still contend for the lock.
+    """
+
+    def __init__(self, lock=None):
+        if lock is None:
+            lock = WeaveRLock()
+        self._lock = lock
+        self._waiters: List[int] = []
+
+    def __enter__(self):
+        self._lock.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self._lock.release()
+        return False
+
+    def acquire(self, *a, **kw):
+        return self._lock.acquire(*a, **kw)
+
+    def release(self):
+        self._lock.release()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        sched, st = _sched_and_state()
+        if sched is None or st is None:
+            raise RuntimeError("weave condition used outside scheduler")
+        if not self._lock._is_owned():
+            raise RuntimeError("cannot wait on un-acquired lock")
+        count = self._lock._release_save()
+        self._waiters.append(st.tid)
+        signaled = sched.block(st, f"cond:{id(self):#x}", timeout)
+        if st.tid in self._waiters:
+            self._waiters.remove(st.tid)
+        self._lock._acquire_restore(count)
+        return signaled
+
+    def wait_for(self, predicate, timeout: Optional[float] = None) -> bool:
+        sched, _ = _sched_and_state()
+        endtime = None
+        if timeout is not None and sched is not None:
+            endtime = sched.now + timeout
+        result = predicate()
+        while not result:
+            waittime = None
+            if endtime is not None and sched is not None:
+                waittime = endtime - sched.now
+                if waittime <= 0:
+                    break
+            self.wait(waittime)
+            result = predicate()
+        return bool(result)
+
+    def notify(self, n: int = 1) -> None:
+        sched, st = _sched_and_state()
+        if sched is None or st is None:
+            raise RuntimeError("weave condition used outside scheduler")
+        if not self._lock._is_owned():
+            raise RuntimeError("cannot notify on un-acquired lock")
+        woken = 0
+        for tid in list(self._waiters):
+            if woken >= n:
+                break
+            self._waiters.remove(tid)
+            t = self._lock._state_of(sched, tid)
+            if t is not None:
+                sched.wake(t)
+                woken += 1
+        sched.yield_point("cond.notify")
+
+    def notify_all(self) -> None:
+        self.notify(len(self._waiters) or 1)
+
+
+class WeaveEvent:
+    """Deterministic Event; mirrors state into a real Event so bystander
+    threads (or post-run stragglers) still see set()."""
+
+    def __init__(self):
+        self._flag = False
+        with _unpatched():
+            self._real = _REAL_EVENT()
+        self._waiters: List[int] = []
+
+    def is_set(self) -> bool:
+        return self._flag
+
+    def set(self) -> None:
+        self._flag = True
+        self._real.set()
+        sched, st = _sched_and_state()
+        if sched is None or st is None:
+            return
+        for tid in list(self._waiters):
+            for t in sched.threads:
+                if t.tid == tid:
+                    sched.wake(t)
+        self._waiters.clear()
+        sched.yield_point("event.set")
+
+    def clear(self) -> None:
+        self._flag = False
+        self._real.clear()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        sched, st = _sched_and_state()
+        if sched is None or st is None:
+            return self._real.wait(timeout)
+        sched.yield_point("event.wait")
+        if self._flag:
+            return True
+        self._waiters.append(st.tid)
+        sched.block(st, f"event:{id(self):#x}", timeout)
+        if st.tid in self._waiters:
+            self._waiters.remove(st.tid)
+        return self._flag
+
+
+class WeaveSemaphore:
+    def __init__(self, value: int = 1):
+        self._value = int(value)
+        self._waiters: List[int] = []
+
+    def acquire(self, blocking: bool = True,
+                timeout: Optional[float] = None) -> bool:
+        sched, st = _sched_and_state()
+        if sched is None or st is None:
+            raise RuntimeError("weave semaphore used outside scheduler")
+        sched.yield_point("sem.acquire")
+        while self._value <= 0:
+            if not blocking:
+                return False
+            self._waiters.append(st.tid)
+            signaled = sched.block(st, f"sem:{id(self):#x}", timeout)
+            if st.tid in self._waiters:
+                self._waiters.remove(st.tid)
+            if not signaled and self._value <= 0:
+                return False
+        self._value -= 1
+        return True
+
+    def release(self, n: int = 1) -> None:
+        sched, _ = _sched_and_state()
+        self._value += int(n)
+        if sched is None:
+            return
+        for tid in list(self._waiters):
+            for t in sched.threads:
+                if t.tid == tid:
+                    sched.wake(t)
+        self._waiters.clear()
+        sched.yield_point("sem.release")
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+
+class WeaveQueue:
+    """Deterministic queue.Queue (put/get/join/task_done and the _nowait
+    variants). Built on weave primitives so every op is a yield point."""
+
+    def __init__(self, maxsize: int = 0):
+        self.maxsize = int(maxsize)
+        self._items: List[object] = []
+        self._lock = WeaveLock()
+        self._not_empty = WeaveCondition(self._lock)
+        self._not_full = WeaveCondition(self._lock)
+        self._all_done = WeaveCondition(self._lock)
+        self._unfinished = 0
+
+    def qsize(self) -> int:
+        return len(self._items)
+
+    def empty(self) -> bool:
+        return not self._items
+
+    def full(self) -> bool:
+        return 0 < self.maxsize <= len(self._items)
+
+    def put(self, item, block: bool = True,
+            timeout: Optional[float] = None) -> None:
+        with self._lock:
+            while 0 < self.maxsize <= len(self._items):
+                if not block:
+                    raise _queue_mod.Full
+                if not self._not_full.wait(timeout):
+                    if 0 < self.maxsize <= len(self._items):
+                        raise _queue_mod.Full
+            self._items.append(item)
+            self._unfinished += 1
+            self._not_empty.notify()
+
+    def put_nowait(self, item) -> None:
+        self.put(item, block=False)
+
+    def get(self, block: bool = True, timeout: Optional[float] = None):
+        with self._lock:
+            while not self._items:
+                if not block:
+                    raise _queue_mod.Empty
+                if not self._not_empty.wait(timeout):
+                    if not self._items:
+                        raise _queue_mod.Empty
+            item = self._items.pop(0)
+            self._not_full.notify()
+            return item
+
+    def get_nowait(self):
+        return self.get(block=False)
+
+    def task_done(self) -> None:
+        with self._lock:
+            if self._unfinished <= 0:
+                raise ValueError("task_done() called too many times")
+            self._unfinished -= 1
+            if self._unfinished == 0:
+                self._all_done.notify_all()
+
+    def join(self) -> None:
+        with self._lock:
+            while self._unfinished:
+                self._all_done.wait()
+
+
+class WeaveSimpleQueue(WeaveQueue):
+    def __init__(self):
+        super().__init__(0)
+
+
+class WeaveThread:
+    """Deterministic Thread: a real OS thread whose body only runs while the
+    scheduler has scheduled it. Created outside an active scheduler (or by
+    a bystander thread), it degrades to a plain real thread."""
+
+    def __init__(self, group=None, target=None, name=None, args=(),
+                 kwargs=None, *, daemon=None):
+        self._target = target
+        self._args = args
+        self._kwargs = kwargs or {}
+        self.daemon = bool(daemon) if daemon is not None else True
+        sched, cur = _sched_and_state()
+        self._sched = sched if (sched is not None and cur is not None) else None
+        self._os_thread: Optional[_threading.Thread] = None
+        if self._sched is not None:
+            self._st = self._sched._register(
+                name or f"weave-{self._sched._next_tid}")
+            self._st.status = BLOCKED
+            self._st.reason = "not-started"
+            self._st.weave_thread = self
+        else:
+            self._st = None
+        self.name = name or (self._st.name if self._st else "thread")
+
+    def start(self) -> None:
+        if self._os_thread is not None:
+            raise RuntimeError("threads can only be started once")
+        if self._sched is None:
+            with _unpatched():
+                self._os_thread = _REAL_THREAD(
+                    target=self._target, args=self._args, kwargs=self._kwargs,
+                    name=self.name, daemon=self.daemon)
+            self._os_thread.start()
+            return
+        sched, st = self._sched, self._st
+        with _unpatched():
+            self._os_thread = _REAL_THREAD(
+                target=self._bootstrap, name=self.name, daemon=True)
+        # mark runnable before the OS thread exists so the starter's next
+        # yield point can already choose it
+        st.status = RUNNABLE
+        st.reason = ""
+        self._os_thread.start()
+        sched.yield_point("thread.start")
+
+    def _bootstrap(self) -> None:
+        sched, st = self._sched, self._st
+        sched._bind(st)
+        # park until scheduled the first time
+        with sched._cv:
+            deadline = _REAL_MONOTONIC() + _GATE_TIMEOUT_S
+            while not st.go and not st.kill:
+                left = deadline - _REAL_MONOTONIC()
+                if left <= 0:
+                    return
+                sched._cv.wait(left)
+        if st.kill:
+            sched.finish(st)
+            return
+        try:
+            if self._target is not None:
+                self._target(*self._args, **self._kwargs)
+        except _WeaveKilled:
+            pass
+        except BaseException as e:  # noqa: BLE001 — report, don't swallow
+            sched.thread_errors.append((st.name, e))
+            sched._abort(WeaveError(f"thread {st.name!r} raised {e!r}"))
+        finally:
+            sched.finish(st)
+
+    def run(self) -> None:
+        if self._target is not None:
+            self._target(*self._args, **self._kwargs)
+
+    def is_alive(self) -> bool:
+        if self._sched is None:
+            return self._os_thread is not None and self._os_thread.is_alive()
+        if self._os_thread is None:
+            return False
+        return self._st.status != FINISHED
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        if self._sched is None:
+            if self._os_thread is not None:
+                self._os_thread.join(timeout)
+            return
+        sched, st = self._sched, self._st
+        cur = sched.current()
+        if cur is None:
+            # bystander joining a weave thread: wait on the real thread
+            if self._os_thread is not None:
+                self._os_thread.join(timeout)
+            return
+        sched.yield_point("thread.join")
+        if st.status == FINISHED or self._os_thread is None:
+            return
+        sched.block(cur, f"join:{st.tid}", timeout)
+
+    @property
+    def ident(self):
+        return self._os_thread.ident if self._os_thread else None
+
+
+# -- patching -----------------------------------------------------------------
+
+
+def _weave_sleep(seconds: float) -> None:
+    sched, st = _sched_and_state()
+    if sched is None or st is None:
+        _REAL_SLEEP(seconds)
+        return
+    sched.sleep(st, float(seconds))
+
+
+def _weave_monotonic() -> float:
+    sched = _ACTIVE
+    if sched is None:
+        return _REAL_MONOTONIC()
+    return sched.monotonic()
+
+
+_TIME_BASE = _REAL_TIME() - _REAL_MONOTONIC()
+
+
+def _weave_time() -> float:
+    sched = _ACTIVE
+    if sched is None:
+        return _REAL_TIME()
+    return _TIME_BASE + sched.monotonic()
+
+
+@contextmanager
+def patched():
+    """Swap threading/queue/time entry points for weave implementations."""
+    saved = (_threading.Thread, _threading.Lock, _threading.RLock,
+             _threading.Condition, _threading.Event, _threading.Semaphore,
+             _queue_mod.Queue, _queue_mod.SimpleQueue,
+             _time_mod.sleep, _time_mod.monotonic, _time_mod.time)
+    _threading.Thread = WeaveThread
+    _threading.Lock = WeaveLock
+    _threading.RLock = WeaveRLock
+    _threading.Condition = WeaveCondition
+    _threading.Event = WeaveEvent
+    _threading.Semaphore = WeaveSemaphore
+    _queue_mod.Queue = WeaveQueue
+    _queue_mod.SimpleQueue = WeaveSimpleQueue
+    _time_mod.sleep = _weave_sleep
+    _time_mod.monotonic = _weave_monotonic
+    _time_mod.time = _weave_time
+    try:
+        yield
+    finally:
+        (_threading.Thread, _threading.Lock, _threading.RLock,
+         _threading.Condition, _threading.Event, _threading.Semaphore,
+         _queue_mod.Queue, _queue_mod.SimpleQueue,
+         _time_mod.sleep, _time_mod.monotonic, _time_mod.time) = saved
+
+
+def yield_point(op: str = "shared-state") -> None:
+    """Optional explicit yield point for scenario code touching shared
+    state outside any primitive. No-op outside a weave run."""
+    sched = _ACTIVE
+    if sched is not None:
+        sched.yield_point(op)

@@ -226,6 +226,55 @@ def run(*, steps=24, persist_every=2, interval_s=0.05, workdir="/tmp/oetpu_sync_
     return report
 
 
+#: the soak topology's actors, as oeweave scenarios: subscriber state
+#: machine, serving batcher, persister, telemetry reporter
+WEAVE_SCENARIOS = ("sync_subscriber", "micro_batcher", "async_persister",
+                   "periodic_reporter")
+
+
+def run_weave(*, schedules=8, sweep=12, seed=0, quiet=False):
+    """Deterministic-interleaving variant of the soak: instead of racing the
+    real actors against the OS scheduler for wall-clock seconds, explore
+    seeded-random + preemption-bounded schedules of the same components
+    under tools/oeweave and fail on ANY schedule that breaks an invariant
+    (torn status, lost wakeup, double apply, leaked thread). Returns a
+    report dict; raises AssertionError listing replay tokens on failure."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from openembedding_tpu.utils import metrics
+    from tools.oeweave import explore as weave_explore
+    from tools.oeweave import scenarios as weave_scenarios
+
+    def log(msg):
+        if not quiet:
+            print(f"[sync_soak --weave] {msg}", flush=True)
+
+    weave_scenarios.warm()
+    report = {"scenarios": {}, "schedules_explored": 0, "failures": 0}
+    for name in WEAVE_SCENARIOS:
+        res = weave_explore.explore(
+            weave_scenarios.SCENARIOS[name],
+            random_schedules=schedules, seed=seed,
+            preemption_schedules=sweep)
+        report["scenarios"][name] = {
+            "explored": res.schedules_explored,
+            "truncated": res.truncated,
+            "failures": [{"kind": f.kind, "error": f.error,
+                          "token": f.token} for f in res.failures],
+        }
+        report["schedules_explored"] += res.schedules_explored
+        report["failures"] += len(res.failures)
+        log(f"{name}: {res.schedules_explored} schedules, "
+            f"{len(res.failures)} failures")
+    metrics.observe("weave.schedules_explored",
+                    float(report["schedules_explored"]))
+    metrics.observe("weave.failures", float(report["failures"]))
+    assert report["failures"] == 0, (
+        "weave found failing interleavings — replay with "
+        "`python -m tools.oeweave <scenario> --replay <scenario>:<token>`: "
+        + json.dumps(report["scenarios"]))
+    return report
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--steps", type=int, default=24)
@@ -248,7 +297,25 @@ def main(argv=None):
                     help="report SLO verdicts but exit 0 regardless "
                          "(default: exit with the SLO verdict — 0 all OK, "
                          "1 breached, 2 unknown)")
+    ap.add_argument("--weave", action="store_true",
+                    help="run the deterministic-interleaving variant "
+                         "(tools/oeweave over the soak's actors) instead "
+                         "of the wall-clock soak")
+    ap.add_argument("--weave-schedules", type=int, default=8,
+                    help="random schedules per scenario with --weave")
+    ap.add_argument("--weave-sweep", type=int, default=12,
+                    help="preemption-sweep schedules per scenario")
+    ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args(argv)
+    if args.weave:
+        try:
+            report = run_weave(schedules=args.weave_schedules,
+                               sweep=args.weave_sweep, seed=args.seed)
+        except AssertionError as e:
+            print(e)
+            return 1
+        print(json.dumps(report))
+        return 0
     report = run(steps=args.steps, persist_every=args.persist_every,
                  interval_s=args.interval_s,
                  predict_threads=args.predict_threads, wire=args.wire,
