@@ -48,7 +48,7 @@ throughput, not host dispatch latency — the same way production input pipeline
 drive TPUs (the axon tunnel adds ~40 ms per dispatch that would otherwise swamp
 the measurement; see PERF.md "Measurement hygiene").
 
-Env knobs: OETPU_BENCH_CASES=dim9[,dim64][,mesh1][,mesh1f][,pull][,wire][,wire_inband][,sync][,skew][,hot][,placement][,zero][,offload_pipe][,pipeline][,health] (default: all),
+Env knobs: OETPU_BENCH_CASES=dim9[,dim64][,mesh1][,mesh1f][,pull][,wire][,wire_inband][,sync][,skew][,hot][,placement][,zero][,offload_pipe][,pipeline][,ingest][,health] (default: all),
 OETPU_BENCH_BUDGET_S (default 540), OETPU_BENCH_SCAN_STEPS / _REPEATS (smoke runs),
 OETPU_BENCH_TOTAL_BUDGET_S / _PROBE_TIMEOUT_S / _PROBE_INTERVAL_S (orchestrator).
 """
@@ -1235,6 +1235,100 @@ def case_pipeline():
     return out
 
 
+def case_ingest():
+    """Round-20 line-rate ingest: the pipelined `train_many` loop fed by the
+    depth-D device feed ring (`data/ingest.py`). Three measurements: (1) the
+    COMPUTE CEILING — pre-staged windows, min ms/step, i.e. what the device
+    can absorb with input off the books; (2) the ring-fed loop
+    (`train_stream` over `ingest.feed`) at generator line rate —
+    examples/s/chip plus the measured input-wait share, which must be ~0
+    when the producer keeps up; (3) a deliberately THROTTLED producer — the
+    same loop must now be attributed input-bound through the
+    `trainer.input_wait_ms` lane (the attribution control: if this share
+    isn't high, the lane is lying). CPU pins attribution STRUCTURE; the
+    examples/s/chip ceiling claim waits for a chip capture (upwindow
+    bench_ingest)."""
+    import jax
+    import openembedding_tpu as embed
+    from openembedding_tpu.data import ingest
+    from openembedding_tpu.models import make_deepfm
+    from openembedding_tpu.parallel import MeshTrainer, make_mesh
+    from openembedding_tpu.utils import metrics as metrics_mod
+
+    WD.stage("ingest:init", 240)
+    devs = jax.devices()
+    S = min(8, len(devs))
+    mesh = make_mesh(devs[:S])
+    cpu = devs[0].platform == "cpu"
+    vocab = int(os.environ.get("OETPU_BENCH_PIPE_VOCAB", str(1 << 13)))
+    batch = min(BATCH, 1024) if cpu else BATCH
+    K = 8                      # steps per compiled window
+    windows = 4 if cpu else 8
+
+    def ring(label, *, n_windows, throttle_s=0.0, depth=3):
+        files = [f"synthetic://steps={n_windows * K // 2}&seed={7 + s}"
+                 f"&id_space={vocab}" for s in range(2)]
+        return ingest.feed(files, batch, mesh=mesh, source="synthetic",
+                           depth=depth, window=K, workers=2, label=label,
+                           throttle_s=throttle_s)
+
+    model = make_deepfm(vocabulary=vocab, dim=9)
+    tr = MeshTrainer(model, embed.Adagrad(learning_rate=0.05), mesh=mesh,
+                     capacity_factor=0.0, wire="fp32", pipeline_steps=True)
+
+    # (1) compute ceiling: the same windows, pre-staged — input off the books
+    WD.stage("ingest:ceiling", 700)
+    metrics_mod._REGISTRY.clear()
+    staged = list(ring("stage", n_windows=windows))
+    first = jax.tree_util.tree_map(lambda x: np.asarray(x[0]), staged[0])
+    state = tr.init(first)
+    many = tr.jit_train_many(staged[0], state)
+    times = []
+    for i, w in enumerate(staged):
+        t0 = time.perf_counter()
+        state, m = many(state, w)
+        jax.block_until_ready((state, m))
+        if i:
+            times.append((time.perf_counter() - t0) / K)
+    ceiling_ms = min(times)
+    out = {"num_shards": S, "vocab": vocab, "batch": batch, "window": K,
+           "windows": windows, "platform": devs[0].platform,
+           "compute_ms_per_step": round(ceiling_ms * 1e3, 2),
+           "compute_ceiling_eps_per_chip": round(
+               batch / ceiling_ms / S, 1)}
+
+    # (2) ring-fed at line rate: input-wait share must stay ~0
+    WD.stage("ingest:line_rate", 700)
+    metrics_mod._REGISTRY.clear()
+    t0 = time.perf_counter()
+    state, rep = tr.train_stream(state, ring("line", n_windows=windows))
+    elapsed = time.perf_counter() - t0
+    share = ingest.input_wait_share()
+    out["line_rate"] = {
+        "windows": rep["windows"],
+        "examples_per_sec_per_chip": round(
+            rep["windows"] * K * batch / elapsed / S, 1),
+        "input_wait_share": round(share, 4) if share is not None else None,
+    }
+
+    # (3) throttled producer: the SAME loop must read input-bound. The
+    # throttle scales off the MEASURED ceiling (2x slower than the device
+    # can absorb), so the control holds on any platform speed.
+    WD.stage("ingest:throttled", 700)
+    metrics_mod._REGISTRY.clear()
+    state, rep = tr.train_stream(
+        state, ring("slow", n_windows=2, throttle_s=2.0 * ceiling_ms,
+                    depth=1))
+    tshare = ingest.input_wait_share()
+    out["throttled"] = {
+        "windows": rep["windows"],
+        "input_wait_share": round(tshare, 4) if tshare is not None else None,
+    }
+    out["attribution_ok"] = bool(
+        share is not None and tshare is not None and share < 0.05 < tshare)
+    return out
+
+
 def case_pull():
     """Embedding-pull p50 (BASELINE.md metric). A pull = the serving/forward read:
     dedup + row gather for one 4096x26 Zipfian batch against the 2^24-row dim-9
@@ -1294,7 +1388,8 @@ def main():
     cases = os.environ.get(
         "OETPU_BENCH_CASES",
         "dim9,dim64,mesh1,mesh1f,pull,wire,wire_inband,sync,skew,hot,"
-        "placement,zero,wire_total,offload_pipe,pipeline,health").split(",")
+        "placement,zero,wire_total,offload_pipe,pipeline,ingest,"
+        "health").split(",")
 
     # PRIMARY first: whatever happens later, this number is in the artifact.
     if "dim9" in cases:
@@ -1318,6 +1413,7 @@ def main():
                  ("wire_total", case_wire_total),
                  ("offload_pipe", case_offload_pipe),
                  ("pipeline", case_pipeline),
+                 ("ingest", case_ingest),
                  ("health", case_health)]
     for name, fn in secondary:
         if name not in cases:

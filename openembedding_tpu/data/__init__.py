@@ -1,4 +1,6 @@
-"""Input pipelines: Criteo readers, synthetic generators, device prefetch.
+"""Input pipelines: Criteo readers, synthetic generators, device prefetch,
+and the line-rate ingest subsystem (per-host file-sharded streaming +
+parse pool + depth-D device feed ring, `data/ingest.py`).
 
 reference: the benchmark readers in `test/benchmark/criteo_deepctr.py:168-240`
 (CSV / TFRecord / Criteo-1TB TSV interleaved readers) and the preprocessors
@@ -8,7 +10,13 @@ reference: the benchmark readers in `test/benchmark/criteo_deepctr.py:168-240`
 from .criteo import (CriteoBatcher, criteo_fold_offsets, hash_category,
                      is_ragged, pad_ragged, planted_criteo, planted_logit,
                      read_criteo_tsv, synthetic_criteo, prefetch_to_device)
+from .ingest import (FeedRing, ParsePool, feed, input_wait_share,
+                     register_source, ring_shard, sharded_files,
+                     sharded_reader)
 
 __all__ = ["CriteoBatcher", "criteo_fold_offsets", "hash_category",
            "is_ragged", "pad_ragged", "planted_criteo", "planted_logit",
-           "read_criteo_tsv", "synthetic_criteo", "prefetch_to_device"]
+           "read_criteo_tsv", "synthetic_criteo", "prefetch_to_device",
+           "FeedRing", "ParsePool", "feed", "input_wait_share",
+           "register_source", "ring_shard", "sharded_files",
+           "sharded_reader"]

@@ -26,6 +26,7 @@ import gzip
 import itertools
 import queue as queue_mod
 import threading
+import time
 from typing import Dict, Iterator, Optional, Sequence
 
 import numpy as np
@@ -481,9 +482,21 @@ def prefetch_to_device(it: Iterator, size: int = 2,
                        sharding=None) -> Iterator:
     """Background-thread device prefetch: overlaps host parsing + H2D transfer with
     device compute (the reference's `pulling()` dataset prefetch + tf.data
-    AUTOTUNE, `exb.py:645-691`). With a NamedSharding, batches land pre-sharded."""
+    AUTOTUNE, `exb.py:645-691`). With a NamedSharding, batches land pre-sharded.
+
+    Telemetry (the `ingest.*` family, label `ring="prefetch"`): queue
+    occupancy (`ingest.queue_depth`), time the producer spent blocked on a
+    full queue (`ingest.producer_stall_ms` — nonzero stall = the consumer is
+    the bottleneck, the healthy compute-bound state), and items discarded by
+    an early consumer exit (`ingest.dropped`). The depth-D generalization
+    with mesh staging, parse workers and window stacking lives in
+    `data.ingest.FeedRing`; this stays the minimal single-stream path (and
+    keeps the single-device_get discipline — gauges are host counters)."""
     import jax
 
+    from ..utils import metrics
+
+    _labels = {"ring": "prefetch"}
     q: queue_mod.Queue = queue_mod.Queue(maxsize=size)
     _END = object()
     stop = threading.Event()
@@ -491,14 +504,28 @@ def prefetch_to_device(it: Iterator, size: int = 2,
     def _put(item) -> bool:
         """Bounded put: gives up once the consumer has left (a consumer that
         abandons the generator would otherwise strand the producer blocked
-        forever on the full queue — the thread leak this replaces)."""
-        while not stop.is_set():
-            try:
-                q.put(item, timeout=0.05)
-                return True
-            except queue_mod.Full:
-                continue
-        return False
+        forever on the full queue — the thread leak this replaces). Any put
+        that could not land immediately counts its whole blocked time into
+        the stall counter (including the final, possibly-successful wait —
+        a put that waits 49ms then lands is still a 49ms stall)."""
+        try:
+            q.put_nowait(item)
+            return True
+        except queue_mod.Full:
+            pass
+        t0 = time.perf_counter()
+        try:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.05)
+                    return True
+                except queue_mod.Full:
+                    continue
+            return False
+        finally:
+            metrics.observe("ingest.producer_stall_ms",
+                            (time.perf_counter() - t0) * 1e3, "sum",
+                            labels=_labels)
 
     def producer():
         try:
@@ -509,6 +536,8 @@ def prefetch_to_device(it: Iterator, size: int = 2,
                     item = jax.tree_util.tree_map(jax.numpy.asarray, item)
                 if not _put(item):
                     return
+                metrics.observe("ingest.queue_depth", float(q.qsize()),
+                                "gauge", labels=_labels)
             _put(_END)
         except BaseException as e:  # propagate to the consumer, don't fake EOF
             _put(e)
@@ -525,9 +554,15 @@ def prefetch_to_device(it: Iterator, size: int = 2,
             yield item
     finally:
         stop.set()
+        dropped = 0
         while True:  # unblock a producer mid-put, then reap it
             try:
-                q.get_nowait()
+                item = q.get_nowait()
             except queue_mod.Empty:
                 break
+            if item is not _END and not isinstance(item, BaseException):
+                dropped += 1
+        if dropped:
+            metrics.observe("ingest.dropped", float(dropped), "sum",
+                            labels=_labels)
         t.join(timeout=5)

@@ -817,20 +817,42 @@ class Trainer:
             raise _metrics.NonFiniteError(health["nonfinite"])
         return health
 
+    def _ensure_stepwatch(self):
+        """The (lazily created, cached) StepWatch for this trainer — shared
+        by the measured step wrapper and the input-wait lane so step samples
+        and input waits land under one label with one counter/baseline.
+        None when measurement is off (`measure_every` <= 0)."""
+        if self.measure_every <= 0:
+            return None
+        if self._stepwatch is None:
+            from .utils.stepwatch import StepWatch
+            self._stepwatch = StepWatch(
+                every=self.measure_every,
+                wire_cost=lambda: getattr(self, "last_wire_cost", None))
+        return self._stepwatch
+
     def _wrap_measured(self, fn):
         """Wrap a jitted step with the sampled measurement mode
         (`measure_every` > 0): one call in N is bracketed host-side with
         `block_until_ready` into `trainer.step_ms` + HLO-byte attribution +
         `exchange.cost_drift`. The watch is cached so repeated
         `jit_train_step()` calls share one sample counter/baseline."""
-        if self.measure_every <= 0:
-            return fn
-        if self._stepwatch is None:
-            from .utils.stepwatch import StepWatch
-            self._stepwatch = StepWatch(
-                every=self.measure_every,
-                wire_cost=lambda: getattr(self, "last_wire_cost", None))
-        return self._stepwatch.wrap(fn)
+        watch = self._ensure_stepwatch()
+        return fn if watch is None else watch.wrap(fn)
+
+    def input_timed(self, batches):
+        """Wrap a batch iterator (typically a `data.ingest.FeedRing`) so the
+        time the train loop blocks on each `next()` lands in the
+        `trainer.input_wait_ms` histogram — the measured input-wait
+        attribution lane (`data.ingest.input_wait_share` folds it against
+        step time). Records through this trainer's StepWatch when
+        measurement is on, straight into the spine otherwise:
+
+            for batch in trainer.input_timed(ring):
+                state, m = step(state, batch)
+        """
+        from .utils.stepwatch import timed_batches
+        return timed_batches(batches, self._ensure_stepwatch())
 
     def table_pull(self, spec, table, ids):
         """-> (new_table, rows, stats, plan). The plan (routing/dedup state) is handed

@@ -100,6 +100,24 @@ def global_batch(local: Dict, mesh: Mesh, axis: str = DATA_AXIS) -> Dict:
     return jax.tree_util.tree_map(put, local)
 
 
+def window_batch(local: Dict, mesh: Mesh, axis: str = DATA_AXIS) -> Dict:
+    """Stacked K-step window sibling of `global_batch`: leaves carry a
+    leading scan dim K (what `MeshTrainer.train_many` scans over), so the
+    BATCH dim is axis 1 — sharded over `axis` — and K stays replicated.
+    Each host contributes its rows of every step in the window."""
+    def put(x):
+        x = np.asarray(x)
+        if x.ndim < 2:
+            raise ValueError(
+                f"window_batch leaf ndim {x.ndim}: need (K, batch, ...)")
+        sharding = NamedSharding(mesh, P(None, axis, *([None] * (x.ndim - 2))))
+        if jax.process_count() == 1:
+            return jax.device_put(x, sharding)
+        return jax.make_array_from_process_local_data(sharding, x)
+
+    return jax.tree_util.tree_map(put, local)
+
+
 def allgather_host_ids(ids: np.ndarray) -> np.ndarray:
     """Union of per-process host-side id sets -> sorted unique int64 array.
 

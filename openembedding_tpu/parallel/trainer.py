@@ -1634,6 +1634,44 @@ class MeshTrainer(Trainer):
     def _many_fn(self, batches, state):
         return self.jit_train_many(batches, state)
 
+    def train_stream(self, state, windows, *, block: bool = True):
+        """Drive `jit_train_many` over a stream of already-resident stacked
+        K-step windows (a `data.ingest.FeedRing` in window mode) with the
+        input-wait attribution lane wired in: each window's blocking
+        `next()` lands in `trainer.input_wait_ms` (via `input_timed`) and
+        each window's wall time in the `trainer.window_ms` histogram — the
+        denominator `data.ingest.input_wait_share` folds the waits against.
+        The first window compiles the driver (`jit_train_many`); window
+        stats fold through `record_window_stats` (one device_get each).
+
+        `block=True` brackets every window with `block_until_ready` — the
+        measured-soak mode, where window_ms is honest wall time per window.
+        With `block=False` only dispatch is timed (dispatch-limited loops,
+        e.g. when an outer StepWatch already samples).
+
+        Returns `(state, {"windows": n, "loss": last_loss})`."""
+        import time as _time
+
+        import numpy as np
+        n = 0
+        last_loss = None
+        many = None
+        for w in self.input_timed(windows):
+            if many is None:
+                many = self.jit_train_many(w, state)
+            t0 = _time.perf_counter()
+            state, m = many(state, w)
+            if block:
+                jax.block_until_ready(state)
+            _metrics.observe("trainer.window_ms",
+                             (_time.perf_counter() - t0) * 1e3, "hist")
+            self.record_window_stats(m)
+            last_loss = m.get("loss") if isinstance(m, dict) else None
+            n += 1
+        if last_loss is not None:
+            last_loss = float(np.asarray(jax.device_get(last_loss))[-1])
+        return state, {"windows": n, "loss": last_loss}
+
     def jit_eval_step(self, sample_batch=None, sample_state=None):
         if self._eval_step_fn is not None:
             return self._eval_step_fn

@@ -108,6 +108,7 @@ class StepWatch:
         self.label = label
         self.calls = 0
         self.samples = 0
+        self.input_waits = 0
         self._hlo_bytes: Optional[Dict[str, int]] = None
         self._hlo_failed = False
         self._baseline_us_per_byte: Optional[float] = None
@@ -167,6 +168,18 @@ class StepWatch:
                             overlapped * self._baseline_us_per_byte / 1e3,
                             "gauge")
 
+    def observe_input_wait(self, ms: float) -> None:
+        """The input-wait attribution lane (round 20): time the TRAIN LOOP
+        spent blocked pulling the next batch off the feed ring — the
+        host-side twin of the sampled `trainer.step_ms` bracket. Near-zero
+        while the producer keeps the ring full (compute-bound, the healthy
+        state); a share of step time that grows means input-bound, and
+        `data.ingest.input_wait_share` folds the two lanes into the gauge
+        tools/ingest_slo.json gates. Every wait records (waits are host
+        wall time already — no device sync to amortize, unlike step_ms)."""
+        self.input_waits += 1
+        metrics.observe(f"{self.label}.input_wait_ms", ms, "hist")
+
     def wrap(self, fn):
         """-> callable with the same signature as `fn`; every Nth call is
         measured to completion (`jax.block_until_ready` on the result — the
@@ -198,3 +211,27 @@ class _MeasuredStep:
 
     def __getattr__(self, name):
         return getattr(self._fn, name)
+
+
+def timed_batches(it, watch: Optional[StepWatch] = None, *,
+                  label: str = "trainer"):
+    """Wrap a batch iterator so each `next()`'s blocking time lands in the
+    input-wait lane: `watch.observe_input_wait` when a StepWatch is given
+    (counted alongside its step samples), else straight into the
+    `{label}.input_wait_ms` histogram. This is the measurement point of
+    tentpole (c) — put it IMMEDIATELY around the source the train loop
+    blocks on (the FeedRing), with no work between `next()` and the step
+    dispatch, or parse time masquerades as input wait."""
+    it = iter(it)
+    while True:
+        t0 = time.perf_counter()
+        try:
+            item = next(it)
+        except StopIteration:
+            return
+        ms = (time.perf_counter() - t0) * 1e3
+        if watch is not None:
+            watch.observe_input_wait(ms)
+        else:
+            metrics.observe(f"{label}.input_wait_ms", ms, "hist")
+        yield item

@@ -1,0 +1,356 @@
+"""Line-rate ingest (round 20): per-host file-sharded reading must be
+bit-identical to the single-global-reader control (file by file — the
+no-shuffle-barrier guarantee), the depth-D feed ring bit-identical to the
+depth-1 synchronous path, the parse pool's reorder stage deterministic under
+adversarial worker delays, and every early-exit path must join every thread
+(the round-19 leak class). Plus the measured attribution lane: input waits
+land in `trainer.input_wait_ms` and `input_wait_share` folds them against
+step time the way tools/ingest_slo.json gates."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+import openembedding_tpu as embed
+from openembedding_tpu.data import criteo, ingest
+from openembedding_tpu.models import make_deepfm
+from openembedding_tpu.parallel import MeshTrainer, make_mesh
+from openembedding_tpu.utils import metrics, stepwatch
+
+VOCAB = 1 << 10
+
+
+@pytest.fixture(autouse=True)
+def _fresh_metrics():
+    metrics._REGISTRY.clear()
+    yield
+    metrics._REGISTRY.clear()
+
+
+def _tsv_files(tmp_path, rows=(10, 7, 12, 9, 11)):
+    """A small day-file set: varying row counts so per-file partial tails
+    (dropped on both paths) are exercised, not dodged."""
+    paths = []
+    for fi, n in enumerate(rows):
+        lines = []
+        for r in range(n):
+            label = str((fi + r) % 2)
+            dense = [str(fi * 100 + r + d) for d in range(13)]
+            cats = [format(fi * 10007 + r * 31 + c, "x") for c in range(26)]
+            lines.append("\t".join([label] + dense + cats))
+        p = tmp_path / f"day_{fi}.tsv"
+        p.write_text("\n".join(lines) + "\n")
+        paths.append(str(p))
+    return paths
+
+
+def _assert_batches_equal(a, b):
+    assert len(a) == len(b)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(np.asarray(x["label"]),
+                                      np.asarray(y["label"]))
+        np.testing.assert_array_equal(np.asarray(x["dense"]),
+                                      np.asarray(y["dense"]))
+        np.testing.assert_array_equal(
+            np.asarray(x["sparse"]["categorical"]),
+            np.asarray(y["sparse"]["categorical"]))
+
+
+# -- per-host file sharding ---------------------------------------------------
+
+
+def test_ring_shard_epoch_coverage_and_rotation():
+    n_files, n_hosts = 11, 4
+    for epoch in range(n_hosts + 1):
+        sets = [ingest.ring_shard(n_files, h, n_hosts, epoch)
+                for h in range(n_hosts)]
+        # every epoch covers every file exactly once across hosts
+        union = sorted(i for s in sets for i in s)
+        assert union == list(range(n_files)), (epoch, union)
+        # ring rotation: host h's files in epoch e are host (h+1)%N's in e+1
+        for h in range(n_hosts):
+            assert (ingest.ring_shard(n_files, h, n_hosts, epoch)
+                    == ingest.ring_shard(n_files, (h + 1) % n_hosts,
+                                         n_hosts, epoch + 1))
+    # a host reads EVERY file once over num_hosts epochs
+    over_epochs = sorted(i for e in range(n_hosts)
+                         for i in ingest.ring_shard(n_files, 0, n_hosts, e))
+    assert over_epochs == list(range(n_files))
+
+
+def test_sharded_files_epoch_major_order():
+    got = list(ingest.sharded_files(["a", "b", "c"], host_id=0, num_hosts=2,
+                                    epochs=2))
+    assert got == [(0, 0, "a"), (0, 2, "c"), (1, 1, "b")]
+
+
+def test_sharded_reader_union_bit_identical_to_global(tmp_path):
+    """The acceptance pin: per-host sharded reading is bit-identical to the
+    global-reader control. Per file, each host's stream must equal the
+    control's stream for that file, and the hosts' file sets partition the
+    set — batches never span files, so the union IS the control."""
+    paths = _tsv_files(tmp_path)
+    n_hosts = 3
+    kw = dict(source="tsv", epochs=1, native="off", id_space=VOCAB)
+
+    def per_file_control(path):
+        return list(ingest.sharded_reader([path], 4, host_id=0, num_hosts=1,
+                                          **kw))
+
+    control = {p: per_file_control(p) for p in paths}
+    covered = []
+    for h in range(n_hosts):
+        mine = ingest.ring_shard(len(paths), h, n_hosts)
+        covered.extend(mine)
+        got = list(ingest.sharded_reader(paths, 4, host_id=h,
+                                         num_hosts=n_hosts, **kw))
+        want = [b for i in mine for b in control[paths[i]]]
+        _assert_batches_equal(got, want)
+    assert sorted(covered) == list(range(len(paths)))
+    # and the num_hosts=1 "union" control is exactly the per-file concat
+    whole = list(ingest.sharded_reader(paths, 4, host_id=0, num_hosts=1,
+                                       **kw))
+    _assert_batches_equal(whole, [b for p in paths for b in control[p]])
+
+
+def test_parse_pool_reader_bit_identical_to_inline(tmp_path):
+    paths = _tsv_files(tmp_path)
+    kw = dict(source="tsv", epochs=2, native="off", id_space=VOCAB)
+    inline = list(ingest.sharded_reader(paths, 4, host_id=0, num_hosts=2,
+                                        workers=0, **kw))
+    pooled = list(ingest.sharded_reader(paths, 4, host_id=0, num_hosts=2,
+                                        workers=3, **kw))
+    _assert_batches_equal(pooled, inline)
+
+
+# -- ParsePool reorder stage --------------------------------------------------
+
+
+def test_parse_pool_order_deterministic_under_adversarial_delays():
+    delays = {0: 0.02, 1: 0.0, 2: 0.015, 3: 0.001, 4: 0.01, 5: 0.0}
+
+    def parse(task):
+        time.sleep(delays[task])  # make workers finish far out of order
+        return task * 10
+
+    for workers in (1, 2, 4):
+        with ingest.ParsePool(range(6), parse, workers=workers) as pool:
+            assert list(pool) == [0, 10, 20, 30, 40, 50], f"{workers=}"
+
+
+def test_parse_pool_fault_surfaces_at_sequence_position():
+    def parse(task):
+        if task == 3:
+            raise RuntimeError("bad file")
+        time.sleep(0.002 if task % 2 else 0.0)
+        return task
+
+    pool = ingest.ParsePool(range(6), parse, workers=3)
+    got = []
+    with pytest.raises(RuntimeError, match="bad file"):
+        for p in pool:
+            got.append(p)
+    assert got == [0, 1, 2]  # everything before the bad task, in order
+    with pool._lock:
+        assert pool._dispatcher is None and not pool._workers
+
+
+def test_parse_pool_early_exit_joins_every_worker():
+    before = {t.ident for t in threading.enumerate()}
+    pool = ingest.ParsePool(range(50), lambda t: t, workers=4)
+    it = iter(pool)
+    assert next(it) == 0
+    pool.close()
+    pool.close()  # idempotent
+    with pool._lock:
+        assert pool._dispatcher is None and not pool._workers
+    leaked = [t for t in threading.enumerate()
+              if t.ident not in before and t.name.startswith("ingest-")]
+    assert not leaked, f"leaked threads: {leaked}"
+
+
+# -- FeedRing -----------------------------------------------------------------
+
+
+def _host_batches(steps=8, bs=16, seed=0):
+    return list(criteo.synthetic_criteo(bs, id_space=VOCAB, steps=steps,
+                                        seed=seed))
+
+
+@pytest.mark.parametrize("depth", [2, 4])
+def test_feed_ring_bit_identical_to_depth1(depth):
+    src = _host_batches()
+    d1 = list(ingest.FeedRing(iter(src), depth=1, device=False, label="d1"))
+    dd = list(ingest.FeedRing(iter(src), depth=depth, device=False,
+                              label=f"d{depth}"))
+    _assert_batches_equal(dd, d1)
+
+
+def test_feed_ring_device_mode_bit_identical():
+    mesh = make_mesh(jax.devices()[:4])
+    src = _host_batches(steps=4)
+    with ingest.FeedRing(iter(src), depth=3, mesh=mesh,
+                         label="dev") as ring:
+        got = list(ring)
+    assert len(got) == len(src)
+    for host, dev in zip(src, got):
+        assert isinstance(dev["dense"], jax.Array)
+        np.testing.assert_array_equal(np.asarray(dev["dense"]),
+                                      host["dense"])
+        np.testing.assert_array_equal(
+            np.asarray(dev["sparse"]["categorical"]),
+            host["sparse"]["categorical"])
+
+
+def test_feed_ring_window_mode_stacks_and_drops_tail():
+    src = _host_batches(steps=7)
+    ring = ingest.FeedRing(iter(src), depth=2, device=False, window=3,
+                           label="win")
+    ws = list(ring)
+    assert len(ws) == 2  # 7 batches -> 2 windows of 3, tail of 1 dropped
+    assert ws[0]["dense"].shape == (3,) + src[0]["dense"].shape
+    np.testing.assert_array_equal(ws[1]["dense"][0], src[3]["dense"])
+    snap = metrics.Accumulator.get("ingest.dropped", "sum",
+                                   labels={"ring": "win"})
+    assert snap.value() == 1.0
+
+
+def test_window_batch_sharding():
+    mesh = make_mesh(jax.devices()[:4])
+    src = _host_batches(steps=4, bs=8)
+    ring = ingest.FeedRing(iter(src), depth=2, mesh=mesh, window=2,
+                           label="wb")
+    ws = list(ring)
+    assert len(ws) == 2
+    w = ws[0]
+    assert w["dense"].shape == (2, 8, 13)
+    # leading K replicated, batch dim sharded: each device holds all K steps
+    # of its batch slice
+    db = w["dense"].addressable_shards[0].data.shape
+    assert db[0] == 2 and db[1] == 2  # K intact, batch 8/4 devices
+
+
+def test_feed_ring_early_exit_joins_producer_and_counts_drops():
+    src = _host_batches(steps=12)
+    ring = ingest.FeedRing(iter(src), depth=4, device=False, label="early")
+    next(ring)
+    time.sleep(0.05)  # let the producer fill the ring
+    ring.close()
+    ring.close()  # idempotent
+    with ring._lock:
+        assert ring._thread is None
+    acc = metrics.Accumulator.get("ingest.dropped", "sum",
+                                  labels={"ring": "early"})
+    assert acc.value() >= 1.0  # staged-but-undelivered batches were counted
+
+
+def test_feed_ring_propagates_source_exception():
+    def bad():
+        yield _host_batches(steps=1)[0]
+        raise ValueError("source died")
+
+    ring = ingest.FeedRing(bad(), depth=2, device=False, label="bad")
+    next(ring)
+    with pytest.raises(ValueError, match="source died"):
+        next(ring)
+    with ring._lock:
+        assert ring._thread is None
+
+
+def test_feed_ring_publishes_throughput_telemetry():
+    src = _host_batches(steps=8, bs=16)
+    list(ingest.FeedRing(iter(src), depth=2, device=False, label="tel",
+                         rate_every=4))
+    rep = metrics.report()
+    assert rep['ingest.examples_per_sec{ring="tel"}'] > 0
+    assert rep['ingest.bytes_per_sec{ring="tel"}'] > 0
+    assert 'ingest.queue_depth{ring="tel"}' in rep
+    assert 'ingest.slot_fill{ring="tel",slot="0"}' in rep
+
+
+# -- prefetch_to_device telemetry (the round-19 producer, now observable) -----
+
+
+def test_prefetch_telemetry_and_early_exit_drop_count():
+    src = _host_batches(steps=6)
+    it = criteo.prefetch_to_device(iter(src), size=3)
+    next(it)
+    time.sleep(0.05)  # producer fills the queue, then stalls on it
+    it.close()
+    rep = metrics.report()
+    assert 'ingest.queue_depth{ring="prefetch"}' in rep
+    assert rep.get('ingest.producer_stall_ms{ring="prefetch"}', 0.0) > 0.0
+    assert rep.get('ingest.dropped{ring="prefetch"}', 0.0) >= 1.0
+
+
+# -- the measured input-wait attribution lane ---------------------------------
+
+
+def test_timed_batches_records_input_wait():
+    def slow():
+        for b in _host_batches(steps=3):
+            time.sleep(0.01)
+            yield b
+
+    got = list(stepwatch.timed_batches(slow()))
+    assert len(got) == 3
+    acc = metrics.Accumulator.get("trainer.input_wait_ms", "hist")
+    assert acc.count == 3
+    assert acc.value() >= 5.0  # mean wait reflects the 10ms source stalls
+
+
+def test_input_wait_share_folds_lanes():
+    assert ingest.input_wait_share() is None  # no lanes yet -> no verdict
+    for _ in range(4):
+        metrics.observe("trainer.input_wait_ms", 1.0, "hist")
+        metrics.observe("trainer.window_ms", 19.0, "hist")
+    share = ingest.input_wait_share()
+    assert share == pytest.approx(0.05)
+    assert metrics.report()["ingest.input_wait_share"] == pytest.approx(0.05)
+
+
+def test_train_stream_compute_bound_vs_throttled():
+    """The soak's attribution pin, miniature: fed at line rate the stream is
+    compute-bound (input-wait share ~0); with a deliberately throttled
+    producer the SAME loop is attributed input-bound."""
+    mesh = make_mesh(jax.devices()[:4])
+    model = make_deepfm(vocabulary=VOCAB, dim=4, hidden=(8,))
+    tr = MeshTrainer(model, embed.Adagrad(learning_rate=0.05), mesh=mesh,
+                     seed=1, wire="fp32")
+    src = _host_batches(steps=6, bs=16)
+    sample = jax.tree_util.tree_map(np.asarray, src[0])
+    state = tr.init(sample)
+
+    ring = ingest.FeedRing(iter(src), depth=3, mesh=mesh, window=2,
+                           label="fast")
+    state, rep = tr.train_stream(state, ring)
+    assert rep["windows"] == 3
+    assert np.isfinite(rep["loss"])
+    fast_share = ingest.input_wait_share()
+    assert fast_share is not None and fast_share < 0.5
+
+    metrics._REGISTRY.clear()
+    throttled = ingest.FeedRing(iter(src), depth=1, mesh=mesh, window=2,
+                                label="slow", throttle_s=0.05)
+    state, rep = tr.train_stream(state, throttled)
+    assert rep["windows"] == 3
+    slow_share = ingest.input_wait_share()
+    assert slow_share is not None and slow_share > 0.5, \
+        f"throttled producer not attributed input-bound: {slow_share}"
+
+
+def test_feed_end_to_end_synthetic(tmp_path):
+    """feed() composes reader -> pool -> ring; synthetic spec files shard
+    like real days and the stream is bit-identical across worker counts."""
+    files = [f"synthetic://steps=4&seed={s}&id_space={VOCAB}"
+             for s in range(3)]
+    a = list(ingest.feed(files, 8, source="synthetic", depth=2, workers=0,
+                         device=False, label="fa"))
+    b = list(ingest.feed(files, 8, source="synthetic", depth=3, workers=2,
+                         device=False, label="fb"))
+    assert len(a) == 12
+    _assert_batches_equal(b, a)

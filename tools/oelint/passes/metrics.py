@@ -42,6 +42,7 @@ KNOWN_GROUPS = {
     "guard",      # runtime invariant guards (utils/guards.py fingerprints)
     "health",     # numerics sentinel (grad norms, non-finite counts, ef/quant error)
     "hot",        # replicated hot-row cache (MeshTrainer(hot_rows=...))
+    "ingest",     # line-rate input path (data/ingest.py feed ring + parse pool)
     "lint",       # oelint's own run health (pass wall times, finding counts)
     "metrics",    # the metrics subsystem's own health (report_errors)
     "offload",    # host-cached table cache admission/flush/staging pipeline

@@ -33,6 +33,7 @@ def warm() -> None:
     global _WARMED
     if _WARMED:
         return
+    import openembedding_tpu.data.ingest        # noqa: F401
     import openembedding_tpu.persist            # noqa: F401
     import openembedding_tpu.serving            # noqa: F401
     import openembedding_tpu.sync.subscriber    # noqa: F401
@@ -409,6 +410,81 @@ def slo_evaluator() -> None:
         assert ev._thread is None, "stop() left _thread set"
 
 
+# -- ingest FeedRing (the depth-D device feed ring, host mode) ----------------
+
+
+def feed_ring() -> None:
+    """Producer staging into the bounded ring racing the consumer and a
+    concurrent close (the early-exit path). Invariants: delivered batches
+    are a PREFIX of the source in source order (the reorder/ring contract —
+    no skips, no reordering, no duplicates), close() always joins the
+    producer (`_thread` None — the round-19 leak class), delivered+dropped
+    never exceeds what the source produced, and a second close is a no-op."""
+    from openembedding_tpu.data.ingest import FeedRing
+
+    src = [{"label": np.full((2,), float(i), np.float32)} for i in range(6)]
+    ring = FeedRing(iter(src), depth=2, device=False, label="weave")
+    got = []
+
+    def consumer() -> None:
+        for b in ring:
+            got.append(int(b["label"][0]))
+            if len(got) >= 3:
+                break  # early exit: the drain path must reap the producer
+
+    def closer() -> None:
+        time.sleep(0.005)
+        ring.close()
+
+    threads = [threading.Thread(target=consumer, name="consume"),
+               threading.Thread(target=closer, name="close")]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    ring.close()  # idempotent; also covers the consumer-broke-early case
+    assert got == list(range(len(got))), \
+        f"ring delivered out of order or with gaps: {got}"
+    assert len(got) <= len(src)
+    with ring._lock:
+        assert ring._thread is None, "close() left the producer thread set"
+
+
+# -- ingest ParsePool (bounded workers + sequence-numbered reorder) -----------
+
+
+def parse_pool() -> None:
+    """Adversarially-delayed workers racing the reorder stage and an early
+    close. Invariants: emitted payloads are a prefix of the task sequence in
+    DISPATCH order regardless of worker scheduling (the sequence-number
+    contract), an injected parse fault surfaces at its sequence position
+    (everything before it emitted first), and close() joins dispatcher and
+    every worker."""
+    from openembedding_tpu.data.ingest import ParsePool
+
+    def parse(task):
+        time.sleep(0.002 if task % 2 else 0.0)  # adversarial skew
+        if task == 4:
+            raise RuntimeError("injected parse fault")
+        return task * 10
+
+    pool = ParsePool(range(6), parse, workers=3, label="weave")
+    got = []
+    fault = []
+    try:
+        for payload in pool:
+            got.append(payload)
+    except RuntimeError:
+        fault.append(1)
+    assert got == [0, 10, 20, 30], \
+        f"reorder stage broke dispatch order: {got}"
+    assert fault, "injected parse fault never surfaced"
+    pool.close()  # idempotent second close
+    with pool._lock:
+        assert pool._dispatcher is None and not pool._workers, \
+            "close() left pool threads set"
+
+
 SCENARIOS: Dict[str, Callable[[], None]] = {
     "sync_subscriber": sync_subscriber,
     "micro_batcher": micro_batcher,
@@ -418,4 +494,6 @@ SCENARIOS: Dict[str, Callable[[], None]] = {
     "async_persister": async_persister,
     "skew_monitor": skew_monitor,
     "slo_evaluator": slo_evaluator,
+    "feed_ring": feed_ring,
+    "parse_pool": parse_pool,
 }

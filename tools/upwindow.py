@@ -182,6 +182,22 @@ CASES = [
       "OETPU_BENCH_PROBE_TIMEOUT_S": "75",
       "JAX_PLATFORMS": "cpu",
       "XLA_FLAGS": "--xla_force_host_platform_device_count=8"}, 1400),
+    # 14c. round-20 line-rate ingest (bench 'ingest' case: compute ceiling
+    #     from pre-staged windows, then the depth-D feed-ring-fed
+    #     train_stream — examples/s/chip + measured input-wait share, plus
+    #     the throttled-producer attribution control). CPU pins the
+    #     attribution structure (share ~0 at line rate, high when
+    #     throttled); a chip re-run pins the real examples/s/chip ceiling
+    #     the v5e-64 target is judged against. One fused-exchange
+    #     train_many compile on the 8-virtual-device CPU mesh.
+    ("bench_ingest",
+     [sys.executable, os.path.join(REPO, "bench.py")],
+     {"OETPU_BENCH_CASES": "ingest",
+      "OETPU_BENCH_BUDGET_S": "900",
+      "OETPU_BENCH_TOTAL_BUDGET_S": "1140",
+      "OETPU_BENCH_PROBE_TIMEOUT_S": "75",
+      "JAX_PLATFORMS": "cpu",
+      "XLA_FLAGS": "--xla_force_host_platform_device_count=8"}, 1200),
     # 15. round-16 numerics sentinel + step watch (bench 'health' case:
     #     per-step loop with sentinel+measure_every on vs off — the <= 2%
     #     overhead acceptance bound). Single-chip relay case like bench_dim9;
