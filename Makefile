@@ -14,9 +14,9 @@ CPU_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 	XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 .PHONY: ci test dryrun bench-smoke native lint lint-fast lint-budget \
-	lint-metrics weave
+	lint-metrics weave capsule-smoke
 
-ci: lint test dryrun bench-smoke weave
+ci: lint test dryrun bench-smoke weave capsule-smoke
 
 # the full static-analysis + invariant-guard suite (tools/oelint): eleven
 # passes — trace-hazard (recompile hazards in jit-reachable code), host-sync
@@ -77,6 +77,24 @@ dryrun:
 bench-smoke:
 	$(CPU_ENV) OETPU_BENCH_SCAN_STEPS=3 OETPU_BENCH_REPEATS=1 \
 	OETPU_BENCH_VOCAB=65536 OETPU_BENCH_BUDGET_S=480 $(PY) bench.py
+
+# the flight-data layer end to end: arm capsules in a temp dir, force one
+# trigger, and round-trip it through the offline renderer — proves the
+# failure path (capsule assembly + atomic write + report) stays importable
+# and renderable without a live process
+capsule-smoke:
+	$(CPU_ENV) $(PY) -c "import tempfile, glob, os; \
+	from openembedding_tpu.utils import capsule, metrics, history, trace; \
+	d = tempfile.mkdtemp(prefix='capsmoke'); capsule.configure(d); \
+	metrics.observe('train.steps', 3.0); \
+	history.HISTORY.sample_registry(); \
+	trace.event('health', 'nonfinite', source='smoke'); \
+	p = capsule.trigger('smoke', origin='make capsule-smoke'); \
+	assert p and os.path.exists(p), 'capsule not written'; \
+	import tools.capsule_report as cr; \
+	text = cr.render(cr.load(p)); \
+	assert 'reason=smoke' in text and 'train.steps' in text, text; \
+	print('capsule smoke OK:', os.path.basename(p))"
 
 # build the native data-path extension explicitly (the package also builds it
 # on demand at import; this target surfaces compiler errors directly)

@@ -538,6 +538,15 @@ class FeedRing:
                 metrics.observe("ingest.stage_ms",
                                 (time.perf_counter() - t0) * 1e3, "hist",
                                 labels=self._labels)
+                if seq == 0:
+                    # ring's worth of staged batches = this ring's share of
+                    # device memory; shapes are static per ring, so the
+                    # first batch prices all depth slots (memwatch ledger)
+                    from ..utils import memwatch
+                    memwatch.WATCH.set_component(
+                        "feed_ring",
+                        self.depth * memwatch.tree_device_bytes(staged),
+                        labels=self._labels)
                 if not _bounded_put(self._q, staged, self._stop,
                                     self._stall_ms):
                     return

@@ -210,6 +210,27 @@ class EmbeddingSpec:
             return -(-self.capacity // num_shards)
         return -(-self.input_dim // num_shards)
 
+    def device_bytes(self, optimizer: SparseOptimizer, num_shards: int, *,
+                     need_ef: bool = False) -> Dict[str, int]:
+        """Analytic PER-DEVICE byte model of this table's base state at
+        shard count S, by subcomponent — the shapes `MeshTrainer.
+        init_tables` materializes, priced without materializing them
+        (utils/memwatch ledger; pinned exact against the live arrays by
+        tests). Key lanes cost 8 bytes/row in BOTH layouts (one int64 or a
+        uint32 pair); the replicated overflow scalar rides `keys`."""
+        rows = self.rows_per_shard(num_shards)
+        item = jnp.dtype(self.dtype).itemsize
+        out = {
+            "weights": rows * self.output_dim * item,
+            "slots": rows * 4 * sum(
+                optimizer.slot_shapes(self.output_dim).values()),
+        }
+        if self.use_hash_table:
+            out["keys"] = rows * 8 + 4
+        if need_ef:
+            out["ef"] = rows * self.output_dim * 4
+        return out
+
     def to_config(self) -> dict:
         return {
             "name": self.name,

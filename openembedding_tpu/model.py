@@ -814,6 +814,8 @@ class Trainer:
             stats = step_metrics["stats"]
         health = _metrics.record_step_stats(stats)
         if self.halt_on_nonfinite and health.get("nonfinite"):
+            from .utils import capsule as _capsule
+            _capsule.trigger("nonfinite", offenders=health["nonfinite"])
             raise _metrics.NonFiniteError(health["nonfinite"])
         return health
 

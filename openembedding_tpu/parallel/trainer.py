@@ -259,6 +259,153 @@ class MeshTrainer(Trainer):
                 offload_stores=self.offload_store_snapshots(state), **kw),
             path)
 
+    # -- device-memory accounting (utils/memwatch ledger) --------------------
+
+    def _hot_device_bytes(self, spec: EmbeddingSpec, H: int) -> int:
+        """Analytic per-device bytes of one table's replicated hot cache at
+        H rows: probe keys/rank (C = max(2H, 8) slots, `build_hot_identity`
+        layout), id list, replicated weights + f32 optimizer slots."""
+        if H <= 0:
+            return 0
+        C = max(2 * H, 8)
+        kb = 8 if spec.use_hash_table else 4  # int64 or uint32-pair vs int32
+        item = jnp.dtype(spec.dtype).itemsize
+        opt = self.opt_for(spec)
+        widths = sum(opt.slot_shapes(spec.output_dim).values())
+        return (C * kb + C * 4 + H * kb
+                + H * spec.output_dim * item + H * 4 * widths)
+
+    def _mig_device_bytes(self, spec: EmbeddingSpec, M: int) -> int:
+        """Analytic per-device bytes of one table's migration set at M rows:
+        replicated directory (probe keys/rank, ids, owners) + this device's
+        annex slice (M rows of the (M*S) sharded weights/slots)."""
+        if M <= 0:
+            return 0
+        C = max(2 * M, 8)
+        kb = 8 if spec.use_hash_table else 4
+        item = jnp.dtype(spec.dtype).itemsize
+        opt = self.opt_for(spec)
+        widths = sum(opt.slot_shapes(spec.output_dim).values())
+        return (C * kb + C * 4 + M * kb + M * 4
+                + M * spec.output_dim * item + M * 4 * widths)
+
+    def memory_model(self, state: Optional[TrainState] = None
+                     ) -> Dict[str, Any]:
+        """Per-device byte model of everything this trainer keeps resident.
+
+        -> {"analytic": {"component/table": bytes}, "measured": {...},
+            "host": {...}, "device_total_bytes": int}. The ANALYTIC view
+        prices the shapes the trainer WOULD materialize (specs + plan only
+        — usable before init, and before a resize commits); the MEASURED
+        view walks the live `state` arrays (largest addressable shard per
+        array — replicated arrays count full, sharded 1/S). The two agree
+        exactly on every component (pinned by tests/test_flightdata.py);
+        dense components need `state` (leaf shapes live there)."""
+        from ..utils import memwatch as _memwatch
+        analytic: Dict[str, int] = {}
+        measured: Dict[str, int] = {}
+        host: Dict[str, int] = {}
+        for name, spec in self.model.ps_specs().items():
+            if spec.storage == "host_cached":
+                ot = self.offload.get(name)
+                if ot is not None:
+                    analytic[f"offload_cache/{name}"] = \
+                        ot.device_cache_bytes()
+                    measured[f"offload_cache/{name}"] = \
+                        _memwatch.tree_device_bytes(ot.state)
+                    host[f"host_store/{name}"] = ot.store.nbytes()
+                continue
+            opt = self.opt_for(spec)
+            for sub, b in spec.device_bytes(
+                    opt, self.num_shards,
+                    need_ef=self.ef_for(name)).items():
+                analytic[f"table_{sub}/{name}"] = b
+            H = self.hot_rows_for(name)
+            if H:
+                analytic[f"hot/{name}"] = self._hot_device_bytes(spec, H)
+            M = self.mig_rows_for(name)
+            if M:
+                analytic[f"mig/{name}"] = self._mig_device_bytes(spec, M)
+            if state is not None:
+                ts = state.tables.get(name)
+                if ts is None:
+                    continue
+                measured[f"table_weights/{name}"] = \
+                    _memwatch.array_device_bytes(ts.weights)
+                measured[f"table_slots/{name}"] = \
+                    _memwatch.tree_device_bytes(ts.slots)
+                if ts.keys is not None:
+                    measured[f"table_keys/{name}"] = (
+                        _memwatch.array_device_bytes(ts.keys)
+                        + (_memwatch.array_device_bytes(ts.overflow)
+                           if ts.overflow is not None else 0))
+                if ts.ef is not None:
+                    measured[f"table_ef/{name}"] = \
+                        _memwatch.array_device_bytes(ts.ef)
+                if ts.hot is not None:
+                    measured[f"hot/{name}"] = \
+                        _memwatch.tree_device_bytes(ts.hot)
+                if ts.mig is not None:
+                    measured[f"mig/{name}"] = \
+                        _memwatch.tree_device_bytes(ts.mig)
+        if state is not None:
+            self._dense_memory(state, analytic, measured)
+        totals = measured or analytic
+        return {"analytic": analytic, "measured": measured, "host": host,
+                "device_total_bytes": sum(totals.values())}
+
+    def _dense_memory(self, state: TrainState, analytic: Dict[str, int],
+                      measured: Dict[str, int]) -> None:
+        """Dense tower components (params replicated; slots flat-sharded
+        under ZeRO, per-leaf replicated otherwise)."""
+        from ..utils import memwatch as _memwatch
+        from . import zero
+        measured["dense_params"] = \
+            _memwatch.tree_device_bytes(state.dense_params)
+        analytic["dense_params"] = measured["dense_params"]
+        slots = state.dense_slots
+        if zero.is_sharded_slots(slots):
+            flat = slots[zero.ZERO_KEY]
+            plan = self._zero_plan_for(self._dense_trainable(state))
+            has_ef = zero.DENSE_EF_KEY in flat
+            has_master = zero.DENSE_MASTER_KEY in flat
+            analytic.update(zero.plan_device_bytes(
+                plan, ef=has_ef, master=has_master))
+            measured["zero_slots"] = sum(
+                _memwatch.array_device_bytes(v) for k, v in flat.items()
+                if k not in (zero.DENSE_EF_KEY, zero.DENSE_MASTER_KEY))
+            if has_ef:
+                measured["zero_ef"] = \
+                    _memwatch.array_device_bytes(flat[zero.DENSE_EF_KEY])
+            if has_master:
+                measured["zero_master"] = _memwatch.array_device_bytes(
+                    flat[zero.DENSE_MASTER_KEY])
+        elif slots is not None:
+            measured["dense_slots"] = _memwatch.tree_device_bytes(slots)
+            analytic["dense_slots"] = measured["dense_slots"]
+
+    def publish_memory(self, state: Optional[TrainState] = None
+                       ) -> Dict[str, Any]:
+        """Push the model into the memwatch ledger (`memory.bytes{
+        component=,table=}` gauges) and reconcile against live device stats
+        where the backend reports them. Host-side only — never touches jit."""
+        from ..utils import memwatch as _memwatch
+        model = self.memory_model(state)
+        view = dict(model["analytic"])
+        view.update(model["measured"])  # measured wins where both exist
+        for key, nbytes in view.items():
+            comp, _, table = key.partition("/")
+            labels = {"table": table} if table else None
+            _memwatch.WATCH.set_component(comp, nbytes, labels=labels)
+        for key, nbytes in model["host"].items():
+            comp, _, table = key.partition("/")
+            _memwatch.WATCH.set_component(
+                comp, nbytes, labels={"table": table} if table else None,
+                host=True)
+        _memwatch.WATCH.publish()
+        _memwatch.WATCH.sample_devices()
+        return model
+
     # -- hot-row replication (skew-aware hybrid placement) -------------------
 
     def hot_rows_for(self, name: str) -> int:
@@ -882,6 +1029,17 @@ class MeshTrainer(Trainer):
         if missing and len(missing) != len(sub):
             self._hot_sub(state)  # raises with the managed-state message
         mode = "fill" if missing else "refresh"
+        if mode == "fill":
+            # attaching caches to cache-less states is the one refresh that
+            # ALLOCATES: preflight the delta against the device budget and
+            # keep the state cache-free when it would not fit
+            from ..utils import memwatch as _memwatch
+            specs = self._hot_specs()
+            delta = sum(self._hot_device_bytes(specs[n],
+                                               self.hot_rows_for(n))
+                        for n in missing if n in specs)
+            if not _memwatch.WATCH.preflight(delta, reason="hot_fill"):
+                return state
         new = self._run_stripped(self._hot_jit(mode), sub, "mig", idents)
         tables = dict(state.tables)
         tables.update(new)

@@ -232,6 +232,30 @@ def dense_wire_cost(plan: DenseShardPlan, fmt: Optional[str]) -> dict:
             "ag_bytes": int(ag), "bytes_per_step": int(a2a + ag)}
 
 
+def plan_device_bytes(plan: DenseShardPlan, *, ef: bool = False,
+                      master: bool = False) -> Dict[str, int]:
+    """Analytic PER-DEVICE bytes of the flat sharded dense state, by
+    subcomponent (utils/memwatch ledger): each vector slot holds a (1, C)
+    f32 chunk per device, scalar slots one replicated f32; `ef` adds the
+    dense-wire error-feedback residual (full padded length per device —
+    its global array is (1, S*padded)) and `master` the fp32 chunk
+    masters. Dense params themselves are replicated: `params_device_bytes`."""
+    out = {"zero_slots": plan.chunk * 4 * len(plan.vector_slots)
+           + 4 * len(plan.scalar_slots)}
+    if ef:
+        out["zero_ef"] = plan.padded * 4
+    if master:
+        out["zero_master"] = plan.chunk * 4
+    return out
+
+
+def params_device_bytes(plan: DenseShardPlan) -> int:
+    """Per-device bytes of the replicated dense params the plan flattens
+    (original leaf dtypes — replication means full size on every device)."""
+    return sum(size * jnp.dtype(dt).itemsize
+               for size, dt in zip(plan.sizes, plan.dtypes))
+
+
 def check_scalar_slots_equal(plan: DenseShardPlan, slots_tree) -> None:
     """Sharing one scalar slot across leaves is only lossless when every
     leaf already holds the same value (always true for states trained by

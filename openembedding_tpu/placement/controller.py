@@ -181,6 +181,18 @@ class PlacementController:
         mig_rows = {n: int(sized_mig.get(n, self.policy.mig_rows))
                     for n in self._managed_tables()}
         tr = self.trainer
+        # memory preflight BEFORE the one-time re-jit: would the grown
+        # hot caches + annexes still fit the device budget? A rejection
+        # keeps the CURRENT capacities (no shape change, no re-jit) —
+        # an oversized placement plan must never OOM the step
+        delta = self._resize_delta_bytes(hot_rows, mig_rows)
+        if delta > 0:
+            from ..utils import memwatch as _memwatch
+            if not _memwatch.WATCH.preflight(delta, reason="placement_prime"):
+                _trace.event("placement", "prime_rejected",
+                             delta_bytes=int(delta))
+                hot_rows = self._current_sizes("hot_rows")
+                mig_rows = self._current_sizes("mig_rows")
         changed = False
         for attr, val in (("hot_rows", hot_rows), ("mig_rows", mig_rows)):
             cur = getattr(tr, attr)
@@ -221,6 +233,27 @@ class PlacementController:
                     self._last_refresh_reason[n] = "prime"
         self._primed = True
         return state
+
+    def _current_sizes(self, attr: str) -> Dict[str, int]:
+        """The trainer's INSTALLED per-table capacity map for one attr."""
+        cur = getattr(self.trainer, attr)
+        return {n: (int(cur.get(n, 0)) if isinstance(cur, dict)
+                    else int(cur)) for n in self._managed_tables()}
+
+    def _resize_delta_bytes(self, hot_rows: Dict[str, int],
+                            mig_rows: Dict[str, int]) -> int:
+        """Per-device byte delta of installing these hot/mig capacities in
+        place of the current ones (the trainer's analytic shape model)."""
+        tr = self.trainer
+        cur_hot = self._current_sizes("hot_rows")
+        cur_mig = self._current_sizes("mig_rows")
+        delta = 0
+        for name, spec in self._managed_tables().items():
+            delta += (tr._hot_device_bytes(spec, int(hot_rows.get(name, 0)))
+                      - tr._hot_device_bytes(spec, cur_hot.get(name, 0)))
+            delta += (tr._mig_device_bytes(spec, int(mig_rows.get(name, 0)))
+                      - tr._mig_device_bytes(spec, cur_mig.get(name, 0)))
+        return delta
 
     def _mig_cap(self, name: str) -> int:
         """The table's INSTALLED annex capacity (a trace-time shape the

@@ -439,6 +439,10 @@ class ServingHandler(BaseHTTPRequestHandler):
             return "tracez", None, None
         if path == "/sloz":
             return "sloz", None, None
+        if path == "/historz":
+            return "historz", None, None
+        if path == "/capsule":
+            return "capsule", None, None
         return None, None, None
 
     # -- verbs --------------------------------------------------------------
@@ -529,6 +533,44 @@ class ServingHandler(BaseHTTPRequestHandler):
             lines.append(slo.EVALUATOR.render_text())
         except Exception as e:  # noqa: BLE001 — statusz must render regardless
             lines.append(f"(slo status unavailable: {e})")
+        lines.append("")
+        lines.append("-- ingest (line-rate) --")
+        try:
+            from .utils import metrics as metrics_mod
+            ingest = {k: v for k, v in metrics_mod.report(reset=False).items()
+                      if k.startswith("ingest.")
+                      and not k.endswith((".p50", ".p95", ".p99"))}
+            lines.append(metrics_mod._format_table(ingest)
+                         if ingest else "(no ingest activity)")
+        except Exception as e:  # noqa: BLE001 — statusz must render regardless
+            lines.append(f"(ingest status unavailable: {e})")
+        lines.append("")
+        lines.append("-- metric history (GET /historz for JSON) --")
+        try:
+            from .utils import history
+            lines.append(history.render_sparklines())
+        except Exception as e:  # noqa: BLE001 — statusz must render regardless
+            lines.append(f"(history unavailable: {e})")
+        lines.append("")
+        lines.append("-- device memory (memwatch ledger) --")
+        try:
+            from .utils import memwatch
+            mem = memwatch.WATCH.export()
+            if mem["components"]:
+                for e in sorted(mem["components"],
+                                key=lambda e: (e["component"],
+                                               sorted(e["labels"].items()))):
+                    lbl = ",".join(f"{k}={v}" for k, v in
+                                   sorted(e["labels"].items()))
+                    tag = e["component"] + (f"{{{lbl}}}" if lbl else "")
+                    host = " (host)" if e["host"] else ""
+                    lines.append(f"{tag}: {e['bytes']:,}B{host}")
+                lines.append(f"device total (model): "
+                             f"{mem['device_total_bytes']:,}B")
+            else:
+                lines.append("(no components registered)")
+        except Exception as e:  # noqa: BLE001 — statusz must render regardless
+            lines.append(f"(memory ledger unavailable: {e})")
         lines.append("")
         n = int(self.query.get("n", 40)) if hasattr(self, "query") else 40
         lines.append(f"-- flight recorder (last {n}) --")
@@ -688,6 +730,23 @@ class ServingHandler(BaseHTTPRequestHandler):
                 return self._json(200, {"verdicts": verdicts,
                                         "exit_code":
                                             slo.EVALUATOR.exit_code()})
+            if kind == "historz":
+                # GET /historz?metric=<name>[&window=<s>][&<label>=<v>...] —
+                # a metric's retained ring(s); without ?metric=, the series
+                # catalogue (names only, cheap)
+                from .utils import history
+                metric = self.query.get("metric")
+                if metric is None:
+                    return self._json(200, {"metrics": history.HISTORY.names()})
+                window = self.query.get("window")
+                window_s = (self._coerce(float, window, "window")
+                            if window is not None else None)
+                labels = {k: v for k, v in self.query.items()
+                          if k not in ("metric", "window")}
+                return self._json(200, {
+                    "metric": metric, "window_s": window_s,
+                    "series": history.HISTORY.query(
+                        metric, window_s=window_s, labels=labels or None)})
             return self._json(404, {"error": "not found"})
         except _BadRequest as e:
             return self._json(400, {"error": str(e)})
@@ -723,6 +782,25 @@ class ServingHandler(BaseHTTPRequestHandler):
         kind, sign, action = self._route()
         try:
             body = self._body()
+            if kind == "capsule":
+                # POST /capsule {"reason": ..., ...attrs} — operator-requested
+                # postmortem dump; 409 when capsules are not armed (no dir),
+                # 429 when the per-reason rate limit suppressed the write
+                from .utils import capsule
+                if not capsule.enabled():
+                    return self._json(409, {
+                        "error": "capsules not configured "
+                                 "(--capsule-dir / OETPU_CAPSULE_DIR)"})
+                reason = str(body.pop("reason", "operator"))
+                path = capsule.trigger(reason, **{
+                    str(k): v for k, v in body.items()})
+                # single exit: 200 with the path, or 429 when the per-reason
+                # rate limit (or a write error) suppressed the dump
+                return self._json(
+                    200 if path else 429,
+                    {"reason": reason, "path": path} if path
+                    else {"error": "capsule suppressed (rate limit or "
+                                   "write error)", "reason": reason})
             if kind == "models" or (kind == "model" and action is None):
                 # POST /models {model_sign, model_uri, replica_num, shard_num}
                 # (controller.proto CreateModelRequest fields)
@@ -1295,9 +1373,21 @@ def main(argv=None) -> int:
                          "seconds (0 = only on /sloz//statusz scrapes) — "
                          "breaches land in the flight recorder even when "
                          "nobody is scraping")
+    ap.add_argument("--capsule-dir", default=None, metavar="DIR",
+                    help="arm postmortem capsules: SLO breaches, WeaveLeaks "
+                         "and POST /capsule write capsule-*.json.gz bundles "
+                         "(flight tail + history rings + memory ledger) "
+                         "here; render with tools/capsule_report.py")
     args = ap.parse_args(argv)
     if args.flight_recorder > 0:
         trace.configure(args.flight_recorder)
+    if args.capsule_dir:
+        from .utils import capsule
+        capsule.configure(args.capsule_dir)
+        capsule.register_context(
+            "serving", lambda: {"argv": list(argv) if argv else None,
+                                "registry": args.registry,
+                                "host": args.host, "port": args.port})
     from .utils import slo
     if args.slo_specs:
         slo.configure(slo.load_specs(args.slo_specs))

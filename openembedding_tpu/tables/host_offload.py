@@ -455,6 +455,10 @@ class HostOffloadTable:
         self.state = self._mk_fresh()
         self.capacity = self.state.keys.shape[0]
         self.rows_per_shard = self.capacity // self.num_shards
+        self._key_bytes_per_row = (
+            self.state.keys.dtype.itemsize * (self.state.keys.shape[1]
+                                              if self.state.keys.ndim == 2
+                                              else 1))
         self.store = HostStore(spec.output_dim,
                                optimizer.slot_shapes(spec.output_dim))
         # sorted id array: O(batch log cache) membership in prepare() with no
@@ -572,6 +576,18 @@ class HostOffloadTable:
         step donates and replaces the arrays, so the Trainer hands the current
         state back before every prepare/flush."""
         self.state = table_state
+
+    def device_cache_bytes(self) -> int:
+        """Analytic PER-DEVICE bytes of the HBM cache tier (the fixed
+        `capacity`-slot state): this shard's weights/slots/keys rows + the
+        replicated overflow scalar — the utils/memwatch ledger figure (the
+        full host table is `self.store.nbytes()`, host-flagged)."""
+        rows = self.rows_per_shard
+        item = jnp.dtype(self.spec.dtype).itemsize
+        widths = sum(self.optimizer.slot_shapes(
+            self.spec.output_dim).values())
+        return (rows * self.spec.output_dim * item + rows * 4 * widths
+                + rows * self._key_bytes_per_row + 4)
 
     def _would_exceed(self, new_ids: np.ndarray) -> bool:
         """Per-shard high-water check: a hot shard can fill while global

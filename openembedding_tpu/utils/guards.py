@@ -52,7 +52,8 @@ from typing import List, Optional, Tuple
 
 __all__ = ["RecompileError", "CollectiveMismatchError", "TraceCounter",
            "assert_no_recompile", "trace_counter", "collective_sequence",
-           "collective_fingerprint", "assert_collective_fingerprint"]
+           "collective_fingerprint", "assert_collective_fingerprint",
+           "last_fingerprint"]
 
 
 class RecompileError(RuntimeError):
@@ -197,12 +198,26 @@ def collective_sequence(fn, *args, **kwargs):
     return seq
 
 
+# the most recent fingerprint computed in this process — postmortem capsules
+# (utils/capsule.py) embed it so a dump names the collective program that was
+# live at the failure without re-tracing anything
+_LAST_FINGERPRINT: Optional[str] = None
+
+
+def last_fingerprint() -> Optional[str]:
+    """The most recently computed/asserted collective fingerprint (None
+    before any `collective_fingerprint` call in this process)."""
+    return _LAST_FINGERPRINT
+
+
 def collective_fingerprint(fn, *args, **kwargs) -> str:
     """sha256 (16 hex chars) over `collective_sequence(fn, *args)`: pin it
     once per compiled mode, and any change to which collectives run, in
     what order, over which axes, at what shapes/dtypes changes the hash."""
+    global _LAST_FINGERPRINT
     seq = collective_sequence(fn, *args, **kwargs)
     fp = hashlib.sha256(repr(seq).encode()).hexdigest()[:16]
+    _LAST_FINGERPRINT = fp
     from . import metrics as _metrics
     _metrics.observe("guard.fingerprints", 1.0)
     return fp
@@ -215,8 +230,10 @@ def assert_collective_fingerprint(fn, pinned: str, *args,
     no longer hashes to `pinned`; returns the (matching) fingerprint. The
     error carries the full current sequence — diff it against the pin
     commit to see which collective moved."""
+    global _LAST_FINGERPRINT
     seq = collective_sequence(fn, *args, **kwargs)
     fp = hashlib.sha256(repr(seq).encode()).hexdigest()[:16]
+    _LAST_FINGERPRINT = fp
     if fp != pinned:
         name = label or getattr(fn, "__name__", None) or repr(fn)
         from . import metrics as _metrics

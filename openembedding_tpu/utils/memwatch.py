@@ -1,0 +1,214 @@
+"""Device-memory accounting: the analytic byte model, live reconciliation,
+and the preflight gate for re-sizing decisions.
+
+Three ROADMAP open items (elastic reshard, bounded continual-learning
+tables, hot-cache re-sizing) all hinge on knowing memory headroom BEFORE
+acting, and nothing in the tree accounted for HBM occupancy until now.
+This module is the ledger:
+
+- **Components**: producers register per-device byte figures under a
+  `(component, labels)` key — per-table `weights`/`slots`/`keys`/`ef`
+  (`MeshTrainer.memory_model`), `hot`/`mig` replicas+annexes, `zero` flat
+  chunks (`parallel/zero.plan_device_bytes`), `feed_ring` staging buffers
+  (`data/ingest.FeedRing`), `host_store` (host-side — flagged `host=True`
+  so HBM totals exclude it). `publish()` exposes the ledger as
+  `memory.bytes{component=,table=}` gauges plus `memory.total_bytes`.
+- **Reconciliation**: `sample_devices()` reads
+  `jax.local_devices()[i].memory_stats()` where the backend provides it
+  (TPU/GPU; CPU returns nothing and degrades gracefully) and publishes
+  `memory.hbm_used` / `memory.hbm_limit` / `memory.headroom_ratio` and the
+  model-vs-measured gap as `memory.model_drift` (signed fraction of the
+  limit). Without device stats, `budget_bytes` (constructor /
+  `OETPU_HBM_BUDGET` env) stands in as the limit so headroom is still a
+  judged SLO metric (`tools/slo_specs.json`: `memory.headroom_ratio >
+  0.1`).
+- **Preflight**: `preflight(delta_bytes)` answers "may I grow by this
+  much" against the budget — the placement controller calls it before the
+  one-time re-jit that installs larger hot/mig sets, and a rejection keeps
+  the old sizes (counted in `memory.preflight_rejects`, with a
+  `memory/preflight_reject` flight event naming the ask).
+
+Everything is host-side bookkeeping: no jit, no device allocation, HLO
+byte-identical with the watcher on or off.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import metrics
+
+# labels carried per component entry are restricted to registered label
+# keys (oelint metrics pass) — in practice {"table": ...} or {"ring": ...}
+
+
+def array_device_bytes(arr) -> int:
+    """Per-device bytes of one jax array: the LARGEST addressable shard —
+    full `nbytes` for replicated arrays, `nbytes / S` for evenly sharded
+    ones. Falls back to `nbytes` for numpy/host arrays."""
+    try:
+        shards = arr.addressable_shards
+        if shards:
+            return max(int(s.data.nbytes) for s in shards)
+    except AttributeError:
+        pass
+    return int(getattr(arr, "nbytes", 0))
+
+
+def tree_device_bytes(tree) -> int:
+    """Sum of `array_device_bytes` over every array leaf of a pytree."""
+    import jax
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        total += array_device_bytes(leaf)
+    return total
+
+
+class MemWatch:
+    """The component ledger + device reconciliation + preflight gate."""
+
+    def __init__(self, budget_bytes: Optional[int] = None):
+        env = os.environ.get("OETPU_HBM_BUDGET")
+        self.budget_bytes = (int(budget_bytes) if budget_bytes is not None
+                             else int(env) if env else None)
+        self._lock = threading.Lock()
+        # guarded-by: self._lock — (component, label items) -> entry
+        self._components: Dict[Tuple[str, Tuple], Dict[str, Any]] = {}
+
+    def configure(self, budget_bytes: Optional[int]) -> "MemWatch":
+        with self._lock:
+            self.budget_bytes = (int(budget_bytes)
+                                 if budget_bytes is not None else None)
+        return self
+
+    # -- the ledger -----------------------------------------------------------
+
+    def set_component(self, component: str, nbytes: int,
+                      labels: Optional[Dict[str, str]] = None,
+                      host: bool = False) -> None:
+        """Record one component's current per-device byte figure (idempotent
+        per (component, labels); `host=True` marks host-RAM residency —
+        reported, but excluded from the device total preflight guards)."""
+        key = (component, tuple(sorted((labels or {}).items())))
+        with self._lock:
+            self._components[key] = {
+                "component": component, "labels": dict(labels or {}),
+                "bytes": int(nbytes), "host": bool(host)}
+
+    def clear(self, component: Optional[str] = None) -> None:
+        with self._lock:
+            if component is None:
+                self._components.clear()
+            else:
+                for k in [k for k in self._components
+                          if k[0] == component]:
+                    del self._components[k]
+
+    def entries(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [dict(e) for e in self._components.values()]
+
+    def total_bytes(self, host: bool = False) -> int:
+        """Device-resident total (or host-resident with `host=True`)."""
+        with self._lock:
+            return sum(e["bytes"] for e in self._components.values()
+                       if e["host"] == host)
+
+    # -- exposition -----------------------------------------------------------
+
+    def publish(self) -> None:
+        """The ledger -> `memory.bytes{component=,table=}` gauges (one per
+        entry) + `memory.total_bytes` / `memory.host_bytes` +
+        `memory.headroom_ratio` when a limit is known."""
+        for e in self.entries():
+            labels = {"component": e["component"]}
+            labels.update(e["labels"])
+            metrics.observe("memory.bytes", float(e["bytes"]), "gauge",
+                            labels=labels)
+        total = self.total_bytes()
+        metrics.observe("memory.total_bytes", float(total), "gauge")
+        metrics.observe("memory.host_bytes", float(self.total_bytes(True)),
+                        "gauge")
+        limit = self._limit()
+        if limit:
+            metrics.observe("memory.headroom_ratio",
+                            max(0.0, 1.0 - total / limit), "gauge")
+
+    def _limit(self) -> Optional[int]:
+        """Best known per-device capacity: measured HBM limit if a device
+        reported one this process, else the configured budget."""
+        stats = getattr(self, "_last_device_stats", None)
+        if stats and stats.get("limit"):
+            return int(stats["limit"])
+        return self.budget_bytes
+
+    def sample_devices(self) -> Optional[Dict[str, int]]:
+        """Read `memory_stats()` off every local device (worst device wins)
+        and publish the measured gauges + `memory.model_drift`. Returns the
+        `{"used": ..., "limit": ...}` summary, or None when no local device
+        exposes memory stats (CPU backends)."""
+        try:
+            import jax
+            devs = jax.local_devices()
+        except Exception:  # noqa: BLE001 — accounting must never break a run
+            return None
+        used = limit = 0
+        seen = False
+        for d in devs:
+            try:
+                st = d.memory_stats()
+            except Exception:  # noqa: BLE001 — backends without stats
+                continue
+            if not st:
+                continue
+            seen = True
+            used = max(used, int(st.get("bytes_in_use", 0)))
+            limit = max(limit, int(st.get("bytes_limit", 0)
+                                   or st.get("bytes_reservable_limit", 0)))
+        if not seen:
+            return None
+        self._last_device_stats = {"used": used, "limit": limit}
+        metrics.observe("memory.hbm_used", float(used), "gauge")
+        if limit:
+            metrics.observe("memory.hbm_limit", float(limit), "gauge")
+            metrics.observe("memory.headroom_ratio",
+                            max(0.0, 1.0 - used / limit), "gauge")
+            model = self.total_bytes()
+            metrics.observe("memory.model_drift",
+                            (used - model) / limit, "gauge")
+        return self._last_device_stats
+
+    # -- the resize gate ------------------------------------------------------
+
+    def preflight(self, delta_bytes: int, reason: str = "") -> bool:
+        """May the device footprint grow by `delta_bytes`? True when no
+        limit is configured/measured or the projected total fits under it;
+        False rejects the resize (callers keep their current shapes)."""
+        limit = self._limit()
+        if limit is None or delta_bytes <= 0:
+            return True
+        projected = self.total_bytes() + int(delta_bytes)
+        if projected <= limit:
+            return True
+        metrics.observe("memory.preflight_rejects", 1.0)
+        from . import trace  # lazy: trace imports metrics at module level
+        trace.event("memory", "preflight_reject", reason=reason,
+                    delta_bytes=int(delta_bytes), projected=int(projected),
+                    limit=int(limit))
+        return False
+
+    def export(self) -> Dict[str, Any]:
+        """The capsule view: ledger entries + totals + limits."""
+        out = {"components": self.entries(),
+               "device_total_bytes": self.total_bytes(),
+               "host_total_bytes": self.total_bytes(True),
+               "budget_bytes": self.budget_bytes}
+        stats = getattr(self, "_last_device_stats", None)
+        if stats:
+            out["device_stats"] = dict(stats)
+        return out
+
+
+WATCH = MemWatch()

@@ -535,14 +535,26 @@ class PeriodicReporter:
     `jsonl_path` additionally appends each report as one timestamped JSONL
     record (`{"ts": ..., "metrics": {...}}`) for offline analysis; `stop()`
     flushes a final record so short runs (or interval=0 runs that never tick)
-    still leave data behind."""
+    still leave data behind. `jsonl_max_bytes` rotates the log when the next
+    record would push it past the bound (`path` -> `path.1` -> ... up to
+    `jsonl_keep` rotated files, oldest dropped) so soak-length runs cannot
+    fill the disk.
+
+    Each tick also samples every accumulator into the history rings
+    (`utils/history.HISTORY`) BEFORE the windowed reset — the rings see the
+    same values the report prints (`history=False` opts out)."""
 
     def __init__(self, interval: float, sink: Optional[Callable[[str], None]] = None,
-                 reset: bool = True, jsonl_path: Optional[str] = None):
+                 reset: bool = True, jsonl_path: Optional[str] = None,
+                 jsonl_max_bytes: int = 0, jsonl_keep: int = 3,
+                 history: bool = True):
         self.interval = interval
         self.sink = sink or (lambda s: print(s, flush=True))
         self.reset = reset
         self.jsonl_path = jsonl_path
+        self.jsonl_max_bytes = int(jsonl_max_bytes)
+        self.jsonl_keep = max(1, int(jsonl_keep))
+        self.history = history
         self._stop = threading.Event()
         self._lock = threading.Lock()
         # guarded-by: self._lock
@@ -563,17 +575,49 @@ class PeriodicReporter:
 
     def _write_jsonl(self, vals: Dict[str, float]) -> None:
         import json
+        line = json.dumps({"ts": time.time(), "metrics": vals},
+                          sort_keys=True) + "\n"
+        if self.jsonl_max_bytes > 0:
+            self._maybe_rotate(len(line))
         with open(self.jsonl_path, "a") as f:
-            f.write(json.dumps({"ts": time.time(), "metrics": vals},
-                               sort_keys=True) + "\n")
+            f.write(line)
+
+    def _maybe_rotate(self, incoming: int) -> None:
+        """path -> path.1 -> ... -> path.{keep}; only when the NEXT record
+        would cross the bound, so every rotated file is <= jsonl_max_bytes
+        and a record never splits across files."""
+        import os
+        try:
+            size = os.path.getsize(self.jsonl_path)
+        except OSError:
+            return
+        if size + incoming <= self.jsonl_max_bytes:
+            return
+        for i in range(self.jsonl_keep, 0, -1):
+            src = self.jsonl_path if i == 1 else f"{self.jsonl_path}.{i - 1}"
+            dst = f"{self.jsonl_path}.{i}"
+            try:
+                if i == self.jsonl_keep and os.path.exists(dst):
+                    os.remove(dst)
+                if os.path.exists(src):
+                    os.replace(src, dst)
+            except OSError:
+                observe("metrics.report_errors", 1)
+                return
+
+    def _tick(self) -> None:
+        if self.history:
+            from . import history as _history  # lazy: history imports metrics
+            _history.HISTORY.sample_registry()
+        vals = report(reset=self.reset)
+        if self.jsonl_path:
+            self._write_jsonl(vals)
+        self.sink("== accumulator report ==\n" + _format_table(vals))
 
     def _run(self) -> None:
         while not self._stop.wait(self.interval):
             try:
-                vals = report(reset=self.reset)
-                if self.jsonl_path:
-                    self._write_jsonl(vals)
-                self.sink("== accumulator report ==\n" + _format_table(vals))
+                self._tick()
             except Exception:  # noqa: BLE001 — a broken pipe/sink must not
                 # kill periodic reporting for the rest of the run
                 observe("metrics.report_errors", 1)

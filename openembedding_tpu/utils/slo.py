@@ -42,11 +42,16 @@ from __future__ import annotations
 import json
 import threading
 import time
-from collections import deque
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
 
+from . import history as _history
 from . import metrics
+
+# verdict-sample ring depth: far above any real window (slow_window_s=300 at
+# 1s cadence is 300 samples), so the TIME prune below is always the binding
+# bound and burn-rate semantics match the former unbounded deques exactly
+SAMPLE_RING_DEPTH = 4096
 
 OK = "OK"
 BREACHED = "BREACHED"
@@ -187,8 +192,11 @@ class SLOEvaluator:
         self._lock = threading.Lock()
         self._specs: List[SLOSpec] = list(
             DEFAULT_SLOS if specs is None else specs)  # guarded-by: self._lock
-        # guarded-by: self._lock — per-spec deque of (ts, ok: bool|None)
-        self._history: Dict[str, deque] = {}
+        # guarded-by: self._lock — per-spec verdict-sample ring of
+        # (ts, ok: bool|None), stored as `utils/history.Ring`s registered
+        # under `slo.samples{slo=}` so capsules and /historz see the same
+        # evidence the burn-rate windows judge from
+        self._history: Dict[str, _history.Ring] = {}
         self._verdicts: Dict[str, dict] = {}    # guarded-by: self._lock
         self._since: Dict[str, float] = {}      # guarded-by: self._lock
         self._stop = threading.Event()
@@ -204,10 +212,30 @@ class SLOEvaluator:
         with self._lock:
             self._specs = list(specs)
             keep = {s.name for s in self._specs}
-            for d in (self._history, self._verdicts, self._since):
+            for name in [k for k in self._history if k not in keep]:
+                del self._history[name]
+                _history.HISTORY.drop("slo.samples", labels={"slo": name})
+            for d in (self._verdicts, self._since):
                 for k in [k for k in d if k not in keep]:
                     del d[k]
         return self
+
+    def _ring(self, name: str) -> _history.Ring:
+        """This spec's verdict-sample ring (caller holds self._lock)."""
+        r = self._history.get(name)
+        if r is None:
+            r = _history.HISTORY.ring(
+                "slo.samples", labels={"slo": name}, kind="gauge",
+                depth=SAMPLE_RING_DEPTH)
+            # this evaluator owns the series from here: judgment starts from
+            # its OWN samples, never a predecessor evaluator's (a fresh
+            # evaluator judging a same-named spec must see never-observed,
+            # exactly like the pre-ring private history)
+            r.clear()
+            # oelint: disable=lockset -- caller holds self._lock (evaluate_now
+            # and configure both enter _ring under the evaluator lock)
+            self._history[name] = r
+        return r
 
     # -- one evaluation round -------------------------------------------------
 
@@ -256,12 +284,10 @@ class SLOEvaluator:
         for spec in specs:
             value, met = self._sample(spec)
             with self._lock:
-                hist = self._history.setdefault(spec.name, deque())
-                hist.append((now, met))
-                cutoff = now - max(spec.slow_window_s, 1e-9)
-                while len(hist) > 1 and hist[0][0] < cutoff:
-                    hist.popleft()
-                samples = list(hist)
+                hist = self._ring(spec.name)
+                hist.append(now, met)
+                hist.prune_older(now - max(spec.slow_window_s, 1e-9), keep=1)
+                samples = hist.items()
                 prev = self._verdicts.get(spec.name, {}).get("verdict")
             fast_bad = self._window_frac_bad(samples, now, spec.fast_window_s)
             slow_bad = self._window_frac_bad(samples, now, spec.slow_window_s)
@@ -298,6 +324,10 @@ class SLOEvaluator:
                 trace.event("slo", "breach", slo=spec.name,
                             metric=spec.metric, value=value,
                             op=spec.op, threshold=spec.threshold)
+                from . import capsule  # lazy: capsule imports slo surfaces
+                capsule.trigger("slo_breach", slo=spec.name,
+                                metric=spec.metric, value=value,
+                                threshold=spec.threshold)
             elif verdict == OK and prev == BREACHED:
                 trace.event("slo", "recovered", slo=spec.name,
                             metric=spec.metric, value=value)

@@ -36,3 +36,11 @@ class CleanLocked:
     def bump(self):
         with self._lock:
             self._n += 1
+
+
+def clean_labeled_metrics():
+    from openembedding_tpu.utils import metrics
+    # registered group + registered label keys: the metrics pass stays quiet
+    metrics.observe("memory.bytes", 4096.0, "gauge",
+                    labels={"component": "weights", "table": "user"})
+    metrics.observe("history.dropped_series", 1.0, "gauge")

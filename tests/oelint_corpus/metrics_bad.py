@@ -10,3 +10,9 @@ def planted_metric_names():
     metrics.observe("serving.shard3.rows", 1)  # PLANT: instance-number
     with metrics.vtimer("nosuchgroup", "step"):  # PLANT: unknown-span-group
         pass
+    metrics.observe(
+        "memory.bytes", 1.0, "gauge",
+        labels={"request_id": "ab12cd"})  # PLANT: unbounded-label-key
+    metrics.observe(
+        "serving.predict.ms", 1.0, "hist",
+        labels={"step": "31337"})  # PLANT: unbounded-label-key-step

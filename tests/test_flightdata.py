@@ -1,0 +1,468 @@
+"""Round-21 flight-data layer (ISSUE 18 acceptance): metric history rings
+(bounded per-series memory, /historz queries, /statusz sparklines),
+device-memory accounting (analytic byte model == measured arrays EXACTLY on
+the 8-virtual-device CPU mesh; the preflight gate rejects over-budget
+hot-cache attachment), and postmortem capsules (`NonFiniteError` and an SLO
+breach edge each auto-emit a capsule bundling correlated flight events,
+history rings, the memory model and the collective fingerprint; the bundle
+round-trips through the offline renderer `tools/capsule_report.py`), plus
+the PeriodicReporter JSONL size rotation boundary."""
+
+import json
+import os
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+import openembedding_tpu as oe
+import tools.capsule_report as capsule_report
+from openembedding_tpu.data import synthetic_criteo
+from openembedding_tpu.model import EmbeddingModel
+from openembedding_tpu.parallel import MeshTrainer, make_mesh
+from openembedding_tpu.utils import (capsule, guards, history, memwatch,
+                                     metrics, slo, trace)
+
+S = 8  # conftest forces 8 virtual CPU devices
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    monkeypatch.delenv("OETPU_CAPSULE_DIR", raising=False)
+    monkeypatch.delenv("OETPU_HBM_BUDGET", raising=False)
+
+    def wipe():
+        metrics._REGISTRY.clear()
+        trace.RECORDER.clear()
+        history.HISTORY.clear()
+        memwatch.WATCH.clear()
+        memwatch.WATCH.configure(None)
+        memwatch.WATCH.__dict__.pop("_last_device_stats", None)
+        capsule.configure(None)
+    wipe()
+    yield
+    wipe()
+
+
+class _Tower(nn.Module):
+    """Two dim-8 tables (array + hash) -> logits (B,)."""
+
+    @nn.compact
+    def __call__(self, embedded, dense):
+        bias = self.param("bias", nn.initializers.zeros, (1,), jnp.float32)
+        out = (jnp.sum(embedded["a"].astype(jnp.float32), axis=(1, 2))
+               + jnp.sum(embedded["b"].astype(jnp.float32), axis=(1, 2)))
+        return out + bias[0]
+
+
+def _model(vocab=256):
+    return EmbeddingModel(_Tower(), [
+        oe.Embedding(vocab, 8, name="a"),
+        oe.Embedding(-1, 8, name="b", capacity=4096),
+    ])
+
+
+def _batch(rng, vocab=256):
+    return {"sparse": {"a": rng.integers(0, vocab, (32, 4)).astype(np.int32),
+                       "b": rng.integers(0, 1 << 40, (32, 3)).astype(np.int64)},
+            "label": rng.integers(0, 2, (32,)).astype(np.float32)}
+
+
+# -- history rings ------------------------------------------------------------
+
+
+def test_ring_depth_eviction_window_and_prune():
+    r = history.Ring(maxlen=4)
+    for i in range(7):
+        r.append(float(i), i * 10)
+    assert len(r) == 4
+    # depth bound evicted the oldest three
+    assert [v for _ts, v in r.items()] == [30, 40, 50, 60]
+    assert r.last() == (6.0, 60)
+    # time-window read
+    assert [v for _ts, v in r.window(now=6.0, window_s=1.5)] == [50, 60]
+    # prune keeps the latest sample even when everything is stale
+    r.prune_older(cutoff=100.0, keep=1)
+    assert r.items() == [(6.0, 60)]
+
+
+def test_sample_registry_records_series_and_caps_labels():
+    h = history.MetricHistory(depth=3, label_cap=2)
+    for t in ("a", "b", "c"):  # 3 label sets > cap of 2
+        metrics.observe("exchange.shard_rows", 1.0, "gauge",
+                        labels={"table": t})
+    metrics.observe("train.steps", 1.0)
+    for ts in (10.0, 11.0, 12.0, 13.0):
+        h.sample_registry(ts=ts)
+    series = h.query("exchange.shard_rows")
+    assert len(series) == 2  # the third label set was capped, not recorded
+    # depth bound: 4 samples into depth-3 rings keeps the newest 3
+    assert all(len(s["points"]) == 3 for s in series)
+    assert [p[0] for p in series[0]["points"]] == [11.0, 12.0, 13.0]
+    # the drop is observable, not silent
+    assert metrics.Accumulator.get("history.dropped_series").value() > 0
+    # hist-kind accumulators store derived-stat dicts
+    metrics.observe("serving.predict.ms", 5.0, "hist")
+    h.sample_registry(ts=14.0)
+    (hs,) = h.query("serving.predict.ms")
+    assert set(hs["points"][-1][1]) == set(history.HIST_FIELDS)
+
+
+def test_reporter_tick_feeds_history_and_sparklines_render():
+    metrics.observe("train.steps", 1.0)
+    rep = metrics.PeriodicReporter(interval=60, sink=lambda s: None)
+    rep._tick()
+    metrics.observe("train.steps", 2.0)
+    rep._tick()
+    (s,) = history.HISTORY.query("train.steps")
+    assert [p[1] for p in s["points"]] == [1.0, 2.0]
+    out = history.render_sparklines()
+    assert "train.steps" in out and "n=2" in out
+
+
+# -- serving surfaces: /historz, /statusz panels, POST /capsule ---------------
+
+
+@pytest.fixture()
+def server(tmp_path):
+    from openembedding_tpu.serving import make_server
+    srv = make_server(str(tmp_path / "reg"), port=0)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+
+
+def _get(url):
+    with urllib.request.urlopen(url) as r:
+        return json.loads(r.read())
+
+
+def test_historz_catalogue_series_and_window_queries(server):
+    metrics.observe("ingest.examples_per_sec", 100.0, "gauge")
+    metrics.observe("exchange.shard_rows", 7.0, "gauge",
+                    labels={"table": "user"})
+    history.HISTORY.sample_registry(ts=1000.0)
+    metrics.observe("ingest.examples_per_sec", 200.0, "gauge")
+    history.HISTORY.sample_registry(ts=2000.0)
+
+    doc = _get(f"{server}/historz")
+    assert "ingest.examples_per_sec" in doc["metrics"]
+    doc = _get(f"{server}/historz?metric=ingest.examples_per_sec")
+    (s,) = doc["series"]
+    assert [p[1] for p in s["points"]] == [100.0, 200.0]
+    # label filter
+    doc = _get(f"{server}/historz?metric=exchange.shard_rows&table=user")
+    assert len(doc["series"]) == 1
+    doc = _get(f"{server}/historz?metric=exchange.shard_rows&table=nope")
+    assert doc["series"] == []
+    # bad window -> 400, not a 500
+    req = urllib.request.Request(
+        f"{server}/historz?metric=train.steps&window=bogus")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req)
+    assert ei.value.code == 400
+
+
+def test_statusz_renders_ingest_history_and_memory_panels(server):
+    metrics.observe("ingest.input_wait_share", 0.01, "gauge")
+    history.HISTORY.sample_registry()
+    memwatch.WATCH.set_component("feed_ring", 4096,
+                                 labels={"ring": "train"})
+    with urllib.request.urlopen(f"{server}/statusz") as r:
+        body = r.read().decode()
+    assert "-- ingest (line-rate) --" in body
+    assert "ingest.input_wait_share" in body
+    assert "-- metric history (GET /historz for JSON) --" in body
+    assert "-- device memory (memwatch ledger) --" in body
+    assert "feed_ring{ring=train}: 4,096B" in body
+
+
+def test_post_capsule_endpoint(server, tmp_path):
+    # not armed -> 409
+    req = urllib.request.Request(f"{server}/capsule", data=b"{}",
+                                 method="POST")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req)
+    assert ei.value.code == 409
+
+    capsule.configure(str(tmp_path / "caps"))
+    body = json.dumps({"reason": "operator_probe", "note": "drill"}).encode()
+    req = urllib.request.Request(f"{server}/capsule", data=body,
+                                 method="POST")
+    with urllib.request.urlopen(req) as r:
+        doc = json.loads(r.read())
+    assert doc["reason"] == "operator_probe"
+    assert os.path.exists(doc["path"])
+    cap = capsule_report.load(doc["path"])
+    assert cap["attrs"]["note"] == "drill"
+    # the same reason inside the rate-limit window -> 429
+    req = urllib.request.Request(f"{server}/capsule", data=body,
+                                 method="POST")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req)
+    assert ei.value.code == 429
+
+
+# -- postmortem capsules: the two auto-trigger acceptance paths ---------------
+
+
+def _mesh_trainer(**kw):
+    trainer = MeshTrainer(_model(), oe.Adagrad(learning_rate=0.05),
+                          mesh=make_mesh(), wire="fp32", **kw)
+    batch = _batch(np.random.default_rng(0))
+    state = trainer.init(batch)
+    return trainer, state, batch
+
+
+def test_nonfinite_capsule_e2e_with_renderer_roundtrip(tmp_path):
+    """THE acceptance pin: a planted NaN under halt_on_nonfinite emits one
+    capsule carrying (a) the health/nonfinite flight event correlated to the
+    failing request id, (b) >= 3 history series, (c) the memory model,
+    (d) the collective fingerprint — and the capsule renders offline."""
+    cap_dir = tmp_path / "caps"
+    capsule.configure(str(cap_dir))
+    trainer, state, batch = _mesh_trainer(halt_on_nonfinite=True)
+    step = trainer.jit_train_step(batch, state)
+    # the flight-data a real run would have accumulated by the failure:
+    trainer.publish_memory(state)                       # memory ledger
+    guards.collective_fingerprint(
+        lambda x: jax.tree_util.tree_map(jnp.sum, x), batch["label"])
+    for _ in range(2):                                   # >= 3 live series
+        history.HISTORY.sample_registry()
+
+    ts = state.tables["a"]
+    state = state.replace(tables={
+        **state.tables,
+        "a": ts.replace(weights=ts.weights.at[:].set(np.nan))})
+    with trace.request() as rid:
+        state, mets = step(state, batch)
+        with pytest.raises(oe.NonFiniteError):
+            trainer.record_step_stats(mets)
+
+    (path,) = cap_dir.glob("capsule-*-nonfinite.json.gz")
+    cap = capsule_report.load(str(path))
+    assert cap["reason"] == "nonfinite"
+    assert "a" in cap["attrs"]["offenders"]
+    # (a) correlated flight evidence: the nonfinite breadcrumb carries the
+    # request id of the step that died
+    evs = [e for e in cap["flight"]
+           if e["kind"] == "event" and e["group"] == "health"
+           and e["name"] == "nonfinite"]
+    assert evs and evs[-1]["request_id"] == rid
+    # (b) history rings rode along
+    assert len(cap["history"]) >= 3
+    # (c) the memory model names the table components
+    comps = {(e["component"], e["labels"].get("table"))
+             for e in cap["memory"]["components"]}
+    assert ("table_weights", "a") in comps and ("table_weights", "b") in comps
+    assert cap["memory"]["device_total_bytes"] > 0
+    # (d) the collective fingerprint of the live program
+    assert cap["fingerprint"] == guards.last_fingerprint()
+    assert len(cap["fingerprint"]) == 16
+    # offline renderer round-trip: header, flight, history, memory sections
+    text = capsule_report.render(cap)
+    assert "reason=nonfinite" in text
+    assert "health/nonfinite" in text
+    assert f"rid={rid}" in text
+    assert "table_weights{table=a}" in text
+    # request-filtered view keeps only the correlated items
+    filtered = capsule_report.render(cap, request=rid)
+    assert "health/nonfinite" in filtered
+
+
+def test_slo_breach_edge_emits_capsule_once(tmp_path):
+    cap_dir = tmp_path / "caps"
+    capsule.configure(str(cap_dir))
+    spec = slo.SLOSpec(name="numerics_cap", metric="health.nonfinite_total",
+                       selector="value", op="==", threshold=0.0,
+                       fast_window_s=0.0, slow_window_s=300.0,
+                       burn_threshold=1e-9)
+    ev = slo.SLOEvaluator([spec])
+    metrics.observe("train.steps", 1.0)
+    metrics.observe("ingest.examples", 10.0)
+    metrics.observe("health.nonfinite_total", 0.0)
+    history.HISTORY.sample_registry()
+    (v,) = ev.evaluate_now()
+    assert v["verdict"] == slo.OK
+    assert list(cap_dir.glob("capsule-*")) == []  # OK never emits
+
+    metrics.observe("health.nonfinite_total", 3.0)
+    (v,) = ev.evaluate_now()
+    assert v["verdict"] == slo.BREACHED
+    (path,) = cap_dir.glob("capsule-*-slo_breach.json.gz")
+    cap = capsule_report.load(str(path))
+    assert cap["attrs"]["slo"] == "numerics_cap"
+    assert cap["attrs"]["value"] == 3.0
+    # still breached on the next round: edge-triggered, no second capsule
+    (v,) = ev.evaluate_now()
+    assert v["verdict"] == slo.BREACHED
+    assert len(list(cap_dir.glob("capsule-*"))) == 1
+    # the SLO's own verdict ring is part of the capsule history
+    assert any(k.startswith("slo.samples") for k in cap["history"])
+
+
+def test_capsule_rate_limit_retention_and_disabled_noop(tmp_path):
+    # disabled: trigger is a no-op that never raises
+    assert capsule.trigger("nonfinite", x=1) is None
+    w = capsule.CapsuleWriter(str(tmp_path), keep=3, min_interval_s=1e9)
+    assert w.trigger("weave_leak", detail="t0") is not None
+    assert w.trigger("weave_leak", detail="t1") is None  # rate-limited
+    assert metrics.Accumulator.get("capsule.rate_limited").value() == 1.0
+    # retention: distinct reasons bypass the per-reason limit; keep=3 prunes
+    for i in range(5):
+        assert w.trigger(f"reason_{i}") is not None
+    caps = sorted(p.name for p in tmp_path.glob("capsule-*"))
+    assert len(caps) == 3
+
+
+def test_weave_leak_aborts_with_capsule(tmp_path):
+    capsule.configure(str(tmp_path / "caps"))
+    from tools.oeweave.explore import SweepPolicy
+    from tools.oeweave.scheduler import WeaveLeak, WeaveScheduler
+
+    def leaky():
+        ev = threading.Event()
+        t = threading.Thread(target=ev.wait)
+        t.start()
+        # no stop path, no join: the planted lifecycle bug
+
+    with pytest.raises(WeaveLeak):
+        WeaveScheduler(SweepPolicy()).run(leaky)
+    caps = list((tmp_path / "caps").glob("capsule-*-weave_leak.json.gz"))
+    assert len(caps) == 1
+    cap = capsule_report.load(str(caps[0]))
+    assert "leaked" in cap["attrs"]["detail"]
+
+
+# -- device-memory accounting -------------------------------------------------
+
+
+def test_memory_model_analytic_matches_measured_exactly():
+    """The acceptance pin: on the 8-device CPU mesh the analytic byte model
+    agrees EXACTLY with the measured per-device shard bytes for every
+    component both views price — base tables (array + hash), optimizer
+    slots, hash keys, dense params — including after a hot-cache attach."""
+    trainer, state, batch = _mesh_trainer(hot_rows=4)
+    model = trainer.memory_model(state)
+    analytic, measured = model["analytic"], model["measured"]
+    # init attaches the (empty) hot caches, so both views price them
+    overlap = set(analytic) & set(measured)
+    assert {"table_weights/a", "table_slots/a", "table_weights/b",
+            "table_slots/b", "table_keys/b", "hot/a", "hot/b",
+            "dense_params"} <= overlap
+    for key in sorted(overlap):
+        assert analytic[key] == measured[key], (
+            f"{key}: analytic {analytic[key]} != measured {measured[key]}")
+
+    # still exact after a refresh installs real hot ids (content swap only)
+    state = trainer.refresh_hot_rows(
+        state, hot_ids={"a": np.arange(4, dtype=np.int64),
+                        "b": np.asarray([(1 << 40) - 3], np.int64)})
+    model = trainer.memory_model(state)
+    analytic, measured = model["analytic"], model["measured"]
+    for key in sorted(set(analytic) & set(measured)):
+        assert analytic[key] == measured[key], (
+            f"{key}: analytic {analytic[key]} != measured {measured[key]}")
+    assert measured["hot/a"] == trainer._hot_device_bytes(
+        trainer.model.ps_specs()["a"], 4)
+
+    # publish: the ledger's gauges carry the same bytes
+    trainer.publish_memory(state)
+    total = metrics.Accumulator.get("memory.total_bytes", "gauge").value()
+    assert total == model["device_total_bytes"]
+    assert metrics.Accumulator.get(
+        "memory.bytes", "gauge",
+        labels={"component": "table_weights", "table": "a"}).value() \
+        == measured["table_weights/a"]
+
+
+def test_memory_model_zero_sharded_dense_slots_exact():
+    trainer, state, batch = _mesh_trainer(dense_shard=True)
+    model = trainer.memory_model(state)
+    analytic, measured = model["analytic"], model["measured"]
+    assert "zero_slots" in analytic and "zero_slots" in measured
+    for key in sorted(set(analytic) & set(measured)):
+        assert analytic[key] == measured[key], (
+            f"{key}: analytic {analytic[key]} != measured {measured[key]}")
+
+
+def test_preflight_rejects_over_budget_hot_attach():
+    # hot_rows enabled AFTER init: the state carries no caches, so the next
+    # refresh is the allocating "fill" — the one resize preflight gates
+    trainer, state, batch = _mesh_trainer()
+    trainer.hot_rows = 4
+    assert state.tables["a"].hot is None
+    hot_ids = {"a": np.arange(4, dtype=np.int64),
+               "b": np.asarray([(1 << 40) - 3], np.int64)}
+    memwatch.WATCH.configure(budget_bytes=64)  # nothing fits
+    state2 = trainer.refresh_hot_rows(state, hot_ids=hot_ids)
+    assert state2 is state  # rejected: the cache-free state is kept
+    assert metrics.Accumulator.get("memory.preflight_rejects").value() == 1.0
+    evs = [e for e in trace.RECORDER.tail()
+           if getattr(e, "group", None) == "memory"
+           and e.name == "preflight_reject"]
+    assert evs and evs[-1].attrs["reason"] == "hot_fill"
+    # with room, the same attach goes through
+    memwatch.WATCH.configure(budget_bytes=None)
+    state3 = trainer.refresh_hot_rows(state, hot_ids=hot_ids)
+    assert state3.tables["a"].hot is not None
+
+
+def test_placement_prime_preflight_keeps_current_sizes():
+    from openembedding_tpu.placement import (PlacementController,
+                                             PlacementPolicy)
+    from openembedding_tpu.placement.policy import row_bytes
+    from openembedding_tpu.utils.sketch import SkewMonitor
+    trainer, state, batch = _mesh_trainer()
+    mon = SkewMonitor(k=32, sync=True)
+    for _ in range(3):  # warm the sketches so prime() sizes H > 0
+        mon.observe("a", batch["sparse"]["a"])
+        mon.observe("b", batch["sparse"]["b"])
+    policy = PlacementPolicy(8 * row_bytes(8, 1), mig_rows=16)
+    ctl = PlacementController(trainer, policy, monitor=mon)
+    memwatch.WATCH.configure(budget_bytes=8)  # the resize delta cannot fit
+    state2 = ctl.prime(state)
+    assert not trainer.hot_rows  # sizes kept at their current values
+    evs = [e for e in trace.RECORDER.tail()
+           if getattr(e, "group", None) == "placement"
+           and e.name == "prime_rejected"]
+    assert evs, "prime under budget pressure must leave a flight event"
+    assert state2.tables["a"].hot is None
+
+
+# -- reporter JSONL rotation --------------------------------------------------
+
+
+def test_jsonl_rotation_boundary_never_splits_a_record(tmp_path):
+    path = tmp_path / "metrics.jsonl"
+    metrics.observe("train.steps", 1.0)
+    rep = metrics.PeriodicReporter(interval=60, sink=lambda s: None,
+                                   jsonl_path=str(path), jsonl_max_bytes=150,
+                                   jsonl_keep=2, history=False)
+    for _ in range(6):
+        rep._tick()
+    files = [path] + [tmp_path / f"metrics.jsonl.{i}" for i in (1, 2)]
+    assert all(f.exists() for f in files)
+    assert not (tmp_path / "metrics.jsonl.3").exists()  # keep=2 bound
+    for f in files:
+        body = f.read_text()
+        assert len(body.encode()) <= 150  # every file under the bound
+        for line in body.splitlines():   # and every record intact
+            rec = json.loads(line)
+            assert "ts" in rec and "metrics" in rec
+
+
+def test_jsonl_unbounded_when_rotation_off(tmp_path):
+    path = tmp_path / "m.jsonl"
+    metrics.observe("train.steps", 1.0)
+    rep = metrics.PeriodicReporter(interval=60, sink=lambda s: None,
+                                   jsonl_path=str(path), history=False)
+    for _ in range(4):
+        rep._tick()
+    assert len(path.read_text().splitlines()) == 4
+    assert not (tmp_path / "m.jsonl.1").exists()

@@ -1,0 +1,206 @@
+"""Render a postmortem capsule (`utils/capsule.py`) into an operator report.
+
+    python tools/capsule_report.py /var/capsules/capsule-20260807T120001-nonfinite.json.gz
+    python tools/capsule_report.py cap.json.gz --json            # raw payload
+    python tools/capsule_report.py cap.json.gz --tail 40         # more flight lines
+    python tools/capsule_report.py cap.json.gz --request ab12cd  # one request only
+
+Fully offline — the capsule is self-contained (flight-recorder tail, metric
+history rings, device-memory ledger, collective fingerprint, resolved
+config, HLO-budget digest), so this renders a dump mailed from a production
+node with no live process and no repo checkout on the reading side. Sections:
+
+- header: reason, trigger attrs, wall time, fingerprint, HLO-budget digest;
+- flight timeline: the last events/spans before the trigger, relative
+  seconds, request ids kept so a NaN step correlates to its ingest batch;
+- history: one sparkline per metric series ring (most recent window);
+- memory: the analytic per-component/per-table ledger vs the device view;
+- context: registered provider snapshots (resolved trainer/serving config).
+"""
+
+from __future__ import annotations
+
+import argparse
+import gzip
+import json
+import sys
+from typing import Any, Dict, List, Optional
+
+SPARK_CHARS = " ▁▂▃▄▅▆▇█"
+
+
+def load(path: str) -> dict:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as f:
+        return json.load(f)
+
+
+def sparkline(values: List[float], width: int = 32) -> str:
+    vals = [float(v) for v in values if v is not None][-width:]
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    span = hi - lo
+    if span <= 0:
+        return SPARK_CHARS[4] * len(vals)
+    return "".join(
+        SPARK_CHARS[1 + int((v - lo) / span * (len(SPARK_CHARS) - 2))]
+        for v in vals)
+
+
+def _fmt_bytes(n: float) -> str:
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024
+    return f"{n:.1f}TiB"
+
+
+def _fmt_attrs(attrs: Dict[str, Any]) -> str:
+    return " ".join(f"{k}={v}" for k, v in sorted(attrs.items()))
+
+
+def render_header(cap: dict) -> List[str]:
+    import time
+    when = time.strftime("%Y-%m-%d %H:%M:%S UTC",
+                         time.gmtime(cap.get("ts", 0)))
+    lines = [f"capsule v{cap.get('version')}  reason={cap.get('reason')}  "
+             f"at {when}"]
+    if cap.get("attrs"):
+        lines.append(f"  attrs: {_fmt_attrs(cap['attrs'])}")
+    if cap.get("fingerprint"):
+        lines.append(f"  collective fingerprint: {cap['fingerprint']}")
+    if cap.get("hlo_budget_digest"):
+        lines.append(f"  hlo budget digest: {cap['hlo_budget_digest']}")
+    return lines
+
+
+def render_flight(cap: dict, tail: int = 25,
+                  request: Optional[str] = None) -> List[str]:
+    items = list(cap.get("flight", [])) + list(cap.get("open_spans", []))
+    if request:
+        items = [it for it in items
+                 if str(it.get("request_id", "")).startswith(request)]
+    if not items:
+        return ["(flight recorder empty)"]
+    t0 = cap.get("ts", 0.0)
+    lines = []
+    for it in items[-tail:]:
+        ts = it.get("ts", it.get("start", 0.0))
+        rel = ts - t0
+        rid = it.get("request_id") or "-"
+        tag = f"{it.get('group', '?')}/{it.get('name', '?')}"
+        if it.get("kind") == "span":
+            dur = it.get("duration_ms")
+            dur_s = f"{dur:8.2f}ms" if dur is not None else "    OPEN  "
+            lines.append(f"  {rel:+9.3f}s  span  {dur_s}  {tag:<34} "
+                         f"rid={rid} {_fmt_attrs(it.get('attrs', {}))}")
+        else:
+            lines.append(f"  {rel:+9.3f}s  event            {tag:<34} "
+                         f"rid={rid} {_fmt_attrs(it.get('attrs', {}))}")
+    return lines
+
+
+def render_history(cap: dict, width: int = 32,
+                   limit: int = 24) -> List[str]:
+    hist = cap.get("history", {})
+    if not hist:
+        return ["(no history rings)"]
+    lines = []
+    for key in sorted(hist)[:limit]:
+        series = hist[key]
+        pts = series.get("points", [])
+        # hist-kind series retain {"mean","count","p50","p95","p99"} dicts
+        vals = [p[1].get("p99") if isinstance(p[1], dict) else p[1]
+                for p in pts]
+        last = vals[-1] if vals else None
+        lines.append(f"  {key:<44} {sparkline(vals, width):<{width}} "
+                     f"last={last!r} n={len(pts)}")
+    extra = len(hist) - limit
+    if extra > 0:
+        lines.append(f"  ... and {extra} more series (--json for all)")
+    return lines
+
+
+def render_memory(cap: dict) -> List[str]:
+    mem = cap.get("memory", {})
+    comps = mem.get("components", [])
+    if not comps and not mem.get("device_stats"):
+        return ["(no memory ledger)"]
+
+    def _key(e):
+        labels = ",".join(f"{k}={v}"
+                          for k, v in sorted(e.get("labels", {}).items()))
+        return e.get("component", "?") + (f"{{{labels}}}" if labels else "")
+
+    lines = []
+    for ent in sorted(comps, key=_key):
+        host = " (host)" if ent.get("host") else ""
+        lines.append(f"  {_key(ent):<44} "
+                     f"{_fmt_bytes(ent.get('bytes', 0)):>12}{host}")
+    lines.append(f"  {'-- device total (model)':<44} "
+                 f"{_fmt_bytes(mem.get('device_total_bytes', 0)):>12}")
+    dev = mem.get("device_stats")
+    if dev:
+        used, limit = dev.get("used", 0), dev.get("limit", 0)
+        extra = ""
+        if limit:
+            drift = (used - mem.get("device_total_bytes", 0)) / limit
+            extra = (f" headroom={1.0 - used / limit:.3f}"
+                     f" model_drift={drift:+.4f}")
+        lines.append(f"  device worst-case: used={_fmt_bytes(used)} "
+                     f"limit={_fmt_bytes(limit)}{extra}")
+    budget = mem.get("budget_bytes")
+    if budget:
+        lines.append(f"  configured budget: {_fmt_bytes(budget)}")
+    return lines
+
+
+def render_context(cap: dict) -> List[str]:
+    ctx = cap.get("context", {})
+    if not ctx:
+        return []
+    lines = ["", "== context"]
+    for name in sorted(ctx):
+        body = json.dumps(ctx[name], indent=2, sort_keys=True, default=repr)
+        lines.append(f"  [{name}]")
+        lines.extend("    " + ln for ln in body.splitlines())
+    return lines
+
+
+def render(cap: dict, tail: int = 25,
+           request: Optional[str] = None) -> str:
+    lines = render_header(cap)
+    lines += ["", "== flight recorder (relative to trigger)"]
+    lines += render_flight(cap, tail=tail, request=request)
+    lines += ["", "== metric history"]
+    lines += render_history(cap)
+    lines += ["", "== device memory"]
+    lines += render_memory(cap)
+    lines += render_context(cap)
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="capsule-*.json.gz (or plain .json)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the raw capsule payload")
+    ap.add_argument("--tail", type=int, default=25,
+                    help="flight-recorder lines to show (default 25)")
+    ap.add_argument("--request", default=None,
+                    help="only show flight items whose request id starts "
+                         "with this prefix")
+    args = ap.parse_args(argv)
+    cap = load(args.path)
+    if args.json:
+        json.dump(cap, sys.stdout, indent=2, sort_keys=True)
+        print()
+        return 0
+    print(render(cap, tail=args.tail, request=args.request))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -44,6 +44,9 @@ KNOWN_GROUPS = {
     "hot",        # replicated hot-row cache (MeshTrainer(hot_rows=...))
     "ingest",     # line-rate input path (data/ingest.py feed ring + parse pool)
     "lint",       # oelint's own run health (pass wall times, finding counts)
+    "capsule",    # postmortem capsule emission health (utils/capsule.py)
+    "history",    # metric history rings (utils/history.py /historz surface)
+    "memory",     # device-memory ledger + preflight gate (utils/memwatch.py)
     "metrics",    # the metrics subsystem's own health (report_errors)
     "offload",    # host-cached table cache admission/flush/staging pipeline
     "persist",    # async/incremental persistence
@@ -63,6 +66,33 @@ KNOWN_GROUPS = {
 INSTANCE_DIM = re.compile(
     r"^(?:(?:table|shard|model|instance)_?\d+"
     r"|[a-z0-9_]+_(?:table|shard|model|instance))$")
+
+# the label-KEY registry: every literal key in a labels={...} dict at an
+# observe()/vtimer()/span() site must be one of these. Label keys are
+# series DIMENSIONS — each new key multiplies registry cardinality (and
+# history-ring count) across every value it ever takes, so an unbounded
+# dimension (request_id, step, a raw feature value) is a memory leak with a
+# metrics API. A new key is a conscious act, like a new group.
+KNOWN_LABELS = {
+    "component",  # memory ledger component (utils/memwatch.py)
+    "instance",   # fleet-merge node id (metrics.merge_prometheus)
+    "kind",       # operation kind within a group (bounded enum)
+    "model",      # serving model sign
+    "pass",       # oelint pass name (bounded by the pass registry)
+    "pool",       # parse-pool instance label (data/ingest.py)
+    "rank",       # hot-row popularity rank bucket (utils/sketch.py)
+    "ring",       # feed-ring instance label (data/ingest.py)
+    "shard",      # table shard ordinal (bounded by mesh size)
+    "slo",        # SLO spec name (bounded by the spec file)
+    "slot",       # optimizer slot name (bounded enum)
+    "table",      # embedding table / variable name
+}
+
+# labels={...} dict literals near a metrics call site; keys checked against
+# KNOWN_LABELS. Only LITERAL keys are checkable — a computed key passes
+# through here, but composes from a dict some other literal site built.
+LABELS_DICT = re.compile(r"""labels\s*=\s*\{(?P<body>[^{}]*)\}""")
+LABEL_KEY = re.compile(r"""(["'])(?P<key>[^"']+)\1\s*:""")
 
 # observe("metric.name", ...) — metrics.observe or bare observe
 OBSERVE = re.compile(r"""(?<![\w.])(?:metrics\.|M\.)?observe\(\s*
@@ -113,6 +143,14 @@ def lint_text(sf: SourceFile) -> List[Finding]:
             flag(m.start(), f"span/vtimer group {group!r} — unknown metric "
                  "group; register it in tools/oelint/passes/metrics.py "
                  "KNOWN_GROUPS")
+    for m in LABELS_DICT.finditer(text):
+        for km in LABEL_KEY.finditer(m.group("body")):
+            key = km.group("key")
+            if key not in KNOWN_LABELS:
+                flag(m.start(), f"label key {key!r} — unknown label "
+                     "dimension; every label key multiplies series "
+                     "cardinality, so the set is a closed registry "
+                     "(tools/oelint/passes/metrics.py KNOWN_LABELS)")
     return bad
 
 

@@ -317,6 +317,12 @@ class WeaveScheduler:
         """Record a fatal failure and kill every weave thread."""
         if self.fatal is None:
             self.fatal = exc
+            if isinstance(exc, WeaveLeak):
+                try:  # oeweave runs standalone too — the package may be absent
+                    from openembedding_tpu.utils import capsule as _capsule
+                    _capsule.trigger("weave_leak", detail=str(exc))
+                except Exception:  # noqa: BLE001 — diagnosis must not mask
+                    pass           # the leak itself
         with self._cv:
             for t in self.threads:
                 if t.status != FINISHED:

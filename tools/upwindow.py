@@ -203,6 +203,11 @@ CASES = [
     #     overhead acceptance bound). Single-chip relay case like bench_dim9;
     #     two compiles of the dim9 step (sentinel on/off), budget sized so.
     ("bench_health", *bench_case("health", 700)),
+    # 16. round-21 flight-data layer (bench 'obs2' case: per-step loop with
+    #     capsules armed + history sampling + memwatch publish every 8 steps
+    #     vs all off — the <= 2% overhead acceptance bound). Two compiles of
+    #     the 1-device mesh step (obs on/off), budget sized like health.
+    ("bench_obs2", *bench_case("obs2", 700)),
 ]
 
 
