@@ -14,9 +14,9 @@ CPU_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 	XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 .PHONY: ci test dryrun bench-smoke native lint lint-fast lint-budget \
-	lint-metrics weave capsule-smoke
+	lint-metrics weave capsule-smoke timeline-smoke
 
-ci: lint test dryrun bench-smoke weave capsule-smoke
+ci: lint test dryrun bench-smoke weave capsule-smoke timeline-smoke
 
 # the full static-analysis + invariant-guard suite (tools/oelint): eleven
 # passes — trace-hazard (recompile hazards in jit-reachable code), host-sync
@@ -95,6 +95,29 @@ capsule-smoke:
 	text = cr.render(cr.load(p)); \
 	assert 'reason=smoke' in text and 'train.steps' in text, text; \
 	print('capsule smoke OK:', os.path.basename(p))"
+
+# the fleet-causality surface end to end: two in-process serving nodes,
+# Cristian clock probes against both /timelinez endpoints, one merged
+# skew-corrected timeline — proves the scrape+merge path stays green without
+# a real fleet
+timeline-smoke:
+	$(CPU_ENV) $(PY) -c "import tempfile, threading; \
+	from openembedding_tpu.serving import make_server; \
+	from openembedding_tpu.utils import trace; \
+	from tools import fleet_timeline as ftl; \
+	srvs = [make_server(tempfile.mkdtemp(prefix='tlsmoke')) \
+	        for _ in range(2)]; \
+	[threading.Thread(target=s.serve_forever, daemon=True).start() \
+	 for s in srvs]; \
+	urls = ['http://127.0.0.1:%d' % s.server_address[1] for s in srvs]; \
+	trace.event('serving', 'smoke', source='make timeline-smoke'); \
+	nodes = []; \
+	[nodes.append((u, *ftl.probe(u, probes=2))) for u in urls]; \
+	items = ftl.merge([(n, d, o) for n, d, o in nodes]); \
+	assert items, 'merged fleet timeline is empty'; \
+	print(ftl.render(items, limit=5)); \
+	[s.shutdown() for s in srvs]; \
+	print('timeline smoke OK: %d merged items' % len(items))"
 
 # build the native data-path extension explicitly (the package also builds it
 # on demand at import; this target surfaces compiler errors directly)

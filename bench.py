@@ -638,6 +638,77 @@ def case_obs2():
     }
 
 
+def case_causality():
+    """Fleet-causality layer overhead (round 22): the PER-STEP mesh train
+    loop with the cross-process propagation stack ON — every step opens a
+    traced request from an injected+extracted `X-OETPU-Trace` header pair,
+    runs under a span, folds a hop decomposition into a lineage book, and
+    closes the chain with an idempotent note_serve — vs the stack OFF.
+    Everything added is host-side contextvar/dict bookkeeping (no device
+    sync), so the acceptance bound is overhead <= 2% (the bench_causality
+    upwindow entry pins it)."""
+    import jax
+    import openembedding_tpu as embed
+    from openembedding_tpu.models import make_deepfm
+    from openembedding_tpu.parallel import MeshTrainer, make_mesh
+    from openembedding_tpu.sync import lineage
+    from openembedding_tpu.utils import metrics as M
+    from openembedding_tpu.utils import trace
+
+    WD.stage("causality:init", 240)
+    batches, _ = _stacked_batches(9, SCAN_STEPS)
+    eps = {}
+    best = {}
+    for flag in (True, False):
+        tag = "on" if flag else "off"
+        with M._LOCK:
+            M._REGISTRY.clear()
+        book = lineage.LineageBook(capacity=64)
+        model = make_deepfm(vocabulary=VOCAB, dim=9)
+        trainer = MeshTrainer(model, embed.Adagrad(learning_rate=0.05),
+                              mesh=make_mesh(jax.devices()[:1]))
+        state = trainer.init(batches[0])
+        step = trainer.jit_train_step(batches[0], state)
+        WD.stage(f"causality:{tag}:compile", 420)
+        state, mets = step(state, batches[0])
+        trainer.record_step_stats(mets)
+        WD.stage(f"causality:{tag}:measure", 240)
+        best[flag] = None
+        for _ in range(REPEATS):
+            t0 = time.perf_counter()
+            for i, b in enumerate(batches):
+                if flag:
+                    with trace.request():  # caller side: stamp the headers
+                        hdrs = trace.inject_headers({})
+                    ctx = trace.extract_context(hdrs)
+                    with trace.request(ctx.trace_id,
+                                       remote_parent=ctx.parent_span):
+                        with trace.span("sync", "bench_step", step=i):
+                            state, mets = step(state, b)
+                        trainer.record_step_stats(mets)
+                        now = time.time()
+                        book.record("bench", i, birth=now - 0.1,
+                                    seen=now - 0.05, fetched=now - 0.03,
+                                    applied=now - 0.02, swapped=now - 0.01,
+                                    hops={"fetch": 20.0}, offset_s=0.0)
+                        book.note_serve("bench", i, now=now)
+                else:
+                    state, mets = step(state, b)
+                    trainer.record_step_stats(mets)
+            dt = time.perf_counter() - t0
+            best[flag] = dt if best[flag] is None else min(best[flag], dt)
+        eps[flag] = BATCH * len(batches) / best[flag]
+    per_step_us = (best[True] - best[False]) / len(batches) * 1e6
+    return {
+        "causality_on_examples_per_sec": round(eps[True], 1),
+        "causality_off_examples_per_sec": round(eps[False], 1),
+        # positive = the propagation + lineage bookkeeping costs throughput
+        "causality_overhead_pct": round((eps[False] / eps[True] - 1.0) * 100,
+                                        2),
+        "per_step_overhead_us": round(per_step_us, 1),
+    }
+
+
 def case_hot():
     """Skew-aware hot-row replication (round 10): a TRUNCATED Zipf(1.05) id
     stream (item-popularity ids over a bounded catalog — no per-field
@@ -1459,7 +1530,7 @@ def main():
         "OETPU_BENCH_CASES",
         "dim9,dim64,mesh1,mesh1f,pull,wire,wire_inband,sync,skew,hot,"
         "placement,zero,wire_total,offload_pipe,pipeline,ingest,"
-        "health,obs2").split(",")
+        "health,obs2,causality").split(",")
 
     # PRIMARY first: whatever happens later, this number is in the artifact.
     if "dim9" in cases:
@@ -1485,7 +1556,8 @@ def main():
                  ("pipeline", case_pipeline),
                  ("ingest", case_ingest),
                  ("health", case_health),
-                 ("obs2", case_obs2)]
+                 ("obs2", case_obs2),
+                 ("causality", case_causality)]
     for name, fn in secondary:
         if name not in cases:
             continue

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import json
 import os
+import time
 from typing import Any, Dict, Optional
 
 import jax
@@ -170,7 +171,8 @@ def export_standalone(state, model: EmbeddingModel, path: str, *,
     with open(os.path.join(path, MODEL_META_FILE), "w") as f:
         d = json.loads(meta.to_json())
         d["extra"] = {"standalone": True, "step": int(state.step),
-                      "model_version": int(state.model_version)}
+                      "model_version": int(state.model_version),
+                      "birth_time": time.time()}
         json.dump(d, f, indent=2, sort_keys=True)
     if model.config is not None:
         with open(os.path.join(path, MODEL_CONFIG_FILE), "w") as f:
@@ -197,6 +199,9 @@ class StandaloneModel:
         # subscriber negotiates against the publisher feed (`sync/`)
         self.step = 0
         self.model_version = 0
+        # when the exported state was captured (freshness zero point); None
+        # on exports written before the stamp existed
+        self.birth_time: Optional[float] = None
 
     @classmethod
     def load(cls, path: str, model: Optional[EmbeddingModel] = None
@@ -230,6 +235,8 @@ class StandaloneModel:
         out = cls(meta, tables, dense_params, model)
         out.step = int(extra.get("step", 0))
         out.model_version = int(extra.get("model_version", 0))
+        bt = extra.get("birth_time")
+        out.birth_time = float(bt) if bt is not None else None
         return out
 
     @property

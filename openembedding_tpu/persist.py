@@ -754,8 +754,14 @@ class IncrementalPersister(AsyncPersister):
                 "params": _flatten_params(jax.device_get(state.dense_params)),
                 "slots": _flatten_params(jax.device_get(state.dense_slots)),
             }
+            # birth_time: the delta's zero point for end-to-end serving
+            # freshness (sync subscriber's birth->swap chain). Captured
+            # AFTER the touched-set allgather above — a wall-clock read
+            # feeding collective-adjacent code would diverge across hosts,
+            # but this value only lands in process 0's meta.json
             scalars = {"step": step,
-                       "model_version": int(state.model_version)}
+                       "model_version": int(state.model_version),
+                       "birth_time": time.time()}
         path = os.path.join(self.root, f"delta_{step:012d}")
         write_cb = lambda tmp: self._write_delta_payload(  # noqa: E731
             tables, dense, scalars, parent, tmp)

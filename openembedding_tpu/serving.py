@@ -353,25 +353,34 @@ class ServingHandler(BaseHTTPRequestHandler):
             super().log_message(fmt, *args)
 
     def send_response(self, code, message=None):
-        """Every response echoes the request id (`X-OETPU-Request-Id`) and
-        stamps the status onto the request's http span."""
+        """Every response echoes the request id (`X-OETPU-Request-Id`),
+        stamps this node's wall clock (`X-OETPU-Server-Time`, the Cristian
+        clock-offset probe clients read), and records the status onto the
+        request's http span."""
         super().send_response(code, message)
         rid = getattr(self, "_request_id", None)
         if rid:
             self.send_header(REQUEST_ID_HEADER, rid)
+        self.send_header(trace.SERVER_TIME_HEADER, repr(time.time()))
         sp = getattr(self, "_http_span", None)
         if sp is not None:
             sp.attrs["status"] = int(code)
 
     def _traced(self, method: str, handler):
-        """Request-id middleware: adopt the client's `X-OETPU-Request-Id` (or
-        generate one), bind it for the request's lifetime, and wrap the whole
+        """Trace-context middleware: adopt the client's `X-OETPU-Trace`
+        context (falling back to `X-OETPU-Request-Id`, generating an id when
+        absent), bind it for the request's lifetime, and wrap the whole
         handler in the root `serving.http` span — every nested span (predict,
         queue wait, batch exec, model call; publisher-side delta serves in a
-        sync round) correlates by this id."""
-        rid = self.headers.get(REQUEST_ID_HEADER) or trace.new_request_id()
+        sync round) correlates by this id, and the http span's
+        `remote_parent` links it under the CALLER's span across the process
+        boundary (the stitched fleet trace tree)."""
+        ctx = trace.extract_context(self.headers)
+        rid = (ctx.trace_id if ctx is not None else None) \
+            or trace.new_request_id()
         self._request_id = rid
-        with trace.request(rid):
+        with trace.request(rid, remote_parent=ctx.parent_span
+                           if ctx is not None else None):
             with trace.span("serving", "http", method=method,
                             path=self.path) as sp:
                 self._http_span = sp
@@ -441,6 +450,8 @@ class ServingHandler(BaseHTTPRequestHandler):
             return "sloz", None, None
         if path == "/historz":
             return "historz", None, None
+        if path == "/timelinez":
+            return "timelinez", None, None
         if path == "/capsule":
             return "capsule", None, None
         return None, None, None
@@ -496,9 +507,11 @@ class ServingHandler(BaseHTTPRequestHandler):
             lines.append("(none)")
         for sign, sub in sorted(self.subscribers.items()):
             st = sub.status()
+            f = st.get("freshness_ms")
+            fresh = f"freshness_ms={f:.1f} " if f is not None else ""
             lines.append(
                 f"{sign}: state={st['state']} version={st['version']} "
-                f"applied={st['applied']} "
+                f"applied={st['applied']} {fresh}"
                 f"last_degraded_reason={st.get('last_degraded_reason')}")
         lines.append("")
         lines.append("-- sync publishers --")
@@ -600,8 +613,9 @@ class ServingHandler(BaseHTTPRequestHandler):
             if not url.startswith("http"):
                 url = f"http://{url}"
             try:
-                with urllib.request.urlopen(f"{url}/metrics",
-                                            timeout=5.0) as r:
+                req = urllib.request.Request(
+                    f"{url}/metrics", headers=trace.inject_headers())
+                with urllib.request.urlopen(req, timeout=5.0) as r:
                     scrapes.append((peer, r.read().decode()))
             except Exception as e:  # noqa: BLE001 — degrade, don't 500
                 comments.append(f"# fleet: peer {peer} unreachable: {e}")
@@ -609,8 +623,53 @@ class ServingHandler(BaseHTTPRequestHandler):
         metrics_mod.observe("fleet.peers", float(len(peers)), "gauge")
         metrics_mod.observe("fleet.nodes_answering", float(len(scrapes)),
                             "gauge")
-        return ("\n".join(comments) + "\n"
-                + metrics_mod.merge_prometheus(scrapes))
+        merged = metrics_mod.merge_prometheus(scrapes)
+        comments.extend(self._fleetz_freshness(merged))
+        return "\n".join(comments) + "\n" + merged
+
+    def _fleetz_freshness(self, merged: str) -> list:
+        """"Who is stale" comment lines for /fleetz: per-instance
+        `sync.freshness_ms` / head / applied version gauges parsed back OUT
+        of the merged scrape (gauges keep their `instance` label through the
+        merge, so no extra round-trips), plus THIS node's last hop
+        breakdown from the lineage book."""
+        out = []
+        try:
+            per: dict = {}
+            for line in merged.splitlines():
+                for metric, field in (("oetpu_sync_freshness_ms", "fresh"),
+                                      ("oetpu_sync_head_version", "head"),
+                                      ("oetpu_sync_applied_version",
+                                       "applied")):
+                    if not line.startswith(metric + "{"):
+                        continue
+                    m = re.search(r'instance="([^"]*)"', line)
+                    inst = m.group(1) if m else "self"
+                    try:
+                        val = float(line.rsplit(None, 1)[-1])
+                    except ValueError:
+                        continue
+                    per.setdefault(inst, {})[field] = val
+            for inst in sorted(per):
+                d = per[inst]
+                parts = [f"# fleet freshness: {inst}:"]
+                if "fresh" in d:
+                    parts.append(f"freshness_ms={d['fresh']:.1f}")
+                if "head" in d:
+                    parts.append(f"head_version={int(d['head'])}")
+                if "applied" in d:
+                    parts.append(f"applied_version={int(d['applied'])}")
+                out.append(" ".join(parts))
+            from .sync import lineage
+            last = lineage.BOOK.last()
+            if last is not None and last.get("hops"):
+                hops = " ".join(f"{h}={v:.1f}ms" for h, v in
+                                sorted(last["hops"].items()))
+                out.append(f"# fleet freshness: self last delta "
+                           f"step={last['step']} hops: {hops}")
+        except Exception as e:  # noqa: BLE001 — degrade, don't 500
+            out.append(f"# fleet freshness: unavailable: {e}")
+        return out
 
     def do_GET(self):  # noqa: N802 (http.server API)
         return self._traced("GET", self._handle_get)
@@ -747,6 +806,22 @@ class ServingHandler(BaseHTTPRequestHandler):
                     "metric": metric, "window_s": window_s,
                     "series": history.HISTORY.query(
                         metric, window_s=window_s, labels=labels or None)})
+            if kind == "timelinez":
+                # GET /timelinez[?n=] — this node's flight events/spans with
+                # (wall, monotonic) pairs, its delta lineage book, and clock
+                # info; `tools/fleet_timeline.py` scrapes N of these, solves
+                # per-node skew Cristian-style off `wall_time`, and renders
+                # one merged causally-ordered fleet timeline
+                from .sync import lineage
+                n = self._coerce(int, self.query.get("n", 512), "n")
+                return self._json(200, {
+                    "node": self.node_info.get("node_id", "self"),
+                    "process": trace.PROCESS_ID,
+                    "wall_time": time.time(),
+                    "events": [e.as_dict()
+                               for e in trace.RECORDER.events(n)],
+                    "spans": [s.as_dict() for s in trace.RECORDER.spans(n)],
+                    "lineage": lineage.BOOK.export()})
             return self._json(404, {"error": "not found"})
         except _BadRequest as e:
             return self._json(400, {"error": str(e)})
@@ -892,6 +967,11 @@ class ServingHandler(BaseHTTPRequestHandler):
                         ) from e
                     except RaggedBatchError as e:
                         raise _BadRequest(str(e)) from e
+                    # close the delta's lineage chain on its FIRST predict
+                    # at this version (idempotent, O(1), no-throw)
+                    from .sync import lineage
+                    lineage.note_serve(
+                        sign, int(getattr(model, "step", 0) or 0))
                     return self._json(
                         200, {"logits": np.asarray(logits).tolist()})
             return self._json(404, {"error": "not found"})
@@ -964,7 +1044,8 @@ class ServingClient:
             node = self.nodes[(start + i) % len(self.nodes)]
             data = json.dumps(body).encode() if body is not None else None
             req = urllib.request.Request(f"{node}{path}", data=data,
-                                         method=method)
+                                         method=method,
+                                         headers=trace.inject_headers())
             if data:
                 req.add_header("Content-Type", "application/json")
             if binary:
@@ -1198,7 +1279,9 @@ def restore_from_peer(peer: str, model_sign: str, dest: str, *,
     from urllib.parse import quote
 
     def get(path: str) -> bytes:
-        with urllib.request.urlopen(f"{peer}{path}", timeout=timeout) as r:
+        req = urllib.request.Request(f"{peer}{path}",
+                                     headers=trace.inject_headers())
+        with urllib.request.urlopen(req, timeout=timeout) as r:
             return r.read()
 
     entry = json.loads(get(f"/models/{model_sign}"))

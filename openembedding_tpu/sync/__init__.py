@@ -29,9 +29,10 @@ arxiv 1802.08021) — so serving freshness becomes a transport problem:
   degradation is testable, not aspirational.
 """
 
+from . import lineage
 from .publisher import SyncPublisher
 from .subscriber import (FaultInjector, SyncChainError, SyncError,
                          SyncSubscriber)
 
 __all__ = ["SyncPublisher", "SyncSubscriber", "SyncError", "SyncChainError",
-           "FaultInjector"]
+           "FaultInjector", "lineage"]

@@ -90,8 +90,13 @@ class SyncPublisher:
                     os.path.join(path, COMMIT_FILE))
             except OSError:
                 continue  # GC'd between the chain walk and here: feed shrinks
+            # birth_time: when the trainer CAPTURED the delta's state
+            # (persist.py stamps it into meta) — the zero point of the
+            # subscriber's end-to-end freshness chain; absent on deltas
+            # written before the stamp existed
             deltas.append({"step": step, "parent": int(meta["parent"]),
                            "commit_time": commit_time,
+                           "birth_time": meta.get("birth_time"),
                            "tables": list(meta.get("tables", []))})
             head = step
         return {"format": FEED_FORMAT, "base_step": base_step,

@@ -40,6 +40,7 @@ import numpy as np
 from ..ops import wire as wire_mod
 from ..persist import DELTA_FORMAT
 from ..utils import metrics, trace
+from . import lineage
 
 IDLE, FETCHING, APPLYING, DEGRADED = "IDLE", "FETCHING", "APPLYING", "DEGRADED"
 _STATE_CODE = {IDLE: 0, FETCHING: 1, APPLYING: 2, DEGRADED: 3}
@@ -108,6 +109,17 @@ class SyncSubscriber:
         self.last_degraded_reason: Optional[str] = None
         self._backoff = 0.0                     # guarded-by: self._mu
         self._head_times: Dict[int, float] = {}  # guarded-by: self._mu
+        # delta lineage bookkeeping: per-step birth stamps off the feed
+        # (publisher clock) and first-seen times (local clock), the
+        # Cristian-style clock-offset estimate to the publisher, and the
+        # last applied delta's hop decomposition / end-to-end freshness
+        self._births: Dict[int, float] = {}      # guarded-by: self._mu
+        self._feed_seen: Dict[int, float] = {}   # guarded-by: self._mu
+        self._clock_offset_s = 0.0               # guarded-by: self._mu
+        self._offset_samples = 0                 # guarded-by: self._mu
+        self._last_hops: Optional[dict] = None   # guarded-by: self._mu
+        # guarded-by: self._mu
+        self._last_freshness_ms: Optional[float] = None
         self._stop = threading.Event()
         # guarded-by: self._mu
         self._thread: Optional[threading.Thread] = None
@@ -115,25 +127,44 @@ class SyncSubscriber:
     # -- wire ----------------------------------------------------------------
 
     def _get(self, path: str):
-        # each sync round binds a request id (`sync_once`); stamping it onto
-        # every feed fetch means the PUBLISHER node's handler spans and this
-        # subscriber's fetch/apply spans correlate as one trace
-        headers = {}
-        rid = trace.get_request_id()
-        if rid:
-            headers[trace.REQUEST_ID_HEADER] = rid
+        # each sync round binds a request id (`sync_once`); injecting the
+        # full trace context (request id + X-OETPU-Trace parent span) onto
+        # every feed fetch means the PUBLISHER node's handler spans link
+        # back to this subscriber's fetch span as ONE cross-process tree
+        headers = trace.inject_headers()
         req = urllib.request.Request(f"{self.feed}{path}", headers=headers)
+        t0 = time.time()
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as r:
                 raw = r.read()
+                server_time = r.headers.get(trace.SERVER_TIME_HEADER)
         except urllib.error.HTTPError as e:
             if e.code == 304:
                 return None
             raise SyncError(f"feed {path}: HTTP {e.code}") from e
         except (urllib.error.URLError, ConnectionError, OSError) as e:
             raise SyncError(f"feed {path}: {e}") from e
+        if server_time:
+            try:
+                self._note_clock(float(server_time), t0, time.time())
+            except (TypeError, ValueError):
+                pass
         metrics.observe("sync.bytes_fetched", len(raw))
         return raw
+
+    def _note_clock(self, t_server: float, t0: float, t2: float) -> None:
+        """Cristian-style clock-offset estimate from one round-trip: the
+        publisher stamped `t_server` somewhere inside [t0, t2] of OUR clock,
+        so offset ~= t_server - (t0 + t2)/2, error bounded by RTT/2. EWMA
+        over rounds smooths network jitter; `status()` and the lineage book
+        expose the estimate so merged timelines can de-skew our stamps."""
+        offset = t_server - (t0 + t2) / 2.0
+        with self._mu:
+            if self._offset_samples == 0:
+                self._clock_offset_s = offset
+            else:
+                self._clock_offset_s += 0.3 * (offset - self._clock_offset_s)
+            self._offset_samples += 1
 
     def _get_json(self, path: str):
         raw = self._get(path)
@@ -181,10 +212,74 @@ class SyncSubscriber:
             return
         metrics.observe("sync.version_lag_steps",
                         max(0, head - self.version), "gauge")
+        metrics.observe("sync.head_version", float(head), "gauge")
+        metrics.observe("sync.applied_version", float(self.version), "gauge")
         t = self._head_times.get(self.version)
         if t is not None:
             metrics.observe("sync.staleness_seconds",
                             max(0.0, time.time() - t), "gauge")
+        f = self._freshness_ms(head)
+        if f is not None:
+            metrics.observe("sync.freshness_ms", f, "gauge")
+
+    def _freshness_ms(self, head: Optional[int]) -> Optional[float]:
+        """End-to-end freshness of what THIS node serves: while the feed
+        head is ahead of the applied version, the skew-corrected age of the
+        head delta's BIRTH (it grows every poll a stalled delta stays
+        unapplied — the SLO trip wire); once caught up, frozen at the last
+        applied delta's measured birth->swap latency."""
+        with self._mu:
+            offset = self._clock_offset_s
+            last = self._last_freshness_ms
+            birth = None
+            if (head is not None and self.version is not None
+                    and head > self.version):
+                birth = self._births.get(head)
+        if birth is not None:
+            return max(0.0, (time.time() + offset - birth) * 1e3)
+        return last
+
+    def _record_lineage(self, step: int, fetched: float, applied_t: float,
+                        swapped: float) -> None:
+        """Fold one applied delta's hop decomposition into `sync.hop_ms`
+        hists, the shared lineage book, and the freshness snapshot. `birth`/
+        `commit` stamps are publisher-domain, `seen`/`fetched`/`applied`/
+        `swapped` local-domain; the publish hop and the end-to-end number
+        cross domains via the Cristian offset estimate. The `fetch` hop runs
+        first-seen-on-feed -> fetched, so DEGRADED retry time during a
+        payload stall lands on it — the soak's stalled-hop attribution."""
+        with self._mu:
+            offset = self._clock_offset_s
+            birth = self._births.get(step)
+            seen = self._feed_seen.get(step)
+            commit_t = self._head_times.get(step)
+            hops: Dict[str, float] = {}
+            if birth is not None and commit_t is not None:
+                hops["commit"] = max(0.0, (commit_t - birth) * 1e3)
+            if commit_t is not None and seen is not None:
+                hops["publish"] = max(0.0, (seen + offset - commit_t) * 1e3)
+            if seen is not None:
+                hops["fetch"] = max(0.0, (fetched - seen) * 1e3)
+            hops["apply"] = max(0.0, (applied_t - fetched) * 1e3)
+            hops["swap"] = max(0.0, (swapped - applied_t) * 1e3)
+            e2e = None
+            if birth is not None:
+                e2e = max(0.0, (swapped + offset - birth) * 1e3)
+                self._last_freshness_ms = e2e
+            self._last_hops = {"step": step, "hops": dict(hops)}
+            # stamps for this and older steps are consumed: bound the maps
+            self._births = {k: v for k, v in self._births.items()
+                            if k > step}
+            self._feed_seen = {k: v for k, v in self._feed_seen.items()
+                               if k > step}
+        for h, v in hops.items():
+            metrics.observe("sync.hop_ms", v, "hist", labels={"hop": h})
+        if e2e is not None:
+            metrics.observe("sync.freshness_ms", e2e, "gauge")
+        lineage.BOOK.record(
+            self.model_sign, step, trace_id=trace.get_request_id(),
+            birth=birth, commit=commit_t, seen=seen, fetched=fetched,
+            applied=applied_t, swapped=swapped, hops=hops, offset_s=offset)
 
     def sync_once(self) -> int:
         """One negotiation round; returns deltas applied. Raises SyncError on
@@ -211,9 +306,15 @@ class SyncSubscriber:
         if feed.get("format") != "oetpu-sync-v1":
             raise SyncError(f"foreign feed format {feed.get('format')!r}")
         head = feed.get("head_step")
+        now = time.time()
         with self._mu:
-            self._head_times.update(
-                {d["step"]: d["commit_time"] for d in feed.get("deltas", [])})
+            for d in feed.get("deltas", []):
+                self._head_times[d["step"]] = d["commit_time"]
+                if d.get("birth_time") is not None:
+                    self._births[d["step"]] = float(d["birth_time"])
+                # first time THIS node saw the delta on the feed (local
+                # clock) — the fetch hop's start, kept across retries
+                self._feed_seen.setdefault(d["step"], now)
         self._observe_lag(head)
         if head is None or head <= self.version:
             return 0
@@ -233,6 +334,7 @@ class SyncSubscriber:
         for step in pending:
             with trace.span("sync", "fetch", step=int(step)):
                 payload = self._fetch_delta(step)
+            t_fetched = time.time()
             if self.faults is not None:
                 payload = self.faults.payload(step, payload)
             meta = payload.get("meta") or {}
@@ -248,15 +350,18 @@ class SyncSubscriber:
                 new_servable = servable.apply_update(
                     payload["tables"], payload["dense"], step=int(step),
                     model_version=meta.get("model_version"))
+            t_applied = time.time()
             with trace.span("sync", "swap", step=int(step)):
                 self.manager.swap(self.model_sign, new_servable,
                                   expected=servable)
+            t_swapped = time.time()
             servable = new_servable
             with self._mu:
                 self.version = int(step)
                 self.applied += 1
             applied += 1
             metrics.observe("sync.applied_deltas", 1)
+            self._record_lineage(int(step), t_fetched, t_applied, t_swapped)
             self._observe_lag(head)
             self._set_state(FETCHING)
         self._set_state(IDLE)
@@ -297,7 +402,11 @@ class SyncSubscriber:
                     "state": self.state, "version": self.version,
                     "applied": self.applied, "wire": self.wire,
                     "last_error": self.last_error,
-                    "last_degraded_reason": self.last_degraded_reason}
+                    "last_degraded_reason": self.last_degraded_reason,
+                    "freshness_ms": self._last_freshness_ms,
+                    "clock_offset_ms": self._clock_offset_s * 1e3,
+                    "last_hops": dict(self._last_hops)
+                    if self._last_hops is not None else None}
 
     # -- background loop -----------------------------------------------------
 

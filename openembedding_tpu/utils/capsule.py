@@ -123,6 +123,13 @@ class CapsuleWriter:
             except Exception as e:  # noqa: BLE001 — a raising provider must
                 # not kill the dump (record what broke instead)
                 context[name] = f"<context provider error: {e!r}>"
+        # delta lineage records (where a stale delta's time went): lazy
+        # import, and a capsule must still write if the sync layer is broken
+        try:
+            from ..sync.lineage import BOOK
+            lineage_records = BOOK.export()
+        except Exception as e:  # noqa: BLE001
+            lineage_records = [{"error": f"<lineage unavailable: {e!r}>"}]
         return {
             "version": CAPSULE_VERSION,
             "ts": now,
@@ -133,6 +140,7 @@ class CapsuleWriter:
             "history": history.HISTORY.export(),
             "metrics": metrics.report(reset=False),
             "memory": memwatch.WATCH.export(),
+            "lineage": lineage_records,
             "fingerprint": guards.last_fingerprint(),
             "context": context,
             "hlo_budget_digest": _hlo_budget_digest(),

@@ -153,6 +153,13 @@ DEFAULT_SLOS: Tuple[SLOSpec, ...] = (
             slow_window_s=300.0, burn_threshold=1e-9,
             description="zero non-finite losses/grads (trips on the first "
                         "bad sample: fast window = latest sample only)"),
+    SLOSpec(name="serving_freshness", metric="sync.freshness_ms",
+            selector="value", op="<=", threshold=30_000.0, fast_window_s=0.0,
+            slow_window_s=300.0, burn_threshold=1e-9,
+            description="end-to-end delta freshness (birth->swap, "
+                        "skew-corrected) stays under 30s; trips on the "
+                        "first stale sample and recovers on the next "
+                        "fresh one (fast window = latest sample only)"),
 )
 
 

@@ -18,6 +18,14 @@ or a DEGRADED transition after the fact.
   (generated when absent) and the sync subscriber stamps each negotiation
   round, so publisher-side handler spans and subscriber-side fetch/apply
   spans of one round share an id.
+- cross-process propagation: `TraceContext` serializes (trace id, parent
+  span uid) onto the `X-OETPU-Trace` header (`inject_headers` on every
+  outbound call, `extract_context` on the serving surface); the callee's
+  root span records the caller's process-qualified span uid as
+  `remote_parent`, so two nodes' dumps stitch into ONE tree
+  (`tools/trace_report.py --trace <rid>` renders it). Spans and events carry
+  a (wall, monotonic) timestamp pair — wall for cross-host merges after skew
+  correction, monotonic for in-process durations.
 - flight recorder: a bounded ring buffer of recent spans + discrete events
   (sync state transitions with reason, rollbacks, persist commits, servable
   swaps). `RECORDER.render_text()` is what `GET /statusz` prints;
@@ -51,21 +59,20 @@ from typing import Any, Dict, Iterable, List, Optional
 from . import metrics
 
 REQUEST_ID_HEADER = "X-OETPU-Request-Id"
+TRACE_HEADER = "X-OETPU-Trace"
+SERVER_TIME_HEADER = "X-OETPU-Server-Time"
 
-# map the monotonic span clock onto wall time once, at import: every span/event
-# timestamp is then comparable across threads AND meaningful as an epoch time
-_PERF0 = time.perf_counter()
-_WALL0 = time.time()
-
-
-def _wall(perf_t: float) -> float:
-    return _WALL0 + (perf_t - _PERF0)
-
+# a stable per-process identity: span ids are process-local counters, so a
+# cross-process parent reference must qualify them (`<process>:<span_id>`) to
+# be unambiguous once two nodes' dumps are merged
+PROCESS_ID = uuid.uuid4().hex[:8]
 
 _current_span: contextvars.ContextVar[Optional["Span"]] = \
     contextvars.ContextVar("oetpu_current_span", default=None)
 _request_id: contextvars.ContextVar[Optional[str]] = \
     contextvars.ContextVar("oetpu_request_id", default=None)
+_remote_parent: contextvars.ContextVar[Optional[str]] = \
+    contextvars.ContextVar("oetpu_remote_parent", default=None)
 _span_ids = itertools.count(1)
 
 
@@ -78,22 +85,95 @@ def get_request_id() -> Optional[str]:
 
 
 @contextmanager
-def request(rid: Optional[str] = None):
+def request(rid: Optional[str] = None, *,
+            remote_parent: Optional[str] = None):
     """Bind a request/trace id for the duration of the block; every span
-    opened inside carries it as `trace_id` (generated when not given)."""
+    opened inside carries it as `trace_id` (generated when not given).
+    `remote_parent` is a process-qualified span uid (`proc:span_id`) from the
+    caller's side of an HTTP hop: the first span opened inside the block with
+    no LOCAL parent records it, stitching the two processes' trees."""
     rid = rid or new_request_id()
     token = _request_id.set(rid)
+    rtoken = _remote_parent.set(remote_parent)
     try:
         yield rid
     finally:
+        _remote_parent.reset(rtoken)
         _request_id.reset(token)
 
 
-class Span:
-    """One timed scope. Mutable while open; recorded on close."""
+class TraceContext:
+    """The serializable cross-process slice of the tracing state: the trace
+    (request) id plus the process-qualified id of the span that was open when
+    the context was captured. Rides the `X-OETPU-Trace` header as
+    `<trace_id>` or `<trace_id>/<process>:<span_id>`."""
 
-    __slots__ = ("group", "name", "span_id", "parent_id", "trace_id",
-                 "start", "duration_ms", "thread", "attrs")
+    __slots__ = ("trace_id", "parent_span")
+
+    def __init__(self, trace_id: str, parent_span: Optional[str] = None):
+        self.trace_id = trace_id
+        self.parent_span = parent_span
+
+    def to_header(self) -> str:
+        if self.parent_span:
+            return f"{self.trace_id}/{self.parent_span}"
+        return self.trace_id
+
+    @classmethod
+    def from_header(cls, value: str) -> Optional["TraceContext"]:
+        value = (value or "").strip()
+        if not value:
+            return None
+        trace_id, _, parent = value.partition("/")
+        return cls(trace_id, parent or None)
+
+    @classmethod
+    def current(cls) -> Optional["TraceContext"]:
+        """Capture the calling context, or None when no request is bound and
+        no span is open (nothing to propagate)."""
+        rid = _request_id.get()
+        s = _current_span.get()
+        if rid is None and s is None:
+            return None
+        parent = s.qualified_id if s is not None else None
+        return cls(rid or new_request_id(), parent)
+
+
+def inject_headers(headers: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+    """Stamp the current trace context onto an outbound HTTP request's
+    headers (creating the dict when not given): the legacy request-id header
+    plus the `X-OETPU-Trace` context. Returns the dict for chaining."""
+    headers = headers if headers is not None else {}
+    ctx = TraceContext.current()
+    if ctx is not None:
+        headers.setdefault(REQUEST_ID_HEADER, ctx.trace_id)
+        headers.setdefault(TRACE_HEADER, ctx.to_header())
+    return headers
+
+
+def extract_context(headers) -> Optional[TraceContext]:
+    """Read a `TraceContext` off inbound HTTP headers (any Mapping with
+    `.get`, e.g. `http.server`'s message object); falls back to the bare
+    request-id header; None when neither is present."""
+    raw = headers.get(TRACE_HEADER) if headers is not None else None
+    ctx = TraceContext.from_header(raw) if raw else None
+    if ctx is not None:
+        return ctx
+    rid = headers.get(REQUEST_ID_HEADER) if headers is not None else None
+    return TraceContext(rid) if rid else None
+
+
+class Span:
+    """One timed scope. Mutable while open; recorded on close.
+
+    Carries a (wall, monotonic) timestamp PAIR: `start` is the monotonic
+    clock (durations, in-process ordering), `wall` is `time.time()` captured
+    at the same moment (cross-host merging after skew correction). Mixing the
+    two domains is exactly the bug the pair exists to prevent."""
+
+    __slots__ = ("group", "name", "span_id", "parent_id", "remote_parent",
+                 "trace_id", "start", "wall", "duration_ms", "thread",
+                 "attrs")
 
     def __init__(self, group: str, name: str, parent: Optional["Span"],
                  attrs: Dict[str, Any]):
@@ -101,36 +181,50 @@ class Span:
         self.name = name
         self.span_id = next(_span_ids)
         self.parent_id = parent.span_id if parent is not None else None
+        # a root span inside request(remote_parent=...) links to the caller's
+        # span across the process boundary; non-roots have a local parent
+        self.remote_parent = _remote_parent.get() if parent is None else None
         self.trace_id = _request_id.get()
         self.start = time.perf_counter()
+        self.wall = time.time()
         self.duration_ms: Optional[float] = None
         self.thread = threading.get_ident()
         self.attrs = attrs
 
+    @property
+    def qualified_id(self) -> str:
+        return f"{PROCESS_ID}:{self.span_id}"
+
     def as_dict(self) -> dict:
         return {"kind": "span", "group": self.group, "name": self.name,
                 "span_id": self.span_id, "parent_id": self.parent_id,
-                "request_id": self.trace_id, "start": _wall(self.start),
+                "remote_parent": self.remote_parent,
+                "request_id": self.trace_id, "start": self.wall,
+                "mono": self.start, "process": PROCESS_ID,
                 "duration_ms": self.duration_ms, "thread": self.thread,
                 "attrs": dict(self.attrs)}
 
 
 class Event:
-    """A discrete moment (state transition, rollback, commit, swap)."""
+    """A discrete moment (state transition, rollback, commit, swap).
+    Like spans, carries the (wall, monotonic) pair — `wall` for cross-host
+    merges, `ts` (monotonic) for in-process deltas."""
 
-    __slots__ = ("group", "name", "ts", "trace_id", "thread", "attrs")
+    __slots__ = ("group", "name", "ts", "wall", "trace_id", "thread", "attrs")
 
     def __init__(self, group: str, name: str, attrs: Dict[str, Any]):
         self.group = group
         self.name = name
         self.ts = time.perf_counter()
+        self.wall = time.time()
         self.trace_id = _request_id.get()
         self.thread = threading.get_ident()
         self.attrs = attrs
 
     def as_dict(self) -> dict:
         return {"kind": "event", "group": self.group, "name": self.name,
-                "request_id": self.trace_id, "ts": _wall(self.ts),
+                "request_id": self.trace_id, "ts": self.wall,
+                "mono": self.ts, "process": PROCESS_ID,
                 "thread": self.thread, "attrs": dict(self.attrs)}
 
 
@@ -262,19 +356,24 @@ def chrome_events(items: Optional[Iterable] = None) -> List[dict]:
         args = {k: _jsonable(v) for k, v in item.attrs.items()}
         if item.trace_id:
             args["request_id"] = item.trace_id
+        args["process"] = PROCESS_ID
         if isinstance(item, Span):
             args["span_id"] = item.span_id
+            args["span_uid"] = f"{PROCESS_ID}:{item.span_id}"
             if item.parent_id is not None:
                 args["parent_id"] = item.parent_id
+                args["parent_uid"] = f"{PROCESS_ID}:{item.parent_id}"
+            if item.remote_parent:
+                args["remote_parent"] = item.remote_parent
             out.append({"name": f"{item.group}.{item.name}",
                         "cat": item.group, "ph": "X",
-                        "ts": _wall(item.start) * 1e6,
+                        "ts": item.wall * 1e6,
                         "dur": (item.duration_ms or 0.0) * 1e3,
                         "pid": pid, "tid": item.thread, "args": args})
         else:
             out.append({"name": f"{item.group}.{item.name}",
                         "cat": item.group, "ph": "i", "s": "g",
-                        "ts": _wall(item.ts) * 1e6,
+                        "ts": item.wall * 1e6,
                         "pid": pid, "tid": item.thread, "args": args})
     return out
 

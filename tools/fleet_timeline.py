@@ -1,0 +1,190 @@
+"""Skew-corrected merged fleet timeline from N nodes' `GET /timelinez`.
+
+    python tools/fleet_timeline.py http://trainer:8501 http://replica1:8501
+    python tools/fleet_timeline.py node1:8501 node2:8501 --request <rid>
+    python tools/fleet_timeline.py node1:8501 node2:8501 --version 42
+
+Each node's `/timelinez` returns its flight-recorder events/spans (every
+item carries a (wall, monotonic) timestamp pair and the node's process id),
+its delta lineage book, and `wall_time` — the node's clock at serve time.
+Raw wall clocks across hosts are NOT comparable (NTP drift, VMs, clock
+steps), so the CLI estimates each node's clock offset Cristian-style: for
+each of `--probes` round-trips it records (t0, node wall_time, t2) and takes
+offset = wall_time - (t0+t2)/2 from the MINIMUM-RTT probe (tightest error
+bound, RTT/2). Every item's wall stamp is then shifted into the scraper's
+clock domain before the merge sorts them into one causally-ordered timeline.
+
+Delta lineage records render as DELTA chain lines
+(commit→publish→fetch→apply→swap→first-predict with per-hop milliseconds);
+their publisher-domain stamps (birth/commit) are translated through the
+RECORDING node's own offset estimate (`offset_s` in the record) before the
+node→CLI correction, so all three clock domains land on one axis. Within one
+record the chain is additionally clamped non-decreasing in hop order —
+cross-domain correction is an estimate, and a merged timeline whose fetch
+precedes its publish by 3ms of residual skew reads as causal nonsense.
+
+Filters: `--request <rid>` keeps one trace's items; `--version <step>` keeps
+one delta's chain + items stamped with that step.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# lineage stamp -> (chain position, display name); publisher-domain stamps
+# (birth, commit) carry the record's own offset on top of the node offset
+_CHAIN = (("birth", "birth", True), ("commit", "commit", True),
+          ("seen", "publish", False), ("fetched", "fetch", False),
+          ("applied", "apply", False), ("swapped", "swap", False),
+          ("first_serve", "first_predict", False))
+
+
+def probe(node: str, timeout: float = 10.0, probes: int = 3):
+    """-> (doc, offset_s) for one node: scrape /timelinez `probes` times,
+    estimate the node->local clock offset from the min-RTT round-trip
+    (Cristian), keep the last full document."""
+    url = node.rstrip("/")
+    if not url.startswith("http"):
+        url = f"http://{url}"
+    doc, best_rtt, offset = None, None, 0.0
+    for _ in range(max(1, int(probes))):
+        t0 = time.time()
+        with urllib.request.urlopen(f"{url}/timelinez",
+                                    timeout=timeout) as r:
+            doc = json.loads(r.read())
+        t2 = time.time()
+        rtt = t2 - t0
+        if best_rtt is None or rtt < best_rtt:
+            best_rtt = rtt
+            offset = float(doc.get("wall_time", (t0 + t2) / 2)) \
+                - (t0 + t2) / 2.0
+    # invert: doc stamps are in the NODE's domain; local = stamp - offset
+    return doc, -offset
+
+
+def _lineage_items(name: str, rec: dict, node_offset: float) -> list:
+    """One lineage record -> DELTA chain items in the CLI clock domain,
+    clamped non-decreasing along hop order."""
+    rec_off = float(rec.get("offset_s") or 0.0)
+    items, floor = [], None
+    hops = rec.get("hops") or {}
+    for stamp, label, publisher_domain in _CHAIN:
+        t = rec.get(stamp)
+        if t is None:
+            continue
+        t = float(t)
+        if publisher_domain:
+            # publisher clock -> recording node's clock -> CLI clock
+            t = t - rec_off
+        t = t + node_offset
+        if floor is not None and t < floor:
+            t = floor  # causal clamp: residual skew must not reorder a chain
+        floor = t
+        hop_key = {"publish": "publish", "fetch": "fetch", "apply": "apply",
+                   "swap": "swap", "first_predict": "serve",
+                   "commit": "commit"}.get(label)
+        ms = hops.get(hop_key) if hop_key else None
+        detail = f" ({ms:.1f}ms)" if isinstance(ms, (int, float)) else ""
+        items.append({
+            "ts": t, "node": name, "kind": "DELTA",
+            "what": f"{rec.get('sign')}#{rec.get('step')} {label}{detail}",
+            "request_id": rec.get("trace_id"), "step": rec.get("step")})
+    return items
+
+
+def merge(nodes_data) -> list:
+    """[(name, doc, offset_s_to_local), ...] -> one merged, skew-corrected,
+    time-sorted item list. Pure function — the tests drive it with fake
+    docs and deliberately skewed clocks."""
+    items = []
+    for name, doc, offset in nodes_data:
+        for e in doc.get("events", []):
+            items.append({"ts": float(e["ts"]) + offset, "node": name,
+                          "kind": "EVT",
+                          "what": f"{e['group']}.{e['name']}",
+                          "request_id": e.get("request_id"),
+                          "step": (e.get("attrs") or {}).get("step"),
+                          "attrs": e.get("attrs") or {}})
+        for s in doc.get("spans", []):
+            items.append({"ts": float(s["start"]) + offset, "node": name,
+                          "kind": "SPAN",
+                          "what": f"{s['group']}.{s['name']} "
+                                  f"{(s.get('duration_ms') or 0.0):.1f}ms",
+                          "request_id": s.get("request_id"),
+                          "step": (s.get("attrs") or {}).get("step"),
+                          "attrs": s.get("attrs") or {}})
+        for rec in doc.get("lineage", []):
+            items.extend(_lineage_items(name, rec, offset))
+    items.sort(key=lambda it: it["ts"])
+    return items
+
+
+def filter_items(items, request=None, version=None):
+    if request is not None:
+        items = [it for it in items if it.get("request_id") == request]
+    if version is not None:
+        items = [it for it in items if it.get("step") == int(version)]
+    return items
+
+
+def render(items, limit=None) -> str:
+    if limit is not None:
+        items = items[-int(limit):]
+    width = max((len(it["node"]) for it in items), default=4)
+    lines = []
+    for it in items:
+        ts = it["ts"]
+        stamp = time.strftime("%H:%M:%S", time.localtime(ts)) \
+            + f".{int((ts % 1) * 1e3):03d}"
+        rid = f" rid={it['request_id']}" if it.get("request_id") else ""
+        lines.append(f"[{stamp}] {it['node'].ljust(width)}  "
+                     f"{it['kind']:<5} {it['what']}{rid}")
+    return "\n".join(lines) if lines else "(no matching timeline items)"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="scrape N nodes' /timelinez and print one "
+                    "skew-corrected merged fleet timeline")
+    ap.add_argument("nodes", nargs="+", help="node base URLs (or host:port)")
+    ap.add_argument("--probes", type=int, default=3,
+                    help="clock-offset round-trips per node (min-RTT wins)")
+    ap.add_argument("--request", default=None,
+                    help="keep only items of one trace/request id")
+    ap.add_argument("--version", type=int, default=None,
+                    help="keep only one delta version's chain + items")
+    ap.add_argument("--limit", type=int, default=None,
+                    help="print only the newest N items")
+    ap.add_argument("--timeout", type=float, default=10.0)
+    args = ap.parse_args(argv)
+    nodes_data, dead = [], []
+    for node in args.nodes:
+        try:
+            doc, offset = probe(node, timeout=args.timeout,
+                                probes=args.probes)
+            name = doc.get("node") or node
+            nodes_data.append((name, doc, offset))
+            print(f"# node {name} ({node}): clock offset "
+                  f"{offset * 1e3:+.2f}ms vs local")
+        except Exception as e:  # noqa: BLE001 — a dead node degrades
+            dead.append(f"# node {node} unreachable: {e}")
+    for line in dead:
+        print(line)
+    if not nodes_data:
+        print("# no node answered", file=sys.stderr)
+        return 1
+    items = filter_items(merge(nodes_data), request=args.request,
+                         version=args.version)
+    print(render(items, limit=args.limit))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -37,6 +37,35 @@ def scrape(node: str, timeout: float) -> str:
         return r.read().decode()
 
 
+def freshness_table(scrapes) -> str:
+    """"Who is stale" table from PER-NODE (unmerged) scrapes: each node's
+    `sync.freshness_ms` / head / applied version gauges side by side — the
+    CLI twin of /fleetz's `# fleet freshness:` comment lines."""
+    cols = {"oetpu_sync_freshness_ms": "freshness_ms",
+            "oetpu_sync_head_version": "head",
+            "oetpu_sync_applied_version": "applied",
+            "oetpu_sync_version_lag_steps": "lag_steps"}
+    rows = []
+    for node, text in scrapes:
+        vals = {}
+        for name, _labels, value in parse_prometheus(text)["samples"]:
+            if name in cols:
+                vals[cols[name]] = value
+        rows.append((node, vals))
+    if not any(v for _, v in rows):
+        return "(no sync freshness series on any node)"
+    width = max(len(n) for n, _ in rows)
+    order = ("freshness_ms", "head", "applied", "lag_steps")
+    head = "node".ljust(width) + "".join(c.rjust(14) for c in order)
+    lines = [head, "-" * len(head)]
+    for node, vals in rows:
+        cells = "".join(
+            (f"{vals[c]:,.1f}" if c == "freshness_ms" else f"{vals[c]:,.0f}")
+            .rjust(14) if c in vals else "-".rjust(14) for c in order)
+        lines.append(node.ljust(width) + cells)
+    return "\n".join(lines)
+
+
 def summary(text: str) -> str:
     """Counter/sum table of the merged exposition (quick fleet health read)."""
     rows = []
@@ -59,6 +88,9 @@ def main(argv=None) -> int:
     ap.add_argument("--summary", action="store_true",
                     help="print a counter summary table instead of the full "
                          "merged exposition")
+    ap.add_argument("--freshness", action="store_true",
+                    help="print the per-node sync freshness / lineage table "
+                         "(who is stale) instead of the merged exposition")
     args = ap.parse_args(argv)
     scrapes, dead = [], []
     for node in args.nodes:
@@ -71,6 +103,9 @@ def main(argv=None) -> int:
     if not scrapes:
         print("# fleet: no node answered", file=sys.stderr)
         return 1
+    if args.freshness:
+        print(freshness_table(scrapes))
+        return 0
     merged = merge_prometheus(scrapes)
     print(summary(merged) if args.summary else merged, end="")
     if not args.summary:

@@ -75,6 +75,8 @@ INSTANCE_DIM = re.compile(
 # metrics API. A new key is a conscious act, like a new group.
 KNOWN_LABELS = {
     "component",  # memory ledger component (utils/memwatch.py)
+    "hop",        # sync lineage hop (bounded enum: commit/publish/fetch/
+                  # apply/swap/serve — sync/lineage.py HOP_ORDER)
     "instance",   # fleet-merge node id (metrics.merge_prometheus)
     "kind",       # operation kind within a group (bounded enum)
     "model",      # serving model sign

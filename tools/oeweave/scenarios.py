@@ -113,6 +113,84 @@ def sync_subscriber() -> None:
     assert st["applied"] == (st["version"] or 0)
 
 
+# -- Subscriber lineage bookkeeping: hop records vs readers vs note_serve -----
+
+
+def sync_lineage() -> None:
+    """Concurrent `_record_lineage`/`_note_clock` writers racing `status()`
+    readers, duplicate `note_serve` calls, and a `LineageBook.export` reader.
+    Invariants: the `last_hops` snapshot in status() is untorn (every hop in
+    the snapshot encodes the snapshot's own step), `first_serve` is written
+    exactly once under a duplicate-predict race (its serve hop agrees with
+    whichever call won), the clock-offset EWMA of a constant sample stays at
+    that constant, and export() never tears mid-record."""
+    from openembedding_tpu.sync import lineage
+    from openembedding_tpu.sync import subscriber as sub
+
+    s = sub.SyncSubscriber(manager=None, model_sign="m", feed="http://feed",
+                           interval_s=0.01)
+    book = lineage.LineageBook(capacity=8)  # local: schedules must not share
+    # pre-seed the served delta: note_serve on an unknown record is a no-op,
+    # and the duplicate-serve race must not depend on beating the writer
+    book.record("m", 2, swapped=2.0)
+
+    def writer() -> None:
+        for k in range(1, 5):
+            b = 10.0 * k
+            with s._mu:
+                s._births[k] = b
+                s._head_times[k] = b
+                s._feed_seen[k] = b
+            # every local-domain hop of step k is exactly k*10ms — a torn
+            # snapshot mixing two steps' hops is mechanically detectable
+            s._record_lineage(k, b + k * 0.01, b + 2 * k * 0.01,
+                              b + 3 * k * 0.01)
+            book.record("m", k, swapped=float(k))
+            time.sleep(0.002)
+
+    def clocker() -> None:
+        for _ in range(6):
+            s._note_clock(2000.5, 1999.9, 2000.1)  # offset exactly +0.5s
+            time.sleep(0.002)
+
+    def reader() -> None:
+        for _ in range(6):
+            st = s.status()
+            lh = st.get("last_hops")
+            if lh is not None:
+                k = lh["step"]
+                for hop in ("fetch", "apply", "swap"):
+                    got = lh["hops"][hop]
+                    assert abs(got - k * 10.0) < 0.5, \
+                        f"torn last_hops: step {k} {hop}={got}"
+            off = st.get("clock_offset_ms") or 0.0
+            assert 0.0 <= off <= 500.0 + 1e-6, f"offset escaped EWMA: {off}"
+            for rec in book.export():
+                assert rec.get("step") is not None, f"torn export: {rec}"
+            time.sleep(0.002)
+
+    def server(now: float) -> None:
+        book.note_serve("m", 2, now=now)
+
+    threads = ([threading.Thread(target=writer, name="write")]
+               + [threading.Thread(target=clocker, name="clock")]
+               + [threading.Thread(target=reader, name=f"read{i}")
+                  for i in range(2)]
+               + [threading.Thread(target=server, args=(n,), name=f"srv{n}")
+                  for n in (2.25, 9.0)])
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    rec = book.get("m", 2)
+    assert rec is not None and rec.get("first_serve") in (2.25, 9.0), rec
+    # the serve hop must agree with whichever duplicate won first_serve
+    want = (rec["first_serve"] - 2.0) * 1e3
+    assert abs(rec["hops"]["serve"] - want) < 1e-6, rec
+    off_ms = s.status()["clock_offset_ms"]
+    assert abs(off_ms - 500.0) < 1e-6, f"EWMA of constant drifted: {off_ms}"
+
+
 # -- MicroBatcher: leader/follower window under the shared condition ----------
 
 
@@ -487,6 +565,7 @@ def parse_pool() -> None:
 
 SCENARIOS: Dict[str, Callable[[], None]] = {
     "sync_subscriber": sync_subscriber,
+    "sync_lineage": sync_lineage,
     "micro_batcher": micro_batcher,
     "periodic_reporter": periodic_reporter,
     "placement_watcher": placement_watcher,

@@ -32,15 +32,66 @@ import urllib.request
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def _check_timeline(urls, *, version, log):
+    """Scrape the soak nodes' /timelinez through tools/fleet_timeline and
+    assert delta `version`'s commit->publish->fetch->apply->swap->
+    first-predict chain merges contiguous and correctly ordered. Retries the
+    scrape briefly: first-predict lands on the hammer's first post-swap hit,
+    a few ms after the drain loop saw the version flip."""
+    from tools import fleet_timeline as ftl
+    want_full = ("birth", "commit", "publish", "fetch", "apply", "swap",
+                 "first_predict")
+    labels, ts, items = [], [], []
+    deadline = time.monotonic() + 10
+    while True:
+        nodes_data = []
+        for u in urls:
+            doc, offset = ftl.probe(u, probes=3)
+            nodes_data.append((doc.get("node") or u, doc, offset))
+        items = ftl.merge(nodes_data)
+        # both soak nodes live in ONE process and share the lineage book
+        # (same node id, two scrape offsets), so the merged view carries the
+        # chain twice: judge ONE node's copy of it
+        chain = [it for it in ftl.merge(nodes_data[-1:])
+                 if it["kind"] == "DELTA" and it.get("step") == version]
+        labels = [it["what"].split()[1] for it in chain]
+        ts = [it["ts"] for it in chain]
+        if "first_predict" in labels or time.monotonic() >= deadline:
+            break
+        time.sleep(0.1)
+    want = [l for l in want_full if l in labels]
+    ok = (labels == want
+          and {"commit", "publish", "fetch", "apply", "swap",
+               "first_predict"} <= set(labels)
+          and all(a <= b for a, b in zip(ts, ts[1:])))
+    log(f"timeline chain for delta {version}: {labels} ok={ok}")
+    assert ok, {"timeline_chain": labels, "version": version}
+    return {"merged_items": len(items), "chain": labels, "chain_ok": ok}
+
+
 def run(*, steps=24, persist_every=2, interval_s=0.05, workdir="/tmp/oetpu_sync_soak",
         predict_threads=4, wire="fp32", vocab=1 << 10, batch=16, dim=4,
         lag_bound_steps=None, step_delay_s=0.0, quiet=False,
-        metrics_log=None, sentinel=True, measure_every=8):
+        metrics_log=None, sentinel=True, measure_every=8,
+        stall_s=0.0, stall_after_frac=0.4, freshness_threshold_ms=None,
+        timeline=False):
     """-> report dict (see asserts at the bottom). Raises AssertionError when
     the soak's invariants break. The report carries the SLO verdicts
     (`utils/slo.DEFAULT_SLOS` judged once at exit over everything the soak
     observed — predict latency, sync freshness, sentinel numerics) and
-    `slo_exit_code`, which `main()` adopts as the process exit status."""
+    `slo_exit_code`, which `main()` adopts as the process exit status.
+
+    `stall_s > 0` runs the CAUSALITY acceptance scenario: once the trainer
+    passes `stall_after_frac` of its steps, the publisher's delta PAYLOADS
+    are withheld for `stall_s` seconds (the feed keeps advancing, so the
+    subscriber sees an ever-older head birth and `sync.freshness_ms` grows)
+    — the `serving_freshness` SLO (threshold `freshness_threshold_ms`,
+    default stall_s/2) must flip to BREACHED mid-run with the stalled hop
+    dominating `sync.hop_ms{hop="fetch"}`, then recover to OK once the
+    stall lifts and a post-stall delta lands. `timeline=True` additionally
+    scrapes both nodes' /timelinez pre-shutdown and asserts the last
+    delta's commit->publish->fetch->apply->swap->first-predict chain merges
+    contiguous and correctly ordered (`tools/fleet_timeline.py`)."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import numpy as np
 
@@ -114,8 +165,28 @@ def run(*, steps=24, persist_every=2, interval_s=0.05, workdir="/tmp/oetpu_sync_
     srv.manager.load_model(sign, export_dir)
     log(f"publisher {pub_url} feeds {root}; serving node {srv_url}")
 
+    # tight backoff cap when a stall is planned: the DEGRADED retry loop
+    # must re-probe fast enough to recover within the post-stall drain
     sub = SyncSubscriber(srv.manager, sign, pub_url, wire=wire,
-                         interval_s=interval_s)
+                         interval_s=interval_s,
+                         max_backoff_s=max(4 * interval_s, 0.25)
+                         if stall_s > 0 else 30.0)
+
+    from openembedding_tpu.utils import slo
+    prior_specs = slo.EVALUATOR.specs
+    if stall_s > 0:
+        # re-anchor serving_freshness to the soak's scale: the stock 30s
+        # threshold would never trip on a CI-sized stall
+        thr = float(freshness_threshold_ms
+                    if freshness_threshold_ms is not None
+                    else stall_s * 500.0)
+        specs = [s for s in prior_specs if s.name != "serving_freshness"]
+        specs.append(slo.SLOSpec(
+            name="serving_freshness", metric="sync.freshness_ms",
+            selector="value", op="<=", threshold=thr, fast_window_s=0.0,
+            slow_window_s=300.0, burn_threshold=1e-9,
+            description=f"soak-scaled freshness bound ({thr:.0f}ms)"))
+        slo.configure(specs)
 
     # predict hammer: live traffic across every swap
     stop = threading.Event()
@@ -163,19 +234,80 @@ def run(*, steps=24, persist_every=2, interval_s=0.05, workdir="/tmp/oetpu_sync_
         train_done.set()
 
     max_lag = 0
+    stall = {"on": False, "done": stall_s <= 0, "orig": None,
+             "denied": 0, "first_deny": None}
+    stall_after_step = max(2, int(steps * stall_after_frac))
+    slo_track = {"breached": False, "recovered": False}
+
+    def _slo_tick():
+        v = {x["name"]: x["verdict"]
+             for x in slo.EVALUATOR.evaluate_now()}.get("serving_freshness")
+        if v == "BREACHED":
+            slo_track["breached"] = True
+        elif v == "OK" and slo_track["breached"]:
+            slo_track["recovered"] = True
+
+    def _stall_tick():
+        # withhold delta PAYLOADS, not the feed: the head keeps advancing,
+        # so the subscriber sees an ever-older unapplied birth (freshness
+        # grows) while its payload fetches 404 into DEGRADED retries —
+        # which is exactly the time the `fetch` hop is defined to absorb
+        pub = pub_srv.publishers[sign]
+        if (not stall["done"] and not stall["on"]
+                and trained["step"] >= stall_after_step):
+            stall["orig"] = pub.delta_meta
+
+            def _withheld(step):
+                # the stall window is anchored to the FIRST fetch actually
+                # denied — a wall-clock window could race the training pace
+                # and cover no delta at all
+                if stall["first_deny"] is None:
+                    stall["first_deny"] = time.monotonic()
+                stall["denied"] += 1
+                raise KeyError(f"soak stall: delta {step} payload withheld")
+
+            pub.delta_meta = _withheld
+            stall["on"] = True
+            log(f"stall ON at step {trained['step']}: withholding payloads "
+                f"for {stall_s}s past the first denied fetch")
+        elif stall["on"] and (train_done.is_set()
+                              or (stall["first_deny"] is not None
+                                  and time.monotonic()
+                                  >= stall["first_deny"] + stall_s)):
+            pub.delta_meta = stall["orig"]
+            stall["on"], stall["done"] = False, True
+            log(f"stall OFF after {stall['denied']} denied fetches")
+
     t0 = time.monotonic()
     trainer_thread = threading.Thread(target=train, daemon=True)
     trainer_thread.start()
     sub.start()
+    timeline_report = None
     try:
         while not train_done.is_set():
             time.sleep(interval_s)
             max_lag = max(max_lag, trained["step"] - (sub.version or 1))
+            if stall_s > 0:
+                _stall_tick()
+                _slo_tick()
+        if stall["on"]:
+            _stall_tick()  # training ended first: force the stall off
         # drain: let the subscriber reach the final committed step
         deadline = time.monotonic() + 60
         final = trained["step"] - (trained["step"] - 1) % persist_every
         while (sub.version or 0) < final and time.monotonic() < deadline:
             time.sleep(interval_s)
+            if stall_s > 0:
+                _slo_tick()
+        if stall_s > 0:
+            # settle: a post-stall delta's fresh sample must re-judge OK
+            settle = time.monotonic() + 10
+            while not slo_track["recovered"] and time.monotonic() < settle:
+                _slo_tick()
+                time.sleep(interval_s)
+        if timeline:
+            timeline_report = _check_timeline([pub_url, srv_url],
+                                              version=sub.version, log=log)
     finally:
         sub.stop()
         stop.set()
@@ -212,24 +344,44 @@ def run(*, steps=24, persist_every=2, interval_s=0.05, workdir="/tmp/oetpu_sync_
     # the SLO gate: judge everything the soak observed (predict latency
     # hists, sync freshness gauges, sentinel numerics) against the stock
     # objectives — the process-exit verdict main() adopts
-    from openembedding_tpu.utils import slo
     verdicts = slo.EVALUATOR.evaluate_now()
     report["slo"] = {v["name"]: v["verdict"] for v in verdicts}
     report["slo_exit_code"] = slo.EVALUATOR.exit_code()
     log("SLOs:\n" + slo.EVALUATOR.render_text())
+    if stall_s > 0:
+        slo.configure(prior_specs)  # un-shadow the stock serving_freshness
+        # stalled-hop attribution: the max over each sync.hop_ms{hop=} hist —
+        # the withheld-payload window is DEGRADED retry time, which the
+        # `fetch` hop is defined to absorb, so fetch must dominate
+        from openembedding_tpu.utils import metrics as metrics_mod
+        with metrics_mod._LOCK:
+            hop_max = {a.labels.get("hop", "?"): a.hist_snapshot()[4]
+                       for a in metrics_mod._REGISTRY.values()
+                       if a.name == "sync.hop_ms" and a.count}
+        stalled_hop = max(hop_max, key=hop_max.get) if hop_max else None
+        report["freshness_breached"] = slo_track["breached"]
+        report["freshness_recovered"] = slo_track["recovered"]
+        report["hop_max_ms"] = {k: round(v, 3) for k, v in hop_max.items()}
+        report["stalled_hop"] = stalled_hop
+    if timeline_report is not None:
+        report["timeline"] = timeline_report
     log(json.dumps(report, indent=2))
     assert report["failed_predicts"] == 0, report
     assert report["final_lag_steps"] == 0, report
     assert report["swaps"] >= 1, report
     if lag_bound_steps is not None:
         assert max_lag <= lag_bound_steps, report
+    if stall_s > 0:
+        assert report["freshness_breached"], report
+        assert report["freshness_recovered"], report
+        assert report["stalled_hop"] == "fetch", report
     return report
 
 
 #: the soak topology's actors, as oeweave scenarios: subscriber state
-#: machine, serving batcher, persister, telemetry reporter
-WEAVE_SCENARIOS = ("sync_subscriber", "micro_batcher", "async_persister",
-                   "periodic_reporter")
+#: machine + its lineage bookkeeping, serving batcher, persister, reporter
+WEAVE_SCENARIOS = ("sync_subscriber", "sync_lineage", "micro_batcher",
+                   "async_persister", "periodic_reporter")
 
 
 def run_weave(*, schedules=8, sweep=12, seed=0, quiet=False):
@@ -293,6 +445,21 @@ def main(argv=None):
     ap.add_argument("--metrics-log", default=None, metavar="PATH",
                     help="append periodic accumulator reports (and a final "
                          "snapshot) as timestamped JSONL records to PATH")
+    ap.add_argument("--stall-s", type=float, default=0.0,
+                    help="withhold publisher delta payloads for this many "
+                         "seconds mid-run (the causality acceptance "
+                         "scenario: serving_freshness must flip BREACHED "
+                         "with the fetch hop dominating, then recover)")
+    ap.add_argument("--stall-after-frac", type=float, default=0.4,
+                    help="engage the stall once the trainer passes this "
+                         "fraction of its steps")
+    ap.add_argument("--freshness-threshold-ms", type=float, default=None,
+                    help="soak-scaled serving_freshness threshold while "
+                         "stalling (default stall_s/2 in ms)")
+    ap.add_argument("--timeline", action="store_true",
+                    help="scrape both nodes' /timelinez pre-shutdown and "
+                         "assert the last delta's lineage chain merges "
+                         "contiguous and ordered (tools/fleet_timeline)")
     ap.add_argument("--no-slo-gate", action="store_true",
                     help="report SLO verdicts but exit 0 regardless "
                          "(default: exit with the SLO verdict — 0 all OK, "
@@ -321,7 +488,10 @@ def main(argv=None):
                  predict_threads=args.predict_threads, wire=args.wire,
                  workdir=args.workdir, lag_bound_steps=args.lag_bound_steps,
                  step_delay_s=args.step_delay_s,
-                 metrics_log=args.metrics_log)
+                 metrics_log=args.metrics_log, stall_s=args.stall_s,
+                 stall_after_frac=args.stall_after_frac,
+                 freshness_threshold_ms=args.freshness_threshold_ms,
+                 timeline=args.timeline)
     print(json.dumps(report))
     return 0 if args.no_slo_gate else report["slo_exit_code"]
 

@@ -1,8 +1,9 @@
-"""Turn a `trace.dump_chrome()` dump into a per-group latency table.
+"""Turn `trace.dump_chrome()` dumps into a latency table or a stitched tree.
 
     python tools/trace_report.py /tmp/serving_trace.json
     python tools/trace_report.py /tmp/serving_trace.json --by name --sort p99
     python tools/trace_report.py http://127.0.0.1:8501/tracez
+    python tools/trace_report.py sub_dump.json pub_dump.json --trace <rid>
 
 Reads the Chrome-trace JSON the flight recorder exports (`utils/trace.py
 dump_chrome`, serving `--trace-dump`, examples `--trace-dump`) — or, given
@@ -12,6 +13,14 @@ events per span name (or per group/category with `--by group`) and prints
 count / mean / p50 / p95 / p99 / max / total milliseconds — the offline twin
 of the live `/metrics` histograms, with the advantage that it works on a
 dump mailed from a production node.
+
+`--trace <request_id>` switches to the STITCHED-TREE view: spans of that
+trace are collected across every given dump (one per process — e.g. the
+subscriber node's and the publisher node's), linked by their
+process-qualified `span_uid`/`parent_uid` args and, ACROSS the HTTP
+boundary, by `remote_parent` (the caller's span uid the callee's root span
+recorded off the `X-OETPU-Trace` header), and printed as one indented
+cross-process tree.
 """
 
 from __future__ import annotations
@@ -27,9 +36,20 @@ def _tracez_events(doc: dict) -> List[dict]:
     already understands (ms -> us for `dur`)."""
     out = []
     for s in doc.get("spans", []):
+        proc = s.get("process")
+        args = {k: v for k, v in (("request_id", s.get("request_id")),
+                                  ("span_id", s.get("span_id")),
+                                  ("remote_parent", s.get("remote_parent")))
+                if v is not None}
+        if proc is not None and s.get("span_id") is not None:
+            args["span_uid"] = f"{proc}:{s['span_id']}"
+            if s.get("parent_id") is not None:
+                args["parent_uid"] = f"{proc}:{s['parent_id']}"
         out.append({"ph": "X", "name": str(s.get("name", "?")),
                     "cat": str(s.get("group", "?")),
-                    "dur": float(s.get("duration_ms") or 0.0) * 1e3})
+                    "ts": float(s.get("start") or 0.0) * 1e6,
+                    "dur": float(s.get("duration_ms") or 0.0) * 1e3,
+                    "args": args})
     return out
 
 
@@ -97,18 +117,72 @@ def format_table(rows: List[dict]) -> str:
     return "\n".join(lines)
 
 
+def trace_tree(events: List[dict], request_id: str) -> List[str]:
+    """One trace's spans across N processes' dumps as an indented tree.
+
+    Spans link locally by `span_uid` -> `parent_uid` and across the HTTP
+    boundary by `remote_parent` (both args `chrome_events` emits); a span
+    whose parent is in no dump renders as a root. Siblings sort by start
+    time. Lines carry the owning process id so the hop between processes is
+    visible in the stitched rendering."""
+    spans = [ev for ev in events
+             if ev.get("ph") == "X"
+             and (ev.get("args") or {}).get("request_id") == request_id
+             and (ev.get("args") or {}).get("span_uid")]
+    by_uid = {ev["args"]["span_uid"]: ev for ev in spans}
+    children: Dict[str, List[dict]] = {}
+    roots = []
+    for ev in spans:
+        a = ev["args"]
+        parent = a.get("parent_uid") or a.get("remote_parent")
+        if parent is not None and parent in by_uid:
+            children.setdefault(parent, []).append(ev)
+        else:
+            roots.append(ev)
+    lines: List[str] = []
+
+    def emit(ev: dict, depth: int) -> None:
+        a = ev["args"]
+        proc = str(a.get("span_uid", ":")).split(":")[0]
+        hop = " <-remote" if (a.get("remote_parent")
+                              and not a.get("parent_uid")) else ""
+        lines.append(f"{'  ' * depth}{ev.get('cat', '?')}.{ev['name']} "
+                     f"[{proc}] {float(ev.get('dur', 0.0)) / 1e3:.3f}ms"
+                     f"{hop}")
+        for c in sorted(children.get(a["span_uid"], []),
+                        key=lambda e: float(e.get("ts", 0.0))):
+            emit(c, depth + 1)
+
+    for r in sorted(roots, key=lambda e: float(e.get("ts", 0.0))):
+        emit(r, 0)
+    return lines
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
-        description="per-group latency table from a trace.dump_chrome() dump")
-    ap.add_argument("dump", help="Chrome-trace JSON path, or a live node's "
-                                 "http(s)://host:port[/tracez] URL")
+        description="per-group latency table (or, with --trace, a stitched "
+                    "cross-process span tree) from trace.dump_chrome() dumps")
+    ap.add_argument("dump", nargs="+",
+                    help="Chrome-trace JSON path(s), or live node "
+                         "http(s)://host:port[/tracez] URL(s)")
     ap.add_argument("--by", choices=("name", "group"), default="name",
                     help="aggregate per span name (default) or per group")
     ap.add_argument("--sort", choices=("p50", "p95", "p99", "mean", "max",
                                        "total", "count"), default="p99",
                     help="sort column (descending)")
+    ap.add_argument("--trace", default=None, metavar="REQUEST_ID",
+                    help="render ONE trace as a stitched cross-process span "
+                         "tree instead of the latency table")
     args = ap.parse_args(argv)
-    rows = report(load_events(args.dump), by=args.by)
+    events: List[dict] = []
+    for path in args.dump:
+        events.extend(load_events(path))
+    if args.trace is not None:
+        lines = trace_tree(events, args.trace)
+        print("\n".join(lines) if lines
+              else f"(no spans for trace {args.trace!r})")
+        return 0
+    rows = report(events, by=args.by)
     key = args.sort if args.sort == "count" else f"{args.sort}_ms"
     rows.sort(key=lambda r: r[key], reverse=True)
     print(format_table(rows))

@@ -208,6 +208,11 @@ CASES = [
     #     vs all off — the <= 2% overhead acceptance bound). Two compiles of
     #     the 1-device mesh step (obs on/off), budget sized like health.
     ("bench_obs2", *bench_case("obs2", 700)),
+    # 17. round-22 fleet-causality layer (bench 'causality' case: per-step
+    #     loop with trace-context inject/extract + lineage bookkeeping vs
+    #     off — the <= 2% overhead acceptance bound). Two compiles of the
+    #     1-device mesh step (on/off), budget sized like health/obs2.
+    ("bench_causality", *bench_case("causality", 700)),
 ]
 
 
