@@ -48,7 +48,7 @@ throughput, not host dispatch latency — the same way production input pipeline
 drive TPUs (the axon tunnel adds ~40 ms per dispatch that would otherwise swamp
 the measurement; see PERF.md "Measurement hygiene").
 
-Env knobs: OETPU_BENCH_CASES=dim9[,dim64][,mesh1][,mesh1f][,pull][,wire][,wire_inband][,sync][,skew][,hot][,placement][,zero][,offload_pipe][,pipeline][,ingest][,health][,obs2] (default: all),
+Env knobs: OETPU_BENCH_CASES=dim9[,dim64][,mesh1][,mesh1f][,pull][,wire][,wire_inband][,sync][,skew][,hot][,placement][,zero][,zero_sparse][,offload_pipe][,pipeline][,ingest][,health][,obs2] (default: all),
 OETPU_BENCH_BUDGET_S (default 540), OETPU_BENCH_SCAN_STEPS / _REPEATS (smoke runs),
 OETPU_BENCH_TOTAL_BUDGET_S / _PROBE_TIMEOUT_S / _PROBE_INTERVAL_S (orchestrator).
 """
@@ -1057,6 +1057,162 @@ def case_zero():
     return out
 
 
+def case_zero_sparse():
+    """Round-20 sparsity-aware dense collectives: dense_wire="sparse_topk"
+    vs the int8 and fp32 dense-grad wires across a PLANTED gradient-density
+    sweep. The tower is one wide Dense(1) over D input features with only a
+    density-p column subset ever nonzero, so the kernel gradient's density
+    is p by construction and the crossover math is measurable, not assumed.
+    Per density: the dense-grad exchange bytes of all three wires from the
+    COMPILED HLO (`collective_payloads` — reduce_scatter f32 result bytes
+    for fp32, the s8 a2a payload for int8/sparse; the sparse-table exchange
+    stays fp32 so the s8 bytes are exactly the dense-grad wire), the
+    measured `dense.grad_density` gauge vs planted p, the policy's
+    crossover verdict (`recommend_dense_wire`), and final-loss parity vs
+    the fp32 control. Asserted floors: in the sparse regime the top-k wire
+    ships <= 0.5x the int8 dense path's grad bytes, the policy picks sparse
+    below the crossover and dense above it, and every wire's loss tracks
+    fp32. Needs S >= 2; the battery entry rides the 8-virtual-device CPU
+    mesh."""
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+    import openembedding_tpu as embed
+    from openembedding_tpu.model import EmbeddingModel
+    from openembedding_tpu.parallel import MeshTrainer, make_mesh
+    from openembedding_tpu.placement.policy import PlacementPolicy
+    from openembedding_tpu.utils import metrics as metrics_mod
+    from tools.oelint.passes.hlo_budget import collective_payloads
+
+    WD.stage("zero_sparse:init", 240)
+    devs = jax.devices()
+    S = min(8, len(devs))
+    if S < 2:
+        return {"skipped": "needs S >= 2 shards (battery entry runs the "
+                           "8-virtual-device CPU mesh)"}
+    mesh = make_mesh(devs[:S])
+    cpu = devs[0].platform == "cpu"
+    D = int(os.environ.get("OETPU_BENCH_SPARSE_D", str(8192)))
+    vocab = 1 << 10
+    batch = min(BATCH, 256) if cpu else BATCH
+    steps = 6
+    densities = (0.01, 0.1, 0.5)
+
+    class Tower(nn.Module):
+        @nn.compact
+        def __call__(self, embedded, dense):
+            first = jnp.sum(embedded["e"][..., 0].astype(jnp.float32),
+                            axis=1)
+            return nn.Dense(1, use_bias=False)(dense)[..., 0] + first
+
+    def build():
+        return EmbeddingModel(Tower(),
+                              [embed.Embedding(vocab, 1, name="e")])
+
+    def stream(p, seed=31):
+        # the density-p column subset is fixed for the sweep point: a
+        # column outside it never sees a nonzero input, so its kernel
+        # gradient is exactly zero every step
+        rng = np.random.default_rng(seed)
+        cols = rng.choice(D, size=max(1, int(round(p * D))), replace=False)
+        bs = []
+        for _ in range(steps):
+            x = np.zeros((batch, D), np.float32)
+            x[:, cols] = rng.standard_normal(
+                (batch, cols.size)).astype(np.float32)
+            bs.append({"sparse": {"e": rng.integers(
+                0, vocab, (batch, 4)).astype(np.int32)},
+                "dense": x,
+                "label": rng.integers(0, 2, (batch,)).astype(np.float32)})
+        return bs
+
+    pol = PlacementPolicy(hot_budget_bytes=0)
+
+    def one_config(name, bs, dense_wire, dense_topk=None):
+        WD.stage(f"zero_sparse:{name}", 600)
+        metrics_mod._REGISTRY.clear()
+        tr = MeshTrainer(build(), embed.Adagrad(learning_rate=0.05),
+                         mesh=mesh, capacity_factor=0.0, wire="fp32",
+                         dense_shard=True, dense_wire=dense_wire,
+                         dense_topk=dense_topk, dense_stats=True)
+        state = tr.init(bs[0])
+        step = tr.jit_train_step(bs[0], state)
+        txt = step.lower(state, bs[0]).compile().as_text()
+        pay = collective_payloads(txt, kinds=("all_to_all", "all_gather",
+                                              "reduce_scatter"))
+        s8_a2a = sum(b for k, d, b in pay
+                     if k == "all_to_all" and d == "s8")
+        rs = sum(b for k, _d, b in pay if k == "reduce_scatter")
+        loss = None
+        for b in bs:
+            state, m = step(state, b)
+            loss = float(m["loss"])
+        metrics_mod.record_step_stats(m["stats"])
+        rep = metrics_mod.report()
+        out = {"grad_wire_bytes": int(s8_a2a if dense_wire else rs),
+               "loss_final": loss,
+               "measured_density": round(
+                   float(rep.get("dense.grad_density", 0.0)), 4)}
+        if dense_wire == "sparse_topk":
+            out["k"] = int(rep.get("dense.grad_topk", 0))
+            out["wire_bytes_saved"] = int(
+                rep.get("dense.wire_bytes_saved", 0))
+        return out
+
+    out = {"num_shards": S, "dense_features": D, "batch": batch,
+           "steps": steps, "crossover": pol.dense_wire_crossover}
+    chunk = None
+    for p in densities:
+        bs = stream(p)
+        tag = f"p{p}"
+        fp32 = one_config(f"{tag}_fp32", bs, None)
+        int8 = one_config(f"{tag}_int8", bs, "int8")
+        if chunk is None:
+            # the ZeRO chunk is a model static — read it once for the
+            # policy's k sizing (margin over planted nnz per chunk)
+            tr0 = MeshTrainer(build(), embed.Adagrad(learning_rate=0.05),
+                              mesh=mesh, dense_shard=True,
+                              dense_wire="sparse_topk")
+            st0 = tr0.init(bs[0])
+            chunk = tr0._zero_plan_for(tr0._dense_trainable(st0)).chunk
+        k = pol._dense_topk(p, chunk)
+        sparse = one_config(f"{tag}_sparse", bs, "sparse_topk",
+                            dense_topk=k)
+        mode, _k, reason = pol.recommend_dense_wire(
+            fp32["measured_density"], "int8", chunk=chunk)
+        row = {"planted_density": p, "fp32": fp32, "int8": int8,
+               "sparse_topk": sparse,
+               "policy": {"mode": mode, "reason": reason},
+               "sparse_vs_int8_bytes": round(
+                   sparse["grad_wire_bytes"]
+                   / max(int8["grad_wire_bytes"], 1), 3)}
+        for cfg in (int8, sparse):
+            row.setdefault("loss_delta_vs_fp32_max", 0.0)
+            row["loss_delta_vs_fp32_max"] = round(max(
+                row["loss_delta_vs_fp32_max"],
+                abs(cfg["loss_final"] - fp32["loss_final"])), 6)
+        out[tag] = row
+        # loss parity: every wire trains to the fp32 control's loss
+        assert np.isfinite(sparse["loss_final"]), row
+        np.testing.assert_allclose(sparse["loss_final"],
+                                   fp32["loss_final"], rtol=0.02, atol=0.02)
+        np.testing.assert_allclose(int8["loss_final"],
+                                   fp32["loss_final"], rtol=0.02, atol=0.02)
+    out["chunk"] = int(chunk)
+    # the acceptance floor: in the sparse regime the top-k wire ships at
+    # most half the int8 dense path's grad bytes (compiled-HLO accounting)
+    assert out["p0.01"]["sparse_vs_int8_bytes"] <= 0.5, out["p0.01"]
+    # the policy sits on the right side of the crossover at both ends
+    assert out["p0.01"]["policy"]["mode"] == "sparse_topk", out["p0.01"]
+    assert out["p0.5"]["policy"]["mode"] == "int8", out["p0.5"]
+    # the density gauge reports the planted fraction (the decision input
+    # is measured, not configured)
+    for p in densities:
+        md = out[f"p{p}"]["fp32"]["measured_density"]
+        assert abs(md - p) <= max(0.25 * p, 0.005), (p, md)
+    return out
+
+
 def case_wire_total():
     """Round-17 bytes endgame: TOTAL compiled-HLO wire bytes per step —
     sparse exchange a2as + hot-row reduce + dense grad/param collectives —
@@ -1529,8 +1685,8 @@ def main():
     cases = os.environ.get(
         "OETPU_BENCH_CASES",
         "dim9,dim64,mesh1,mesh1f,pull,wire,wire_inband,sync,skew,hot,"
-        "placement,zero,wire_total,offload_pipe,pipeline,ingest,"
-        "health,obs2,causality").split(",")
+        "placement,zero,zero_sparse,wire_total,offload_pipe,pipeline,"
+        "ingest,health,obs2,causality").split(",")
 
     # PRIMARY first: whatever happens later, this number is in the artifact.
     if "dim9" in cases:
@@ -1551,6 +1707,7 @@ def main():
                  ("hot", case_hot),
                  ("placement", case_placement),
                  ("zero", case_zero),
+                 ("zero_sparse", case_zero_sparse),
                  ("wire_total", case_wire_total),
                  ("offload_pipe", case_offload_pipe),
                  ("pipeline", case_pipeline),

@@ -660,6 +660,7 @@ class Trainer:
             # quantity in both the allreduce and the ZeRO (unreduced-here)
             # paths
             raw_dense_grads = dense_grads if self.sentinel else None
+            stats.update(self.dense_grad_stats(dense_grads))
             dense_grads = self.reduce_dense_grads(dense_grads)
 
         with _trace.span("trainer", "apply"):
@@ -732,6 +733,15 @@ class Trainer:
 
     def reduce_dense_grads(self, grads):
         return grads
+
+    def dense_grad_stats(self, grads):
+        """Stats read off the PRE-reduction dense grads (they ride the
+        step's per-key stats psum like everything else in `stats`).
+        Default: none. MeshTrainer(dense_stats=True) publishes the
+        `dense/grad_density` nonzero fraction the sparse dense-wire policy
+        prices against."""
+        del grads
+        return {}
 
     def dense_update(self, params, slots, grads):
         """Apply the dense optimizer update. `grads` arrive already reduced

@@ -343,6 +343,63 @@ def decode_grads(wire: jax.Array, dim: int, fmt: str):
 
 
 # ---------------------------------------------------------------------------
+# Sparse top-k payloads (the dense ZeRO grad exchange, dense_wire=
+# "sparse_topk"). SparCML (arxiv 1802.08021) stream-sparse collectives with
+# the house in-band layout: k is a TRACE-TIME constant, so the payload shape
+# is static and the sparse mode compiles to ordinary fixed-shape a2as — no
+# host round-trip, no dynamic shapes. Each payload row carries the k
+# largest-|x| elements of a dense vector as int8 value lanes (per-
+# INBAND_BLOCK fp32 scales in-band, same codec as rows) followed by their
+# int32 column indices bitcast into 4 trailing int8 lanes per value.
+# Untransmitted elements decode to EXACT zeros, so the decode-side residual
+# (x - unpack_topk(pack_topk(x))) is precisely the untransmitted mass the
+# `__dense_ef__` error-feedback slots accumulate.
+# ---------------------------------------------------------------------------
+
+# bitcast int8 lanes per transmitted element's int32 column index
+_INDEX_LANES = 4
+
+
+def topk_wire_width(k: int) -> int:
+    """Wire columns of one sparse top-k payload row in the int8 carrier:
+    k quantized value lanes + their in-band scale lanes + 4 bitcast index
+    lanes per value. Bytes/element ~= 1 + 4 + 4/INBAND_BLOCK ~= 5.125 —
+    the honest sparse price `zero.dense_wire_cost` and the Densifying
+    (arxiv 1905.04035) crossover rule in `PlacementPolicy` both use."""
+    return rows_wire_width(k, "int8") + _INDEX_LANES * k
+
+
+def pack_topk(x: jax.Array, k: int) -> jax.Array:
+    """(n, m) f32 -> (n, topk_wire_width(k)) int8: per row the k largest-
+    magnitude elements, int8-quantized with per-INBAND_BLOCK in-band fp32
+    scales (partial trailing blocks pad exactly like the row codec), plus
+    their int32 column indices bitcast into trailing lanes. k must be a
+    static 1 <= k <= m; top_k index sets are distinct per row, so the
+    decode scatter is collision-free by construction."""
+    n, m = x.shape
+    if not 1 <= k <= m:
+        raise ValueError(f"pack_topk: k={k} outside [1, {m}]")
+    idx = jax.lax.top_k(jnp.abs(x), k)[1]  # (n, k) int32, indices distinct
+    vals = jnp.take_along_axis(x, idx, axis=1)
+    q = _quantize_int8(vals.astype(jnp.float32))
+    lanes = jax.lax.bitcast_convert_type(
+        idx.astype(jnp.int32), jnp.int8).reshape(n, _INDEX_LANES * k)
+    return jnp.concatenate([q, lanes], axis=1)
+
+
+def unpack_topk(wire: jax.Array, k: int, m: int) -> jax.Array:
+    """Inverse of pack_topk -> dense (n, m) f32 with every untransmitted
+    element exactly 0."""
+    n = wire.shape[0]
+    body = rows_wire_width(k, "int8")
+    vals = _dequantize_int8(wire[:, :body], k)
+    idx = jax.lax.bitcast_convert_type(
+        wire[:, body:].reshape(n, k, _INDEX_LANES), jnp.int32)
+    out = jnp.zeros((n, m), jnp.float32)
+    return out.at[jnp.arange(n)[:, None], idx].set(vals)
+
+
+# ---------------------------------------------------------------------------
 # Static wire-cost model (bytes/step, collectives/step) — what the metrics
 # gauges, PERF.md and tools/wire_microbench.py report. The model prices the
 # a2a RESULT buffers (S * cap slots per table, self-shard included), which is

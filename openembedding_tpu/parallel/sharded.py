@@ -163,6 +163,12 @@ class ExchangePlan(NamedTuple):
     # its hash home (None when the table has no directory) — pure accounting,
     # folded into the step stats as `mig_unique`/`mig_hits`
     mig_moved: Optional[jax.Array] = None
+    # pipelined prefetch only, int8 wire with error feedback: the PRE-serve
+    # EF residual this shard gathered for each recv slot, (S, cap, dim) f32
+    # (zeros for annex/invalid slots). Local serving-shard state — never on
+    # the wire — that `grouped_conflict_patch` replays so the patched rows
+    # AND the post-patch residuals are bit-identical to the serial schedule
+    ef_stash: Optional[jax.Array] = None
 
 
 def _bucket_capacity(n: int, num_shards: int, capacity_factor: float) -> int:
@@ -455,7 +461,7 @@ def exchange_load_stats(plan: ExchangePlan, *, axis: str = DATA_AXIS
 
 def _serve_rows(spec: EmbeddingSpec, state: EmbeddingTableState,
                 plan: ExchangePlan, *, train: bool, axis: str,
-                fmt: str = "fp32") -> Tuple[EmbeddingTableState, jax.Array]:
+                fmt: str = "fp32", return_stash: bool = False):
     """Server side of a pull: gather this shard's rows for the received ids.
     With a migration directory, received MIGRATED ids (the indirection routed
     them here because this shard is their assigned owner) read from the annex
@@ -471,7 +477,12 @@ def _serve_rows(spec: EmbeddingSpec, state: EmbeddingTableState,
     server-side compression EF (dist-EF-SGD), sharded like the slots so the
     residual follows its row through checkpoints. Annex (migrated) rows
     quantize WITHOUT a residual — their owner is the assigned shard, not
-    the hash home the ef array is laid out for."""
+    the hash home the ef array is laid out for.
+
+    `return_stash=True` (the pipelined prefetch) returns a third value: the
+    PRE-serve residual gathered per recv slot ((S, cap, dim) f32; None when
+    no EF ran) — `grouped_conflict_patch` replays it against the post-apply
+    weights to reproduce exactly what a serial serve would have shipped."""
     S = jax.lax.axis_size(axis)
     pair = plan.recv_ids.ndim == 3  # (S, cap, 2) split-pair buckets
     flat_recv = (plan.recv_ids.reshape(-1, 2) if pair
@@ -526,7 +537,10 @@ def _serve_rows(spec: EmbeddingSpec, state: EmbeddingTableState,
         M = mig.weights.shape[0]
         arows = lookup_rows(mig.weights, jnp.where(m_found, m_rank, M))
         rows = jnp.where(m_found[:, None], arows.astype(rows.dtype), rows)
+    stash = None
     if fmt == "fp32":
+        if return_stash:
+            return state, rows.reshape(S, plan.cap, spec.output_dim), None
         return state, rows.reshape(S, plan.cap, spec.output_dim)
     # owner-edge encode: the pull a2a operand is already int8/bf16
     from ..ops import wire as wire_mod
@@ -535,13 +549,19 @@ def _serve_rows(spec: EmbeddingSpec, state: EmbeddingTableState,
         # invalid/annex slots index OOB: the gather fills 0, the scatter
         # drops. Duplicate recv slots (one id requested by several sources)
         # gather the same w+ef and write the same residual — deterministic.
-        x = x + state.ef.at[ef_idx].get(mode="fill", fill_value=0)
+        ef_prev = state.ef.at[ef_idx].get(mode="fill", fill_value=0) \
+            .astype(jnp.float32)
+        x = x + ef_prev
         enc = wire_mod.pack_inband(x, fmt)
         ef_new = x - wire_mod.unpack_inband(enc, spec.output_dim, fmt)
         state = state.replace(ef=state.ef.at[ef_idx].set(
             ef_new.astype(state.ef.dtype), mode="drop"))
+        if return_stash:
+            stash = ef_prev.reshape(S, plan.cap, spec.output_dim)
     else:
         enc = wire_mod.pack_inband(x, fmt)
+    if return_stash:
+        return state, enc.reshape(S, plan.cap, -1), stash
     return state, enc.reshape(S, plan.cap, -1)
 
 
@@ -1118,8 +1138,13 @@ def grouped_apply_gradients(
 # `grouped_conflict_patch` re-gathers only the rows batch t's push actually
 # updated, and `grouped_finalize_pull` runs the client tail (hot overlay +
 # duplicate expansion) at consume time. fp32 wire stays bit-exact to the
-# serial `grouped_lookup_train` flow; narrow wire is approximate (the patch
-# re-quantizes and error-feedback residuals are not replayed).
+# serial `grouped_lookup_train` flow; narrow wire re-encodes patched rows
+# with the same deterministic codec the serve uses AND — when the table
+# carries error feedback — replays the pre-serve residual stash
+# (`ExchangePlan.ef_stash`) against the post-apply weights, so the int8 wire
+# is bit-exact to the serial schedule too: patched rows decode to exactly
+# what a serial serve would have shipped, and the post-patch residuals match
+# the serial EF state bit for bit.
 # ---------------------------------------------------------------------------
 
 
@@ -1130,7 +1155,8 @@ def plan_carry(plan: ExchangePlan) -> dict:
     re-attaches them from the prologue's trace-time plan)."""
     return {"uniq": plan.uniq, "buckets": plan.buckets,
             "recv_ids": plan.recv_ids, "recv_valid": plan.recv_valid,
-            "hot_slot": plan.hot_slot, "mig_moved": plan.mig_moved}
+            "hot_slot": plan.hot_slot, "mig_moved": plan.mig_moved,
+            "ef_stash": plan.ef_stash}
 
 
 def plan_from_carry(carry: dict, cap: int, hot_rows: int) -> ExchangePlan:
@@ -1138,7 +1164,7 @@ def plan_from_carry(carry: dict, cap: int, hot_rows: int) -> ExchangePlan:
     carried arrays with the trace-time static ints re-attached."""
     return ExchangePlan(carry["uniq"], carry["buckets"], carry["recv_ids"],
                         carry["recv_valid"], cap, carry["hot_slot"],
-                        hot_rows, carry["mig_moved"])
+                        hot_rows, carry["mig_moved"], carry["ef_stash"])
 
 
 def conflict_patch_cap(cap: int, conflict_factor: float) -> int:
@@ -1195,12 +1221,18 @@ def grouped_prefetch(
                                capacity_factor=capacity_factor, hots=hots,
                                migs=[state.mig for state in states])
     fmt = wire_mod.wire_format(wire)
-    new_states, rows_list = [], []
+    new_states, rows_list, stashed_plans = [], [], []
     for spec, state, plan in zip(specs, states, plans):
-        state, rows = _serve_rows(spec, state, plan, train=True, axis=axis,
-                                  fmt=fmt)
+        state, rows, stash = _serve_rows(spec, state, plan, train=True,
+                                         axis=axis, fmt=fmt,
+                                         return_stash=True)
         new_states.append(state)
         rows_list.append(rows)
+        # the pre-serve EF residuals ride the plan to the conflict patch
+        # (local serving-shard state, zero extra wire)
+        stashed_plans.append(plan._replace(ef_stash=stash)
+                             if stash is not None else plan)
+    plans = stashed_plans
     # same wire flow as grouped_lookup_train: ONE a2a for the group's rows
     stacked = jnp.concatenate(rows_list, axis=1)
     if fmt == "fp32":
@@ -1260,13 +1292,17 @@ def grouped_finalize_pull(specs, states, ids_list, plans, uniq_rows_list):
 
 def _gather_rows_readonly(spec: EmbeddingSpec, state: EmbeddingTableState,
                           flat_recv: jax.Array, flat_valid: jax.Array,
-                          S: int) -> jax.Array:
+                          S: int, *, want_ef_idx: bool = False):
     """Row gather for ids this shard serves, strictly read-only: no hash
     insert (the prefetch already inserted every patched id), no
     error-feedback side effects. Mig-annex-aware exactly like `_serve_rows`;
     packed train_many layouts slice the weight columns out. -> (n, dim) in
-    the table's storage dtype."""
+    the table's storage dtype, plus (with `want_ef_idx`) each row's index
+    into `state.ef` — the SAME index `_serve_rows` computes (OOB for
+    invalid/annex rows), so the conflict patch's replay writes exactly the
+    slots the speculative serve wrote."""
     mig = state.mig
+    ef_idx = None
     if mig is not None:
         m_found, m_rank, _ = _mig_find(mig, flat_recv, flat_valid)
         main_valid = flat_valid & ~m_found
@@ -1284,9 +1320,16 @@ def _gather_rows_readonly(spec: EmbeddingSpec, state: EmbeddingTableState,
         slot = hash_find(state.keys, probe)
         idx = jnp.where((slot < capacity) & main_valid, slot, capacity)
         rows = lookup_rows(state.weights, idx)
+        if want_ef_idx:
+            ef_idx = idx
     else:
         idx = jnp.where(main_valid, flat_recv // S, -1)
         rows = lookup_rows(state.weights, idx)
+        if want_ef_idx:
+            N = state.ef.shape[0] if state.ef is not None \
+                else state.weights.shape[0]
+            ef_idx = jnp.where(main_valid, flat_recv // S,
+                               N).astype(jnp.int32)
     if rows.shape[1] != spec.output_dim:
         # packed weights+slots layout inside train_many's scan
         rows = rows[:, :spec.output_dim]
@@ -1296,6 +1339,8 @@ def _gather_rows_readonly(spec: EmbeddingSpec, state: EmbeddingTableState,
         if arows.shape[1] != spec.output_dim:
             arows = arows[:, :spec.output_dim]
         rows = jnp.where(m_found[:, None], arows.astype(rows.dtype), rows)
+    if want_ef_idx:
+        return rows, ef_idx
     return rows
 
 
@@ -1317,18 +1362,23 @@ def grouped_conflict_patch(
     ships row + origin bucket slot back (slot+1 riding the exact count
     lanes, 0 = empty — the push codec reused verbatim); the client scatters
     them over its speculative unique rows. fp32 wire makes patched rows
-    bit-identical to an unpipelined pull.
+    bit-identical to an unpipelined pull; with error feedback (int8 wire)
+    the serving shard replays the plan's pre-serve residual stash against
+    the post-apply weights — re-encoding x' = w_post + ef_pre and rewriting
+    ef' = x' - deq(q(x')) at the same slots the speculative serve wrote —
+    so patched rows AND residuals match the serial schedule bit for bit.
 
-    Returns (patched_uniq_rows_list, stats_list) with per-table
+    Returns (patched_uniq_rows_list, stats_list, new_states) with per-table
     `conflict_rows` (this source's compacted patch rows — psum to the step
     total) and `conflict_overflow` (members dropped by the pcap budget;
-    those rows keep their one-step-stale value)."""
+    those rows keep their one-step-stale value); `new_states` carries the
+    replayed EF residuals (the input states unchanged otherwise)."""
     from ..ops import wire as wire_mod
     from ..ops.dedup import compact_member_slots, member_mask
     S = jax.lax.axis_size(axis)
     dim = specs[0].output_dim
     fmt = wire_mod.wire_format(wire)
-    payloads, metas = [], []
+    payloads, metas, new_states = [], [], []
     for spec, state, pplan, plan in zip(specs, states, prev_plans, plans):
         cap = plan.cap
         pcap = conflict_patch_cap(cap, conflict_factor)
@@ -1344,11 +1394,34 @@ def grouped_conflict_patch(
         taken = jnp.take_along_axis(plan.recv_ids,
                                     cl[..., None] if pair else cl, axis=1)
         flat_ids = taken.reshape(-1, 2) if pair else taken.reshape(-1)
-        rows = _gather_rows_readonly(spec, state, flat_ids,
-                                     (slots >= 0).reshape(-1), S)
-        payload = wire_mod.encode_grads(
-            rows.astype(jnp.float32),
-            (slots + 1).reshape(-1).astype(jnp.int32), fmt)
+        live = (slots >= 0).reshape(-1)
+        want_ef = (fmt != "fp32" and state.ef is not None
+                   and plan.ef_stash is not None)
+        if want_ef:
+            rows, ef_idx = _gather_rows_readonly(
+                spec, state, flat_ids, live, S, want_ef_idx=True)
+            # x' = post-apply weights + the residual the speculative serve
+            # consumed (stash zeros for annex rows — no EF there, like the
+            # serve); non-live compaction padding masks to zero and its
+            # OOB ef_idx drops the scatter
+            stash = jnp.take_along_axis(
+                plan.ef_stash, cl[..., None], axis=1).reshape(-1, dim)
+            x = rows.astype(jnp.float32) \
+                + jnp.where(live[:, None], stash, 0.0)
+            enc_rows = wire_mod.pack_inband(x, fmt)
+            ef_new = x - wire_mod.unpack_inband(enc_rows, dim, fmt)
+            state = state.replace(ef=state.ef.at[ef_idx].set(
+                ef_new.astype(state.ef.dtype), mode="drop"))
+            payload = jnp.concatenate(
+                [enc_rows, wire_mod.counts_to_lanes(
+                    (slots + 1).reshape(-1).astype(jnp.int32), fmt)],
+                axis=1)
+        else:
+            rows = _gather_rows_readonly(spec, state, flat_ids, live, S)
+            payload = wire_mod.encode_grads(
+                rows.astype(jnp.float32),
+                (slots + 1).reshape(-1).astype(jnp.int32), fmt)
+        new_states.append(state)
         payloads.append(payload.reshape(S, pcap, -1))
         metas.append((pcap, member, oflow))
     recv = jax.lax.all_to_all(jnp.concatenate(payloads, axis=1), axis, 0, 0)
@@ -1375,7 +1448,7 @@ def grouped_conflict_patch(
         stats_list.append({
             "conflict_rows": jnp.sum(member).astype(jnp.int32) - oflow,
             "conflict_overflow": oflow})
-    return patched, stats_list
+    return patched, stats_list, new_states
 
 
 def build_hot_identity(spec: EmbeddingSpec, hot_rows: int, ids64=None, *,

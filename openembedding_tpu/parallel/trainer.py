@@ -48,6 +48,8 @@ class MeshTrainer(Trainer):
                  error_feedback: Optional[bool] = None,
                  dense_shard: bool = False,
                  dense_wire: Optional[str] = None,
+                 dense_topk: Optional[int] = None,
+                 dense_stats: bool = False,
                  offload_pipeline: bool = False,
                  offload_densify: int = 1,
                  offload_stage_depth: int = 1,
@@ -159,18 +161,47 @@ class MeshTrainer(Trainer):
         # error-feedback residual) kept as extra `__zero__` flat slots
         # (parallel/zero.py DENSE_MASTER_KEY / DENSE_EF_KEY). Requires
         # dense_shard; inert at mesh size 1 like everything else here.
+        # dense_wire="sparse_topk" is the stream-sparse variant (round 23,
+        # SparCML arXiv:1802.08021): each replica ships only the k largest-
+        # magnitude elements per destination chunk (int8 values + in-band
+        # scales + bitcast index lanes, `ops.wire.pack_topk`), the receiver
+        # scatter-sums the decoded partials in fp32, and the untransmitted
+        # mass accumulates in the same `__dense_ef__` residual int8 uses.
         if dense_wire in ("fp32", "none"):
             dense_wire = None
         if dense_wire is not None:
-            if dense_wire not in ("bf16", "int8"):
+            if dense_wire not in ("bf16", "int8", "sparse_topk"):
                 raise ValueError(
                     f"dense_wire={dense_wire!r}: expected 'int8', 'bf16', "
-                    "or None/'fp32' (the lossless round-14 path)")
+                    "'sparse_topk', or None/'fp32' (the lossless round-14 "
+                    "path)")
             if not self.dense_shard:
                 raise ValueError(
                     "dense_wire quantizes the ZeRO dense collectives — "
                     "construct MeshTrainer(dense_shard=True, dense_wire=...)")
         self.dense_wire = dense_wire
+        # elements shipped per destination chunk under sparse_topk; None ->
+        # auto-size at plan time (`dense_topk_for`: ~1/16 of the chunk,
+        # rounded up to whole INBAND_BLOCK codec blocks). A trace-time
+        # constant — changing it is a deliberate re-jit
+        # (`set_dense_wire`, counted in dense.wire_rejits).
+        if dense_topk is not None:
+            dense_topk = int(dense_topk)
+            if dense_topk <= 0:
+                raise ValueError(
+                    f"dense_topk={dense_topk}: expected a positive element "
+                    "count (or None to auto-size from the chunk)")
+            if dense_wire != "sparse_topk":
+                raise ValueError(
+                    "dense_topk sizes the sparse_topk payload — construct "
+                    "MeshTrainer(dense_wire='sparse_topk', dense_topk=...)")
+        self.dense_topk = dense_topk
+        # publish the dense.grad_density stat (nonzero fraction of the dense
+        # grad vector, psum-averaged across replicas on the existing per-key
+        # stats psum). Off by default so density-stat-off configs compile
+        # byte-identical HLO; `PlacementController(manage_wire=True)` turns
+        # it on at prime() to feed `PlacementPolicy.recommend_dense_wire`.
+        self.dense_stats = bool(dense_stats)
         # software-pipelined train_many (round 18): prefetch batch t+1's
         # exchange (id plane + speculative row gather) under batch t's dense
         # compute, then re-gather only the rows batch t actually updated (the
@@ -521,6 +552,28 @@ class MeshTrainer(Trainer):
                                               self.num_shards, align=align)
         return self._zero_plan
 
+    @property
+    def dense_ef_enabled(self) -> bool:
+        """Dense wire modes that carry the `__dense_ef__` residual: int8's
+        quantization bias and sparse_topk's untransmitted mass both need
+        error feedback; bf16 truncation is unbiased enough without."""
+        return self.dense_wire in ("int8", "sparse_topk")
+
+    def dense_topk_for(self, plan) -> int:
+        """Resolved trace-time k for dense_wire='sparse_topk': the explicit
+        `dense_topk` clamped to the chunk, else ~1/16 of the chunk rounded
+        up to whole INBAND_BLOCK codec blocks (at the sparse price of ~5.125
+        bytes per transmitted element that default is ~0.28x the int8 dense
+        path's grad bytes — comfortably under the Densifying crossover)."""
+        from ..ops import wire as wire_mod
+        if plan.chunk <= 0:
+            return 0
+        k = self.dense_topk
+        if k is None:
+            k = -(-plan.chunk // 16)
+            k = -(-k // wire_mod.INBAND_BLOCK) * wire_mod.INBAND_BLOCK
+        return max(1, min(int(k), plan.chunk))
+
     def dense_to_sharded(self, state: TrainState) -> TrainState:
         """Baseline per-leaf dense_slots -> the flat sharded form (no-op when
         ZeRO is off or the state is already sharded). Pure concats — a
@@ -539,13 +592,13 @@ class MeshTrainer(Trainer):
             if self.dense_wire:
                 # dense_wire rides two more flat slots: fp32 masters for this
                 # replica's chunk (the all_gather ships a rounded bf16
-                # carrier) and — int8 only — the full-length per-replica
-                # error-feedback residual. Both are derived/zero state:
-                # `unshard_slots` iterates plan slots only, so externalize()
-                # drops them and checkpoints stay byte-identical to a
-                # dense_wire-off run.
+                # carrier) and — int8/sparse_topk — the full-length
+                # per-replica error-feedback residual. Both are derived/zero
+                # state: `unshard_slots` iterates plan slots only, so
+                # externalize() drops them and checkpoints stay
+                # byte-identical to a dense_wire-off run.
                 extra.append(zero.DENSE_MASTER_KEY)
-                if self.dense_wire == "int8":
+                if self.dense_ef_enabled:
                     extra.append(zero.DENSE_EF_KEY)
             out_sh = {zero.ZERO_KEY: {
                 k: NamedSharding(self.mesh,
@@ -558,7 +611,7 @@ class MeshTrainer(Trainer):
                 if self.dense_wire:
                     flat[zero.DENSE_MASTER_KEY] = \
                         zero.flatten_tree(plan, trainable).reshape(1, -1)
-                    if self.dense_wire == "int8":
+                    if self.dense_ef_enabled:
                         flat[zero.DENSE_EF_KEY] = jnp.zeros(
                             (1, plan.num_shards * plan.padded), jnp.float32)
                 return {zero.ZERO_KEY: flat}
@@ -588,8 +641,9 @@ class MeshTrainer(Trainer):
         # all_gather's rounding — the external form must hold the fp32
         # masters instead (exactly what a dense_wire-off run would hold, and
         # what dense_to_sharded seeds the masters from on the way back in).
-        # The int8 error-feedback residual is dropped here and re-seeded to
-        # zeros on load: EF is a convergence aid, not model state.
+        # The int8/sparse_topk error-feedback residual is dropped here and
+        # re-seeded to zeros on load: EF is a convergence aid, not model
+        # state.
         if "master" not in self._zero_fns:
             self._zero_fns["master"] = jax.jit(
                 lambda fm, tr: zero.unflatten_tree(plan, fm.reshape(-1), tr),
@@ -608,6 +662,47 @@ class MeshTrainer(Trainer):
     def externalize(self, state: TrainState) -> TrainState:
         """See Trainer.externalize: placement writeback + dense unshard."""
         return self.dense_to_replicated(self.hot_sync(state))
+
+    def set_dense_wire(self, state: TrainState, dense_wire,
+                       dense_topk=None) -> TrainState:
+        """Flip the dense-gradient wire on a LIVE trainer (the
+        `PlacementController(manage_wire=True)` hook, usable directly too).
+        No-op when the format and k already match. Otherwise: unshard to
+        the external fp32 form (masters land in dense_params, wire-only
+        slots drop), swap the knobs, drop the compiled artifacts — the
+        flat layout's alignment and extra slots are format-dependent, so
+        this is a counted re-jit, not a content swap — and re-shard under
+        the new format. The int8/sparse_topk error-feedback residual
+        re-seeds to zeros, same as a checkpoint round trip."""
+        if dense_wire in (None, "fp32"):
+            dense_wire = None
+        elif dense_wire not in ("int8", "bf16", "sparse_topk"):
+            raise ValueError(
+                f"set_dense_wire: dense_wire={dense_wire!r}: expected "
+                "'int8', 'bf16', 'sparse_topk', or None/'fp32'")
+        if dense_topk is not None:
+            if dense_wire != "sparse_topk":
+                raise ValueError(
+                    "set_dense_wire: dense_topk only applies to "
+                    "dense_wire='sparse_topk'")
+            dense_topk = int(dense_topk)
+            if dense_topk <= 0:
+                raise ValueError(f"set_dense_wire: dense_topk={dense_topk} "
+                                 "must be positive")
+        if dense_wire == self.dense_wire and dense_topk == self.dense_topk:
+            return state
+        state = self.dense_to_replicated(state)
+        self.dense_wire = dense_wire
+        self.dense_topk = dense_topk
+        # layout + codec are trace-time statics: rebuild the plan and every
+        # compiled program that baked them in
+        self._zero_plan = None
+        self._zero_fns = {}
+        self._train_step_fn = None
+        self._eval_step_fn = None
+        self._train_many_fn = None
+        _metrics.observe("dense.wire_rejits", 1)
+        return self.dense_to_sharded(state)
 
     # -- sharding specs ------------------------------------------------------
 
@@ -1125,6 +1220,25 @@ class MeshTrainer(Trainer):
         return jax.tree_util.tree_map(
             lambda g: jax.lax.psum(g, self.axis), grads)
 
+    def dense_grad_stats(self, grads):
+        """`dense/grad_density`: the nonzero fraction of this replica's
+        PRE-reduction dense grad vector, emitted pre-divided by S so the
+        per-key stats psum (`reduce_metrics`) yields the MEAN replica
+        density — the measured input to
+        `PlacementPolicy.recommend_dense_wire`. Off by default
+        (`dense_stats=False` compiles byte-identical HLO; the placement
+        controller flips it on at prime())."""
+        if not self.dense_stats:
+            return {}
+        leaves = jax.tree_util.tree_leaves(grads)
+        total = sum(int(leaf.size) for leaf in leaves)
+        if total == 0:
+            return {}
+        nnz = sum(jnp.count_nonzero(leaf).astype(jnp.float32)
+                  for leaf in leaves)
+        return {"dense/grad_density":
+                nnz / jnp.float32(total * self.num_shards)}
+
     # oelint: hot-path device_get=0
     def dense_update(self, params, slots, grads):
         """The ZeRO-sharded dense apply (runs inside shard_map; see
@@ -1135,8 +1249,12 @@ class MeshTrainer(Trainer):
         per replica in fp32 (the round-13 two-stage hot-reduce shape — a
         reduce_scatter that never ships fp32), the updated params all_gather
         on the u16 bf16 carrier, and the chunk's fp32 masters (plus, for
-        int8, the full-length error-feedback residual) persist as two more
-        "__zero__" flat slots that externalize() drops."""
+        int8/sparse_topk, the full-length error-feedback residual) persist
+        as two more "__zero__" flat slots that externalize() drops.
+        dense_wire='sparse_topk' ships only the k largest-|x| elements per
+        destination chunk (values + in-band scales + bitcast index lanes,
+        `ops.wire.pack_topk`); the receiver scatter-sums the decoded sparse
+        partials in fp32 and the untransmitted mass feeds the residual."""
         if not self.zero_enabled:
             return super().dense_update(params, slots, grads)
         from ..utils import trace as _trace
@@ -1146,7 +1264,8 @@ class MeshTrainer(Trainer):
             return super().dense_update(params, slots, grads)
         flat_slots = slots[zero.ZERO_KEY]
         fmt = self.dense_wire
-        dcost = zero.dense_wire_cost(plan, fmt)
+        k = self.dense_topk_for(plan) if fmt == "sparse_topk" else None
+        dcost = zero.dense_wire_cost(plan, fmt, topk=k)
         if self.last_wire_cost is not None:
             # trace-time byte attribution for the dense collectives — the
             # hlo-budget pass pins model == compiled HLO on these
@@ -1156,6 +1275,8 @@ class MeshTrainer(Trainer):
             cost["dense_reduce_scatter_bytes"] = dcost["rs_bytes"]
             cost["dense_all_gather_bytes"] = dcost["ag_bytes"]
             cost["dense_bytes_per_step"] = dcost["bytes_per_step"]
+            if k is not None:
+                cost["dense_wire_k"] = int(k)
             self.last_wire_cost = cost
         _metrics.observe("dense.params_total", float(plan.total), "gauge")
         _metrics.observe("dense.zero_shards", float(plan.num_shards), "gauge")
@@ -1175,6 +1296,20 @@ class MeshTrainer(Trainer):
                          "gauge")
         _metrics.observe("dense.wire_bytes_per_step",
                          float(dcost["bytes_per_step"]), "gauge")
+        # wire_dtype as an itemsize gauge (same convention as
+        # exchange.wire_dtype; sparse_topk's value lanes are int8 = 1) and
+        # the bytes the chosen mode saves vs the lossless fp32 plan
+        _metrics.observe(
+            "dense.wire_dtype",
+            {None: 4.0, "bf16": 2.0, "int8": 1.0, "sparse_topk": 1.0}[fmt],
+            "gauge")
+        fp32_cost = zero.dense_wire_cost(plan, None)
+        _metrics.observe(
+            "dense.wire_bytes_saved",
+            float(fp32_cost["bytes_per_step"] - dcost["bytes_per_step"]),
+            "gauge")
+        if k is not None:
+            _metrics.observe("dense.grad_topk", float(k), "gauge")
         S, chunk = plan.num_shards, plan.chunk
         new_ef = None
         if not fmt:
@@ -1184,6 +1319,24 @@ class MeshTrainer(Trainer):
                 g_local = jax.lax.psum_scatter(flat_g, self.axis,
                                                scatter_dimension=0,
                                                tiled=True)
+        elif fmt == "sparse_topk":
+            with _trace.span("trainer", "dense_grad_exchange",
+                             bytes=dcost["a2a_bytes"], k=int(k)):
+                flat_g = zero.flatten_tree(plan, grads) \
+                    + flat_slots[zero.DENSE_EF_KEY].reshape(-1)
+                x = flat_g.reshape(S, chunk)  # destination-major partials
+                enc = zero.encode_flat_topk(flat_g, S, k)    # (S, Wk) s8
+                # the residual keeps EVERYTHING the sparse payload failed to
+                # ship: untransmitted elements whole, transmitted ones their
+                # int8 rounding error
+                new_ef = (x - zero.decode_flat_topk(enc, k, chunk)) \
+                    .reshape(1, -1)
+                recv = jax.lax.all_to_all(
+                    enc.reshape(S, 1, enc.shape[1]), self.axis, 0, 0)
+                # stream-sparse two-stage reduce: decode ALL S sources'
+                # sparse partials of this chunk and scatter-sum in fp32
+                g_local = zero.decode_flat_topk(
+                    recv.reshape(S, -1), k, chunk).sum(axis=0)
         else:
             with _trace.span("trainer", "dense_grad_exchange",
                              bytes=dcost["a2a_bytes"]):
@@ -1234,7 +1387,7 @@ class MeshTrainer(Trainer):
         if fmt:
             new_flat_slots = dict(new_flat_slots)
             new_flat_slots[zero.DENSE_MASTER_KEY] = new_w_local.reshape(1, -1)
-            if fmt == "int8":
+            if new_ef is not None:
                 new_flat_slots[zero.DENSE_EF_KEY] = new_ef
         return new_params, {zero.ZERO_KEY: new_flat_slots}
 
@@ -1392,28 +1545,33 @@ class MeshTrainer(Trainer):
     def _pipeline_patch(self, ps_specs, tables, prev_plans, plans, rows):
         """Repair the next batch's speculative rows against what this batch's
         apply just wrote (`sharded.grouped_conflict_patch`). Returns
-        (patched_rows, {name: conflict_rows psum}, conflict_overflow psum)."""
+        (patched_rows, new_tables, {name: conflict_rows psum},
+        conflict_overflow psum) — `new_tables` carries the replayed
+        error-feedback residuals on narrow-wire tables (unchanged
+        otherwise)."""
         from ..utils import trace as _trace
         from .sharded import grouped_conflict_patch
         patched, conflict = {}, {}
+        new_tables = dict(tables)
         coflow = jnp.zeros((), jnp.int32)
         with _trace.span("trainer", "conflict_patch"):
             for names in self._pipeline_groups(ps_specs):
                 specs = [ps_specs[n] for n in names]
-                outs, stats_list = grouped_conflict_patch(
+                outs, stats_list, states = grouped_conflict_patch(
                     specs, [tables[n] for n in names],
                     [prev_plans[n] for n in names],
                     [plans[n] for n in names],
                     [rows[n] for n in names], axis=self.axis,
                     conflict_factor=self.conflict_factor,
                     wire=self.wire_for(names[0]))
-                for n, out, st in zip(names, outs, stats_list):
+                for n, out, st, ts in zip(names, outs, stats_list, states):
                     patched[n] = out
+                    new_tables[n] = ts
                     conflict[n] = jax.lax.psum(st["conflict_rows"],
                                                self.axis)
                     coflow = coflow + jax.lax.psum(st["conflict_overflow"],
                                                    self.axis)
-        return patched, conflict, coflow
+        return patched, new_tables, conflict, coflow
 
     def train_many(self, state: TrainState, batches):
         """See `Trainer.train_many`. With pipeline_steps=True on a real mesh
@@ -1442,7 +1600,11 @@ class MeshTrainer(Trainer):
         order (prologue inserts b[0], body t inserts b[t+1]), apply never
         touches keys, and the patch re-gathers every row the apply could
         have touched — fp32 results are bit-exact vs the serial scan.
-        Narrow wire stays approximate (error feedback is not replayed)."""
+        Narrow wire replays error feedback at patch time: the prefetch
+        stashes each served row's pre-serve residual on the plan, and the
+        patch re-encodes the patched rows with the same codec and rewrites
+        the residual slots, so pipelined int8 windows match serial int8
+        bit-for-bit."""
         if self.offload and not getattr(self, "_offload_prepared", False):
             raise ValueError(
                 "train_many on storage='host_cached' tables needs the union "
@@ -1521,9 +1683,11 @@ class MeshTrainer(Trainer):
             # ride this step's metrics (the per-batch stats accounting)
             state, metrics = step_tail(state, bt, pulled, dict(pf_stats),
                                        plans_t)
-            # (4) repair batch t+1's speculative rows post-apply
-            patched, conflict, coflow = self._pipeline_patch(
+            # (4) repair batch t+1's speculative rows post-apply; narrow
+            # wire also rewrites the replayed error-feedback residuals
+            patched, patch_tables, conflict, coflow = self._pipeline_patch(
                 ps_specs, state.tables, plans_t, plans_n, rows_n)
+            state = state.replace(tables=patch_tables)
             oflow = stats_overflow(metrics.get("stats", {}))
             pre_n = {n: {"plan": plan_carry(plans_n[n]), "rows": patched[n]}
                      for n in plans_n}

@@ -202,7 +202,26 @@ def decode_flat(enc: jax.Array, fmt: str) -> jax.Array:
     return wire.unpack_inband(enc, wire.INBAND_BLOCK, fmt).reshape(-1)
 
 
-def dense_wire_cost(plan: DenseShardPlan, fmt: Optional[str]) -> dict:
+def encode_flat_topk(flat: jax.Array, num_shards: int, k: int) -> jax.Array:
+    """(padded,) f32 destination-major grad vector -> (S, topk_wire_width(k))
+    int8: one sparse top-k payload per DESTINATION chunk (SparCML-style
+    stream-sparse partials; k is trace-time static). Round-to-nearest — the
+    untransmitted residual feeds the `__dense_ef__` slots instead of
+    stochastic rounding, exactly like the int8 dense wire."""
+    from ..ops import wire
+    return wire.pack_topk(flat.reshape(num_shards, -1), k)
+
+
+def decode_flat_topk(enc: jax.Array, k: int, chunk: int) -> jax.Array:
+    """(n, topk_wire_width(k)) int8 payloads -> dense (n, chunk) f32 with
+    untransmitted elements exactly 0 (the receiver scatter-sums these
+    per-source partials in fp32)."""
+    from ..ops import wire
+    return wire.unpack_topk(enc, k, chunk)
+
+
+def dense_wire_cost(plan: DenseShardPlan, fmt: Optional[str],
+                    *, topk: Optional[int] = None) -> dict:
     """Static per-device collective bytes of one dense update, per dense
     wire format — the dense counterpart of `ops.wire.exchange_cost`, priced
     off the same RESULT buffers the oelint hlo-budget counters read:
@@ -213,7 +232,13 @@ def dense_wire_cost(plan: DenseShardPlan, fmt: Optional[str]) -> dict:
       (S, R/S, W) result buffer re-assembles every source's encoding of
       this replica's chunk (R = padded/INBAND_BLOCK codec blocks, W the
       in-band wire width) — plus a u16-carrier all_gather of the updated
-      params (`a2a_bytes`/`ag_bytes`).
+      params (`a2a_bytes`/`ag_bytes`);
+    - 'sparse_topk' (requires `topk`=k): the stream-sparse variant — the a2a
+      result buffer holds S sparse payloads of `topk_wire_width(k)` int8
+      lanes each (k values + in-band scales + 4 index lanes per value), the
+      params all_gather unchanged on the u16 carrier. The honest sparse
+      price is ~5.125 bytes per TRANSMITTED element vs int8's ~1.125 per
+      element — the crossover `PlacementPolicy.recommend_dense_wire` prices.
     """
     from ..ops import wire
     S, padded = plan.num_shards, plan.padded
@@ -224,6 +249,14 @@ def dense_wire_cost(plan: DenseShardPlan, fmt: Optional[str]) -> dict:
         rs = ag = padded * 4
         return {"format": "fp32", "rs_bytes": rs, "a2a_bytes": 0,
                 "ag_bytes": ag, "bytes_per_step": rs + ag}
+    if fmt == "sparse_topk":
+        if not topk:
+            raise ValueError("dense_wire_cost: fmt='sparse_topk' needs topk")
+        a2a = S * wire.topk_wire_width(int(topk))
+        ag = padded * 2  # updated params ship on the u16 bf16 carrier
+        return {"format": fmt, "k": int(topk), "rs_bytes": 0,
+                "a2a_bytes": int(a2a), "ag_bytes": int(ag),
+                "bytes_per_step": int(a2a + ag)}
     blocks = padded // wire.INBAND_BLOCK
     w = jnp.dtype(wire.wire_carrier_dtype(fmt)).itemsize
     a2a = blocks * wire.rows_wire_width(wire.INBAND_BLOCK, fmt) * w

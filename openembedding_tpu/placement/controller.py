@@ -85,6 +85,10 @@ class PlacementController:
         self.manage_wire = bool(manage_wire)
         self._wire_active: Dict[str, str] = {}
         self._wire_rejits = 0
+        # dense-gradient wire management (guarded-by: self._lock)
+        self._dense_wire_rejits = 0
+        self._last_dense_wire_step = -10**9
+        self._dense_wire_reason = ""
         self._lock = threading.Lock()
         # guarded-by: self._lock
         self._pending: Optional[PlacementDecision] = None
@@ -172,6 +176,16 @@ class PlacementController:
             # set the formats BEFORE the sizing re-jit below so enabling
             # wire management at prime time costs zero extra compiles
             state = self.apply_wire(state, self.policy.recommend_wire(tel))
+            tr0 = self.trainer
+            if getattr(tr0, "zero_enabled", False) \
+                    and tr0.dense_wire in ("int8", "sparse_topk") \
+                    and not tr0.dense_stats:
+                # the measured gradient density feeds
+                # `recommend_dense_wire`; turning the stat on is a
+                # trace-time change, folded into prime's one re-jit
+                tr0.dense_stats = True
+                tr0._train_step_fn = None
+                tr0._train_many_fn = None
         sizes = self.policy.size_hot(tel)
         hot_rows = {n: int(h) for n, h in sizes.items() if h > 0}
         # per-table annex capacity off the measured cold-tail imbalance
@@ -430,6 +444,49 @@ class PlacementController:
                      formats=dict(sorted(self._wire_active.items())))
         return state
 
+    def apply_dense_wire(self, state):
+        """Density-adaptive dense-gradient wire (the decision half of the
+        sparse collective): read the measured `dense.grad_density` gauge,
+        price sparse vs dense via `policy.recommend_dense_wire`, and flip
+        the trainer through `MeshTrainer.set_dense_wire` when the verdict
+        changes — a counted re-jit, hysteresis + cooldown gated. Only
+        active once the operator chose a narrow dense wire (int8 or
+        sparse_topk); fp32/bf16 runs are left alone."""
+        tr = self.trainer
+        if not getattr(tr, "zero_enabled", False):
+            return state
+        current = tr.dense_wire
+        if current not in ("int8", "sparse_topk"):
+            return state
+        density = _metrics.report().get("dense.grad_density")
+        if density is None:
+            return state  # stat not published yet (dense_stats off or
+            # no step recorded) — nothing measured to decide on
+        plan = tr._zero_plan_for(tr._dense_trainable(state))
+        with self._lock:
+            since = self._step - self._last_dense_wire_step
+        mode, k, reason = self.policy.recommend_dense_wire(
+            float(density), current, chunk=plan.chunk, steps_since=since)
+        _metrics.observe("placement.dense_wire_sparse",
+                         1.0 if mode == "sparse_topk" else 0.0, "gauge")
+        target_k = k if mode == "sparse_topk" else None
+        with self._lock:
+            self._dense_wire_reason = reason
+        if mode == current and target_k == tr.dense_topk:
+            return state
+        if since < self.policy.dense_wire_cooldown_steps:
+            # the policy's cooldown covers mode flips; this also paces
+            # same-mode k resizes — every change here is a re-jit
+            return state
+        state = tr.set_dense_wire(state, mode, target_k)
+        with self._lock:
+            self._dense_wire_rejits += 1
+            self._last_dense_wire_step = self._step
+        _trace.event("placement", "dense_wire", step=self._step,
+                     mode=mode, k=target_k, density=float(density),
+                     reason=reason[:200])
+        return state
+
     # -- apply ---------------------------------------------------------------
 
     def apply(self, state, decision: PlacementDecision):
@@ -483,6 +540,7 @@ class PlacementController:
         if self.manage_wire:
             state = self.apply_wire(
                 state, self.policy.recommend_wire(self.telemetry()))
+            state = self.apply_dense_wire(state)
         return state
 
     # -- background watcher --------------------------------------------------
@@ -540,6 +598,10 @@ class PlacementController:
                 "manage_wire": self.manage_wire,
                 "wire_formats": dict(self._wire_active),
                 "wire_rejits": self._wire_rejits,
+                "dense_wire": getattr(self.trainer, "dense_wire", None)
+                or "fp32",
+                "dense_wire_rejits": self._dense_wire_rejits,
+                "dense_wire_reason": self._dense_wire_reason,
             }
 
     def render_text(self) -> str:
@@ -549,6 +611,8 @@ class PlacementController:
                  f"budget={st['hot_budget_bytes']}B "
                  f"imbalance_target={st['imbalance_target']}"
                  + (f" manage_wire=on wire_rejits={st['wire_rejits']}"
+                    f" dense_wire={st['dense_wire']}"
+                    f" dense_wire_rejits={st['dense_wire_rejits']}"
                     if st["manage_wire"] else "")]
         import re
         rep = _metrics.report()

@@ -298,6 +298,82 @@ class PlacementPolicy:
                 out[t.name] = "bf16"
         return out
 
+    # -- dense-wire recommendation -------------------------------------------
+
+    # knobs for `recommend_dense_wire` (class-level for the same reason as
+    # the `recommend_wire` set). The sparse_topk codec ships ~5.125 B per
+    # transmitted element (int8 value + in-band fp32 block scales + a
+    # bitcast int32 index lane) vs int8 dense's ~1.125 B per element, so
+    # sparse pays off only below density ~0.22 — the Densifying
+    # (arXiv:1905.04035) crossover for this payload shape.
+    dense_wire_crossover = 0.22
+    # hysteresis band, as fractions of the crossover: enter sparse only
+    # well below it, fall back to dense only near it — a density sitting on
+    # the boundary must not thrash re-jits
+    dense_sparse_enter = 0.6
+    dense_sparse_exit = 0.9
+    # k headroom over the measured nonzeros per destination row, so a
+    # density estimate that wobbles upward does not silently truncate
+    dense_topk_margin = 1.5
+    # re-jit floor: flipping the dense wire recompiles the step
+    dense_wire_cooldown_steps = 200
+    # sparse k is padded to the in-band codec's block (ops/wire.INBAND_BLOCK
+    # — mirrored here so the policy stays numpy-pure)
+    dense_topk_block = 32
+
+    def recommend_dense_wire(self, density: float, current: str = "int8", *,
+                             chunk: Optional[int] = None,
+                             steps_since: int = 10**9) \
+            -> Tuple[str, Optional[int], str]:
+        """Dense-gradient wire off the MEASURED gradient density
+        (`dense.grad_density` — mean nonzero fraction over the fleet) ->
+        (mode, k, reason). `mode` is "sparse_topk" or the dense fallback
+        (`current` when already dense, else "int8"); `k` sizes the sparse
+        payload (None for dense). Hysteresis: enter sparse below
+        `dense_sparse_enter x crossover`, leave above
+        `dense_sparse_exit x crossover`, never flip inside the cooldown."""
+        dense_mode = current if current != "sparse_topk" else "int8"
+        d = float(density)
+        if not np.isfinite(d) or d < 0:
+            return dense_mode, None, f"density {density!r} unusable"
+        enter = self.dense_sparse_enter * self.dense_wire_crossover
+        exit_ = self.dense_sparse_exit * self.dense_wire_crossover
+        want_sparse = (d <= enter if current != "sparse_topk"
+                       else d < exit_)
+        target = "sparse_topk" if want_sparse else dense_mode
+        if target != current and steps_since < self.dense_wire_cooldown_steps:
+            k = None
+            if current == "sparse_topk" and chunk:
+                k = self._dense_topk(d, int(chunk))
+            return current, k, (
+                f"cooldown ({steps_since} < "
+                f"{self.dense_wire_cooldown_steps} steps)")
+        if not want_sparse:
+            if current == "sparse_topk":
+                return dense_mode, None, (
+                    f"density {d:.3f} >= exit {exit_:.3f} "
+                    f"(crossover {self.dense_wire_crossover})")
+            return dense_mode, None, (
+                f"density {d:.3f} above enter {enter:.3f} "
+                f"(crossover {self.dense_wire_crossover})")
+        k = self._dense_topk(d, int(chunk)) if chunk else None
+        side = "<" if current == "sparse_topk" else "<="
+        bound = exit_ if current == "sparse_topk" else enter
+        return "sparse_topk", k, (
+            f"density {d:.3f} {side} {bound:.3f} "
+            f"(crossover {self.dense_wire_crossover})")
+
+    def _dense_topk(self, density: float, chunk: int) -> int:
+        """Sparse payload size for a measured density: margin over the
+        expected nonzeros per destination row, padded to the codec block,
+        clamped to the row."""
+        if chunk <= 0:
+            return 0
+        k = int(np.ceil(max(density, 0.0) * chunk * self.dense_topk_margin))
+        b = self.dense_topk_block
+        k = -(-max(k, 1) // b) * b
+        return max(1, min(k, chunk))
+
     # -- cold-tail migration gate --------------------------------------------
 
     def migration_due(self, t: TableTelemetry) -> Tuple[bool, str]:

@@ -310,6 +310,12 @@ def record_step_stats(stats: Dict[str, "object"]) -> Dict[str, "object"]:
         if table_stat and stat in _HEALTH_TABLE_STATS:
             per_table.setdefault(var, {})[stat] = v
             continue
+        if key == "dense/grad_density":
+            # MEAN replica density (emitted pre-divided by S, psum'd to the
+            # mean): a level, not a count — publish as the gauge the sparse
+            # dense-wire policy reads, never the additive counter fold
+            observe("dense.grad_density", v, "gauge")
+            continue
         observe(key.replace("/", "."), v)
         if table_stat:
             observe(f"trainer.{stat}", v, "sum", labels={"table": var})

@@ -44,11 +44,13 @@ def _stack(batches):
     return jax.tree_util.tree_map(lambda *xs: np.stack(xs), *batches)
 
 
-def _run_pair(hot=0, mig=0, group=True, k=K, seed=5, overlap=False):
+def _run_pair(hot=0, mig=0, group=True, k=K, seed=5, overlap=False,
+              wire="fp32"):
     """Train the same window serial and pipelined; return both (state,
     metrics) pairs. `overlap` plants heavy id overlap between consecutive
     batches so the speculative prefetch is guaranteed stale (the conflict
-    patch must repair it)."""
+    patch must repair it). `wire` selects the exchange codec — narrow wires
+    exercise the round-23 error-feedback replay in the patch."""
     model = make_deepfm(vocabulary=VOCAB, dim=4, hidden=(8,))
     batches = list(synthetic_criteo(16, id_space=VOCAB, steps=k, seed=seed))
     if overlap:
@@ -62,7 +64,7 @@ def _run_pair(hot=0, mig=0, group=True, k=K, seed=5, overlap=False):
     for pipe in (False, True):
         tr = MeshTrainer(model, embed.Adagrad(learning_rate=0.05), seed=1,
                          hot_rows=hot, mig_rows=mig, group_exchange=group,
-                         wire="fp32", pipeline_steps=pipe)
+                         wire=wire, pipeline_steps=pipe)
         state = tr.init(batches[0])
         if hot:
             state = tr.refresh_hot_rows(state, hot_ids=hot_ids)
@@ -92,6 +94,11 @@ def _assert_bit_exact(sa, ma, sb, mb):
             np.testing.assert_array_equal(
                 np.asarray(sa.tables[n].mig.weights),
                 np.asarray(sb.tables[n].mig.weights))
+        # narrow wires: the per-row error-feedback residuals must match
+        # too — the patch's EF replay rewrites them, not just the weights
+        if sa.tables[n].ef is not None:
+            np.testing.assert_array_equal(np.asarray(sa.tables[n].ef),
+                                          np.asarray(sb.tables[n].ef))
 
 
 # ---------------------------------------------------------------------------
@@ -127,6 +134,27 @@ def test_conflict_patch_repairs_overlapping_batches():
     tr.record_window_stats(mb)
     rep = metrics.report()
     assert rep['exchange.conflict_rows{table="categorical"}'] > 0
+
+
+@pytest.mark.parametrize("case", ["disjoint", "overlap", "overlap_hot_mig"])
+def test_pipelined_bit_exact_int8_wire(case):
+    """Round 23's EF replay pin. With the int8 exchange wire every served
+    row ships q(w + ef) and rewrites the residual — so a speculatively
+    prefetched row is stale in BOTH planes. The conflict patch must replay
+    the quantizer against the post-apply weights plus the PRE-serve
+    residual stash (`ExchangePlan.ef_stash`), restoring bit-exactness of
+    losses, weights, optimizer slots AND the `state.ef` residuals vs the
+    serial int8 scan. Overlapping batches force the patch to fire; the
+    hot-cache and migration annexes ride the same window."""
+    kw = {"disjoint": {}, "overlap": {"overlap": True},
+          "overlap_hot_mig": {"overlap": True, "hot": 8, "mig": 8}}[case]
+    (_, sa, ma), (_, sb, mb) = _run_pair(wire="int8", **kw)
+    _assert_bit_exact(sa, ma, sb, mb)
+    for n in sa.tables:
+        assert sa.tables[n].ef is not None  # the pin is not vacuous
+    patched = sum(int(np.asarray(v)) for v in mb["conflict"].values())
+    if case != "disjoint":
+        assert patched > 0
 
 
 # ---------------------------------------------------------------------------
@@ -425,3 +453,53 @@ def test_size_mig_adapts_to_measured_imbalance():
         top_ids=[(6, 10**6), (5, 10**6)] + hot_homed,  # id%8 != 7: ignored
         shard_positions=load)
     assert pol.size_mig([mixed])["mixed"] == 18
+
+
+# ---------------------------------------------------------------------------
+# round 23: dense-wire policy hysteresis (no thrash under noisy density)
+# ---------------------------------------------------------------------------
+
+
+def test_dense_wire_policy_hysteresis_no_thrash():
+    """A density that oscillates inside the hysteresis band [enter, exit)
+    must flip the wire exactly once: enter sparse when d <= enter
+    (0.6 x crossover), stay sparse until d >= exit (0.9 x crossover) —
+    each flip is a counted re-jit, so thrash here is a compile storm."""
+    from openembedding_tpu.placement.policy import PlacementPolicy
+
+    pol = PlacementPolicy(1 << 20, mig_rows=64)
+    chunk = 1024
+    enter = pol.dense_sparse_enter * pol.dense_wire_crossover
+    exit_ = pol.dense_sparse_exit * pol.dense_wire_crossover
+    assert enter < exit_ < pol.dense_wire_crossover
+
+    mode, flips = "int8", 0
+    # every sample sits strictly between enter and exit except the first,
+    # which trips the entry — the band must absorb all the oscillation
+    stream = [0.10] + [enter + 0.01, exit_ - 0.01, enter + 0.005,
+                       exit_ - 0.002] * 4
+    for d in stream:
+        new, k, _reason = pol.recommend_dense_wire(d, current=mode,
+                                                   chunk=chunk,
+                                                   steps_since=10**9)
+        if new != mode:
+            flips += 1
+        mode = new
+        if mode == "sparse_topk":
+            assert 1 <= k <= chunk and k % pol.dense_topk_block == 0
+    assert flips == 1 and mode == "sparse_topk"
+
+    # leaving the band upward flips back out...
+    new, k, _ = pol.recommend_dense_wire(exit_ + 0.01, current=mode,
+                                         chunk=chunk, steps_since=10**9)
+    assert new == "int8" and k is None
+    # ...but never inside the cooldown window
+    new, _k, reason = pol.recommend_dense_wire(
+        0.01, current="int8", chunk=chunk,
+        steps_since=pol.dense_wire_cooldown_steps - 1)
+    assert new == "int8" and "cooldown" in reason
+    # unusable densities never recommend a change
+    for bad in (float("nan"), -1.0):
+        new, k, _ = pol.recommend_dense_wire(bad, current="int8",
+                                             chunk=chunk, steps_since=10**9)
+        assert new == "int8" and k is None

@@ -312,7 +312,7 @@ def test_zero_rejects_wide_dtypes():
 # ---------------------------------------------------------------------------
 
 
-@pytest.mark.parametrize("fmt", ["bf16", "int8"])
+@pytest.mark.parametrize("fmt", ["bf16", "int8", "sparse_topk"])
 def test_dense_wire_trains_close_to_fp32(fmt):
     """`dense_wire` swaps the fp32 psum_scatter for the in-band-encoded
     two-stage reduce (encode -> a2a partials -> per-replica fp32 sum) and
@@ -341,7 +341,12 @@ def test_dense_wire_trains_close_to_fp32(fmt):
     assert plan.chunk % wire_mod.INBAND_BLOCK == 0
     flat = st_q.dense_slots[zero.ZERO_KEY]
     assert zero.DENSE_MASTER_KEY in flat
-    assert (zero.DENSE_EF_KEY in flat) == (fmt == "int8")
+    # int8 and sparse_topk both need error feedback (quantization bias /
+    # untransmitted mass); bf16 truncation rides without. On this toy model
+    # chunk == 32 so the auto top-k resolves to k == chunk: the sparse path
+    # exercises the full encode -> a2a -> scatter-sum pipeline while every
+    # element still ships (int8-quantized), keeping the int8 loss tier.
+    assert (zero.DENSE_EF_KEY in flat) == (fmt in ("int8", "sparse_topk"))
     assert np.all(np.isfinite(l_q))
     np.testing.assert_allclose(l_q, l_f, rtol=0.02, atol=0.02)
     # externalize folds the masters back and drops the wire-only slots:
@@ -366,15 +371,16 @@ def test_dense_wire_trains_close_to_fp32(fmt):
 def test_dense_wire_checkpoint_cross_compatible(tmp_path):
     """The serialized form stays ONE layout (replicated fp32 — masters
     folded into dense_params, EF wire residuals dropped/reseeded): a dump
-    saved under any of {replicated, ZeRO, ZeRO-bf16, ZeRO-int8} loads into
-    any other, the loaded external state is bitwise the saved one, and
-    training continues finite."""
+    saved under any of {replicated, ZeRO, ZeRO-bf16, ZeRO-int8,
+    ZeRO-sparse} loads into any other, the loaded external state is bitwise
+    the saved one, and training continues finite."""
     batches = _batches(3, seed=13)
     configs = {
         "replicated": {},
         "zero": {"dense_shard": True},
         "zero_bf16": {"dense_shard": True, "dense_wire": "bf16"},
         "zero_int8": {"dense_shard": True, "dense_wire": "int8"},
+        "zero_sparse": {"dense_shard": True, "dense_wire": "sparse_topk"},
     }
 
     def make(cfg):
@@ -382,7 +388,7 @@ def test_dense_wire_checkpoint_cross_compatible(tmp_path):
                            mesh=make_mesh(), wire="fp32", **configs[cfg])
 
     saved = {}
-    for cfg in ("replicated", "zero_int8"):
+    for cfg in ("replicated", "zero_int8", "zero_sparse"):
         tr = make(cfg)
         state = tr.init(batches[0])
         step = tr.jit_train_step(batches[0], state)
@@ -410,14 +416,15 @@ def test_dense_wire_checkpoint_cross_compatible(tmp_path):
             assert np.isfinite(float(m["loss"])), (src, dst)
 
 
-def test_dense_wire_artifacts_schema_oblivious_and_reload(tmp_path):
-    """A dense_wire="int8" run writes artifacts — sharded checkpoint,
-    standalone export, incremental sync deltas — with EXACTLY the file set
-    and array schema of a replicated fp32 control run (masters fold into
-    dense_params; `__dense_ef__`/`__dense_master__` never leak to disk),
-    and its checkpoint reloads into a fresh dense_wire trainer which keeps
-    training."""
-    l_q = _run_training(tmp_path, "q", dense_shard=True, dense_wire="int8")
+@pytest.mark.parametrize("fmt", ["int8", "sparse_topk"])
+def test_dense_wire_artifacts_schema_oblivious_and_reload(tmp_path, fmt):
+    """A narrow-wire run (int8 or sparse_topk) writes artifacts — sharded
+    checkpoint, standalone export, incremental sync deltas — with EXACTLY
+    the file set and array schema of a replicated fp32 control run (masters
+    fold into dense_params; `__dense_ef__`/`__dense_master__` never leak to
+    disk), and its checkpoint reloads into a fresh dense_wire trainer which
+    keeps training."""
+    l_q = _run_training(tmp_path, "q", dense_shard=True, dense_wire=fmt)
     _run_training(tmp_path, "c", dense_shard=False)
     assert np.all(np.isfinite(l_q))
 
@@ -446,7 +453,7 @@ def test_dense_wire_artifacts_schema_oblivious_and_reload(tmp_path):
 
     tr = MeshTrainer(_model(), embed.Adam(learning_rate=0.01),
                      mesh=make_mesh(), wire="fp32", dense_shard=True,
-                     dense_wire="int8")
+                     dense_wire=fmt)
     batches = _batches(2, seed=7)
     st = tr.init(batches[0])
     st = tr.load(st, str(tmp_path / "q" / "ckpt"))
@@ -469,3 +476,110 @@ def test_dense_wire_validation():
     tr = MeshTrainer(_model(), embed.Adagrad(learning_rate=0.1),
                      mesh=make_mesh(), dense_shard=True, dense_wire="fp32")
     assert tr.dense_wire is None
+    # dense_topk only sizes the sparse_topk payload, and must be positive
+    with pytest.raises(ValueError, match="dense_topk"):
+        MeshTrainer(_model(), embed.Adagrad(learning_rate=0.1),
+                    mesh=make_mesh(), dense_shard=True, dense_wire="int8",
+                    dense_topk=32)
+    with pytest.raises(ValueError, match="dense_topk"):
+        MeshTrainer(_model(), embed.Adagrad(learning_rate=0.1),
+                    mesh=make_mesh(), dense_shard=True,
+                    dense_wire="sparse_topk", dense_topk=0)
+    # set_dense_wire re-validates (it raises before touching the state)
+    tr2 = MeshTrainer(_model(), embed.Adagrad(learning_rate=0.1),
+                      mesh=make_mesh(), dense_shard=True, dense_wire="int8")
+    with pytest.raises(ValueError, match="dense_topk"):
+        tr2.set_dense_wire(None, "int8", dense_topk=4)
+    with pytest.raises(ValueError, match="dense_wire"):
+        tr2.set_dense_wire(None, "int4")
+
+
+# ---------------------------------------------------------------------------
+# round 23: stream-sparse dense wire (sparse_topk) units
+# ---------------------------------------------------------------------------
+
+
+def test_sparse_topk_codec_round_trip():
+    """pack_topk/unpack_topk: per row the k largest-|x| elements survive
+    within int8 in-band quantization error, every untransmitted element
+    decodes to EXACT 0.0 (the receiver scatter-sums partials, so stray
+    nonzeros would corrupt other sources' contributions), and the index
+    lanes are collision-free (<= k nonzeros per row). k=8/40 exercise
+    partial codec blocks, k=96 the k == m degenerate case."""
+    from openembedding_tpu.ops import wire as wire_mod
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((4, 96)), jnp.float32)
+    xn = np.asarray(x)
+    for k in (8, 32, 40, 96):
+        w = wire_mod.pack_topk(x, k)
+        assert w.shape == (4, wire_mod.topk_wire_width(k))
+        assert w.dtype == jnp.int8
+        out = np.asarray(wire_mod.unpack_topk(w, k, x.shape[-1]))
+        for r in range(x.shape[0]):
+            idx = np.argsort(-np.abs(xn[r]))[:k]
+            mask = np.zeros(x.shape[-1], bool)
+            mask[idx] = True
+            assert not out[r][~mask].any(), (k, r)
+            assert (out[r] != 0).sum() <= k
+            np.testing.assert_allclose(
+                out[r][mask], xn[r][mask],
+                atol=np.abs(xn).max() / 127 + 1e-7, err_msg=f"k={k} row={r}")
+
+
+def test_sparse_topk_wire_width_partial_blocks():
+    """topk_wire_width = int8 in-band rows (value lanes + scales, padded to
+    whole codec blocks) + 4 bitcast-int32 index lanes per element; partial
+    blocks price a whole block of value lanes, the index lanes are exact."""
+    from openembedding_tpu.ops import wire as wire_mod
+
+    for k in (1, 8, 32, 40, 96):
+        want = wire_mod.rows_wire_width(k, "int8") + 4 * k
+        assert wire_mod.topk_wire_width(k) == want, k
+    assert wire_mod.topk_wire_width(32) == 164
+
+
+def test_sparse_topk_error_feedback_converges():
+    """Error feedback at fixed k < chunk: feeding the residual (true value
+    minus decoded transmission, which also captures int8 quantization
+    error) back into the next encode makes the TIME-AVERAGE of decoded
+    transmissions converge to the true per-step gradient at ~1/T — the
+    untransmitted mass is delayed, never lost (arXiv:1905.04035)."""
+    S_, chunk, k = 4, 32, 8
+    rng = np.random.default_rng(2)
+    g = jnp.asarray(rng.standard_normal(S_ * chunk), jnp.float32)
+    gn = np.asarray(g, np.float64)
+    resid = jnp.zeros_like(g)
+    sent = np.zeros(S_ * chunk, np.float64)
+    errs = {}
+    for t in range(1, 51):
+        x = g + resid
+        enc = zero.encode_flat_topk(x, S_, k)
+        dec = zero.decode_flat_topk(enc, k, chunk).reshape(-1)
+        resid = x - dec
+        sent += np.asarray(dec, np.float64)
+        if t in (5, 50):
+            errs[t] = np.abs(sent / t - gn).max()
+    # telescoping: sent/T - g == -resid_T/T exactly, so convergence only
+    # needs the residual to stay bounded — pin both
+    assert np.abs(np.asarray(resid)).max() < 2 * np.abs(gn).max()
+    assert errs[50] < errs[5] / 4
+    assert errs[50] < 0.1
+
+
+def test_sparse_topk_dense_wire_cost():
+    """dense_wire_cost prices sparse honestly: no reduce_scatter, a2a = S
+    payloads of topk_wire_width(k) int8 lanes, params all_gather unchanged
+    on the 2-byte carrier — and requires the resolved k."""
+    from openembedding_tpu.ops import wire as wire_mod
+
+    params = {"w": jnp.zeros((40,), jnp.float32)}
+    plan = zero.build_plan(params, embed.Adagrad(learning_rate=0.1), S)
+    cost = zero.dense_wire_cost(plan, "sparse_topk", topk=32)
+    assert cost["format"] == "sparse_topk" and cost["k"] == 32
+    assert cost["rs_bytes"] == 0
+    assert cost["a2a_bytes"] == S * wire_mod.topk_wire_width(32)
+    assert cost["ag_bytes"] == plan.padded * 2
+    assert cost["bytes_per_step"] == cost["a2a_bytes"] + cost["ag_bytes"]
+    with pytest.raises(ValueError, match="topk"):
+        zero.dense_wire_cost(plan, "sparse_topk")

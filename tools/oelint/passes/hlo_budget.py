@@ -130,6 +130,20 @@ CONFIGS = (
      "hot_rows": 0, "dense_shard": True, "dense_wire": "int8",
      "require_a2a_dtypes": ("s8",),
      "pins": {"hlo_reduce_scatter_bytes": 0}},
+    # round-23 density-adaptive sparse dense collectives: dense_wire=
+    # "sparse_topk" ships each destination's top-k gradient entries as s8
+    # values + in-band scales + bitcast-s8 index lanes through the same
+    # encoded a2a slot the int8 path uses (reduce-scatter stays at exactly
+    # 0, pinned), with dense_stats=True riding the per-key stats psum (one
+    # extra scalar lane — the measured density that drives the crossover).
+    # The unattributed pin proves the sparse scatter-sum decode stays local:
+    # GSPMD must not insert resharding around the index-lane plumbing.
+    {"name": "fused_fp32_zero_sparse", "group_exchange": True,
+     "wire": "fp32", "hot_rows": 0, "dense_shard": True,
+     "dense_wire": "sparse_topk", "dense_stats": True,
+     "require_a2a_dtypes": ("s8",),
+     "pins": {"hlo_reduce_scatter_bytes": 0,
+              "unattributed_collectives": 0}},
     # round-18 software-pipelined train_many: the K-step window compiles a
     # scan whose body prefetches batch t+1's exchange BEFORE batch t's dense
     # compute/apply. fused_fp32_many is the serial K-step window on the same
@@ -281,6 +295,8 @@ def make_trainer(config: Dict):
         hot_wire=config.get("hot_wire"),
         dense_shard=config.get("dense_shard", False),
         dense_wire=config.get("dense_wire"),
+        dense_topk=config.get("dense_topk"),
+        dense_stats=config.get("dense_stats", False),
         sentinel=config.get("sentinel", False),
         pipeline_steps=config.get("pipeline_steps", False))
     return trainer, batch

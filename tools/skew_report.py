@@ -189,10 +189,12 @@ def recommend_report(samples, *, budget_bytes: int, mig_rows: int,
                                                        plan_migration)
     from openembedding_tpu.placement.policy import PlacementPolicy, row_bytes
     tel = telemetry_from_samples(samples, default_dim=default_dim)
-    if not tel:
-        return "(no skew.* series — node has no id streams observed yet)"
     policy = PlacementPolicy(budget_bytes, mig_rows=mig_rows,
                              imbalance_target=imbalance_target)
+    if not tel:
+        return "\n".join(
+            ["(no skew.* series — node has no id streams observed yet)"]
+            + _dense_wire_lines(samples, policy))
     sizes = policy.size_hot(tel)
     wires = policy.recommend_wire(tel)
     # per-table annex capacity off the measured cold-tail imbalance — the
@@ -227,7 +229,25 @@ def recommend_report(samples, *, budget_bytes: int, mig_rows: int,
         else:
             line += " (no shard load vector — trainer nodes only)"
             lines.append(line)
+    lines.extend(_dense_wire_lines(samples, policy))
     return "\n".join(lines)
+
+
+def _dense_wire_lines(samples, policy) -> list:
+    """The dense-gradient wire row of --recommend: the measured gradient
+    density (`dense.grad_density` — a `MeshTrainer(dense_stats=True)` run
+    publishes it) against the sparse/dense crossover
+    (`policy.recommend_dense_wire` — what a manage_wire controller would
+    install, hysteresis aside)."""
+    density = next((v for n, _labels, v in samples
+                    if n == "oetpu_dense_grad_density"), None)
+    if density is None:
+        return ["dense wire: (no oetpu_dense_grad_density gauge — a "
+                "MeshTrainer(dense_stats=True) trainer publishes it)"]
+    mode, k, reason = policy.recommend_dense_wire(float(density))
+    return [f"dense wire: measured grad density {float(density):.3f}"
+            f" -> {mode}" + (f" (k={k}/chunk)" if k else "")
+            + f" — {reason}"]
 
 
 def main(argv=None) -> int:

@@ -143,6 +143,21 @@ CASES = [
       "OETPU_BENCH_PROBE_TIMEOUT_S": "75",
       "JAX_PLATFORMS": "cpu",
       "XLA_FLAGS": "--xla_force_host_platform_device_count=8"}, 1200),
+    # 13a. round-23 sparsity-aware dense collectives (bench 'zero_sparse'
+    #     case: dense_wire sparse_topk vs int8 vs fp32 grad wire bytes from
+    #     the compiled HLO across a planted gradient-density sweep, with
+    #     the measured-density gauge and the policy's crossover verdict at
+    #     each point; loss parity asserted). NINE small compiles on the
+    #     8-virtual-device CPU mesh; a chip re-run prices the sparse a2a's
+    #     actual link time on top of the byte accounting.
+    ("bench_zero_sparse",
+     [sys.executable, os.path.join(REPO, "bench.py")],
+     {"OETPU_BENCH_CASES": "zero_sparse",
+      "OETPU_BENCH_BUDGET_S": "1100",
+      "OETPU_BENCH_TOTAL_BUDGET_S": "1340",
+      "OETPU_BENCH_PROBE_TIMEOUT_S": "75",
+      "JAX_PLATFORMS": "cpu",
+      "XLA_FLAGS": "--xla_force_host_platform_device_count=8"}, 1400),
     # 13b. round-17 bytes endgame (bench 'wire_total' case: total compiled
     #     wire bytes per step — sparse a2as + hot reduce + dense collectives
     #     — round-12 fp32 system vs global-int8 vs policy-mixed wire with
